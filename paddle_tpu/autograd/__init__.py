@@ -1,0 +1,143 @@
+"""Autograd user API.
+
+Reference surface: python/paddle/autograd (backward(), PyLayer, no_grad,
+hooks) over the eager engine (paddle/fluid/eager/backward.cc:428).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+
+from ..core import engine
+from ..core.engine import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+from ..core.tensor import Tensor
+
+__all__ = [
+    "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled", "PyLayer", "PyLayerContext",
+]
+
+
+def _listify(x):
+    if x is None:
+        return None
+    if isinstance(x, Tensor):
+        return [x]
+    return list(x)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = _listify(tensors)
+    grad_tensors = _listify(grad_tensors)
+    engine.run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None) -> List[Optional[Tensor]]:
+    """``paddle.grad``: grads of outputs wrt inputs without polluting .grad.
+
+    ``create_graph`` (double grad) is supported by re-running the tape's
+    closures under jax differentiation — deferred to the functional
+    ``jax.grad`` escape hatch for now (raises if requested).
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.incubate.autograd functional "
+            "transforms (jax.grad composition) for higher-order derivatives")
+    outputs = _listify(outputs)
+    inputs = _listify(inputs)
+    grad_outputs = _listify(grad_outputs)
+    retain = bool(retain_graph) if retain_graph is not None else False
+    raws = engine.run_backward(outputs, grad_outputs, retain_graph=retain,
+                               inputs=inputs, allow_unused=allow_unused)
+    return [None if g is None else Tensor(g) for g in raws]
+
+
+class PyLayerContext:
+    """Mirror of paddle's PyLayerContext (reference:
+    paddle/fluid/eager/pylayer/py_layer_node.h + python/paddle/autograd/
+    py_layer.py): save_for_backward / saved_tensor + not_inplace marks."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined autograd op: subclass with static forward/backward.
+
+    forward(ctx, *args) -> Tensor(s); backward(ctx, *grad_outputs) ->
+    grads for each Tensor input of forward, positionally.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        tensor_positions = [i for i, a in enumerate(args)
+                            if isinstance(a, Tensor)]
+        with engine.set_grad_enabled(False):
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+
+        if not engine.is_grad_enabled() or not any(
+                not args[i].stop_gradient for i in tensor_positions):
+            return outputs
+
+        out_avals = [(tuple(o._data.shape), o._data.dtype) for o in out_list]
+
+        # backward() returns one grad per tensor input of forward, in
+        # order; the engine only needs those for non-stop-gradient inputs.
+        diff_mask = [not args[i].stop_gradient for i in tensor_positions]
+
+        def vjp_fn(cotangents):
+            cot_tensors = [Tensor(c) for c in cotangents]
+            with engine.set_grad_enabled(False):
+                grads = cls.backward(ctx, *cot_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            out = []
+            for keep, g in zip(diff_mask, grads):
+                if not keep:
+                    continue
+                out.append(None if g is None else
+                           (g._data if isinstance(g, Tensor) else g))
+            return tuple(out)
+
+        edges = []
+        for i in tensor_positions:
+            t = args[i]
+            if t.stop_gradient:
+                continue
+            if t._grad_node is not None:
+                edges.append(("node", t._grad_node, t._out_idx))
+            else:
+                edges.append(("leaf", t))
+
+        node = engine.GradNode(cls.__name__, vjp_fn, edges, out_avals)
+        for idx, o in enumerate(out_list):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._out_idx = idx
+        return outputs
+
+
+class Function(PyLayer):
+    """torch-style alias."""

@@ -1,0 +1,1 @@
+from . import dtype, engine, flags, generator, place, tensor  # noqa: F401

@@ -1,0 +1,140 @@
+"""Dtype system for paddle_tpu.
+
+TPU-native equivalent of the reference's ``phi::DataType`` enum
+(reference: paddle/phi/common/data_type.h). We wrap numpy/jax dtypes in a
+small ``DType`` value class so user code can write ``paddle_tpu.float32``
+exactly like ``paddle.float32`` while the backing representation stays a
+``jnp.dtype`` that XLA understands. bfloat16 is first-class (the TPU MXU's
+native matmul dtype).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "DType", "dtype", "convert_dtype", "to_jax_dtype",
+    "float16", "float32", "float64", "bfloat16",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "bool_", "complex64", "complex128",
+]
+
+
+class DType:
+    """A framework dtype: hashable, comparable with strings/numpy/jax dtypes."""
+
+    __slots__ = ("name", "np_dtype")
+
+    _registry: dict = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = jnp.dtype(np_dtype)
+        DType._registry[name] = self
+
+    # -- comparisons ---------------------------------------------------
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            try:
+                return self.name == convert_dtype(other).name
+            except (TypeError, ValueError):
+                return False
+        try:
+            return self.np_dtype == jnp.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    # -- property helpers ---------------------------------------------
+    @property
+    def is_floating_point(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, jnp.floating)
+
+    @property
+    def is_integer(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, jnp.integer)
+
+    @property
+    def is_complex(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, jnp.complexfloating)
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+
+float16 = DType("float16", jnp.float16)
+float32 = DType("float32", jnp.float32)
+float64 = DType("float64", jnp.float64)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+int8 = DType("int8", jnp.int8)
+int16 = DType("int16", jnp.int16)
+int32 = DType("int32", jnp.int32)
+int64 = DType("int64", jnp.int64)
+uint8 = DType("uint8", jnp.uint8)
+uint16 = DType("uint16", jnp.uint16)
+uint32 = DType("uint32", jnp.uint32)
+uint64 = DType("uint64", jnp.uint64)
+bool_ = DType("bool", jnp.bool_)
+complex64 = DType("complex64", jnp.complex64)
+complex128 = DType("complex128", jnp.complex128)
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bool_": "bool",
+}
+
+
+def convert_dtype(d) -> DType:
+    """Normalize any dtype-like (str, np.dtype, jnp dtype, DType) to DType."""
+    if d is None:
+        raise TypeError("dtype must not be None")
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        name = _ALIASES.get(d, d)
+        if name in DType._registry:
+            return DType._registry[name]
+        # fall through to numpy parsing for e.g. "f4"
+    npd = jnp.dtype(d)
+    name = npd.name
+    if name in DType._registry:
+        return DType._registry[name]
+    raise TypeError(f"unsupported dtype: {d!r}")
+
+
+def to_jax_dtype(d):
+    """DType | str | np dtype -> jnp dtype usable in jax calls."""
+    return convert_dtype(d).np_dtype
+
+
+# what `paddle.get_default_dtype` controls
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if not d.is_floating_point:
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> DType:
+    return _default_dtype
+
+
+dtype = DType  # paddle exposes `paddle.dtype` as the type itself

@@ -1,0 +1,294 @@
+"""Tape-based autograd engine.
+
+TPU-native equivalent of the reference's eager autograd
+(reference: paddle/fluid/eager/backward.cc:105 ``RunBackward`` — in-degree
+map over the GradNode graph, ready-queue topological execution,
+``GradTensorHolder`` accumulation; grad_node_info.h for GradNode/edges).
+
+Design: every differentiable eager op records one ``GradNode`` holding a
+``jax.vjp`` closure (JAX computes the VJP — we never hand-write per-op
+gradients) plus edges to the producers of its differentiable inputs. The
+engine mirrors RunBackward's semantics: in-degree counting, topological
+ready queue, per-slot grad accumulation, leaf ``.grad`` accumulation with
+hooks. The closures are pure functions of immutable jax arrays, so
+``retain_graph`` re-execution is always safe.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+__all__ = [
+    "GradNode", "run_backward", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_STATE = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _STATE.enabled
+
+
+@contextlib.contextmanager
+def _grad_enabled_ctx(mode: bool):
+    prev = _STATE.enabled
+    _STATE.enabled = bool(mode)
+    try:
+        yield
+    finally:
+        _STATE.enabled = prev
+
+
+def set_grad_enabled(mode: bool):
+    return _grad_enabled_ctx(mode)
+
+
+def no_grad(func=None):
+    """Context manager *and* decorator, like ``paddle.no_grad``."""
+    if func is None:
+        return _grad_enabled_ctx(False)
+    if callable(func):
+        import functools
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            with _grad_enabled_ctx(False):
+                return func(*args, **kwargs)
+
+        return wrapper
+    raise TypeError("no_grad used incorrectly")
+
+
+def enable_grad():
+    return _grad_enabled_ctx(True)
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``vjp_fn`` maps output cotangents -> input cotangents (for the
+    differentiable inputs only). ``edges[i]`` says where the i-th input
+    cotangent flows: ``("node", producer, slot)`` into a producer node's
+    accumulation buffer, or ``("leaf", tensor)`` into a leaf's ``.grad``.
+    ``retain_map`` lets intermediate tensors observe their fully-accumulated
+    grad the moment this node executes (Tensor.retain_grads / paddle.grad
+    on intermediates).
+    """
+
+    __slots__ = (
+        "name", "vjp_fn", "edges", "out_avals", "grad_buffer",
+        "retain_map", "post_hooks",
+    )
+
+    def __init__(self, name: str, vjp_fn: Callable, edges: List[Tuple],
+                 out_avals: List[Tuple]):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.edges = edges
+        self.out_avals = out_avals  # [(shape, dtype), ...] per output slot
+        self.grad_buffer: List[Optional[Any]] = [None] * len(out_avals)
+        # slot -> list of observers: Tensor (retain_grads) or
+        # ("capture", key) entries added temporarily by paddle.grad
+        self.retain_map: Dict[int, List[Any]] = {}
+        self.post_hooks: List[Callable] = []
+
+    def add_retain(self, slot: int, target) -> None:
+        self.retain_map.setdefault(slot, []).append(target)
+
+    def accumulate(self, slot: int, grad) -> None:
+        cur = self.grad_buffer[slot]
+        self.grad_buffer[slot] = grad if cur is None else cur + grad
+
+    def assembled_cotangents(self):
+        import numpy as _np
+
+        import jax as _jax
+
+        cots = []
+        for slot, (shape, dt) in enumerate(self.out_avals):
+            g = self.grad_buffer[slot]
+            if g is None:
+                if jnp.issubdtype(dt, jnp.inexact):
+                    g = jnp.zeros(shape, dt)
+                else:
+                    # integer/bool outputs carry float0 cotangents in JAX
+                    g = _np.zeros(shape, _jax.dtypes.float0)
+            cots.append(g)
+        return tuple(cots)
+
+    def release(self):
+        self.vjp_fn = None
+        self.grad_buffer = [None] * len(self.out_avals)
+
+    def __repr__(self):
+        return f"<GradNode {self.name} outs={len(self.out_avals)}>"
+
+
+def _wrap(array):
+    from .tensor import Tensor
+
+    return Tensor(array, stop_gradient=True)
+
+
+def _accumulate_leaf(tensor, grad) -> None:
+    # tensor-level hooks fire as the grad finalizes
+    # (reference: egr hooks, reducer marks vars ready here)
+    for hook in list(tensor._grad_hooks.values()):
+        out = hook(_wrap(grad))
+        if out is not None:
+            grad = out._data if hasattr(out, "_data") else out
+    if tensor.grad is None:
+        tensor.grad = _wrap(grad)
+    else:
+        tensor.grad = _wrap(tensor.grad._data + grad)
+
+
+def run_backward(
+    tensors: Sequence,
+    grad_tensors: Optional[Sequence] = None,
+    retain_graph: bool = False,
+    inputs: Optional[Sequence] = None,
+    allow_unused: bool = False,
+) -> Optional[List[Optional[Any]]]:
+    """Reverse-mode sweep from ``tensors``.
+
+    Mirrors ``egr::RunBackward`` (backward.cc:105). With ``inputs`` set,
+    captures and returns raw grads of those tensors without touching any
+    ``.grad`` (``paddle.grad`` semantics); intermediates are captured via a
+    temporary entry in their producer's ``retain_map``.
+    """
+    roots: List[GradNode] = []
+    for t, g in zip(tensors, grad_tensors or [None] * len(tensors)):
+        node = t._grad_node
+        if g is None:
+            g_arr = jnp.ones(t._data.shape, t._data.dtype)
+        else:
+            g_arr = g._data if hasattr(g, "_data") else jnp.asarray(g)
+        if node is None:
+            if not t.stop_gradient:
+                _accumulate_leaf(t, g_arr)
+            continue
+        node.accumulate(t._out_idx, g_arr)
+        if node not in roots:
+            roots.append(node)
+
+    # capture bookkeeping for paddle.grad-style calls
+    captured: Dict[int, Any] = {}
+    capture_leaf_ids: Dict[int, Any] = {}
+    temp_retains: List[Tuple[GradNode, int]] = []
+    if inputs is not None:
+        for t in inputs:
+            if t._grad_node is None:
+                capture_leaf_ids[id(t)] = t
+            else:
+                node, slot = t._grad_node, t._out_idx
+                entry = ("capture", id(t))
+                node.add_retain(slot, entry)
+                temp_retains.append((node, slot, entry))
+        # a root tensor listed in inputs: its grad is the seeded cotangent
+        for t, g in zip(tensors, grad_tensors or [None] * len(tensors)):
+            if id(t) in {id(i) for i in inputs} and t._grad_node is None:
+                pass  # handled as leaf below if reachable
+
+    # ---- in-degree map over reachable nodes (getInDegreeMap, backward.cc:23)
+    indeg: Dict[int, int] = {}
+    stack = list(roots)
+    seen = set()
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        indeg.setdefault(id(n), 0)
+        for edge in n.edges:
+            if edge[0] == "node":
+                p = edge[1]
+                indeg[id(p)] = indeg.get(id(p), 0) + 1
+                stack.append(p)
+
+    ready: List[GradNode] = [n for n in roots if indeg[id(n)] == 0]
+    queued = {id(n) for n in ready}
+
+    def _observe_retained(node: GradNode):
+        """Before the node consumes its buffer, surface retained slot grads."""
+        for slot, targets in list(node.retain_map.items()):
+            g = node.grad_buffer[slot]
+            if g is None:
+                continue
+            for target in targets:
+                if isinstance(target, tuple) and target[0] == "capture":
+                    k = target[1]
+                    captured[k] = g if k not in captured else captured[k] + g
+                elif inputs is None:
+                    # a Tensor with retain_grads(); paddle.grad passes must
+                    # not touch .grad of anything
+                    _accumulate_leaf(target, g)
+
+    while ready:
+        node = ready.pop()
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"the grad graph through {node.name} has been freed; use "
+                "backward(retain_graph=True) to backward through it twice")
+        _observe_retained(node)
+        cots = node.assembled_cotangents()
+        in_grads = node.vjp_fn(cots)
+        for hook in node.post_hooks:
+            hook()
+        if not retain_graph:
+            node.release()
+        else:
+            node.grad_buffer = [None] * len(node.out_avals)
+        for edge, g in zip(node.edges, in_grads):
+            if edge[0] == "leaf":
+                if g is None:
+                    continue
+                t = edge[1]
+                if inputs is not None:
+                    if id(t) in capture_leaf_ids:
+                        k = id(t)
+                        captured[k] = g if k not in captured else captured[k] + g
+                    # paddle.grad never pollutes other leaves' .grad
+                else:
+                    _accumulate_leaf(t, g)
+            else:
+                # a None grad still consumes the dependency edge — the
+                # producer must run once every consumer has reported
+                _, p, slot = edge
+                if g is not None:
+                    p.accumulate(slot, g)
+                indeg[id(p)] -= 1
+                if indeg[id(p)] == 0 and id(p) not in queued:
+                    ready.append(p)
+                    queued.add(id(p))
+
+    for node, slot, entry in temp_retains:
+        targets = node.retain_map.get(slot)
+        if targets is not None:
+            # identity comparison: targets mixes tuples and Tensors, and
+            # Tensor.__eq__ is elementwise
+            node.retain_map[slot] = [t for t in targets if t is not entry]
+            if not node.retain_map[slot]:
+                node.retain_map.pop(slot, None)
+
+    if inputs is not None:
+        out = []
+        for t in inputs:
+            g = captured.get(id(t))
+            if g is None and not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors receives no gradient; pass "
+                    "allow_unused=True to get None for it")
+            out.append(g)
+        return out
+    return None

@@ -1,0 +1,75 @@
+"""Global flags registry.
+
+TPU-native equivalent of the reference's gflags-compatible flag system
+(reference: paddle/phi/core/flags.cc — 120 PHI_DEFINE_EXPORTED_* flags,
+macro at flags.h:145, settable by env ``FLAGS_*`` or ``paddle.set_flags``).
+
+We keep the same surface: flags declared once with a default + doc, env
+``FLAGS_<name>`` overrides the default at first read, and ``set_flags`` /
+``get_flags`` mutate/inspect at runtime.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flag"]
+
+_FLAGS: Dict[str, dict] = {}
+
+
+def _coerce(value, proto):
+    if isinstance(proto, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(proto, int):
+        return int(value)
+    if isinstance(proto, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    if name in _FLAGS:
+        return
+    env = os.environ.get(f"FLAGS_{name}")
+    value = _coerce(env, default) if env is not None else default
+    _FLAGS[name] = {"default": default, "value": value, "doc": doc}
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Mirror of ``paddle.set_flags`` (python/paddle/base/framework.py:64)."""
+    for name, value in flags.items():
+        key = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+        if key not in _FLAGS:
+            raise ValueError(f"unknown flag {name!r}")
+        _FLAGS[key]["value"] = _coerce(value, _FLAGS[key]["default"])
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+        if key not in _FLAGS:
+            raise ValueError(f"unknown flag {name!r}")
+        out[name] = _FLAGS[key]["value"]
+    return out
+
+
+def flag(name: str):
+    """Fast internal read."""
+    return _FLAGS[name]["value"]
+
+
+# ---- core flags (subset of reference's paddle/phi/core/flags.cc) ----
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf in eager mode")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: report stats only")
+define_flag("benchmark", False, "synchronize after each op for timing")
+define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
+define_flag("eager_jit_ops", True, "dispatch eager ops through cached jit computations")
+define_flag("stop_check_timeout", 900, "bound (seconds) on distributed store waits")
+define_flag("allocator_strategy", "auto_growth", "kept for API parity; PJRT owns memory")
+define_flag("cudnn_deterministic", False, "kept for API parity; XLA is deterministic")

@@ -1,0 +1,156 @@
+"""Stateful RNG on top of JAX's functional keys.
+
+TPU-native equivalent of the reference's per-device stateful ``Generator``
+(reference: paddle/phi/core/generator.h) and the TP-aware
+``RNGStatesTracker`` (reference:
+python/paddle/distributed/fleet/layers/mpu/random.py:34), which keeps
+separate named RNG streams so dropout stays deterministic across
+tensor-parallel ranks.
+
+Design: a Generator owns a jax PRNG key and splits it on every draw —
+stateful shell over the functional core. ``rng_state(name)`` context
+switches the default generator to a named tracked stream.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import jax
+
+__all__ = [
+    "Generator", "default_generator", "seed", "get_rng_state", "set_rng_state",
+    "RNGStatesTracker", "get_rng_tracker", "rng_state",
+]
+
+
+class Generator:
+    """Stateful PRNG: every ``next_key()`` splits the internal key."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int) -> "Generator":
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+            self._offset = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            self._offset += 1
+            return sub
+
+    def get_state(self):
+        return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state) -> None:
+        self.manual_seed(state["seed"])
+        # replay the split chain to the recorded offset
+        for _ in range(state["offset"]):
+            self.next_key()
+        self._offset = state["offset"]
+
+    def spawn_key(self, data: int):
+        """Deterministic fold-in (no state mutation) — for per-step keys."""
+        return jax.random.fold_in(self._key, data)
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _CURRENT.gen if _CURRENT.gen is not None else _default_generator
+
+
+def seed(value: int) -> Generator:
+    """Mirror of ``paddle.seed``: reseed the default generator (and tracker)."""
+    _default_generator.manual_seed(value)
+    get_rng_tracker().reset(value)
+    return _default_generator
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+class _Current(threading.local):
+    def __init__(self):
+        self.gen: Optional[Generator] = None
+
+
+_CURRENT = _Current()
+
+
+class RNGStatesTracker:
+    """Named RNG streams for TP determinism (mpu/random.py:34 equivalent).
+
+    ``add("local_seed", s)`` registers a stream; ``rng_state("local_seed")``
+    makes draws inside the context come from that stream. Model-parallel
+    layers use a rank-offset stream for dropout on sharded activations and
+    the global stream for replicated ones.
+    """
+
+    def __init__(self):
+        self.states_: Dict[str, Generator] = {}
+
+    def reset(self, base_seed: Optional[int] = None):
+        import zlib
+
+        if base_seed is None:
+            self.states_.clear()
+        else:
+            for name, gen in self.states_.items():
+                # stable digest: python hash() is per-process randomized,
+                # which would desync dropout masks across TP ranks
+                gen.manual_seed(base_seed ^ zlib.crc32(name.encode()))
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise ValueError(f"rng state {name!r} already exists")
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return {k: g.get_state() for k, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for k, s in states.items():
+            if k not in self.states_:
+                self.states_[k] = Generator(0)
+            self.states_[k].set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name == "global_seed":
+            yield
+            return
+        if name not in self.states_:
+            raise ValueError(f"rng state {name!r} was never added")
+        prev = _CURRENT.gen
+        _CURRENT.gen = self.states_[name]
+        try:
+            yield
+        finally:
+            _CURRENT.gen = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def rng_state(name: str = "global_seed"):
+    return _tracker.rng_state(name)

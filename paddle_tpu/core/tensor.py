@@ -1,0 +1,253 @@
+"""Eager Tensor.
+
+TPU-native equivalent of the reference's eager ``paddle::Tensor``
+(reference: paddle/phi/api/include/tensor.h:82 and the pybind eager tensor
+in paddle/fluid/pybind/eager.cc). The backing store is an immutable
+``jax.Array`` (PJRT buffer); "in-place" ops rebind ``_data`` and bump a
+version counter, which is exactly the functional-rewrite the XLA
+programming model wants while preserving Paddle's mutable-tensor API.
+
+Autograd state lives on the tensor: ``stop_gradient`` (Paddle defaults new
+tensors to True; ``Parameter`` flips it), ``grad``, and the producing
+``GradNode`` + output slot (reference: AutogradMeta in
+paddle/fluid/eager/autograd_meta.h).
+
+Op methods (``t.matmul``, ``t.__add__`` …) are attached by the ops modules
+at import time via ``Tensor._attach_method`` — the tensor-method surface is
+generated from the op registry, mirroring how the reference generates
+method bindings from ops.yaml.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import engine
+from .dtype import DType, convert_dtype
+from .place import Place, current_place
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+_name_counter = itertools.count()
+_hook_counter = itertools.count()
+
+
+class Tensor:
+    __slots__ = (
+        "_data", "stop_gradient", "grad", "_grad_node", "_out_idx",
+        "name", "persistable", "_grad_hooks", "_version", "__weakref__",
+        "_dist_attr",
+    )
+
+    def __init__(self, data, stop_gradient: bool = True, name: str = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        elif not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._data: jax.Array = data
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._grad_node: Optional[engine.GradNode] = None
+        self._out_idx: int = 0
+        self.name = name or f"generated_tensor_{next(_name_counter)}"
+        self.persistable = False
+        self._grad_hooks: Dict[int, Callable] = {}
+        self._version = 0
+        self._dist_attr = None  # (ProcessMesh, placements) when distributed
+
+    # ---------------- basic properties ----------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self) -> DType:
+        return convert_dtype(self._data.dtype)
+
+    @property
+    def place(self) -> Place:
+        return current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        return self.transpose(list(range(self.ndim))[::-1])
+
+    def dim(self):
+        return self.ndim
+
+    def numel(self):
+        return self.size
+
+    # ---------------- conversion ----------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    # ---------------- autograd ----------------
+    def backward(self, grad_tensor: "Tensor" = None, retain_graph: bool = False):
+        engine.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data))
+        else:
+            self.grad = None
+
+    def zero_grad(self):
+        self.clear_grad()
+
+    def retain_grads(self):
+        """Ask a non-leaf to keep its grad after backward (Paddle API)."""
+        if self._grad_node is not None:
+            targets = self._grad_node.retain_map.get(self._out_idx, [])
+            if not any(t is self for t in targets):
+                self._grad_node.add_retain(self._out_idx, self)
+
+    def register_hook(self, hook: Callable):
+        hid = next(_hook_counter)
+        self._grad_hooks[hid] = hook
+
+        class _Handle:
+            def remove(_self):
+                self._grad_hooks.pop(hid, None)
+
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        # participates in autograd like an identity op
+        from ..ops.dispatch import eager_apply
+
+        return eager_apply("clone", lambda x: x + 0, [self], {})
+
+    # ---------------- mutation (functional rebind) ----------------
+    def _rebind(self, new_array, node: engine.GradNode = None, out_idx: int = 0):
+        """In-place update: swap the buffer, bump version (inplace version
+        check parity with reference tensor_wrapper.h)."""
+        self._data = new_array
+        self._version += 1
+        if node is not None:
+            self._grad_node = node
+            self._out_idx = out_idx
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._rebind(jnp.asarray(value, self._data.dtype).reshape(self._data.shape))
+
+    def copy_(self, other, blocking: bool = True):
+        self.set_value(other)
+        return self
+
+    @property
+    def inplace_version(self):
+        return self._version
+
+    # ---------------- misc ----------------
+    def __repr__(self):
+        grad_part = f", stop_gradient={self.stop_gradient}"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_part},\n       {np.asarray(self._data)})")
+
+    def __hash__(self):
+        return id(self)
+
+    # method attachment point used by ops modules
+    @classmethod
+    def _attach_method(cls, name: str, fn: Callable):
+        setattr(cls, name, fn)
+
+    # block jnp from consuming Tensor via operators and returning jax arrays
+    __jax_array__ = None
+
+
+# remove the placeholder so jnp.asarray(Tensor) raises rather than silently
+# treating it as an opaque object
+del Tensor.__jax_array__
+
+
+class Parameter(Tensor):
+    """Trainable tensor: ``stop_gradient=False``, ``persistable=True``
+    (reference: python/paddle/base/framework.py Parameter)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, name: str = None, trainable: bool = True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """``paddle.to_tensor`` equivalent."""
+    if isinstance(data, Tensor):
+        arr = data._data
+    elif isinstance(data, jax.Array):
+        arr = data
+    else:
+        arr = np.asarray(data)
+        # paddle keeps python float defaulting to the default float dtype
+        if arr.dtype == np.float64 and not isinstance(data, np.ndarray) and dtype is None:
+            from .dtype import get_default_dtype
+
+            arr = arr.astype(get_default_dtype().np_dtype)
+        arr = jnp.asarray(arr)
+    if dtype is not None:
+        arr = arr.astype(convert_dtype(dtype).np_dtype)
+    if place is not None and isinstance(place, Place):
+        arr = jax.device_put(arr, place.jax_device())
+    return Tensor(arr, stop_gradient=stop_gradient)

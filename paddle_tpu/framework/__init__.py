@@ -1,0 +1,2 @@
+from . import io  # noqa: F401
+from .io import load, save  # noqa: F401

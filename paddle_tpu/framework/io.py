@@ -1,0 +1,38 @@
+"""save/load — filled in with full checkpoint support (framework/io.py)."""
+import pickle
+
+
+def save(obj, path, protocol=4):
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    def conv(o):
+        if isinstance(o, Tensor):
+            return {"__tensor__": True, "data": np.asarray(o._data)}
+        if isinstance(o, dict):
+            return {k: conv(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return type(o)(conv(v) for v in o)
+        return o
+
+    with open(path, "wb") as f:
+        pickle.dump(conv(obj), f, protocol=protocol)
+
+
+def load(path, **kwargs):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    def conv(o):
+        if isinstance(o, dict):
+            if o.get("__tensor__"):
+                return Tensor(jnp.asarray(o["data"]))
+            return {k: conv(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return type(o)(conv(v) for v in o)
+        return o
+
+    with open(path, "rb") as f:
+        return conv(pickle.load(f))

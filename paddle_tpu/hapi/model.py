@@ -1,0 +1,3 @@
+class Model:  # placeholder — replaced by full hapi
+    def __init__(self, *a, **k):
+        raise NotImplementedError("hapi.Model lands with the hapi module")

@@ -1,0 +1,5 @@
+def to_static(function=None, **kwargs):
+    """placeholder — replaced by full jit module."""
+    def deco(fn):
+        return fn
+    return deco(function) if callable(function) else deco

@@ -1,0 +1,19 @@
+"""Op layer: functional API over jnp with eager autograd dispatch.
+
+The import order matters: each module registers ops + Tensor methods.
+"""
+from . import dispatch, registry  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from . import indexing  # noqa: F401
+
+from . import creation, linalg, logic, manipulation, math, random  # noqa: F401
+
+__all__ = (
+    list(creation.__all__) + list(math.__all__) + list(manipulation.__all__)
+    + list(logic.__all__) + list(linalg.__all__) + list(random.__all__)
+)

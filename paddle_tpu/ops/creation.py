@@ -1,0 +1,187 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, get_default_dtype, to_jax_dtype
+from ..core.tensor import Tensor, to_tensor
+from .registry import register_op
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "logspace", "eye", "tril", "triu",
+    "diag", "diagflat", "meshgrid", "assign", "clone", "numel",
+    "to_tensor", "tril_indices", "triu_indices", "one_hot",
+]
+
+
+def _dt(dtype):
+    if dtype is None:
+        return get_default_dtype().np_dtype
+    return to_jax_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = get_default_dtype() if isinstance(fill_value, float) else None
+    d = _dt(dtype) if dtype is not None else None
+    return Tensor(jnp.full(_shape(shape), fill_value, d))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jnp.zeros_like(x._data, dtype=_dt(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jnp.ones_like(x._data, dtype=_dt(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jnp.full_like(x._data, fill_value,
+                                dtype=_dt(dtype) if dtype else None))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer))
+                                for v in (start, end, step))
+                 else get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, to_jax_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.linspace(float(start), float(stop), int(num),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(float(start), float(stop), int(num),
+                               base=float(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    from .dispatch import eager_apply
+
+    return eager_apply("tril", lambda a: jnp.tril(a, int(diagonal)), [x], {})
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    from .dispatch import eager_apply
+
+    return eager_apply("triu", lambda a: jnp.triu(a, int(diagonal)), [x], {})
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    from .dispatch import eager_apply
+
+    def raw(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(int(offset))
+            base = jnp.full((n, n), padding_value, a.dtype)
+            return base + jnp.diag(a, int(offset)) - jnp.diag(
+                jnp.full((a.shape[0],), padding_value, a.dtype), int(offset))
+        return jnp.diag(a, int(offset))
+
+    return eager_apply("diag", raw, [x], {})
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    from .dispatch import eager_apply
+
+    return eager_apply("diagflat", lambda a: jnp.diagflat(a, int(offset)), [x], {})
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[t._data for t in tensors], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None) -> Tensor:
+    from .dispatch import eager_apply
+
+    src = x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    out = eager_apply("assign", lambda a: a + 0, [src], {})
+    if output is not None:
+        output._rebind(out._data, out._grad_node, out._out_idx)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return assign(x)
+
+
+def numel(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), to_jax_dtype(dtype)))
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    from .dispatch import eager_apply
+
+    return eager_apply(
+        "one_hot",
+        lambda a: jax.nn.one_hot(a, int(num_classes),
+                                 dtype=get_default_dtype().np_dtype),
+        [x], {})
+
+
+for _name in __all__:
+    _f = globals()[_name]
+    if callable(_f) and _name not in ("to_tensor",):
+        register_op(_name, _f, tags=("creation",))
+register_op("clone", clone, methods=["clone"], tags=("creation",))

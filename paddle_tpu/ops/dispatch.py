@@ -1,0 +1,153 @@
+"""Eager op dispatch.
+
+TPU-native equivalent of the reference's generated eager AD functions +
+PHI dispatch (reference: the per-op ``*_ad_func`` emitted by
+paddle/fluid/eager/auto_code_generator/generator/eager_gen.py and kernel
+selection in paddle/phi/api/lib/kernel_dispatch.h:100).
+
+Where the reference's codegen emits, per op, (forward call + GradNode
+creation + saved TensorWrappers), we get the same artifact generically:
+``eager_apply`` runs the op's functional jnp implementation under
+``jax.vjp`` when any input requires grad, records a GradNode with the vjp
+closure (JAX traces the backward — the GradNode *is* the saved-tensor
+wrapper, closed over immutable arrays), and wires edges to producers.
+
+Ops never hand-write gradients; XLA differentiates the same code that runs
+forward, which is the single-source-of-truth property the reference gets
+from ops.yaml + backward.yaml.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import engine
+from ..core.flags import flag
+from ..core.tensor import Tensor
+
+__all__ = ["eager_apply", "as_tensor_args", "defun"]
+
+
+def _is_diff_dtype(arr) -> bool:
+    return jnp.issubdtype(arr.dtype, jnp.inexact)
+
+
+def as_tensor_args(*args) -> List[Tensor]:
+    out = []
+    for a in args:
+        if isinstance(a, Tensor):
+            out.append(a)
+        else:
+            out.append(Tensor(jnp.asarray(a)))
+    return out
+
+
+def _check_finite(op_name: str, arrays) -> None:
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            bad = bool(jnp.any(~jnp.isfinite(a)))
+            if bad:
+                msg = f"NaN/Inf detected in output of op `{op_name}`"
+                if flag("check_nan_inf_level") == 0:
+                    raise FloatingPointError(msg)
+                print("[check_nan_inf]", msg)
+
+
+def eager_apply(
+    op_name: str,
+    raw_fn: Callable,
+    tensor_inputs: Sequence[Tensor],
+    static_kwargs: Optional[Dict[str, Any]] = None,
+    n_outputs: int = 1,
+):
+    """Run one eager op.
+
+    ``raw_fn(*arrays, **static_kwargs)`` is the functional implementation
+    over raw jax arrays; ``tensor_inputs`` are the Tensor operands in
+    positional order. Returns Tensor or tuple of Tensors (``n_outputs``).
+    """
+    static_kwargs = static_kwargs or {}
+    arrays = [t._data for t in tensor_inputs]
+
+    grad_wanted = engine.is_grad_enabled() and any(
+        (not t.stop_gradient) and _is_diff_dtype(t._data)
+        for t in tensor_inputs
+    )
+
+    if not grad_wanted:
+        out = raw_fn(*arrays, **static_kwargs)
+        outs = out if isinstance(out, tuple) else (out,)
+        if flag("check_nan_inf"):
+            _check_finite(op_name, outs)
+        tensors = tuple(Tensor(o) for o in outs)
+        return tensors if n_outputs != 1 else tensors[0]
+
+    diff_idx = [
+        i for i, t in enumerate(tensor_inputs)
+        if (not t.stop_gradient) and _is_diff_dtype(t._data)
+    ]
+    diff_set = set(diff_idx)
+    const_arrays = {i: a for i, a in enumerate(arrays) if i not in diff_set}
+
+    def f(*diff_arrays):
+        full = []
+        it = iter(diff_arrays)
+        for i in range(len(arrays)):
+            full.append(const_arrays[i] if i in const_arrays else next(it))
+        out = raw_fn(*full, **static_kwargs)
+        return out if isinstance(out, tuple) else (out,)
+
+    primals_out, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
+
+    if flag("check_nan_inf"):
+        _check_finite(op_name, primals_out)
+
+    edges = []
+    for i in diff_idx:
+        t = tensor_inputs[i]
+        if t._grad_node is not None:
+            edges.append(("node", t._grad_node, t._out_idx))
+        else:
+            edges.append(("leaf", t))
+
+    out_avals = [(o.shape, o.dtype) for o in primals_out]
+    node = engine.GradNode(op_name, vjp_fn, edges, out_avals)
+
+    tensors = []
+    for idx, o in enumerate(primals_out):
+        t = Tensor(o, stop_gradient=not _is_diff_dtype(o))
+        t._grad_node = node
+        t._out_idx = idx
+        tensors.append(t)
+    tensors = tuple(tensors)
+    return tensors if n_outputs != 1 else tensors[0]
+
+
+def defun(op_name: str, n_tensor_args: int = 1, n_outputs: int = 1):
+    """Turn a raw-array function into an eager op.
+
+    The first ``n_tensor_args`` positional args are Tensors (scalars are
+    promoted); everything keyword is static. ``n_tensor_args=-1`` means all
+    positional args are tensors.
+    """
+
+    def deco(raw_fn):
+        import functools
+
+        @functools.wraps(raw_fn)
+        def op(*args, **kwargs):
+            nt = len(args) if n_tensor_args < 0 else n_tensor_args
+            tensors = as_tensor_args(*args[:nt])
+            static = dict(kwargs)
+            if nt < len(args):
+                raise TypeError(
+                    f"{op_name}: extra positional args beyond tensor slots; "
+                    "pass them as keywords")
+            return eager_apply(op_name, raw_fn, tensors, static, n_outputs)
+
+        op.__name__ = op_name
+        return op
+
+    return deco

@@ -1,0 +1,67 @@
+"""__getitem__ / __setitem__.
+
+Reference: the eager tensor indexing in paddle/fluid/pybind/
+eager_method.cc (`__getitem__` slicing + advanced indexing) and
+python/paddle/base/variable_index.py. Basic indexing lowers to static XLA
+slices; integer-tensor indexing to gathers; boolean-mask reads are
+dynamic-shape and therefore eager-only (host roundtrip), while boolean
+mask *writes* stay compiled via ``where``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .dispatch import eager_apply
+
+__all__ = []
+
+
+def _parse(index):
+    """Split index into (static_part, tensor_arrays). Tensor indices are
+    replaced by sentinels resolved inside the closure."""
+    if not isinstance(index, tuple):
+        index = (index,)
+    out = []
+    for it in index:
+        if isinstance(it, Tensor):
+            d = it._data
+            if d.dtype == jnp.bool_:
+                out.append(np.asarray(d))  # dynamic: host materialize
+            else:
+                out.append(d)
+        elif isinstance(it, (list, np.ndarray)):
+            arr = np.asarray(it)
+            out.append(arr)
+        else:
+            out.append(it)
+    return tuple(out)
+
+
+def _getitem(self: Tensor, index):
+    idx = _parse(index)
+    has_bool = any(isinstance(i, np.ndarray) and i.dtype == np.bool_
+                   for i in idx)
+    if has_bool:
+        # dynamic result shape: eager-only host path
+        return Tensor(jnp.asarray(np.asarray(self._data)[idx]))
+    return eager_apply("getitem", lambda a: a[idx], [self], {})
+
+
+def _setitem(self: Tensor, index, value):
+    idx = _parse(index)
+    if isinstance(value, Tensor):
+        out = eager_apply(
+            "setitem",
+            lambda a, v: a.at[idx].set(v.astype(a.dtype)), [self, value], {})
+    else:
+        out = eager_apply(
+            "setitem", lambda a: a.at[idx].set(value), [self], {})
+    self._rebind(out._data, out._grad_node, out._out_idx)
+    return self
+
+
+Tensor._attach_method("__getitem__", _getitem)
+Tensor._attach_method("__setitem__", _setitem)

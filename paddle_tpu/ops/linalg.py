@@ -1,0 +1,304 @@
+"""Linear algebra ops.
+
+Reference surface: python/paddle/tensor/linalg.py (matmul at :245 →
+_C_ops.matmul) and paddle/phi/kernels gpu matmul/blas kernels. On TPU the
+matmul family lowers straight onto the MXU; ``FLAGS_use_bf16_matmul``
+keeps inputs in bf16 with f32 accumulation via ``preferred_element_type``
+— the idiomatic XLA way to hit MXU peak.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .dispatch import eager_apply
+from .registry import register_op
+
+__all__: list = []
+
+
+def _export(name, fn, methods=(), differentiable=True):
+    globals()[name] = fn
+    __all__.append(name)
+    register_op(name, fn, methods=methods, differentiable=differentiable,
+                tags=("linalg",))
+    return fn
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def raw(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        # f32 accumulation for low-precision inputs: MXU-native
+        if a.dtype in (jnp.bfloat16, jnp.float16):
+            return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        return jnp.matmul(a, b)
+
+    return eager_apply("matmul", raw, [_as_tensor(x), _as_tensor(y)], {})
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return eager_apply("mv", lambda a, b: a @ b, [x, vec], {})
+
+
+def dot(x, y, name=None):
+    return eager_apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), [x, y], {})
+
+
+def t(input, name=None):
+    def raw(a):
+        return a.T if a.ndim >= 2 else a
+
+    return eager_apply("t", raw, [input], {})
+
+
+Tensor._attach_method("__matmul__", lambda self, other: matmul(self, other))
+Tensor._attach_method("__rmatmul__", lambda self, other: matmul(other, self))
+
+for _n in ("matmul", "mm", "bmm", "mv", "dot", "t"):
+    _export(_n, globals()[_n], methods=[_n])
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _as_tensor(x)
+
+    def raw(a):
+        if axis is None and p is None:
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(a))))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        pp = 2 if p is None or p == "fro" else p
+        if pp == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        if pp == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(a)), axis=ax,
+                                    keepdims=keepdim))
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), pp), axis=ax, keepdims=keepdim),
+            1.0 / pp)
+
+    return eager_apply("norm", raw, [x], {})
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p=float(p))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    a = np.asarray(_as_tensor(input)._data)
+    lo, hi = (a.min(), a.max()) if min == 0 and max == 0 else (min, max)
+    h, _ = np.histogram(a, bins=int(bins), range=(float(lo), float(hi)),
+                        weights=None if weight is None else np.asarray(weight._data),
+                        density=density)
+    return Tensor(jnp.asarray(h if density else h.astype(np.int64)))
+
+
+def cross(x, y, axis=9, name=None):
+    x = _as_tensor(x)
+    ax = axis if axis != 9 else next(
+        i for i, s in enumerate(x.shape) if s == 3)
+    return eager_apply("cross",
+                       lambda a, b: jnp.cross(a, b, axis=int(ax)),
+                       [x, _as_tensor(y)], {})
+
+
+for _n in ("norm", "dist", "histogram", "cross"):
+    _export(_n, globals()[_n], methods=[_n],
+            differentiable=_n != "histogram")
+
+
+# ---------------------------------------------------- decompositions
+def cholesky(x, upper=False, name=None):
+    def raw(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return eager_apply("cholesky", raw, [x], {})
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def raw(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return eager_apply("cholesky_solve", raw, [x, y], {})
+
+
+def qr(x, mode="reduced", name=None):
+    outs = eager_apply("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)),
+                       [x], {}, n_outputs=2)
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    return eager_apply(
+        "svd",
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        [x], {}, n_outputs=3)
+
+
+def eig(x, name=None):
+    a = np.asarray(_as_tensor(x)._data)
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return eager_apply("eigh",
+                       lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)),
+                       [x], {}, n_outputs=2)
+
+
+def eigvals(x, name=None):
+    a = np.asarray(_as_tensor(x)._data)
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return eager_apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a), [x], {})
+
+
+def inverse(x, name=None):
+    return eager_apply("inverse", lambda a: jnp.linalg.inv(a), [x], {})
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return eager_apply(
+        "pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+        [x], {})
+
+
+def solve(x, y, name=None):
+    def raw(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return eager_apply("solve", raw, [x, y], {})
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def raw(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+
+    return eager_apply("triangular_solve", raw, [x, y], {})
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a = np.asarray(_as_tensor(x)._data)
+    b = np.asarray(_as_tensor(y)._data)
+    sol, res, rank, sv = np.linalg.lstsq(a, b, rcond=rcond)
+    return (Tensor(jnp.asarray(sol)), Tensor(jnp.asarray(res)),
+            Tensor(jnp.asarray(rank)), Tensor(jnp.asarray(sv)))
+
+
+def det(x, name=None):
+    return eager_apply("det", lambda a: jnp.linalg.det(a), [x], {})
+
+
+def slogdet(x, name=None):
+    def raw(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet], axis=0)
+
+    return eager_apply("slogdet", raw, [x], {})
+
+
+def matrix_power(x, n, name=None):
+    return eager_apply("matrix_power",
+                       lambda a: jnp.linalg.matrix_power(a, int(n)), [x], {})
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(_as_tensor(x)._data,
+                                         rtol=tol).astype(jnp.int64))
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(_as_tensor(x)._data, p=p))
+
+
+def multi_dot(x, name=None):
+    return eager_apply("multi_dot",
+                       lambda *arrs: jnp.linalg.multi_dot(arrs), list(x), {})
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def raw(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv
+
+    a = _as_tensor(x)._data
+    lu_, piv = jax.scipy.linalg.lu_factor(a)
+    outs = (Tensor(lu_), Tensor((piv + 1).astype(jnp.int32)))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    def _norm_axes(ax):
+        if isinstance(ax, Tensor):
+            ax = ax.tolist()
+        if isinstance(ax, (list, tuple)):
+            return tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                         for a in ax)
+        return int(ax)
+
+    return eager_apply("tensordot",
+                       lambda a, b: jnp.tensordot(a, b, axes=_norm_axes(axes)),
+                       [x, y], {})
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return eager_apply("corrcoef",
+                       lambda a: jnp.corrcoef(a, rowvar=rowvar), [x], {})
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return eager_apply(
+        "cov",
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), [x], {})
+
+
+for _n in ("cholesky", "cholesky_solve", "qr", "svd", "eig", "eigh",
+           "eigvals", "eigvalsh", "inverse", "pinv", "solve",
+           "triangular_solve", "lstsq", "det", "slogdet", "matrix_power",
+           "matrix_rank", "cond", "multi_dot", "lu", "tensordot",
+           "corrcoef", "cov"):
+    _export(_n, globals()[_n], methods=[_n],
+            differentiable=_n not in ("eig", "eigvals", "lstsq",
+                                      "matrix_rank", "lu"))
+
+
+def einsum(equation, *operands):
+    tensors = [_as_tensor(o) for o in operands]
+    return eager_apply("einsum",
+                       lambda *arrs: jnp.einsum(equation, *arrs),
+                       tensors, {})
+
+
+_export("einsum", einsum)

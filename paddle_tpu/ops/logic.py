@@ -1,0 +1,144 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .dispatch import eager_apply
+from .registry import register_op
+
+__all__: list = []
+
+
+def _export(name, fn, methods=()):
+    globals()[name] = fn
+    __all__.append(name)
+    register_op(name, fn, methods=methods, differentiable=False,
+                tags=("logic",))
+    return fn
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _make_cmp(name, jfn, methods):
+    def op(x, y=None, name=None, _jfn=jfn):
+        if y is None:  # unary (isnan etc.)
+            return Tensor(_jfn(_as_tensor(x)._data))
+        xa = _as_tensor(x)._data if isinstance(x, Tensor) else x
+        ya = _as_tensor(y)._data if isinstance(y, Tensor) else y
+        return Tensor(_jfn(xa, ya))
+
+    op.__name__ = name
+    return _export(name, op, methods)
+
+
+_make_cmp("equal", jnp.equal, ["equal", "__eq__"])
+_make_cmp("not_equal", jnp.not_equal, ["not_equal", "__ne__"])
+_make_cmp("less_than", jnp.less, ["less_than", "__lt__"])
+_make_cmp("less_equal", jnp.less_equal, ["less_equal", "__le__"])
+_make_cmp("greater_than", jnp.greater, ["greater_than", "__gt__"])
+_make_cmp("greater_equal", jnp.greater_equal, ["greater_equal", "__ge__"])
+_make_cmp("logical_and", jnp.logical_and, ["logical_and", "__and__"])
+_make_cmp("logical_or", jnp.logical_or, ["logical_or", "__or__"])
+_make_cmp("logical_xor", jnp.logical_xor, ["logical_xor", "__xor__"])
+
+
+def logical_not(x, out=None, name=None):
+    return Tensor(jnp.logical_not(_as_tensor(x)._data))
+
+
+_export("logical_not", logical_not, ["logical_not", "__invert__"])
+
+
+def _make_unary_pred(name, jfn, methods):
+    def op(x, name=None, _jfn=jfn):
+        return Tensor(_jfn(_as_tensor(x)._data))
+
+    op.__name__ = name
+    return _export(name, op, methods)
+
+
+_make_unary_pred("isnan", jnp.isnan, ["isnan"])
+_make_unary_pred("isinf", jnp.isinf, ["isinf"])
+_make_unary_pred("isfinite", jnp.isfinite, ["isfinite"])
+_make_unary_pred("isneginf", jnp.isneginf, ["isneginf"])
+_make_unary_pred("isposinf", jnp.isposinf, ["isposinf"])
+_make_unary_pred("isreal", jnp.isreal, ["isreal"])
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(_as_tensor(x)._data, _as_tensor(y)._data,
+                               rtol=float(rtol), atol=float(atol),
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(_as_tensor(x)._data, _as_tensor(y)._data,
+                              rtol=float(rtol), atol=float(atol),
+                              equal_nan=equal_nan))
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(_as_tensor(x)._data, _as_tensor(y)._data))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+for _n in ("allclose", "isclose", "equal_all", "is_empty"):
+    _export(_n, globals()[_n], [_n])
+_export("is_tensor", is_tensor)
+
+
+# bitwise family
+def _make_bitwise(name, jfn, methods):
+    def op(x, y=None, out=None, name=None, _jfn=jfn):
+        xa = _as_tensor(x)._data
+        if y is None:
+            return Tensor(_jfn(xa))
+        ya = _as_tensor(y)._data if isinstance(y, Tensor) else y
+        return Tensor(_jfn(xa, ya))
+
+    op.__name__ = name
+    return _export(name, op, methods)
+
+
+_make_bitwise("bitwise_and", jnp.bitwise_and, ["bitwise_and"])
+_make_bitwise("bitwise_or", jnp.bitwise_or, ["bitwise_or"])
+_make_bitwise("bitwise_xor", jnp.bitwise_xor, ["bitwise_xor"])
+_make_bitwise("bitwise_not", jnp.bitwise_not, ["bitwise_not"])
+_make_bitwise("bitwise_left_shift", jnp.left_shift, ["bitwise_left_shift"])
+_make_bitwise("bitwise_right_shift", jnp.right_shift, ["bitwise_right_shift"])
+
+
+# where lives logically with search ops but is differentiable
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .manipulation import nonzero
+
+        return nonzero(condition, as_tuple=True)
+    cond = _as_tensor(condition)._data
+
+    xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+    if xt and yt:
+        return eager_apply("where", lambda a, b: jnp.where(cond, a, b),
+                           [x, y], {})
+    if xt:
+        return eager_apply("where", lambda a: jnp.where(cond, a, y), [x], {})
+    if yt:
+        return eager_apply("where", lambda b: jnp.where(cond, x, b), [y], {})
+    return Tensor(jnp.where(cond, x, y))
+
+
+globals()["where"] = where
+__all__.append("where")
+register_op("where", where, methods=["where"], tags=("search",))

@@ -1,0 +1,662 @@
+"""Shape/layout manipulation ops.
+
+Reference surface: python/paddle/tensor/manipulation.py (+ phi kernels
+cpu/gpu concat, split, gather, scatter, stride/ view kernels).
+
+Note on dynamic shapes: ``nonzero``/``masked_select``/``unique`` have
+data-dependent output shapes, which XLA cannot compile statically; they
+are eager-only here (documented), matching §7.2 of the build plan —
+jit-path code should use ``where``/masking instead.
+"""
+from __future__ import annotations
+
+import builtins
+
+from typing import List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.tensor import Tensor
+from .dispatch import eager_apply
+from .registry import register_op
+
+__all__: list = []
+
+
+def _export(name, fn, methods=(), differentiable=True):
+    globals()[name] = fn
+    __all__.append(name)
+    register_op(name, fn, methods=methods, differentiable=differentiable,
+                tags=("manipulation",))
+    return fn
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        seq = seq.tolist()
+    if isinstance(seq, (int, np.integer)):
+        return int(seq)
+    return [int(s.item() if isinstance(s, Tensor) else s) for s in seq]
+
+
+# ------------------------------------------------------------- reshape
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return eager_apply("reshape", lambda a: jnp.reshape(a, shape), [x], {})
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._rebind(out._data, out._grad_node, out._out_idx)
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return eager_apply("view_dtype",
+                       lambda a: a.view(to_jax_dtype(shape_or_dtype)), [x], {})
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _as_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    new_shape = x.shape[:s] + [-1] + x.shape[e + 1:]
+    return reshape(x, new_shape)
+
+
+def squeeze(x, axis=None, name=None):
+    x = _as_tensor(x)
+    if axis is None:
+        ax = None
+    else:
+        ax = _ints(axis)
+        if isinstance(ax, int):
+            ax = [ax]
+        ax = tuple(a % x.ndim for a in ax if x.shape[a % x.ndim] == 1)
+    return eager_apply("squeeze", lambda a: jnp.squeeze(a, ax), [x], {})
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _ints(axis)
+    if isinstance(ax, int):
+        ax = [ax]
+    return eager_apply("unsqueeze",
+                       lambda a: jnp.expand_dims(a, tuple(ax)), [x], {})
+
+
+for _n, _f in (("reshape", reshape), ("reshape_", reshape_), ("view", view),
+               ("flatten", flatten), ("squeeze", squeeze),
+               ("unsqueeze", unsqueeze)):
+    _export(_n, _f, methods=[_n])
+
+
+def transpose(x, perm=None, name=None):
+    x = _as_tensor(x)
+    if perm is None:
+        perm = list(range(x.ndim))[::-1]
+    return eager_apply("transpose",
+                       lambda a: jnp.transpose(a, _ints(perm)), [x], {})
+
+
+def moveaxis(x, source, destination, name=None):
+    return eager_apply("moveaxis",
+                       lambda a: jnp.moveaxis(a, _ints(source),
+                                              _ints(destination)), [x], {})
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return eager_apply("swapaxes",
+                       lambda a: jnp.swapaxes(a, int(axis0), int(axis1)),
+                       [x], {})
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return eager_apply("rot90", lambda a: jnp.rot90(a, k, tuple(axes)), [x], {})
+
+
+def flip(x, axis, name=None):
+    ax = _ints(axis)
+    ax = tuple(ax) if isinstance(ax, list) else (ax,)
+    return eager_apply("flip", lambda a: jnp.flip(a, ax), [x], {})
+
+
+def roll(x, shifts, axis=None, name=None):
+    return eager_apply(
+        "roll",
+        lambda a: jnp.roll(a, _ints(shifts),
+                           None if axis is None else _ints(axis)), [x], {})
+
+
+for _n, _f in (("transpose", transpose), ("moveaxis", moveaxis),
+               ("swapaxes", swapaxes), ("rot90", rot90), ("flip", flip),
+               ("roll", roll)):
+    _export(_n, _f, methods=[_n])
+
+
+# ------------------------------------------------------- concat / split
+def concat(x: Sequence[Tensor], axis=0, name=None):
+    tensors = [_as_tensor(t) for t in x]
+    ax = int(axis.item() if isinstance(axis, Tensor) else axis)
+    return eager_apply("concat", lambda *arrs: jnp.concatenate(arrs, ax),
+                       tensors, {})
+
+
+def stack(x: Sequence[Tensor], axis=0, name=None):
+    tensors = [_as_tensor(t) for t in x]
+    return eager_apply("stack", lambda *arrs: jnp.stack(arrs, int(axis)),
+                       tensors, {})
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _as_tensor(x)
+    ax = int(axis.item() if isinstance(axis, Tensor) else axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {ax} (size {dim}) is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = _ints(num_or_sections)
+        neg = [i for i, s in enumerate(sections) if s < 0]
+        if neg:
+            known = sum(s for s in sections if s >= 0)
+            sections[neg[0]] = dim - known
+    offsets = np.cumsum([0] + sections)
+
+    def raw(a):
+        return tuple(jax.lax.slice_in_dim(a, int(offsets[i]),
+                                          int(offsets[i + 1]), axis=ax)
+                     for i in range(len(sections)))
+
+    outs = eager_apply("split", raw, [x], {}, n_outputs=len(sections))
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    # paddle.chunk allows a smaller trailing chunk on non-divisible dims
+    x = _as_tensor(x)
+    ax = int(axis) % x.ndim
+    dim = x.shape[ax]
+    n = int(chunks)
+    if dim % n == 0:
+        return split(x, n, ax)
+    per = -(-dim // n)  # ceil
+    sections = [per] * (dim // per) + ([dim - per * (dim // per)]
+                                       if dim % per else [])
+    return split(x, sections, ax)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _as_tensor(x)
+    ax = int(axis) % x.ndim
+    n = num or x.shape[ax]
+
+    def raw(a):
+        return tuple(jnp.squeeze(s, ax)
+                     for s in jnp.split(a, n, axis=ax))
+
+    return list(eager_apply("unstack", raw, [x], {}, n_outputs=n))
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def tile(x, repeat_times, name=None):
+    return eager_apply("tile", lambda a: jnp.tile(a, tuple(_ints(repeat_times))),
+                       [x], {})
+
+
+def expand(x, shape, name=None):
+    x = _as_tensor(x)
+    target = _ints(shape)
+    cur = x.shape
+    full = []
+    for i, s in enumerate(target):
+        if s in (-1, 0) and len(target) - i <= len(cur):
+            full.append(cur[len(cur) - (len(target) - i)])
+        else:
+            full.append(s)
+    return eager_apply("expand",
+                       lambda a: jnp.broadcast_to(a, tuple(full)), [x], {})
+
+
+def expand_as(x, y, name=None):
+    return eager_apply("expand_as",
+                       lambda a: jnp.broadcast_to(a, tuple(y.shape)), [x], {})
+
+
+def broadcast_to(x, shape, name=None):
+    return eager_apply("broadcast_to",
+                       lambda a: jnp.broadcast_to(a, tuple(_ints(shape))),
+                       [x], {})
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [broadcast_to(t, out_shape) for t in inputs]
+
+
+for _n in ("concat", "stack", "split", "chunk", "unstack", "unbind", "tile",
+           "expand", "expand_as", "broadcast_to", "broadcast_tensors"):
+    _export(_n, globals()[_n],
+            methods=[_n] if _n in ("split", "chunk", "tile", "expand",
+                                   "expand_as", "broadcast_to", "unbind") else ())
+
+
+# ------------------------------------------------------- gather/scatter
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item() if isinstance(axis, Tensor) else axis)
+    idx = _as_tensor(index)
+
+    def raw(a):
+        ind = idx._data
+        if ind.ndim == 2 and ind.shape[1] == 1:
+            ind = ind.reshape(-1)
+        return jnp.take(a, ind, axis=ax)
+
+    return eager_apply("gather", raw, [x], {})
+
+
+def gather_nd(x, index, name=None):
+    idx = _as_tensor(index)._data
+
+    def raw(a):
+        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[ind]
+
+    return eager_apply("gather_nd", raw, [x], {})
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = _as_tensor(indices)._data
+
+    def raw(a):
+        return jnp.take_along_axis(a, idx, axis=int(axis))
+
+    return eager_apply("take_along_axis", raw, [arr], {})
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    idx = _as_tensor(indices)._data
+    vals = _as_tensor(values)
+
+    def raw(a, v):
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        dims = list(range(a.ndim))
+        ax = int(axis) % a.ndim
+        index_arrays = []
+        for d in dims:
+            if d == ax:
+                index_arrays.append(idx)
+            else:
+                shape = [1] * a.ndim
+                shape[d] = a.shape[d] if d >= idx.ndim or idx.shape[d] != 1 else 1
+                r = jnp.arange(idx.shape[d] if d < idx.ndim else a.shape[d])
+                sh = [1] * idx.ndim
+                sh[d] = -1
+                index_arrays.append(r.reshape(sh))
+        at = a.at[tuple(jnp.broadcast_arrays(*index_arrays))]
+        if reduce == "assign":
+            return at.set(v)
+        if reduce in ("add", "sum"):
+            return at.add(v)
+        if reduce in ("mul", "multiply"):
+            return at.multiply(v)
+        if reduce == "amax":
+            return at.max(v)
+        if reduce == "amin":
+            return at.min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return eager_apply("put_along_axis", raw, [arr, vals], {})
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = _as_tensor(index)._data.reshape(-1)
+
+    def raw(a, u):
+        if overwrite:
+            return a.at[idx].set(u.astype(a.dtype))
+        # paddle !overwrite: zero the rows then accumulate
+        zeroed = a.at[idx].set(jnp.zeros_like(u, a.dtype))
+        return zeroed.at[idx].add(u.astype(a.dtype))
+
+    return eager_apply("scatter", raw, [x, _as_tensor(updates)], {})
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = _as_tensor(index)._data
+
+    def raw(a, u):
+        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[ind].add(u.astype(a.dtype))
+
+    return eager_apply("scatter_nd_add", raw, [x, _as_tensor(updates)], {})
+
+
+def scatter_nd(index, updates, shape, name=None):
+    u = _as_tensor(updates)
+    zeros = Tensor(jnp.zeros(tuple(_ints(shape)), u._data.dtype))
+    return scatter_nd_add(zeros, index, u)
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = _as_tensor(index)._data.reshape(-1)
+    return eager_apply("index_select",
+                       lambda a: jnp.take(a, idx, axis=int(axis)), [x], {})
+
+
+def index_sample(x, index):
+    idx = _as_tensor(index)._data
+
+    def raw(a):
+        return jnp.take_along_axis(a, idx, axis=1)
+
+    return eager_apply("index_sample", raw, [x], {})
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = _as_tensor(index)._data.reshape(-1)
+
+    def raw(a, v):
+        ax = int(axis) % a.ndim
+        moved = jnp.moveaxis(a, ax, 0)
+        vm = jnp.moveaxis(v.astype(a.dtype), ax, 0)
+        out = moved.at[idx].add(vm)
+        return jnp.moveaxis(out, 0, ax)
+
+    return eager_apply("index_add", raw, [x, _as_tensor(value)], {})
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(_as_tensor(i)._data for i in indices)
+
+    def raw(a, v):
+        at = a.at[idx]
+        return at.add(v.astype(a.dtype)) if accumulate else at.set(v.astype(a.dtype))
+
+    return eager_apply("index_put", raw, [x, _as_tensor(value)], {})
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats._data
+
+    def raw(a):
+        return jnp.repeat(a, repeats, axis=None if axis is None else int(axis))
+
+    return eager_apply("repeat_interleave", raw, [x], {})
+
+
+for _n in ("gather", "gather_nd", "take_along_axis", "put_along_axis",
+           "scatter", "scatter_nd_add", "scatter_nd", "index_select",
+           "index_sample", "index_add", "index_put", "repeat_interleave"):
+    _export(_n, globals()[_n], methods=[_n])
+
+
+# ---------------------------------------------------------------- pad
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _as_tensor(x)
+    p = _ints(pad)
+    nd = x.ndim
+    if len(p) == 2 * nd:
+        # paddle flat form: [d0_left, d0_right, d1_left, ...] per *all* dims
+        width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+    else:
+        # NCHW/NCL/NCDHW spatial-only form, reversed pairs like torch
+        n_spatial = len(p) // 2
+        width = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial_dims = list(range(2, 2 + n_spatial))
+        else:
+            spatial_dims = list(range(1, 1 + n_spatial))
+        for i, d in enumerate(reversed(spatial_dims)):
+            width[d] = (p[2 * i], p[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def raw(a):
+        if jmode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return eager_apply("pad", raw, [x], {})
+
+
+_export("pad", pad)
+
+
+# ------------------------------------------------------ sort / search
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = _as_tensor(x)
+    kk = int(k.item() if isinstance(k, Tensor) else k)
+    ax = int(axis)
+
+    def raw(a):
+        src = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(src, kk)
+        else:
+            v, i = jax.lax.top_k(-src, kk)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax)
+
+    vals, idx = eager_apply("topk", raw, [x], {}, n_outputs=2)
+    return vals, Tensor(idx._data.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def raw(a):
+        s = jnp.sort(a, axis=int(axis), stable=True)
+        return jnp.flip(s, int(axis)) if descending else s
+
+    return eager_apply("sort", raw, [x], {})
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = _as_tensor(x)
+    i = jnp.argsort(x._data, axis=int(axis), stable=True)
+    if descending:
+        i = jnp.flip(i, int(axis))
+    return Tensor(i.astype(jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    seq = _as_tensor(sorted_sequence)._data
+    v = _as_tensor(values)._data
+    side = "right" if right else "left"
+    if seq.ndim == 1:
+        out = jnp.searchsorted(seq, v, side=side)
+    else:
+        flat_seq = seq.reshape(-1, seq.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+            flat_seq, flat_v).reshape(v.shape)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+for _n in ("topk", "sort", "argsort", "searchsorted", "bucketize"):
+    _export(_n, globals()[_n], methods=[_n],
+            differentiable=_n in ("topk", "sort"))
+
+
+# ---------------------------------------- dynamic-shape (eager-only) ops
+def nonzero(x, as_tuple=False):
+    a = np.asarray(_as_tensor(x)._data)
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None].astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def masked_select(x, mask, name=None):
+    a = np.asarray(_as_tensor(x)._data)
+    m = np.asarray(_as_tensor(mask)._data).astype(bool)
+    return Tensor(jnp.asarray(a[np.broadcast_to(m, a.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    m = _as_tensor(mask)._data
+    v = value.item() if isinstance(value, Tensor) else value
+    return eager_apply("masked_fill",
+                       lambda a: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                       [x], {})
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(_as_tensor(x)._data)
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts,
+                    axis=None if axis is None else int(axis))
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    res = list(res if isinstance(res, tuple) else (res,))
+    outs = [Tensor(jnp.asarray(res[0]))]
+    for r in res[1:]:
+        outs.append(Tensor(jnp.asarray(r.astype(np.int64))))
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(_as_tensor(x)._data)
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = int(axis) % a.ndim
+        a = np.moveaxis(a, ax, 0)
+    if a.shape[0] == 0:
+        keep = np.zeros((0,), bool)
+    else:
+        flat = a.reshape(a.shape[0], -1)
+        keep = np.concatenate([[True], np.any(flat[1:] != flat[:-1], axis=1)])
+    vals = a[keep]
+    if axis is not None:
+        vals = np.moveaxis(vals, 0, ax)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, a.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+for _n in ("nonzero", "masked_select", "masked_fill", "unique",
+           "unique_consecutive"):
+    _export(_n, globals()[_n], methods=[_n],
+            differentiable=_n == "masked_fill")
+
+
+# ------------------------------------------------------------- casting
+def cast(x, dtype):
+    x = _as_tensor(x)
+    d = to_jax_dtype(dtype)
+    if jnp.issubdtype(d, jnp.inexact) and jnp.issubdtype(x._data.dtype, jnp.inexact):
+        return eager_apply("cast", lambda a: a.astype(d), [x], {})
+    return Tensor(x._data.astype(d))
+
+
+def astype(x, dtype):
+    return cast(x, dtype)
+
+
+_export("cast", cast, methods=["cast", "astype"])
+
+
+def slice(input, axes, starts, ends):
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+
+    def raw(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            dim = a.shape[ax]
+            s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            idx[ax] = builtins.slice(s2, e2)
+        return a[tuple(idx)]
+
+    return eager_apply("slice", raw, [input], {})
+
+
+_export("slice", slice)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = (_ints(axes), _ints(starts), _ints(ends),
+                                   _ints(strides))
+
+    def raw(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+
+    return eager_apply("strided_slice", raw, [x], {})
+
+
+_export("strided_slice", strided_slice)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _as_tensor(x)
+    shape = _ints(shape) if shape is not None else x.shape
+    offsets = _ints(offsets) if offsets is not None else [0] * x.ndim
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+
+    def raw(a):
+        return jax.lax.dynamic_slice(a, offsets, shape)
+
+    return eager_apply("crop", raw, [x], {})
+
+
+_export("crop", crop)
+
+
+def as_complex(x, name=None):
+    return eager_apply("as_complex",
+                       lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [x], {})
+
+
+def as_real(x, name=None):
+    return eager_apply(
+        "as_real",
+        lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), [x], {})
+
+
+def real(x, name=None):
+    return eager_apply("real", lambda a: jnp.real(a), [x], {})
+
+
+def imag(x, name=None):
+    return eager_apply("imag", lambda a: jnp.imag(a), [x], {})
+
+
+for _n in ("as_complex", "as_real", "real", "imag"):
+    _export(_n, globals()[_n], methods=[_n])

@@ -1,0 +1,454 @@
+"""Elementwise math + reduction ops.
+
+Reference surface: python/paddle/tensor/math.py and python/paddle/tensor/
+stat.py; kernels under paddle/phi/kernels (cpu/gpu elementwise + reduce).
+Here each op is a thin functional jnp lambda dispatched through
+``eager_apply`` — XLA fuses elementwise chains into matmul/reduce
+neighbors on TPU, so there is no need for hand-fused variants on the
+forward path.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, get_default_dtype, to_jax_dtype
+from ..core.tensor import Tensor
+from .dispatch import eager_apply
+from .registry import register_op
+
+__all__: list = []
+
+
+def _export(name, fn, methods=(), differentiable=True):
+    globals()[name] = fn
+    __all__.append(name)
+    register_op(name, fn, methods=methods, differentiable=differentiable,
+                tags=("math",))
+    return fn
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# ---------------------------------------------------------------- unary
+_UNARY = {
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt, "abs": jnp.abs, "sin": jnp.sin, "cos": jnp.cos,
+    "tan": jnp.tan, "asin": jnp.arcsin, "acos": jnp.arccos,
+    "atan": jnp.arctan, "sinh": jnp.sinh, "cosh": jnp.cosh,
+    "tanh": jnp.tanh, "asinh": jnp.arcsinh, "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh, "sigmoid": jax.nn.sigmoid, "floor": jnp.floor,
+    "ceil": jnp.ceil, "round": jnp.round, "trunc": jnp.trunc,
+    "sign": jnp.sign, "reciprocal": lambda a: 1.0 / a,
+    "square": jnp.square, "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv, "digamma": jax.scipy.special.digamma,
+    "lgamma": jax.scipy.special.gammaln, "neg": jnp.negative,
+    "conj": jnp.conj, "angle": jnp.angle, "frac": lambda a: a - jnp.trunc(a),
+    "i0": jax.scipy.special.i0, "i1": jax.scipy.special.i1,
+}
+
+
+def _make_unary(name, jfn):
+    def op(x, name=None, _jfn=jfn, _opname=name):
+        return eager_apply(_opname, _jfn, [_as_tensor(x)], {})
+
+    op.__name__ = name
+    return op
+
+
+for _n, _f in _UNARY.items():
+    _op = _make_unary(_n, _f)
+    _methods = [_n]
+    _export(_n, _op, methods=_methods)
+
+Tensor._attach_method("__neg__", globals()["neg"])
+Tensor._attach_method("__abs__", globals()["abs"])
+
+
+# --------------------------------------------------------------- binary
+def _make_binary(name, jfn, int_to_float=False):
+    def op(x, y, name=None, _jfn=jfn, _opname=name):
+        xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+        if xt and yt:
+            if int_to_float and (jnp.issubdtype(x._data.dtype, jnp.integer)
+                                 and jnp.issubdtype(y._data.dtype, jnp.integer)):
+                d = get_default_dtype().np_dtype
+                return eager_apply(
+                    _opname,
+                    lambda a, b: _jfn(a.astype(d), b.astype(d)), [x, y], {})
+            return eager_apply(_opname, _jfn, [x, y], {})
+        if xt:
+            return eager_apply(_opname, lambda a: _jfn(a, y), [x], {})
+        if yt:
+            return eager_apply(_opname, lambda b: _jfn(x, b), [y], {})
+        return Tensor(jnp.asarray(_jfn(x, y)))
+
+    op.__name__ = name
+    return op
+
+
+_BINARY = {
+    "add": (jnp.add, ["add", "__add__", "__radd__"]),
+    "subtract": (jnp.subtract, ["subtract", "__sub__"]),
+    "multiply": (jnp.multiply, ["multiply", "__mul__", "__rmul__"]),
+    "divide": (jnp.true_divide, ["divide", "__truediv__"]),
+    "floor_divide": (jnp.floor_divide, ["floor_divide", "__floordiv__"]),
+    "mod": (jnp.mod, ["mod", "__mod__"]),
+    "remainder": (jnp.remainder, ["remainder"]),
+    "pow": (jnp.power, ["pow", "__pow__"]),
+    "maximum": (jnp.maximum, ["maximum"]),
+    "minimum": (jnp.minimum, ["minimum"]),
+    "fmax": (jnp.fmax, ["fmax"]),
+    "fmin": (jnp.fmin, ["fmin"]),
+    "atan2": (jnp.arctan2, ["atan2"]),
+    "logaddexp": (jnp.logaddexp, ["logaddexp"]),
+    "hypot": (jnp.hypot, ["hypot"]),
+    "copysign": (jnp.copysign, ["copysign"]),
+    "heaviside": (jnp.heaviside, ["heaviside"]),
+    "gcd": (jnp.gcd, ["gcd"]),
+    "lcm": (jnp.lcm, ["lcm"]),
+}
+
+for _n, (_f, _methods) in _BINARY.items():
+    _op = _make_binary(_n, _f, int_to_float=(_n == "divide"))
+    _export(_n, _op, methods=_methods)
+
+
+def _rsub(self, other):
+    return globals()["subtract"](other, self)
+
+
+def _rdiv(self, other):
+    return globals()["divide"](other, self)
+
+
+def _rpow(self, other):
+    return globals()["pow"](other, self)
+
+
+Tensor._attach_method("__rsub__", _rsub)
+Tensor._attach_method("__rtruediv__", _rdiv)
+Tensor._attach_method("__rpow__", _rpow)
+
+
+# ---------------------------------------------------- scalar-attr ops
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def raw(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out.astype(a.dtype)
+
+    out = eager_apply("scale", raw, [_as_tensor(x)], {})
+    if act is not None:
+        out = globals()[act](out)
+    return out
+
+
+_export("scale", scale, methods=["scale"])
+
+
+def clip(x, min=None, max=None, name=None):
+    tensors = [_as_tensor(x)]
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return eager_apply("clip", lambda a: jnp.clip(a, mn, mx), tensors, {})
+
+
+_export("clip", clip, methods=["clip"])
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return eager_apply("lerp", lambda a, b, w: a + w * (b - a),
+                           [x, y, weight], {})
+    return eager_apply("lerp", lambda a, b: a + weight * (b - a), [x, y], {})
+
+
+_export("lerp", lerp, methods=["lerp"])
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return eager_apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a),
+                       [_as_tensor(x)], {})
+
+
+_export("stanh", stanh)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return eager_apply("addmm",
+                       lambda i, a, b: beta * i + alpha * (a @ b),
+                       [input, x, y], {})
+
+
+_export("addmm", addmm)
+
+
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack([t._data for t in inputs], axis=0)
+
+    def raw(idx, *arrs):
+        st = jnp.stack(arrs, axis=0)
+        rows = jnp.arange(st.shape[1])
+        return st[idx.reshape(-1), rows]
+
+    return eager_apply("multiplex", lambda *arrs: raw(index._data, *arrs),
+                       list(inputs), {})
+
+
+_export("multiplex", multiplex)
+
+
+# ------------------------------------------------------------ reductions
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _make_reduce(name, jfn, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None,
+           _jfn=jfn, _opname=name):
+        x = _as_tensor(x)
+        ax = _axis_arg(axis)
+
+        def raw(a):
+            if dtype is not None:
+                a = a.astype(to_jax_dtype(dtype))
+            elif int_promote and jnp.issubdtype(a.dtype, jnp.integer):
+                a = a.astype(jnp.int64)
+            return _jfn(a, axis=ax, keepdims=keepdim)
+
+        return eager_apply(_opname, raw, [x], {})
+
+    op.__name__ = name
+    return op
+
+
+_REDUCE = {
+    "sum": (jnp.sum, True), "mean": (jnp.mean, False),
+    "prod": (jnp.prod, True), "max": (jnp.max, False),
+    "min": (jnp.min, False), "amax": (jnp.amax, False),
+    "amin": (jnp.amin, False), "nansum": (jnp.nansum, True),
+    "nanmean": (jnp.nanmean, False),
+    "logsumexp": (jax.scipy.special.logsumexp, False),
+    "all": (jnp.all, False), "any": (jnp.any, False),
+}
+
+for _n, (_f, _p) in _REDUCE.items():
+    _op = _make_reduce(_n, _f, _p)
+    _export(_n, _op, methods=[_n], differentiable=_n not in ("all", "any"))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.count_nonzero(_as_tensor(x)._data, axis=_axis_arg(axis),
+                                    keepdims=keepdim).astype(jnp.int64))
+
+
+_export("count_nonzero", count_nonzero, differentiable=False)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = _as_tensor(x)
+    ax = None if axis is None else int(axis)
+    out = jnp.argmax(x._data, axis=ax, keepdims=keepdim if ax is not None else False)
+    return Tensor(out.astype(to_jax_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = _as_tensor(x)
+    ax = None if axis is None else int(axis)
+    out = jnp.argmin(x._data, axis=ax, keepdims=keepdim if ax is not None else False)
+    return Tensor(out.astype(to_jax_dtype(dtype)))
+
+
+_export("argmax", argmax, methods=["argmax"], differentiable=False)
+_export("argmin", argmin, methods=["argmin"], differentiable=False)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = _as_tensor(x)
+
+    def raw(a):
+        if dtype is not None:
+            a = a.astype(to_jax_dtype(dtype))
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1))
+        return jnp.cumsum(a, axis=int(axis))
+
+    return eager_apply("cumsum", raw, [x], {})
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = _as_tensor(x)
+
+    def raw(a):
+        if dtype is not None:
+            a = a.astype(to_jax_dtype(dtype))
+        return jnp.cumprod(a, axis=int(dim) if dim is not None else None)
+
+    return eager_apply("cumprod", raw, [x], {})
+
+
+def _running_arg_scan(a, ax, cmp):
+    """Running (value, first-index) scan along ax — associative combiner:
+    keep the earlier index on ties."""
+    idx_shape = [1] * a.ndim
+    idx_shape[ax] = a.shape[ax]
+    idx0 = jnp.broadcast_to(
+        jnp.arange(a.shape[ax], dtype=jnp.int64).reshape(idx_shape), a.shape)
+
+    def comb(lhs, rhs):
+        lv, li = lhs
+        rv, ri = rhs
+        take_r = cmp(rv, lv)  # strict: ties keep the earlier (left) index
+        return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+
+    return jax.lax.associative_scan(comb, (a, idx0), axis=ax)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = _as_tensor(x)
+    ax = 0 if axis is None else int(axis)
+    a = x._data if axis is not None else x._data.reshape(-1)
+    _, idx = _running_arg_scan(a, ax % a.ndim, jnp.greater)
+    out = eager_apply("cummax", lambda b: jax.lax.associative_scan(
+        jnp.maximum, b if axis is not None else b.reshape(-1), axis=ax), [x], {})
+    return out, Tensor(idx.astype(to_jax_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = _as_tensor(x)
+    ax = 0 if axis is None else int(axis)
+    a = x._data if axis is not None else x._data.reshape(-1)
+    _, idx = _running_arg_scan(a, ax % a.ndim, jnp.less)
+    out = eager_apply("cummin", lambda b: jax.lax.associative_scan(
+        jnp.minimum, b if axis is not None else b.reshape(-1), axis=ax), [x], {})
+    return out, Tensor(idx.astype(to_jax_dtype(dtype)))
+
+
+_export("cumsum", cumsum, methods=["cumsum"])
+_export("cumprod", cumprod, methods=["cumprod"])
+_export("cummax", cummax)
+_export("cummin", cummin)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = _as_tensor(x)
+    return eager_apply("median",
+                       lambda a: jnp.median(a, axis=_axis_arg(axis),
+                                            keepdims=keepdim), [x], {})
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None, interpolation="linear"):
+    x = _as_tensor(x)
+    return eager_apply(
+        "quantile",
+        lambda a: jnp.quantile(a, jnp.asarray(q), axis=_axis_arg(axis),
+                               keepdims=keepdim, method=interpolation),
+        [x], {})
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = _as_tensor(x)
+    return eager_apply(
+        "std",
+        lambda a: jnp.std(a, axis=_axis_arg(axis), ddof=1 if unbiased else 0,
+                          keepdims=keepdim), [x], {})
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = _as_tensor(x)
+    return eager_apply(
+        "var",
+        lambda a: jnp.var(a, axis=_axis_arg(axis), ddof=1 if unbiased else 0,
+                          keepdims=keepdim), [x], {})
+
+
+_export("median", median, methods=["median"])
+_export("quantile", quantile)
+_export("std", std, methods=["std"])
+_export("var", var, methods=["var"])
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = _as_tensor(x)
+    ax = int(axis)
+
+    def raw(a):
+        s = jnp.sort(a, axis=ax)
+        v = jnp.take(s, k - 1, axis=ax)
+        return jnp.expand_dims(v, ax) if keepdim else v
+
+    vals = eager_apply("kthvalue", raw, [x], {})
+    idx = jnp.take(jnp.argsort(x._data, axis=ax), k - 1, axis=ax)
+    if keepdim:
+        idx = jnp.expand_dims(idx, ax)
+    return vals, Tensor(idx.astype(jnp.int64))
+
+
+_export("kthvalue", kthvalue)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return eager_apply("trace",
+                       lambda a: jnp.trace(a, offset, int(axis1), int(axis2)),
+                       [_as_tensor(x)], {})
+
+
+_export("trace", trace, methods=["trace"])
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return eager_apply("nan_to_num",
+                       lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                                neginf=neginf),
+                       [_as_tensor(x)], {})
+
+
+_export("nan_to_num", nan_to_num, methods=["nan_to_num"])
+
+
+def log_softmax_raw(a, axis):
+    return jax.nn.log_softmax(a, axis=axis)
+
+
+def increment(x, value=1.0, name=None):
+    out = eager_apply("increment", lambda a: a + value, [x], {})
+    x._rebind(out._data, out._grad_node, out._out_idx)
+    return x
+
+
+_export("increment", increment)
+
+
+def outer(x, y, name=None):
+    return eager_apply("outer",
+                       lambda a, b: jnp.outer(a, b), [x, y], {})
+
+
+def inner(x, y, name=None):
+    return eager_apply("inner", lambda a, b: jnp.inner(a, b), [x, y], {})
+
+
+_export("outer", outer, methods=["outer"])
+_export("inner", inner, methods=["inner"])
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+_export("broadcast_shape", broadcast_shape, differentiable=False)

@@ -1,0 +1,172 @@
+"""Random ops.
+
+Reference surface: python/paddle/tensor/random.py; the stateful-seed
+semantics come from the framework Generator (see core/generator.py — the
+stateful shell over jax functional keys, reference phi/core/generator.h).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import get_default_dtype, to_jax_dtype
+from ..core.generator import default_generator
+from ..core.tensor import Tensor
+from .registry import register_op
+
+__all__ = [
+    "uniform", "uniform_", "normal", "normal_", "standard_normal", "randn",
+    "rand", "randint", "randint_like", "randperm", "bernoulli", "poisson",
+    "multinomial", "exponential_", "rand_like", "randn_like", "gumbel_softmax",
+]
+
+
+def _dt(dtype):
+    return get_default_dtype().np_dtype if dtype is None else to_jax_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = (jax.random.key(seed) if seed else default_generator().next_key())
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=float(min), maxval=float(max)))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    x._rebind(jax.random.uniform(default_generator().next_key(),
+                                 tuple(x._data.shape), x._data.dtype,
+                                 minval=float(min), maxval=float(max)))
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        out_shape = np.broadcast_shapes(np.shape(m), np.shape(s))
+        eps = jax.random.normal(default_generator().next_key(), out_shape,
+                                get_default_dtype().np_dtype)
+        return Tensor(m + s * eps)
+    eps = jax.random.normal(default_generator().next_key(), _shape(shape),
+                            get_default_dtype().np_dtype)
+    return Tensor(mean + std * eps)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    eps = jax.random.normal(default_generator().next_key(),
+                            tuple(x._data.shape), x._data.dtype)
+    x._rebind(mean + std * eps)
+    return x
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(default_generator().next_key(),
+                                    _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.uniform(default_generator().next_key(),
+                                     _shape(shape), _dt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(default_generator().next_key(),
+                                     _shape(shape), int(low), int(high),
+                                     to_jax_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    return randint(low, high, tuple(x.shape), dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(default_generator().next_key(),
+                                         int(n)).astype(to_jax_dtype(dtype)))
+
+
+def bernoulli(x, p=None, name=None) -> Tensor:
+    probs = x._data if p is None else p
+    return Tensor(
+        jax.random.bernoulli(default_generator().next_key(),
+                             probs, tuple(np.shape(probs))).astype(
+                                 x._data.dtype if p is None else jnp.float32))
+
+
+def poisson(x, name=None) -> Tensor:
+    return Tensor(jax.random.poisson(default_generator().next_key(),
+                                     x._data).astype(x._data.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    key = default_generator().next_key()
+    probs = x._data
+    if probs.ndim == 1:
+        out = jax.random.choice(key, probs.shape[0], (int(num_samples),),
+                                replace=replacement, p=probs / probs.sum())
+        return Tensor(out.astype(jnp.int64))
+    keys = jax.random.split(key, probs.shape[0])
+    rows = [jax.random.choice(k, probs.shape[1], (int(num_samples),),
+                              replace=replacement, p=r / r.sum())
+            for k, r in zip(keys, probs)]
+    return Tensor(jnp.stack(rows).astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    e = jax.random.exponential(default_generator().next_key(),
+                               tuple(x._data.shape), x._data.dtype)
+    x._rebind(e / lam)
+    return x
+
+
+def rand_like(x, dtype=None, name=None) -> Tensor:
+    return rand(tuple(x.shape), dtype or x.dtype)
+
+
+def randn_like(x, dtype=None, name=None) -> Tensor:
+    return randn(tuple(x.shape), dtype or x.dtype)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from .dispatch import eager_apply
+
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(default_generator().next_key(),
+                           tuple(x.shape), x._data.dtype) + 1e-20) + 1e-20)
+
+    def raw(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            ax = axis % y.ndim
+            one_hot = jnp.moveaxis(
+                jax.nn.one_hot(jnp.argmax(y, axis=ax), y.shape[ax],
+                               dtype=y.dtype), -1, ax)
+            # straight-through estimator
+            return one_hot + y - jax.lax.stop_gradient(y)
+        return y
+
+    return eager_apply("gumbel_softmax", raw, [x], {})
+
+
+for _n in __all__:
+    register_op(_n, globals()[_n], tags=("random",),
+                differentiable=_n == "gumbel_softmax")
+Tensor._attach_method("uniform_", uniform_)
+Tensor._attach_method("normal_", normal_)
+Tensor._attach_method("exponential_", exponential_)
+Tensor._attach_method("bernoulli", bernoulli)
+Tensor._attach_method("multinomial", multinomial)

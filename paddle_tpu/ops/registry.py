@@ -1,0 +1,58 @@
+"""Single-source op registry.
+
+TPU-native equivalent of the reference's YAML op registry
+(reference: paddle/phi/api/yaml/ops.yaml — the single source of truth from
+which Paddle generates C++ API, autograd functions, Python bindings and
+SPMD variants; generators under paddle/phi/api/yaml/generator/).
+
+Here the registry is the single source from which we derive: the module-
+level functional API (``paddle_tpu.matmul``), Tensor methods
+(``t.matmul``), the ``_C_ops`` raw-dispatch namespace, and the op
+inventory that tests validate against. Gradients and sharding rules need
+no per-op tables: JAX vjp and XLA GSPMD propagation supply them from the
+same functional definition (rule overrides registered per-op when XLA's
+default is suboptimal).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+__all__ = ["OpDef", "register_op", "get_op", "all_ops"]
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "methods", "differentiable", "inplace_of", "tags")
+
+    def __init__(self, name: str, fn: Callable, methods: Sequence[str] = (),
+                 differentiable: bool = True, inplace_of: Optional[str] = None,
+                 tags: Sequence[str] = ()):
+        self.name = name
+        self.fn = fn
+        self.methods = tuple(methods)
+        self.differentiable = differentiable
+        self.inplace_of = inplace_of
+        self.tags = tuple(tags)
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, fn: Callable, methods: Sequence[str] = (),
+                differentiable: bool = True, inplace_of: Optional[str] = None,
+                tags: Sequence[str] = ()) -> Callable:
+    """Register ``fn`` as op ``name``; attach Tensor methods listed in
+    ``methods``. Returns fn unchanged so it can be used at module level."""
+    from ..core.tensor import Tensor
+
+    _REGISTRY[name] = OpDef(name, fn, methods, differentiable, inplace_of, tags)
+    for m in methods:
+        Tensor._attach_method(m, fn)
+    return fn
+
+
+def get_op(name: str) -> OpDef:
+    return _REGISTRY[name]
+
+
+def all_ops() -> Dict[str, OpDef]:
+    return dict(_REGISTRY)

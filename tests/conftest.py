@@ -1,0 +1,31 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Port of the reference's "distributed tests without a real cluster" trick
+(reference: test/legacy_test/test_dist_base.py:962 — localhost multi-proc;
+and the fake-device precedent paddle/phi/backends/custom/fake_cpu_device.h):
+here a single process gets 8 virtual XLA host devices, which exercises the
+full sharding/collective path without TPU hardware.
+
+Must run before jax initializes a backend. The container pins
+JAX_PLATFORMS=axon via sitecustomize, so we override programmatically too.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reseed():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    yield

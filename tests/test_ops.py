@@ -1,0 +1,407 @@
+"""Op correctness: numpy-reference forward + finite-difference grad checks
+(reference pattern: test/legacy_test/op_test.py check_output/check_grad)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(42)
+
+
+def f32(*shape):
+    return rng.rand(*shape).astype(np.float32) + 0.1
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("name", [
+        "exp", "log", "sqrt", "rsqrt", "tanh", "sigmoid", "sin", "cos",
+        "abs", "square", "reciprocal", "erf", "log1p", "expm1",
+    ])
+    def test_forward_and_grad(self, name):
+        np_map = {
+            "rsqrt": lambda a: 1 / np.sqrt(a),
+            "sigmoid": lambda a: 1 / (1 + np.exp(-a)),
+            "square": np.square, "reciprocal": lambda a: 1 / a,
+            "erf": lambda a: np.vectorize(__import__("math").erf)(a),
+        }
+        np_fn = np_map.get(name, getattr(np, name, None))
+        op = getattr(paddle, name)
+        x = f32(3, 4) + 0.5
+        check_output(lambda t: op(t), lambda a: np_fn(a), [x], atol=1e-5)
+        check_grad(lambda t: op(t), [x.astype(np.float64)])
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("add", np.add), ("subtract", np.subtract),
+        ("multiply", np.multiply), ("divide", np.divide),
+        ("maximum", np.maximum), ("minimum", np.minimum),
+        ("pow", np.power),
+    ])
+    def test_forward_and_grad(self, name, np_fn):
+        op = getattr(paddle, name)
+        x, y = f32(3, 4) + 0.5, f32(3, 4) + 0.5
+        check_output(op, np_fn, [x, y])
+        if name not in ("maximum", "minimum"):  # kinks break numeric diff
+            check_grad(op, [x.astype(np.float64), y.astype(np.float64)])
+
+    def test_broadcast_grad(self):
+        x, y = f32(3, 4), f32(4)
+        check_grad(paddle.add, [x.astype(np.float64), y.astype(np.float64)])
+        check_grad(paddle.multiply,
+                   [x.astype(np.float64), y.astype(np.float64)])
+
+    def test_scalar_operand(self):
+        x = paddle.to_tensor(f32(2, 2), stop_gradient=False)
+        y = (2.0 * x + 1.0) / 2.0 - 0.5
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((2, 2)), rtol=1e-6)
+
+    def test_int_divide_promotes_to_float(self):
+        out = paddle.divide(paddle.to_tensor([3, 4]), paddle.to_tensor([2, 2]))
+        assert out.dtype == paddle.float32
+        np.testing.assert_allclose(out.numpy(), [1.5, 2.0])
+
+
+class TestReductions:
+    @pytest.mark.parametrize("name", ["sum", "mean", "max", "min", "prod"])
+    def test_forward(self, name):
+        x = f32(3, 4, 5)
+        op = getattr(paddle, name)
+        np_fn = getattr(np, name)
+        check_output(lambda t: op(t), lambda a: np_fn(a), [x])
+        check_output(lambda t: op(t, axis=1), lambda a: np_fn(a, axis=1), [x])
+        check_output(lambda t: op(t, axis=[0, 2], keepdim=True),
+                     lambda a: np_fn(a, axis=(0, 2), keepdims=True), [x])
+
+    def test_sum_grad(self):
+        check_grad(lambda t: paddle.sum(t, axis=1), [f32(3, 4).astype(np.float64)])
+
+    def test_mean_grad(self):
+        check_grad(lambda t: paddle.mean(t), [f32(3, 4).astype(np.float64)])
+
+    def test_argmax(self):
+        x = f32(3, 4)
+        assert paddle.argmax(paddle.to_tensor(x)).item() == np.argmax(x)
+        np.testing.assert_array_equal(
+            paddle.argmax(paddle.to_tensor(x), axis=1).numpy(),
+            np.argmax(x, axis=1))
+        assert paddle.argmax(paddle.to_tensor(x)).dtype == paddle.int64
+
+    def test_cumsum(self):
+        x = f32(3, 4)
+        check_output(lambda t: paddle.cumsum(t, axis=1),
+                     lambda a: np.cumsum(a, axis=1), [x])
+        check_grad(lambda t: paddle.cumsum(t, axis=0), [x.astype(np.float64)])
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as np_lse
+
+        x = f32(3, 4)
+        check_output(lambda t: paddle.logsumexp(t, axis=1),
+                     lambda a: np_lse(a, axis=1), [x])
+
+
+class TestManipulation:
+    def test_reshape_transpose_grad(self):
+        x = f32(3, 4).astype(np.float64)
+        check_grad(lambda t: paddle.reshape(t, [4, 3]), [x])
+        check_grad(lambda t: paddle.transpose(t, [1, 0]), [x])
+
+    def test_concat_stack_split(self):
+        x, y = f32(2, 3), f32(2, 3)
+        check_output(lambda a, b: paddle.concat([a, b], axis=0),
+                     lambda a, b: np.concatenate([a, b], axis=0), [x, y])
+        check_output(lambda a, b: paddle.stack([a, b], axis=1),
+                     lambda a, b: np.stack([a, b], axis=1), [x, y])
+        parts = paddle.split(paddle.to_tensor(x), [1, 2], axis=1)
+        assert parts[0].shape == [2, 1] and parts[1].shape == [2, 2]
+
+    def test_concat_grad(self):
+        x, y = f32(2, 3).astype(np.float64), f32(2, 3).astype(np.float64)
+        check_grad(lambda a, b: paddle.concat([a, b], axis=1), [x, y])
+
+    def test_gather(self):
+        x = f32(5, 3)
+        idx = np.array([0, 2, 4])
+        check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                     lambda a: a[idx], [x])
+        check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                   [x.astype(np.float64)])
+
+    def test_gather_nd(self):
+        x = f32(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]])
+        out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]], rtol=1e-6)
+
+    def test_scatter(self):
+        x = np.zeros((4, 2), np.float32)
+        idx = np.array([1, 3])
+        upd = np.ones((2, 2), np.float32)
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        expect = x.copy()
+        expect[idx] = upd
+        np.testing.assert_allclose(out.numpy(), expect)
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        x, y = f32(3), f32(3)
+        check_output(
+            lambda a, b: paddle.where(paddle.to_tensor(c), a, b),
+            lambda a, b: np.where(c, a, b), [x, y])
+        check_grad(
+            lambda a, b: paddle.where(paddle.to_tensor(c), a, b),
+            [x.astype(np.float64), y.astype(np.float64)])
+
+    def test_topk_sort(self):
+        x = f32(3, 5)
+        v, i = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+        np.testing.assert_allclose(v.numpy(), np.sort(x, axis=1)[:, ::-1][:, :2],
+                                   rtol=1e-6)
+        s = paddle.sort(paddle.to_tensor(x), axis=1, descending=True)
+        np.testing.assert_allclose(s.numpy(), np.sort(x, axis=1)[:, ::-1],
+                                   rtol=1e-6)
+
+    def test_pad(self):
+        x = f32(2, 3)
+        # flat all-dims form: [d0_before, d0_after, d1_before, d1_after]
+        out = paddle.pad(paddle.to_tensor(x), [1, 1, 2, 0], value=9.0)
+        assert out.shape == [4, 5]
+        assert out.numpy()[0, 0] == 9.0
+        np.testing.assert_allclose(out.numpy()[1:3, 2:], x, rtol=1e-6)
+
+    def test_tile_expand(self):
+        x = f32(1, 3)
+        assert paddle.tile(paddle.to_tensor(x), [2, 2]).shape == [2, 6]
+        assert paddle.expand(paddle.to_tensor(x), [4, 3]).shape == [4, 3]
+
+    def test_dynamic_ops_eager(self):
+        x = np.array([1.0, -1.0, 2.0, -2.0], np.float32)
+        nz = paddle.nonzero(paddle.to_tensor(x > 0))
+        np.testing.assert_array_equal(nz.numpy().ravel(), [0, 2])
+        m = paddle.masked_select(paddle.to_tensor(x),
+                                 paddle.to_tensor(x > 0))
+        np.testing.assert_allclose(m.numpy(), [1.0, 2.0])
+        u, counts = paddle.unique(paddle.to_tensor([1, 1, 2]),
+                                  return_counts=True)
+        np.testing.assert_array_equal(u.numpy(), [1, 2])
+        np.testing.assert_array_equal(counts.numpy(), [2, 1])
+
+    def test_cast(self):
+        x = paddle.to_tensor([1.7, 2.3])
+        assert paddle.cast(x, "int32").dtype == paddle.int32
+        assert x.astype(paddle.float16).dtype == paddle.float16
+        check_grad(lambda t: paddle.cast(t, "float32"),
+                   [f32(2, 2).astype(np.float64)], atol=1e-2)
+
+
+class TestLinalg:
+    def test_matmul_grad(self):
+        x, y = f32(3, 4).astype(np.float64), f32(4, 2).astype(np.float64)
+        check_output(paddle.matmul, np.matmul, [x, y], atol=1e-6)
+        check_grad(paddle.matmul, [x, y])
+
+    def test_matmul_transpose_flags(self):
+        x, y = f32(4, 3), f32(4, 2)
+        check_output(lambda a, b: paddle.matmul(a, b, transpose_x=True),
+                     lambda a, b: a.T @ b, [x, y], atol=1e-5)
+
+    def test_batched_matmul(self):
+        x, y = f32(5, 3, 4), f32(5, 4, 2)
+        check_output(paddle.bmm, np.matmul, [x, y], atol=1e-5)
+
+    def test_einsum(self):
+        x, y = f32(3, 4), f32(4, 5)
+        check_output(lambda a, b: paddle.einsum("ij,jk->ik", a, b),
+                     lambda a, b: a @ b, [x, y], atol=1e-5)
+
+    def test_norm(self):
+        x = f32(3, 4)
+        check_output(lambda t: paddle.norm(t),
+                     lambda a: np.linalg.norm(a), [x])
+        check_output(lambda t: paddle.norm(t, p=1, axis=1),
+                     lambda a: np.abs(a).sum(axis=1), [x])
+
+    def test_solve_inverse_det(self):
+        a = f32(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        b = f32(3, 2)
+        check_output(paddle.solve, np.linalg.solve, [a, b], atol=1e-4)
+        check_output(paddle.inverse, np.linalg.inv, [a], atol=1e-4)
+        check_output(paddle.det, np.linalg.det, [a], atol=1e-4)
+
+    def test_cholesky_svd(self):
+        m = f32(3, 3)
+        a = m @ m.T + 3 * np.eye(3, dtype=np.float32)
+        L = paddle.cholesky(paddle.to_tensor(a))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, a, atol=1e-4)
+        u, s, vt = paddle.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vt.numpy(), a, atol=1e-4)
+
+
+class TestLogic:
+    def test_comparisons(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        y = paddle.to_tensor([2.0, 2.0, 2.0])
+        np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+        np.testing.assert_array_equal((x == y).numpy(), [False, True, False])
+        np.testing.assert_array_equal((x >= y).numpy(), [False, True, True])
+
+    def test_allclose_equal_all(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        assert paddle.allclose(x, x).item()
+        assert paddle.equal_all(x, x).item()
+        assert not paddle.equal_all(x, x + 1).item()
+
+    def test_isnan_isinf(self):
+        x = paddle.to_tensor([1.0, float("nan"), float("inf")])
+        np.testing.assert_array_equal(paddle.isnan(x).numpy(),
+                                      [False, True, False])
+        np.testing.assert_array_equal(paddle.isinf(x).numpy(),
+                                      [False, False, True])
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], dtype="int32").dtype == paddle.int32
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        assert paddle.arange(5).dtype == paddle.int64
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        assert paddle.full([2, 2], 7).numpy().tolist() == [[7, 7], [7, 7]]
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+
+    def test_like_family(self):
+        x = paddle.to_tensor(f32(2, 3))
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.ones_like(x, dtype="int64").dtype == paddle.int64
+
+    def test_tril_triu(self):
+        x = f32(4, 4)
+        check_output(paddle.tril, np.tril, [x])
+        check_output(paddle.triu, np.triu, [x])
+        check_grad(lambda t: paddle.tril(t), [x.astype(np.float64)])
+
+    def test_one_hot(self):
+        out = paddle.one_hot(paddle.to_tensor([0, 2]), 3)
+        np.testing.assert_allclose(out.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([3, 4])
+        paddle.seed(7)
+        b = paddle.randn([3, 4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        assert paddle.rand([2, 2]).shape == [2, 2]
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))
+
+    def test_uniform_range(self):
+        u = paddle.uniform([1000], min=-2.0, max=3.0)
+        assert u.numpy().min() >= -2.0 and u.numpy().max() < 3.0
+
+
+class TestIndexing:
+    def test_getitem_grad(self):
+        x = f32(4, 5).astype(np.float64)
+        check_grad(lambda t: t[1:3, ::2], [x])
+
+    def test_tensor_index(self):
+        x = paddle.to_tensor(f32(5, 3))
+        idx = paddle.to_tensor([0, 4])
+        np.testing.assert_allclose(x[idx].numpy(), x.numpy()[[0, 4]])
+
+    def test_bool_mask(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        m = paddle.to_tensor(np.array([True, False, True]))
+        np.testing.assert_allclose(x[m].numpy(), [1.0, 3.0])
+
+    def test_setitem(self):
+        x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        x[1] = 5.0
+        assert x.numpy()[1].tolist() == [5.0] * 3
+        x[0, 0] = 1.0
+        assert x.numpy()[0, 0] == 1.0
+
+
+class TestTensorBasics:
+    def test_properties(self):
+        t = paddle.to_tensor(f32(2, 3, 4))
+        assert t.shape == [2, 3, 4]
+        assert t.ndim == 3
+        assert t.size == 24
+        assert t.numel() == 24
+        assert len(t) == 2
+        assert t.T.shape == [4, 3, 2]
+
+    def test_item_and_conversion(self):
+        t = paddle.to_tensor(3.5)
+        assert t.item() == 3.5
+        assert float(t) == 3.5
+        assert int(paddle.to_tensor(7)) == 7
+
+    def test_set_value_and_version(self):
+        t = paddle.to_tensor(np.zeros(3, np.float32))
+        v0 = t.inplace_version
+        t.set_value(np.ones(3, np.float32))
+        assert t.inplace_version == v0 + 1
+        np.testing.assert_allclose(t.numpy(), [1, 1, 1])
+
+    def test_default_dtype(self):
+        assert paddle.get_default_dtype() == paddle.float32
+        paddle.set_default_dtype("bfloat16")
+        try:
+            assert paddle.ones([1]).dtype == paddle.bfloat16
+        finally:
+            paddle.set_default_dtype("float32")
+
+
+class TestReviewRegressions:
+    def test_cummax_cummin_indices(self):
+        v, i = paddle.cummax(paddle.to_tensor([3.0, 1.0, 2.0, 5.0]))
+        assert v.numpy().tolist() == [3, 3, 3, 5]
+        assert i.numpy().tolist() == [0, 0, 0, 3]
+        v, i = paddle.cummin(paddle.to_tensor([[3.0, 1.0], [2.0, 5.0]]), axis=0)
+        assert i.numpy().tolist() == [[0, 0], [1, 0]]
+
+    def test_split_non_divisible_raises_chunk_allows(self):
+        with pytest.raises(ValueError):
+            paddle.split(paddle.ones([7]), 3)
+        shapes = [t.shape for t in paddle.chunk(paddle.ones([7]), 3)]
+        assert shapes == [[3], [3], [1]]
+
+    def test_unique_consecutive_axis(self):
+        u, inv = paddle.unique_consecutive(
+            paddle.to_tensor([[1, 1], [1, 1], [2, 3]]),
+            return_inverse=True, axis=0)
+        assert u.shape == [2, 2]
+        assert inv.numpy().tolist() == [0, 0, 1]
+
+    def test_eye_zero_columns(self):
+        assert paddle.eye(3, 0).shape == [3, 0]
+
+    def test_gumbel_softmax_hard_is_one_hot(self):
+        g = paddle.gumbel_softmax(paddle.to_tensor([[1.0, 5.0, 2.0]]),
+                                  hard=True)
+        assert abs(g.numpy().sum() - 1.0) < 1e-5
+
+    def test_rng_tracker_stable_across_reset(self):
+        from paddle_tpu.core.generator import get_rng_tracker
+
+        tr = get_rng_tracker()
+        if "test_stream" not in tr.states_:
+            tr.add("test_stream", 0)
+        paddle.seed(123)
+        s1 = tr.states_["test_stream"].initial_seed()
+        paddle.seed(123)
+        s2 = tr.states_["test_stream"].initial_seed()
+        assert s1 == s2
