@@ -47,6 +47,8 @@ from . import autograd  # noqa: E402
 from .autograd import grad  # noqa: E402
 
 from . import nn  # noqa: E402
+from .nn.layer_base import ParamAttr  # noqa: E402
+from . import regularizer  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import amp  # noqa: E402
 from . import io  # noqa: E402

@@ -1,0 +1,10 @@
+"""paddle_tpu.amp — mirrors python/paddle/amp."""
+from .auto_cast import (  # noqa: F401
+    amp_decorate, amp_guard, auto_cast, black_list, decorate,
+    get_amp_dtype, is_auto_cast_enabled, white_list,
+)
+from .grad_scaler import AmpScaler, GradScaler, OptimizerState  # noqa: F401
+from . import debugging  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate",
+           "GradScaler", "AmpScaler", "debugging"]

@@ -1,0 +1,162 @@
+"""Automatic mixed precision — autocast.
+
+TPU-native equivalent of the reference's AMP (reference:
+python/paddle/amp/auto_cast.py:703 ``auto_cast``, ``amp_guard:273``;
+op lists python/paddle/amp/amp_lists.py:28). bf16-first: TPU matmuls are
+natively bf16 on the MXU and need no loss scaling; fp16 is kept for parity.
+
+O1: per-op cast at dispatch time (white list → low precision, black list →
+float32). O2: ``decorate`` casts the model's params (minus norms) to the
+target dtype; optimizers keep fp32 master weights via ``multi_precision``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate",
+           "white_list", "black_list", "is_auto_cast_enabled",
+           "get_amp_dtype"]
+
+# reference amp_lists.py:28 — ops that benefit from low precision (matmul /
+# conv MXU ops) vs ops needing fp32 accumulation (softmax/norm/exp/log).
+WHITE_LIST: Set[str] = {
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "matmul", "mm", "bmm", "linear", "einsum",
+    "scaled_dot_product_attention", "addmm",
+}
+BLACK_LIST: Set[str] = {
+    "exp", "expm1", "log", "log2", "log10", "log1p", "square", "pow",
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "kl_div", "bce_with_logits", "binary_cross_entropy",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
+    "mean", "sum", "cumsum", "logsumexp", "softmax_with_cross_entropy",
+    "erf", "erfinv", "cos_sim", "sigmoid_focal_loss", "normalize",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white: Set[str] = set()
+        self.custom_black: Set[str] = set()
+        # effective sets precomputed on auto_cast entry (dispatch hot path)
+        self.eff_white: Set[str] = WHITE_LIST
+        self.eff_black: Set[str] = BLACK_LIST
+
+    def recompute(self):
+        self.eff_white = (WHITE_LIST | self.custom_white) - self.custom_black
+        self.eff_black = (BLACK_LIST | self.custom_black) - self.custom_white
+
+
+_STATE = _AmpState()
+
+
+def is_auto_cast_enabled() -> bool:
+    return _STATE.enabled
+
+
+def get_amp_dtype():
+    return _STATE.dtype
+
+
+def white_list() -> Set[str]:
+    return _STATE.eff_white
+
+
+def black_list() -> Set[str]:
+    return _STATE.eff_black
+
+
+def _amp_cast_arrays(op_name: str, arrays):
+    """Dispatch-time cast hook; no-op when autocast is off."""
+    if not _STATE.enabled:
+        return arrays
+    target = None
+    if op_name in _STATE.eff_white:
+        target = _STATE.dtype
+    elif op_name in _STATE.eff_black:
+        target = jnp.float32
+    if target is None:
+        return arrays
+    out = []
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != target and \
+                a.dtype != jnp.float64:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """``paddle.amp.auto_cast`` parity (auto_cast.py:703)."""
+    prev = (_STATE.enabled, _STATE.dtype, _STATE.level,
+            _STATE.custom_white, _STATE.custom_black,
+            _STATE.eff_white, _STATE.eff_black)
+    _STATE.enabled = bool(enable)
+    _STATE.dtype = jnp.float16 if str(dtype) in ("float16", "fp16") \
+        else jnp.bfloat16
+    _STATE.level = level
+    _STATE.custom_white = set(custom_white_list or ())
+    _STATE.custom_black = set(custom_black_list or ())
+    _STATE.recompute()
+    try:
+        yield
+    finally:
+        (_STATE.enabled, _STATE.dtype, _STATE.level,
+         _STATE.custom_white, _STATE.custom_black,
+         _STATE.eff_white, _STATE.eff_black) = prev
+
+
+amp_guard = auto_cast
+
+_NORM_LAYER_NAMES = ("BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+                     "SyncBatchNorm", "RMSNorm")
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decorate (reference auto_cast.py ``amp_decorate``): cast params to
+    the low-precision dtype except normalization layers; enable fp32 master
+    weights on the optimizer."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level not in ("O1", "O2"):
+        raise ValueError("level must be O1 or O2")
+    if level == "O2":
+        for m in model_list:
+            _cast_model_to(m, dtype)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for o in opt_list:
+            o._multi_precision = True
+        if single_model and single_opt:
+            return model_list[0], opt_list[0]
+        return model_list, opt_list
+    return model_list[0] if single_model else model_list
+
+
+amp_decorate = decorate
+
+
+def _cast_model_to(layer, dtype):
+    from ..core.dtype import convert_dtype
+
+    np_dt = convert_dtype(dtype).np_dtype
+    for _, sub in layer.named_sublayers(include_self=True):
+        if type(sub).__name__.startswith(_NORM_LAYER_NAMES):
+            continue
+        for p in sub._parameters.values():
+            if p is not None and jnp.issubdtype(p._data.dtype, jnp.floating):
+                p._rebind(p._data.astype(np_dt))
+    layer._casted_by_pure_fp16 = True
+    return layer

@@ -1,0 +1,82 @@
+"""AMP debugging utilities (reference: python/paddle/amp/debugging.py —
+tensor stat collection, nan/inf op tracking via FLAGS_check_nan_inf)."""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core.flags import get_flags, set_flags
+from ..core.tensor import Tensor
+
+__all__ = ["enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "enable_tensor_checker", "disable_tensor_checker",
+           "check_numerics", "TensorCheckerConfig"]
+
+_op_stats = {"enabled": False, "counts": {}}
+
+
+def enable_operator_stats_collection():
+    _op_stats["enabled"] = True
+    _op_stats["counts"] = {}
+
+
+def disable_operator_stats_collection():
+    _op_stats["enabled"] = False
+    counts = _op_stats["counts"]
+    if counts:
+        print("<------------------------------------------------------->")
+        print("Op list with dtype counts:")
+        for k, v in sorted(counts.items()):
+            print(f"  {k}: {v}")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def _record_op(op_name: str, dtype) -> None:
+    if _op_stats["enabled"]:
+        key = f"{op_name}<{dtype}>"
+        _op_stats["counts"][key] = _op_stats["counts"].get(key, 0) + 1
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def enable_tensor_checker(config: TensorCheckerConfig = None):
+    set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.sum(jnp.isnan(arr)))
+    num_inf = int(jnp.sum(jnp.isinf(arr)))
+    stats = {
+        "num_nan": num_nan,
+        "num_inf": num_inf,
+        "min": float(jnp.min(arr)) if arr.size else 0.0,
+        "max": float(jnp.max(arr)) if arr.size else 0.0,
+        "mean": float(jnp.mean(arr)) if arr.size else 0.0,
+    }
+    if num_nan or num_inf:
+        print(f"[check_numerics] op={op_type} var={var_name} stats={stats}")
+    return Tensor(jnp.asarray(num_nan)), Tensor(jnp.asarray(num_inf))

@@ -123,6 +123,11 @@ class GradNode:
                 else:
                     # integer/bool outputs carry float0 cotangents in JAX
                     g = _np.zeros(shape, _jax.dtypes.float0)
+            elif jnp.issubdtype(dt, jnp.inexact) and g.dtype != dt:
+                # mixed-precision graphs (AMP O1): a consumer may return a
+                # cotangent in its own compute dtype; vjp demands the
+                # producer's output dtype
+                g = g.astype(dt)
             cots.append(g)
         return tuple(cots)
 

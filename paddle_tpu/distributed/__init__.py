@@ -1,0 +1,8 @@
+"""paddle_tpu.distributed — mirrors python/paddle/distributed.
+
+Built out incrementally; env/rank plumbing first, then collectives, mesh
+sharding, fleet, and parallel wrappers (SURVEY.md §2.3 inventory).
+"""
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+
+__all__ = ["ParallelEnv", "get_rank", "get_world_size"]

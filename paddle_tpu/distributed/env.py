@@ -1,0 +1,82 @@
+"""Distributed environment / rank bookkeeping.
+
+TPU-native equivalent of the reference's env plumbing (reference:
+python/paddle/distributed/parallel.py — ``ParallelEnv`` reads
+``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM`` set by the launcher).
+Under JAX multi-host, process_index/process_count are authoritative once
+``jax.distributed`` is initialized; env vars seed the pre-init view.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["ParallelEnv", "get_rank", "get_world_size"]
+
+_initialized = False
+
+
+def _mark_initialized():
+    global _initialized
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    try:
+        import jax
+
+        if _initialized:
+            return jax.process_index()
+    except Exception:
+        pass
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.world_size
+    try:
+        import jax
+
+        if _initialized:
+            return jax.process_count()
+    except Exception:
+        pass
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+class ParallelEnv:
+    """reference: parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus",
+                                  os.environ.get("FLAGS_selected_gpus", "0")))
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        r = self.rank
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nrings(self):
+        return 1

@@ -1,0 +1,18 @@
+"""paddle_tpu.io — mirrors python/paddle/io."""
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .dataset import (  # noqa: F401
+    ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
+    Subset, TensorDataset, random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
+    SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
+)
+
+__all__ = [
+    "DataLoader", "Dataset", "IterableDataset", "TensorDataset",
+    "ComposeDataset", "ChainDataset", "ConcatDataset", "Subset",
+    "random_split", "Sampler", "SequenceSampler", "RandomSampler",
+    "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
+    "SubsetRandomSampler", "default_collate_fn", "get_worker_info",
+]

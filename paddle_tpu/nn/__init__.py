@@ -1,0 +1,29 @@
+"""paddle_tpu.nn — neural network layers.
+
+Mirrors the reference's python/paddle/nn package surface.
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer_base import (  # noqa: F401
+    Layer, LayerDict, LayerList, ParamAttr, ParameterList, Sequential,
+)
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)
+from .layers.activation import *  # noqa: F401,F403
+from .layers.common import *  # noqa: F401,F403
+from .layers.conv import *  # noqa: F401,F403
+from .layers.loss import *  # noqa: F401,F403
+from .layers.norm import *  # noqa: F401,F403
+from .layers.pooling import *  # noqa: F401,F403
+from .layers.rnn import *  # noqa: F401,F403
+from .layers.transformer import *  # noqa: F401,F403
+
+from .layers import (  # noqa: F401
+    activation, common, conv, loss, norm, pooling, rnn, transformer,
+)
+
+# `paddle.nn.layer` namespace alias (reference keeps layers under nn.layer)
+from . import layers as layer  # noqa: F401
+
+from . import utils  # noqa: F401

@@ -1,0 +1,25 @@
+"""paddle_tpu.nn.functional — functional op surface.
+
+Mirrors the reference's python/paddle/nn/functional package.
+"""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+
+from . import (  # noqa: F401
+    activation, attention, common, conv, loss, norm, pooling,
+)
+
+# flash_attention module alias for `from paddle.nn.functional import
+# flash_attention` style imports used by reference models
+flash_attention_mod = attention
+
+__all__ = (
+    list(activation.__all__) + list(common.__all__) + list(conv.__all__)
+    + list(pooling.__all__) + list(norm.__all__) + list(loss.__all__)
+    + list(attention.__all__)
+)

@@ -1,0 +1,187 @@
+"""Activation functionals.
+
+TPU-native equivalent of the reference's activation ops
+(reference: python/paddle/nn/functional/activation.py backed by PHI
+activation kernels, paddle/phi/kernels/activation_kernel.h). Each op is a
+pure jnp function dispatched through the eager tape; XLA fuses these into
+neighbouring matmuls so no hand-written kernels are needed on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import defun, eager_apply, as_tensor_args
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "sigmoid", "log_sigmoid", "silu",
+    "swish", "mish", "softmax", "softmax_", "log_softmax", "softplus",
+    "softshrink", "hardshrink", "tanhshrink", "hardsigmoid", "hardswish",
+    "hardtanh", "leaky_relu", "elu", "elu_", "celu", "selu", "prelu", "rrelu",
+    "glu", "tanh", "tanh_", "maxout", "softsign", "thresholded_relu",
+    "swiglu",
+]
+
+
+def _unary(name, raw):
+    return defun(name, n_tensor_args=1)(raw)
+
+
+relu = _unary("relu", lambda x: jax.nn.relu(x))
+relu6 = _unary("relu6", lambda x: jnp.clip(x, 0.0, 6.0))
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+silu = _unary("silu", jax.nn.silu)
+tanh = _unary("tanh", jnp.tanh)
+softsign = _unary("softsign", jax.nn.soft_sign)
+tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
+mish = _unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+
+
+@defun("gelu", n_tensor_args=1)
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@defun("swish", n_tensor_args=1)
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@defun("softmax", n_tensor_args=1)
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        from ...core.dtype import convert_dtype
+        x = x.astype(convert_dtype(dtype).np_dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax_(x, axis=-1, dtype=None):
+    out = softmax(x, axis=axis, dtype=dtype)
+    x._rebind(out._data, out._grad_node, out._out_idx)
+    return x
+
+
+@defun("log_softmax", n_tensor_args=1)
+def log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        from ...core.dtype import convert_dtype
+        x = x.astype(convert_dtype(dtype).np_dtype)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@defun("softplus", n_tensor_args=1)
+def softplus(x, beta=1.0, threshold=20.0):
+    scaled = x * beta
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+@defun("softshrink", n_tensor_args=1)
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@defun("hardshrink", n_tensor_args=1)
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@defun("hardsigmoid", n_tensor_args=1)
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+@defun("hardswish", n_tensor_args=1)
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@defun("hardtanh", n_tensor_args=1)
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@defun("leaky_relu", n_tensor_args=1)
+def leaky_relu(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@defun("elu", n_tensor_args=1)
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def elu_(x, alpha=1.0):
+    out = elu(x, alpha=alpha)
+    x._rebind(out._data, out._grad_node, out._out_idx)
+    return x
+
+
+@defun("celu", n_tensor_args=1)
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+@defun("selu", n_tensor_args=1)
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defun("thresholded_relu", n_tensor_args=1)
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def raw(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format.startswith("NC") and a.ndim > 1 else a.ndim - 1
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a >= 0, a, wb * a)
+
+    return eager_apply("prelu", raw, as_tensor_args(x, weight))
+
+
+@defun("rrelu", n_tensor_args=1)
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True):
+    # eval-mode (deterministic) slope; training mode draws handled by caller
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@defun("glu", n_tensor_args=1)
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@defun("swiglu", n_tensor_args=-1)
+def swiglu(x, y=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+@defun("maxout", n_tensor_args=1)
+def maxout(x, groups, axis=1):
+    ax = axis if axis >= 0 else x.ndim + axis
+    c = x.shape[ax]
+    new_shape = x.shape[:ax] + (c // groups, groups) + x.shape[ax + 1:]
+    return jnp.max(x.reshape(new_shape), axis=ax + 1)
+
+
+def relu_(x):
+    out = relu(x)
+    x._rebind(out._data, out._grad_node, out._out_idx)
+    return x
+
+
+def tanh_(x):
+    out = tanh(x)
+    x._rebind(out._data, out._grad_node, out._out_idx)
+    return x

@@ -1,0 +1,163 @@
+"""Attention functionals: SDPA + flash attention.
+
+TPU-native equivalent of the reference's attention surface (reference:
+python/paddle/nn/functional/flash_attention.py:146 ``flash_attention``,
+``scaled_dot_product_attention``; CUDA FA2 via phi/backends/dynload/flashattn.h
+and the memory-efficient cutlass kernel). Here the hot path is the Pallas
+TPU flash-attention kernel (tiled online-softmax over VMEM blocks feeding
+the MXU); off-TPU we fall back to XLA's fused ``jax.nn.dot_product_attention``
+so the same API runs everywhere (the fake-device test precedent, SURVEY §4).
+
+Layout: paddle convention [batch, seqlen, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...core.generator import default_generator
+from ...ops.dispatch import eager_apply, as_tensor_args
+
+__all__ = [
+    "scaled_dot_product_attention", "flash_attention",
+    "flash_attn_unpadded", "sdp_kernel",
+]
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _pallas_flash(q, k, v, causal: bool, scale: float):
+    """[b, s, h, d] in/out; pallas kernel wants [b, h, s, d]."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _fa,
+    )
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _fa(qt, kt, vt, causal=causal, sm_scale=scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _xla_attention(q, k, v, bias, causal: bool, scale: float):
+    return jax.nn.dot_product_attention(
+        q, k, v, bias=bias, is_causal=causal, scale=scale)
+
+
+def _attention_raw(q, k, v, *maybe_mask, causal=False, scale=None,
+                   dropout_p=0.0, dropout_key=None):
+    head_dim = q.shape[-1]
+    scale = scale if scale is not None else head_dim ** -0.5
+    bias = maybe_mask[0] if maybe_mask else None
+    if bias is not None and bias.dtype == jnp.bool_:
+        bias = jnp.where(bias, 0.0, jnp.finfo(q.dtype).min).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        # dropout on attention weights → fall back to explicit softmax path
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if bias is not None:
+            logits = logits + (bias if bias.ndim == 4 else bias[:, None])
+        if causal:
+            s_q, s_k = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        w = jax.nn.softmax(logits, axis=-1)
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, w.shape)
+        w = w * keep.astype(w.dtype) / (1.0 - dropout_p)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    if _on_tpu() and bias is None and head_dim % 128 == 0 \
+            and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0:
+        return _pallas_flash(q, k, v, causal, scale)
+    return _xla_attention(q, k, v, bias, causal, scale)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    tensors = as_tensor_args(*((query, key, value, attn_mask)
+                               if attn_mask is not None
+                               else (query, key, value)))
+    dkey = default_generator().next_key() if (dropout_p > 0.0 and training) else None
+
+    def raw(*arrs):
+        return _attention_raw(
+            *arrs, causal=is_causal,
+            dropout_p=dropout_p if training else 0.0, dropout_key=dkey)
+
+    return eager_apply("scaled_dot_product_attention", raw, tensors)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """Paddle flash_attention parity (flash_attention.py:146): returns
+    (out, softmax) — softmax is None unless return_softmax (debug-only in the
+    reference; unsupported here as flash never materialises it)."""
+    if return_softmax:
+        raise NotImplementedError(
+            "return_softmax materialises the attention matrix — unsupported "
+            "by the flash path (reference only supports it in debug mode)")
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen flash attention (reference flash_attention.py:302).
+
+    TPU-native treatment: varlen batches are segment-masked dense batches
+    (dynamic shapes would defeat XLA); we reconstruct the segment mask from
+    cu_seqlens and run the dense kernel with masking.
+    """
+    tensors = as_tensor_args(query, key, value)
+    cu_q = jnp.asarray(cu_seqlens_q._data if hasattr(cu_seqlens_q, "_data")
+                       else cu_seqlens_q)
+    cu_k = jnp.asarray(cu_seqlens_k._data if hasattr(cu_seqlens_k, "_data")
+                       else cu_seqlens_k)
+
+    def raw(q, k, v):
+        # q: [total_q, h, d] packed; build per-token segment ids
+        total_q, h, d = q.shape
+        total_k = k.shape[0]
+        pos_q = jnp.arange(total_q)
+        pos_k = jnp.arange(total_k)
+        seg_q = jnp.searchsorted(cu_q[1:], pos_q, side="right")
+        seg_k = jnp.searchsorted(cu_k[1:], pos_k, side="right")
+        mask = seg_q[:, None] == seg_k[None, :]
+        logits = jnp.einsum("qhd,khd->hqk", q, k) * scale
+        if causal:
+            off_q = pos_q - cu_q[seg_q]
+            off_k = pos_k - cu_k[seg_k]
+            mask = mask & (off_q[:, None] >= off_k[None, :])
+        logits = jnp.where(mask[None], logits, jnp.finfo(logits.dtype).min)
+        w = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("hqk,khd->qhd", w, v)
+
+    out = eager_apply("flash_attn_unpadded", raw, tensors)
+    return out, None
+
+
+class sdp_kernel:
+    """Context selecting attention backends (paddle/torch-compat no-op:
+    backend choice is automatic — pallas on TPU, XLA elsewhere)."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

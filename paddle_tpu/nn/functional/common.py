@@ -1,0 +1,346 @@
+"""Common functionals: linear, dropout, embedding, padding, resizing.
+
+TPU-native equivalent of the reference's common functional ops
+(reference: python/paddle/nn/functional/common.py, input.py — linear via
+matmul_v2 kernel, dropout kernel with seeded mask, embedding lookup).
+Dropout draws its key from the framework's stateful Generator (respecting
+the TP RNGStatesTracker), keeping the reference's dropout-determinism
+semantics across model-parallel ranks.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.generator import default_generator
+from ...core.tensor import Tensor
+from ...ops.dispatch import defun, eager_apply, as_tensor_args
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "pad", "zeropad2d", "interpolate", "upsample",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
+    "cosine_similarity", "bilinear", "label_smooth", "class_center_sample",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b); W is [in, out] per paddle convention — a single MXU
+    matmul with XLA-fused bias add."""
+    if bias is None:
+        return eager_apply(
+            "linear", lambda a, w: jnp.matmul(a, w), as_tensor_args(x, weight))
+    return eager_apply(
+        "linear", lambda a, w, b: jnp.matmul(a, w) + b,
+        as_tensor_args(x, weight, bias))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return eager_apply("dropout_scale", lambda a: a * (1.0 - p),
+                              as_tensor_args(x))
+        return x
+    if p == 1.0:
+        return eager_apply("dropout", lambda a: jnp.zeros_like(a),
+                          as_tensor_args(x))
+    key = default_generator().next_key()
+    t = as_tensor_args(x)[0]
+    shape = list(t._data.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        mask_shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    else:
+        mask_shape = shape
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(mask_shape))
+
+    def raw(a):
+        m = keep.astype(a.dtype)
+        if mode == "upscale_in_train":
+            return a * m / (1.0 - p)
+        return a * m
+
+    return eager_apply("dropout", raw, [t])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = default_generator().next_key()
+    t = as_tensor_args(x)[0]
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(t._data.shape))
+    a_coef = (1.0 - p + p * alpha_p ** 2) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+
+    def raw(arr):
+        m = keep
+        return a_coef * jnp.where(m, arr, alpha_p) + b_coef
+
+    return eager_apply("alpha_dropout", raw, [t])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def raw(w, ids):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    # weight first so its gradient flows (ids are integer → non-diff)
+    return eager_apply("embedding", raw, as_tensor_args(weight, x))
+
+
+@defun("one_hot", n_tensor_args=1)
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x.astype(jnp.int32), num_classes, dtype=jnp.float32)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    t = as_tensor_args(x)[0]
+    nd = t.ndim
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    if len(pad) == 2 * nd:
+        # full-form paddle order: [d0_lo, d0_hi, d1_lo, d1_hi, ...]
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial form applies to trailing spatial dims (NCHW: reversed pairs
+        # like torch — paddle uses [left,right,top,bottom] for 4D)
+        n_sp = len(pad) // 2
+        pairs = [(0, 0)] * (nd - n_sp)
+        sp = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_sp)]
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            pairs = [(0, 0), (0, 0)] + sp[::-1]
+        else:
+            pairs = [(0, 0)] + sp[::-1] + [(0, 0)]
+
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def raw(a):
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode="constant", constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+
+    return eager_apply("pad", raw, [t])
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    t = as_tensor_args(x)[0]
+    if data_format[-1] == "C" and len(data_format) > 2:
+        raise NotImplementedError("interpolate supports NC... layouts")
+    n_sp = t.ndim - 2
+    in_sp = t._data.shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sp = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * n_sp))
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * n_sp
+        out_sp = tuple(int(np.floor(in_sp[i] * float(sf[i]))) for i in range(n_sp))
+
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "area"}[mode]
+
+    def raw(a):
+        out_shape = a.shape[:2] + out_sp
+        if method == "area":
+            # adaptive mean over source bins (paddle/torch 'area' semantics)
+            r = a
+            for i in range(n_sp):
+                axis = 2 + i
+                in_s, out_s = in_sp[i], out_sp[i]
+                if in_s == out_s:
+                    continue
+                if in_s % out_s == 0:
+                    k = in_s // out_s
+                    new_shape = r.shape[:axis] + (out_s, k) + r.shape[axis + 1:]
+                    r = jnp.mean(r.reshape(new_shape), axis=axis + 1)
+                else:
+                    starts = np.floor(np.arange(out_s) * in_s / out_s).astype(int)
+                    ends = np.ceil((np.arange(out_s) + 1) * in_s / out_s).astype(int)
+                    pieces = [
+                        jnp.mean(jax.lax.slice_in_dim(r, s, e, axis=axis),
+                                 axis=axis, keepdims=True)
+                        for s, e in zip(starts, ends)]
+                    r = jnp.concatenate(pieces, axis=axis)
+            return r
+        if method == "nearest":
+            idxs = [
+                jnp.floor(jnp.arange(out_sp[i]) * in_sp[i] / out_sp[i]).astype(jnp.int32)
+                for i in range(n_sp)]
+            r = a
+            for i, idx in enumerate(idxs):
+                r = jnp.take(r, idx, axis=2 + i)
+            return r
+        if align_corners:
+            # jax.image has no align_corners; gather-based linear resize
+            r = a
+            for i in range(n_sp):
+                out_s, in_s = out_sp[i], in_sp[i]
+                if out_s == 1 or in_s == 1:
+                    pos = jnp.zeros(out_s)
+                else:
+                    pos = jnp.arange(out_s) * (in_s - 1) / (out_s - 1)
+                lo = jnp.floor(pos).astype(jnp.int32)
+                hi = jnp.clip(lo + 1, 0, in_s - 1)
+                w = (pos - lo).astype(a.dtype)
+                ax = 2 + i
+                shape = [1] * r.ndim
+                shape[ax] = out_s
+                wv = w.reshape(shape)
+                r = jnp.take(r, lo, axis=ax) * (1 - wv) + jnp.take(r, hi, axis=ax) * wv
+            return r
+        return jax.image.resize(a, out_shape, method=method)
+
+    return eager_apply("interpolate", raw, [t])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+@defun("pixel_shuffle", n_tensor_args=1)
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    y = x.reshape(n, oc, r, r, h, w)
+    y = jnp.transpose(y, (0, 1, 4, 2, 5, 3))
+    return y.reshape(n, oc, h * r, w * r)
+
+
+@defun("pixel_unshuffle", n_tensor_args=1)
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // r, r, w // r, r)
+    y = jnp.transpose(y, (0, 1, 3, 5, 2, 4))
+    return y.reshape(n, c * r * r, h // r, w // r)
+
+
+@defun("channel_shuffle", n_tensor_args=1)
+def channel_shuffle(x, groups, data_format="NCHW"):
+    n, c, h, w = x.shape
+    y = x.reshape(n, groups, c // groups, h, w)
+    y = jnp.transpose(y, (0, 2, 1, 3, 4))
+    return y.reshape(n, c, h, w)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _tuplize
+    k = _tuplize(kernel_sizes, 2)
+    s = _tuplize(strides, 2)
+    p = _tuplize(paddings, 2)
+    d = _tuplize(dilations, 2)
+
+    def raw(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patch = a_p[:, :,
+                            i * d[0]: i * d[0] + (oh - 1) * s[0] + 1: s[0],
+                            j * d[1]: j * d[1] + (ow - 1) * s[1] + 1: s[1]]
+                cols.append(patch.reshape(n, c, oh * ow))
+        # [N, C*kh*kw, L] with channel-major ordering like the reference
+        stacked = jnp.stack(cols, axis=2)  # [N, C, kh*kw, L]
+        return stacked.reshape(n, c * k[0] * k[1], oh * ow)
+
+    return eager_apply("unfold", raw, as_tensor_args(x))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    from .conv import _tuplize
+    out_sz = _tuplize(output_sizes, 2)
+    k = _tuplize(kernel_sizes, 2)
+    s = _tuplize(strides, 2)
+    p = _tuplize(paddings, 2)
+    d = _tuplize(dilations, 2)
+
+    def raw(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_sz[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out_sz[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        a_r = a.reshape(n, c, k[0], k[1], oh, ow)
+        h_p, w_p = out_sz[0] + 2 * p[0], out_sz[1] + 2 * p[1]
+        out = jnp.zeros((n, c, h_p, w_p), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :,
+                             i * d[0]: i * d[0] + (oh - 1) * s[0] + 1: s[0],
+                             j * d[1]: j * d[1] + (ow - 1) * s[1] + 1: s[1]
+                             ].add(a_r[:, :, i, j])
+        return out[:, :, p[0]: p[0] + out_sz[0], p[1]: p[1] + out_sz[1]]
+
+    return eager_apply("fold", raw, as_tensor_args(x))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def raw(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return eager_apply("cosine_similarity", raw, as_tensor_args(x1, x2))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    has_b = bias is not None
+    tensors = as_tensor_args(*((x1, x2, weight, bias) if has_b else (x1, x2, weight)))
+
+    def raw(a, b, w, *mb):
+        # w: [out, in1, in2]
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if mb:
+            out = out + mb[0]
+        return out
+
+    return eager_apply("bilinear", raw, tensors)
+
+
+@defun("label_smooth", n_tensor_args=1)
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample is a PLSC-specific op; not yet implemented")

@@ -1,0 +1,188 @@
+"""Convolution functionals.
+
+TPU-native equivalent of the reference's conv ops (reference:
+python/paddle/nn/functional/conv.py → phi/kernels/conv_kernel.h, gpudnn
+impls). Built on ``jax.lax.conv_general_dilated`` which XLA maps straight
+onto the MXU; NCHW semantics are kept for API parity and XLA handles the
+layout assignment for TPU (internally NHWC).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.dispatch import eager_apply, as_tensor_args
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(v) * n
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    """Paddle padding: int, list[int] (per-dim), list of pairs, or SAME/VALID."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # may include batch/channel dims (NCHW full-form) — strip them
+        if len(padding) == n + 2:
+            padding = padding[2:]
+        return [tuple(p) for p in padding]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv_nd(n, x, weight, bias, stride, padding, dilation, groups, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    pad = _padding(padding, n)
+    lhs_dn, rhs_dn, out_dn = _dim_numbers(n, channel_last)
+
+    def raw(a, w, *maybe_bias):
+        # weight layout is paddle's [out_c, in_c/groups, *k]; transpose for
+        # channel-last rhs spec
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w_t = jnp.transpose(w, perm)
+        else:
+            w_t = w
+        out = lax.conv_general_dilated(
+            a, w_t, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=(lhs_dn, rhs_dn, out_dn),
+            preferred_element_type=None)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.size
+            out = out + b.reshape(shape)
+        return out
+
+    tensors = as_tensor_args(*( (x, weight, bias) if bias is not None else (x, weight) ))
+    return eager_apply(f"conv{n}d", raw, tensors)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_nd(1, x, weight, bias, stride, padding, dilation, groups, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(2, x, weight, bias, stride, padding, dilation, groups,
+                    data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(3, x, weight, bias, stride, padding, dilation, groups,
+                    data_format)
+
+
+def _conv_transpose_nd(n, x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    out_padding = _tuplize(output_padding, n)
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        pad_pairs = [(0, 0)] * n if pad == "VALID" else None
+    else:
+        pad_pairs = pad
+    lhs_dn, rhs_dn, out_dn = _dim_numbers(n, channel_last)
+
+    def raw(a, w, *maybe_bias):
+        # paddle conv_transpose weight layout: [in_c, out_c/groups, *k]
+        k = w.shape[2:]
+        if pad_pairs is None:  # SAME
+            tp = "SAME"
+        else:
+            # standard transpose-conv padding transform:
+            # lhs_dilation=stride, pad_lo = dil*(k-1) - pad_lo
+            tp = [
+                (dilation[i] * (k[i] - 1) - pad_pairs[i][0],
+                 dilation[i] * (k[i] - 1) - pad_pairs[i][1] + out_padding[i])
+                for i in range(n)
+            ]
+        if groups > 1:
+            # grouped transpose: [in_c, oc/g, *k] -> [oc, ic/g, *k] blockwise
+            ic = w.shape[0]
+            ocg = w.shape[1]
+            wg = w.reshape((groups, ic // groups, ocg) + k)
+            wg = jnp.flip(wg, axis=tuple(range(3, 3 + n)))
+            wg = jnp.swapaxes(wg, 1, 2)  # [g, oc/g, ic/g, *k]
+            w_oihw = wg.reshape((groups * ocg, ic // groups) + k)
+        else:
+            w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+            w_oihw = jnp.swapaxes(w_flip, 0, 1)  # [out_c, in_c, *k]
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w_rhs = jnp.transpose(w_oihw, perm)
+        else:
+            w_rhs = w_oihw
+        out = lax.conv_general_dilated(
+            a, w_rhs, window_strides=(1,) * n, padding=tp,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=(lhs_dn, rhs_dn, out_dn))
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.size
+            out = out + b.reshape(shape)
+        return out
+
+    tensors = as_tensor_args(*((x, weight, bias) if bias is not None else (x, weight)))
+    return eager_apply(f"conv{n}d_transpose", raw, tensors)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose_nd(1, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(2, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(3, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              output_size)

@@ -1,0 +1,365 @@
+"""Loss functionals.
+
+TPU-native equivalent of the reference's loss ops (reference:
+python/paddle/nn/functional/loss.py → phi cross_entropy /
+softmax_with_cross_entropy kernels). Label-index cross entropy uses
+one-hot-free gather of log-probs (XLA lowers take_along_axis efficiently);
+reductions follow paddle semantics ('none' | 'mean' | 'sum').
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import eager_apply, as_tensor_args
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "square_error_cost",
+    "log_loss", "sigmoid_focal_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    has_w = weight is not None
+    tensors = as_tensor_args(*((input, label, weight) if has_w
+                               else (input, label)))
+
+    def raw(logits, lab, *maybe_w):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+            else jnp.log(jnp.clip(logits, 1e-10))
+        nclass = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+            per = -jnp.sum(soft * logp, axis=axis)
+            return _reduce(per, reduction)
+        ids = lab.astype(jnp.int32)
+        squeeze = False
+        if ids.ndim == logp.ndim:
+            ids = jnp.squeeze(ids, axis=axis)
+            squeeze = True
+        safe_ids = jnp.where(ids == ignore_index, 0, ids)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe_ids, axis), axis=axis)
+        per = -jnp.squeeze(picked, axis)
+        if label_smoothing > 0.0:
+            smooth_term = -jnp.mean(logp, axis=axis)
+            per = (1 - label_smoothing) * per + label_smoothing * smooth_term
+        valid = ids != ignore_index
+        if has_w:
+            w = maybe_w[0][safe_ids]
+            per = per * w
+            per = jnp.where(valid, per, 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(valid, w, 0.0))
+                return jnp.sum(per) / jnp.maximum(denom, 1e-12)
+            return _reduce(per, reduction)
+        per = jnp.where(valid, per, 0.0)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+            return jnp.sum(per) / denom
+        return _reduce(per, reduction)
+
+    return eager_apply("cross_entropy", raw, tensors)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    # paddle returns loss with the class axis kept as size-1
+    from ...ops import manipulation as _m
+    loss = loss.unsqueeze(axis) if hasattr(loss, "unsqueeze") else loss
+    if return_softmax:
+        from .activation import softmax as _softmax
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return eager_apply(
+        "mse_loss",
+        lambda a, b: _reduce(jnp.square(a - b), reduction),
+        as_tensor_args(input, label))
+
+
+def square_error_cost(input, label):
+    return eager_apply("square_error_cost",
+                       lambda a, b: jnp.square(a - b),
+                       as_tensor_args(input, label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return eager_apply(
+        "l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction),
+        as_tensor_args(input, label))
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    has_w = weight is not None
+    tensors = as_tensor_args(*((input, label, weight) if has_w
+                               else (input, label)))
+
+    def raw(logp, lab, *maybe_w):
+        ids = lab.astype(jnp.int32)
+        safe = jnp.where(ids == ignore_index, 0, ids)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        per = -jnp.squeeze(picked, 1)
+        valid = ids != ignore_index
+        if has_w:
+            w = maybe_w[0][safe]
+            per = jnp.where(valid, per * w, 0.0)
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+        else:
+            per = jnp.where(valid, per, 0.0)
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.maximum(
+                    jnp.sum(valid.astype(per.dtype)), 1.0)
+        return _reduce(per, reduction)
+
+    return eager_apply("nll_loss", raw, tensors)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    has_w = weight is not None
+    tensors = as_tensor_args(*((input, label, weight) if has_w
+                               else (input, label)))
+
+    def raw(p, y, *maybe_w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        per = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if maybe_w:
+            per = per * maybe_w[0]
+        return _reduce(per, reduction)
+
+    return eager_apply("binary_cross_entropy", raw, tensors)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    extra = []
+    if weight is not None:
+        extra.append(weight)
+    if pos_weight is not None:
+        extra.append(pos_weight)
+    tensors = as_tensor_args(logit, label, *extra)
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+
+    def raw(z, y, *wp):
+        i = 0
+        w = None
+        pw = None
+        if has_w:
+            w = wp[i]
+            i += 1
+        if has_pw:
+            pw = wp[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight folding
+        if pw is not None:
+            log_weight = (pw - 1) * y + 1
+            per = (1 - y) * z + log_weight * (
+                jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0))
+        else:
+            per = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+
+    return eager_apply("bce_with_logits", raw, tensors)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def raw(logp, y):
+        if log_target:
+            per = jnp.exp(y) * (y - logp)
+        else:
+            per = jnp.where(y > 0, y * (jnp.log(jnp.clip(y, 1e-12)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(per) / logp.shape[0]
+        return _reduce(per, reduction)
+
+    return eager_apply("kl_div", raw, as_tensor_args(input, label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def raw(a, b):
+        diff = jnp.abs(a - b)
+        per = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                        diff - 0.5 * delta)
+        return _reduce(per, reduction)
+
+    return eager_apply("smooth_l1_loss", raw, as_tensor_args(input, label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def raw(x1, x2, y):
+        per = jnp.maximum(0.0, -y * (x1 - x2) + margin)
+        return _reduce(per, reduction)
+
+    return eager_apply("margin_ranking_loss", raw,
+                       as_tensor_args(input, other, label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def raw(p, y):
+        return -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log(1 - p + epsilon))
+
+    return eager_apply("log_loss", raw, as_tensor_args(input, label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    has_n = normalizer is not None
+    tensors = as_tensor_args(*((logit, label, normalizer) if has_n
+                               else (logit, label)))
+
+    def raw(z, y, *mn):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        mod = jnp.power(1 - p_t, gamma)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        per = a_t * mod * ce
+        if mn:
+            per = per / mn[0]
+        return _reduce(per, reduction)
+
+    return eager_apply("sigmoid_focal_loss", raw, tensors)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def raw(x, y):
+        per = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(per, reduction)
+
+    return eager_apply("hinge_embedding_loss", raw, as_tensor_args(input, label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def raw(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(per, reduction)
+
+    return eager_apply("cosine_embedding_loss", raw,
+                       as_tensor_args(input1, input2, label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def raw(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(
+                jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), -1), 1.0 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        per = jnp.maximum(0.0, d_ap - d_an + margin)
+        return _reduce(per, reduction)
+
+    return eager_apply("triplet_margin_loss", raw,
+                       as_tensor_args(input, positive, negative))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    d_ap = distance_function(input, positive)
+    d_an = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        from ...ops import math as _m
+        d_an = _m.minimum(d_an, d_pn)
+
+    def raw(dap, dan):
+        per = jnp.maximum(0.0, dap - dan + margin)
+        return _reduce(per, reduction)
+
+    return eager_apply("triplet_margin_with_distance_loss", raw,
+                       as_tensor_args(d_ap, d_an))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    has_w = weight is not None
+    tensors = as_tensor_args(*((input, label, weight) if has_w
+                               else (input, label)))
+
+    def raw(z, y, *mw):
+        per = y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z)
+        per = -jnp.mean(per, axis=-1)
+        if mw:
+            per = per * mw[0]
+        return _reduce(per, reduction)
+
+    return eager_apply("multi_label_soft_margin_loss", raw, tensors)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def raw(z, y):
+        per = jnp.log1p(jnp.exp(-y * z))
+        return _reduce(per, reduction)
+
+    return eager_apply("soft_margin_loss", raw, as_tensor_args(input, label))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def raw(x, y):
+        if log_input:
+            per = jnp.exp(x) - y * x
+        else:
+            per = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * jnp.pi * (y + epsilon))
+            per = per + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(per, reduction)
+
+    return eager_apply("poisson_nll_loss", raw, as_tensor_args(input, label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def raw(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        per = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            per = per + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(per, reduction)
+
+    return eager_apply("gaussian_nll_loss", raw,
+                       as_tensor_args(input, label, variance))

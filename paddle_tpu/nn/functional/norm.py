@@ -1,0 +1,216 @@
+"""Normalization functionals.
+
+TPU-native equivalent of the reference's norm ops (reference:
+python/paddle/nn/functional/norm.py → phi/kernels/batch_norm_kernel.h,
+layer_norm_kernel.h, and the fork's fused_layernorm). Plain jnp math —
+XLA fuses the reductions + affine into neighbouring ops, which is the
+fusion the reference needs hand-written CUDA for.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import eager_apply, as_tensor_args
+
+__all__ = [
+    "batch_norm", "layer_norm", "group_norm", "instance_norm",
+    "local_response_norm", "normalize", "rms_norm",
+]
+
+
+def _channel_axis(ndim, data_format):
+    return ndim - 1 if data_format[-1] == "C" and len(data_format) > 2 else 1
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = _channel_axis(x.ndim if isinstance(x, Tensor) else x.ndim,
+                            data_format)
+    use_batch_stats = training and not (use_global_stats is True)
+
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(weight)
+    if has_b:
+        tensors.append(bias)
+
+    if use_batch_stats:
+        # running buffers updated in place (momentum smoothing, matching the
+        # reference: new = m*old + (1-m)*batch); these updates are
+        # stop-gradient by construction (outside the vjp'd raw fn)
+        axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+        stat_mean = jnp.mean(x._data, axis=axes)
+        stat_var = jnp.var(x._data, axis=axes)
+        if running_mean is not None:
+            running_mean._rebind(
+                (momentum * running_mean._data
+                 + (1.0 - momentum) * stat_mean).astype(running_mean._data.dtype))
+        if running_var is not None:
+            n = x.size / stat_mean.size
+            unbiased = stat_var * (n / max(n - 1.0, 1.0))
+            running_var._rebind(
+                (momentum * running_var._data
+                 + (1.0 - momentum) * unbiased).astype(running_var._data.dtype))
+
+        def raw(a, *wb):
+            # stats recomputed INSIDE the differentiated fn so gradients flow
+            # through mean/var (the true BN backward)
+            mean = jnp.mean(a, axis=axes)
+            var = jnp.var(a, axis=axes)
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            xhat = (a - mean.reshape(shape)) * \
+                (1.0 / jnp.sqrt(var + epsilon)).reshape(shape)
+            i = 0
+            if has_w:
+                xhat = xhat * wb[i].reshape(shape)
+                i += 1
+            if has_b:
+                xhat = xhat + wb[i].reshape(shape)
+            return xhat.astype(a.dtype)
+
+        return eager_apply("batch_norm", raw, as_tensor_args(*tensors))
+
+    rm, rv = running_mean._data, running_var._data
+
+    def raw(a, *wb):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        xhat = (a - rm.reshape(shape)) * \
+            (1.0 / jnp.sqrt(rv + epsilon)).reshape(shape)
+        i = 0
+        if has_w:
+            xhat = xhat * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            xhat = xhat + wb[i].reshape(shape)
+        return xhat.astype(a.dtype)
+
+    return eager_apply("batch_norm", raw, as_tensor_args(*tensors))
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_norm = len(tuple(normalized_shape))
+    has_w, has_b = weight is not None, bias is not None
+    tensors = [x] + ([weight] if has_w else []) + ([bias] if has_b else [])
+
+    def raw(a, *wb):
+        axes = tuple(range(a.ndim - n_norm, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        xhat = (a - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            xhat = xhat * wb[i]
+            i += 1
+        if has_b:
+            xhat = xhat + wb[i]
+        return xhat.astype(a.dtype)
+
+    return eager_apply("layer_norm", raw, as_tensor_args(*tensors))
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (the fork's LLM path uses fused rmsnorm; here one fused XLA op)."""
+    has_w = weight is not None
+    tensors = [x] + ([weight] if has_w else [])
+
+    def raw(a, *w):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = a * (1.0 / jnp.sqrt(ms + epsilon)).astype(a.dtype)
+        if has_w:
+            out = out * w[0]
+        return out
+
+    return eager_apply("rms_norm", raw, as_tensor_args(*tensors))
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    if data_format[-1] == "C" and len(data_format) > 2:
+        raise NotImplementedError("group_norm supports NC... layouts")
+    has_w, has_b = weight is not None, bias is not None
+    tensors = [x] + ([weight] if has_w else []) + ([bias] if has_b else [])
+
+    def raw(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        g = num_groups
+        rest = a.shape[2:]
+        r = a.reshape((n, g, c // g) + rest)
+        axes = tuple(range(2, r.ndim))
+        mean = jnp.mean(r, axis=axes, keepdims=True)
+        var = jnp.var(r, axis=axes, keepdims=True)
+        xhat = ((r - mean) / jnp.sqrt(var + epsilon)).reshape(a.shape)
+        shape = [1] * a.ndim
+        shape[1] = c
+        i = 0
+        if has_w:
+            xhat = xhat * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            xhat = xhat + wb[i].reshape(shape)
+        return xhat.astype(a.dtype)
+
+    return eager_apply("group_norm", raw, as_tensor_args(*tensors))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    has_w, has_b = weight is not None, bias is not None
+    tensors = [x] + ([weight] if has_w else []) + ([bias] if has_b else [])
+
+    def raw(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        xhat = (a - mean) / jnp.sqrt(var + eps)
+        shape = [1] * a.ndim
+        shape[1] = a.shape[1]
+        i = 0
+        if has_w:
+            xhat = xhat * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            xhat = xhat + wb[i].reshape(shape)
+        return xhat.astype(a.dtype)
+
+    return eager_apply("instance_norm", raw, as_tensor_args(*tensors))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def raw(a):
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[1]
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jnp.take(padded, jnp.arange(i, i + c), axis=1)
+        div = jnp.power(k + alpha * acc / size, beta)
+        return a / div
+
+    return eager_apply("local_response_norm", raw, as_tensor_args(x))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def raw(a):
+        if p == 2:
+            norm = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=True))
+        else:
+            norm = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True),
+                1.0 / p)
+        return a / jnp.maximum(norm, epsilon)
+
+    return eager_apply("normalize", raw, as_tensor_args(x))

@@ -1,0 +1,197 @@
+"""Pooling functionals.
+
+TPU-native equivalent of the reference's pooling ops (reference:
+python/paddle/nn/functional/pooling.py → phi/kernels/pool_kernel.h).
+Implemented with ``lax.reduce_window``, which XLA lowers to efficient
+windowed reductions on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.dispatch import eager_apply, as_tensor_args
+from .conv import _tuplize, _padding
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _window(n, kernel, stride, padding, ceil_mode, channel_last):
+    kernel = _tuplize(kernel, n)
+    stride = _tuplize(stride if stride is not None else kernel, n)
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        raise ValueError("string padding not supported for pooling yet")
+    if channel_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = [(0, 0)] + pad + [(0, 0)]
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = [(0, 0), (0, 0)] + pad
+    if ceil_mode:
+        pads = [
+            (lo, hi + (s - 1)) if d > 1 else (lo, hi)
+            for (lo, hi), s, d in zip(pads, strides, dims)
+        ]
+    return dims, strides, pads
+
+
+def _pool_nd(n, kind, x, kernel_size, stride, padding, ceil_mode,
+             exclusive, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    dims, strides, pads = _window(n, kernel_size, stride, padding, ceil_mode,
+                                  channel_last)
+
+    def raw(a):
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
+                jnp.iinfo(a.dtype).min
+            return lax.reduce_window(a, init, lax.max, dims, strides, pads)
+        s = lax.reduce_window(a, 0.0, lax.add, dims, strides, pads)
+        if exclusive and any(p != (0, 0) for p in pads):
+            ones = jnp.ones(a.shape, a.dtype)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+            return s / cnt
+        return s / float(np.prod([d for d in dims if d > 1]))
+
+    return eager_apply(f"{kind}_pool{n}d", raw, as_tensor_args(x))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool_nd(1, "avg", x, kernel_size, stride, padding, ceil_mode,
+                    exclusive, "NCW")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_nd(2, "avg", x, kernel_size, stride, padding, ceil_mode,
+                    exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(3, "avg", x, kernel_size, stride, padding, ceil_mode,
+                    exclusive, data_format)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    out = _pool_nd(1, "max", x, kernel_size, stride, padding, ceil_mode,
+                   True, "NCW")
+    return (out, _pool_indices(1, x, out, kernel_size, stride, padding)) \
+        if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_nd(2, "max", x, kernel_size, stride, padding, ceil_mode,
+                   True, data_format)
+    return (out, _pool_indices(2, x, out, kernel_size, stride, padding)) \
+        if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool_nd(3, "max", x, kernel_size, stride, padding, ceil_mode,
+                   True, data_format)
+    return (out, _pool_indices(3, x, out, kernel_size, stride, padding)) \
+        if return_mask else out
+
+
+def _pool_indices(n, x, out, kernel_size, stride, padding):
+    """Flat within-window index of the max (the reference's ``return_mask``).
+
+    Supported for zero padding; each window offset contributes one strided
+    slice, argmax over the stacked offsets gives the winner's flat index.
+    """
+    kernel = _tuplize(kernel_size, n)
+    stride_t = _tuplize(stride if stride is not None else kernel_size, n)
+    if _padding(padding, n) != [(0, 0)] * n:
+        raise NotImplementedError("return_mask requires padding=0")
+
+    def raw(a):
+        out_sp = out._data.shape[2:]
+        patches = []
+        for pos in np.ndindex(*kernel):
+            slices = [slice(None), slice(None)]
+            for i in range(n):
+                start = pos[i]
+                end = start + (out_sp[i] - 1) * stride_t[i] + 1
+                slices.append(slice(start, end, stride_t[i]))
+            patches.append(a[tuple(slices)])
+        stacked = jnp.stack(patches, axis=0)
+        return jnp.argmax(stacked, axis=0).astype(jnp.int64)
+
+    return eager_apply("max_pool_indices", raw, as_tensor_args(x))
+
+
+def _adaptive_pool(n, kind, x, output_size, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    if channel_last:
+        raise NotImplementedError("adaptive pooling supports NCHW-family only")
+    out_size = _tuplize(output_size, n)
+
+    def raw(a):
+        spatial = a.shape[2:]
+        r = a
+        for i in range(n):
+            axis = 2 + i
+            in_s, out_s = spatial[i], out_size[i]
+            if out_s is None or in_s == out_s:
+                continue
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                new_shape = r.shape[:axis] + (out_s, k) + r.shape[axis + 1:]
+                rr = r.reshape(new_shape)
+                r = jnp.max(rr, axis=axis + 1) if kind == "max" else \
+                    jnp.mean(rr, axis=axis + 1)
+            else:
+                # general case: per-output-bin variable windows
+                starts = np.floor(np.arange(out_s) * in_s / out_s).astype(int)
+                ends = np.ceil((np.arange(out_s) + 1) * in_s / out_s).astype(int)
+                pieces = []
+                for s, e in zip(starts, ends):
+                    seg = lax.slice_in_dim(r, s, e, axis=axis)
+                    red = jnp.max(seg, axis=axis, keepdims=True) if kind == "max" \
+                        else jnp.mean(seg, axis=axis, keepdims=True)
+                    pieces.append(red)
+                r = jnp.concatenate(pieces, axis=axis)
+        return r
+
+    return eager_apply(f"adaptive_{kind}_pool{n}d", raw, as_tensor_args(x))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(1, "avg", x, output_size, "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(2, "avg", x, output_size, data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(3, "avg", x, output_size, data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(1, "max", x, output_size, "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(2, "max", x, output_size, "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(3, "max", x, output_size, "NCDHW")

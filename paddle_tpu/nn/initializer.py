@@ -1,0 +1,233 @@
+"""Parameter initializers.
+
+TPU-native equivalent of the reference's initializer suite
+(reference: python/paddle/nn/initializer/*.py — Constant, Normal,
+TruncatedNormal, Uniform, XavierNormal/Uniform, KaimingNormal/Uniform,
+Assign, Orthogonal, Dirac). Initializers are callables mapping
+(shape, dtype) -> jax array; Layer.create_parameter invokes them with the
+framework's stateful Generator so results are reproducible under
+``paddle.seed``.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.generator import default_generator
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
+]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    """Recommended gain per nonlinearity (parity with the reference's
+    paddle.nn.initializer.calculate_gain)."""
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return gains[nonlinearity]
+
+
+def _fan_in_out(shape):
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # Linear weight is stored [in, out] (paddle convention)
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+    def _key(self):
+        return default_generator().next_key()
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        return jnp.full(shape, self.value, convert_dtype(dtype).np_dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype).np_dtype
+        return (jax.random.normal(self._key(), shape, jnp.float32) * self.std
+                + self.mean).astype(dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0,
+                 b: float = 2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype).np_dtype
+        x = jax.random.truncated_normal(self._key(), self.a, self.b, shape,
+                                        jnp.float32)
+        return (x * self.std + self.mean).astype(dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype).np_dtype
+        return jax.random.uniform(
+            self._key(), shape, jnp.float32, self.low, self.high).astype(dt)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype).np_dtype
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (jax.random.normal(self._key(), shape, jnp.float32) * std).astype(dt)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype).np_dtype
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(
+            self._key(), shape, jnp.float32, -limit, limit).astype(dt)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype).np_dtype
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return (jax.random.normal(self._key(), shape, jnp.float32) * std).astype(dt)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype).np_dtype
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(
+            self._key(), shape, jnp.float32, -limit, limit).astype(dt)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype).np_dtype
+        arr = jnp.asarray(np.asarray(self.value), dt)
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(shape)
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype).np_dtype
+        if len(shape) < 2:
+            raise ValueError("Orthogonal initializer needs >=2 dims")
+        rows = int(shape[0])
+        cols = int(np.prod(shape[1:]))
+        n = max(rows, cols)
+        a = jax.random.normal(self._key(), (n, n), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diag(r))
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dt)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv kernel init (reference: nn/initializer/dirac.py)."""
+
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype).np_dtype
+        out = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        min_c = min(oc // self.groups, ic)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for c in range(min_c):
+                idx = (g * (oc // self.groups) + c, c, *centers)
+                out[idx] = 1.0
+        return jnp.asarray(out, dt)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Mirror paddle.nn.initializer.set_global_initializer."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _default_weight_init():
+    return _global_weight_init if _global_weight_init is not None else XavierNormal()
+
+
+def _default_bias_init():
+    return _global_bias_init if _global_bias_init is not None else Constant(0.0)

@@ -1,0 +1,3 @@
+from . import (  # noqa: F401
+    activation, common, conv, loss, norm, pooling, rnn, transformer,
+)

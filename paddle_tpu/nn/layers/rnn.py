@@ -1,0 +1,397 @@
+"""Recurrent layers.
+
+TPU-native equivalent of the reference's RNN stack (reference:
+python/paddle/nn/layer/rnn.py — RNNCellBase, SimpleRNNCell/LSTMCell/GRUCell,
+RNN/BiRNN wrappers, multi-layer LSTM/GRU/SimpleRNN backed by cudnn kernels).
+Here the recurrence is a ``lax.scan`` — the XLA-native loop construct — so
+the whole unrolled sequence compiles to one fused while-loop on TPU instead
+of per-step kernel launches.
+
+Weight convention matches the reference: weight_ih [gates*h, in],
+weight_hh [gates*h, h], gate order LSTM=(i,f,c,o) (phi lstm kernel order),
+GRU=(r,z,c).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import eager_apply, as_tensor_args
+from .. import functional as F
+from .. import initializer as I
+from ..layer_base import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU", "RNNCellBase"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        import paddle_tpu as paddle
+
+        batch = batch_ref.shape[batch_dim_idx]
+        if isinstance(self.state_shape[0], (list, tuple)):
+            return tuple(
+                paddle.full([batch] + list(s), init_value, dtype or "float32")
+                for s in self.state_shape)
+        return paddle.full([batch] + list(self.state_shape), init_value,
+                           dtype or "float32")
+
+
+def _cell_params(layer, input_size, hidden_size, gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+    std = 1.0 / math.sqrt(hidden_size)
+    u = I.Uniform(-std, std)
+    layer.weight_ih = layer.create_parameter(
+        [gates * hidden_size, input_size], weight_ih_attr,
+        default_initializer=u)
+    layer.weight_hh = layer.create_parameter(
+        [gates * hidden_size, hidden_size], weight_hh_attr,
+        default_initializer=u)
+    layer.bias_ih = layer.create_parameter(
+        [gates * hidden_size], bias_ih_attr, is_bias=True,
+        default_initializer=u)
+    layer.bias_hh = layer.create_parameter(
+        [gates * hidden_size], bias_hh_attr, is_bias=True,
+        default_initializer=u)
+
+
+def _lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    z = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    xz = x @ w_ih.T + b_ih
+    hz = h @ w_hh.T + b_hh
+    xr, xu, xc = jnp.split(xz, 3, axis=-1)
+    hr, hu, hc = jnp.split(hz, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    u = jax.nn.sigmoid(xu + hu)
+    c = jnp.tanh(xc + r * hc)
+    return (1 - u) * c + u * h
+
+
+def _rnn_step(x, h, w_ih, w_hh, b_ih, b_hh, activation):
+    z = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    return jnp.tanh(z) if activation == "tanh" else jax.nn.relu(z)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = self.activation
+
+        def raw(x, h, w_ih, w_hh, b_ih, b_hh):
+            return _rnn_step(x, h, w_ih, w_hh, b_ih, b_hh, act)
+
+        out = eager_apply("simple_rnn_cell", raw, as_tensor_args(
+            inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+            self.bias_hh))
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def raw(x, hh, cc, w_ih, w_hh, b_ih, b_hh):
+            return _lstm_step(x, hh, cc, w_ih, w_hh, b_ih, b_hh)
+
+        h_new, c_new = eager_apply("lstm_cell", raw, as_tensor_args(
+            inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+            self.bias_hh), n_outputs=2)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def raw(x, h, w_ih, w_hh, b_ih, b_hh):
+            return _gru_step(x, h, w_ih, w_hh, b_ih, b_hh)
+
+        out = eager_apply("gru_cell", raw, as_tensor_args(
+            inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+            self.bias_hh))
+        return out, out
+
+
+class RNN(Layer):
+    """Run a cell over a sequence (reference rnn.py RNN); python loop over
+    steps in eager, trace-friendly for to_static."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = []
+        for t in order:
+            x_t = inputs[:, t] if time_axis == 1 else inputs[t]
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = paddle.stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, fin_fw = self.rnn_fw(inputs, st_fw)
+        out_bw, fin_bw = self.rnn_bw(inputs, st_bw)
+        out = paddle.concat([out_fw, out_bw], axis=-1)
+        return out, (fin_fw, fin_bw)
+
+
+class _MultiLayerRNN(Layer):
+    """Stacked (optionally bidirectional) recurrence as a single fused
+    ``lax.scan`` per layer-direction."""
+
+    MODE_GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        if direction in ("forward",):
+            self.num_directions = 1
+        elif direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        else:
+            raise ValueError(f"unknown direction {direction!r}")
+        self.direction = direction
+
+        gates = self.MODE_GATES[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._param_names = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 \
+                    else hidden_size * self.num_directions
+                suffix = f"_reverse" if d == 1 else ""
+                names = [f"weight_ih_l{layer}{suffix}",
+                         f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}",
+                         f"bias_hh_l{layer}{suffix}"]
+                shapes = [[gates * hidden_size, in_sz],
+                          [gates * hidden_size, hidden_size],
+                          [gates * hidden_size], [gates * hidden_size]]
+                attrs = [weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                         bias_hh_attr]
+                for n, s, a in zip(names, shapes, attrs):
+                    p = self.create_parameter(s, a, is_bias=(len(s) == 1),
+                                              default_initializer=u)
+                    self.add_parameter(n, p)
+                self._param_names.append(names)
+
+    @property
+    def state_components(self):
+        return 2 if self.mode == "LSTM" else 1
+
+    def _scan_one(self, x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse):
+        """x: [T, B, in]; returns (outputs [T, B, H], h_T, c_T)."""
+        mode, act = self.mode, self.activation
+
+        def step(carry, x_t):
+            if mode == "LSTM":
+                h, c = carry
+                h_new, c_new = _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh)
+                return (h_new, c_new), h_new
+            h = carry[0]
+            if mode == "GRU":
+                h_new = _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh)
+            else:
+                h_new = _rnn_step(x_t, h, w_ih, w_hh, b_ih, b_hh,
+                                  "tanh" if mode == "RNN_TANH" else "relu")
+            return (h_new,), h_new
+
+        init = (h0, c0) if mode == "LSTM" else (h0,)
+        carry, ys = lax.scan(step, init, x, reverse=bool(reverse))
+        if reverse:
+            pass  # lax.scan(reverse=True) already emits outputs in orig order
+        if mode == "LSTM":
+            return ys, carry[0], carry[1]
+        return ys, carry[0], carry[0]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        mode = self.mode
+
+        params = []
+        flat_names = []
+        for names in self._param_names:
+            for n in names:
+                params.append(self._parameters[n])
+                flat_names.append(n)
+
+        has_init = initial_states is not None
+        init_tensors = []
+        if has_init:
+            if mode == "LSTM":
+                init_tensors = [initial_states[0], initial_states[1]]
+            else:
+                init_tensors = [initial_states]
+
+        dropout = self.dropout if self.training else 0.0
+        dkeys = None
+        if dropout > 0.0 and nl > 1:
+            from ...core.generator import default_generator
+            dkeys = [default_generator().next_key() for _ in range(nl - 1)]
+
+        def raw(x, *rest):
+            n_par = len(params)
+            ws = rest[:n_par]
+            inits = rest[n_par:]
+            xt = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, in]
+            b = xt.shape[1]
+            if inits:
+                if mode == "LSTM":
+                    h0_all = inits[0]  # [nl*nd, B, H]
+                    c0_all = inits[1]
+                else:
+                    h0_all = inits[0]
+                    c0_all = h0_all
+            else:
+                h0_all = jnp.zeros((nl * nd, b, hs), xt.dtype)
+                c0_all = h0_all
+            layer_in = xt
+            h_finals, c_finals = [], []
+            for layer in range(nl):
+                outs_dirs = []
+                for d in range(nd):
+                    idx = layer * nd + d
+                    w_ih, w_hh, b_ih, b_hh = ws[4 * idx: 4 * idx + 4]
+                    ys, h_f, c_f = self._scan_one(
+                        layer_in, h0_all[idx], c0_all[idx], w_ih, w_hh, b_ih,
+                        b_hh, reverse=(d == 1))
+                    outs_dirs.append(ys)
+                    h_finals.append(h_f)
+                    c_finals.append(c_f)
+                layer_in = outs_dirs[0] if nd == 1 else \
+                    jnp.concatenate(outs_dirs, axis=-1)
+                if dkeys is not None and layer < nl - 1:
+                    keep = jax.random.bernoulli(dkeys[layer], 1.0 - dropout,
+                                                layer_in.shape)
+                    layer_in = layer_in * keep.astype(layer_in.dtype) / (1.0 - dropout)
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(h_finals, 0)
+            c_stack = jnp.stack(c_finals, 0)
+            if mode == "LSTM":
+                return out, h_stack, c_stack
+            return out, h_stack
+
+    # three tensor outputs for LSTM, two otherwise
+        n_out = 3 if mode == "LSTM" else 2
+        tensors = as_tensor_args(inputs, *params, *init_tensors)
+        res = eager_apply(f"rnn_{mode.lower()}", raw, tensors, n_outputs=n_out)
+        if mode == "LSTM":
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_MultiLayerRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kw)
+
+
+class LSTM(_MultiLayerRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_MultiLayerRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

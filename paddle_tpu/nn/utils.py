@@ -1,0 +1,86 @@
+"""nn.utils (reference: python/paddle/nn/utils — clip_grad helpers,
+parameters_to_vector / vector_to_parameters, weight_norm)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm"]
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    arr = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = p.size
+        p._rebind(arr[offset:offset + n].reshape(p._data.shape).astype(
+            p._data.dtype))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `weight` as g * v/|v| (reference:
+    python/paddle/nn/utils/weight_norm_hook.py)."""
+    import numpy as np
+    from .layer_base import Layer
+
+    weight = getattr(layer, name)
+    w = weight._data
+    if dim is None:
+        norm = jnp.sqrt(jnp.sum(jnp.square(w)))
+        g0 = norm.reshape(1)
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != dim)
+        g0 = jnp.sqrt(jnp.sum(jnp.square(w), axis=axes))
+    from ..core.tensor import Parameter
+
+    g = Parameter(g0)
+    v = Parameter(w)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def _compute(layer_, _inputs):
+        vv, gg = layer_._parameters[name + "_v"], layer_._parameters[name + "_g"]
+        from ..ops.dispatch import eager_apply
+
+        def raw(varr, garr):
+            if dim is None:
+                nrm = jnp.sqrt(jnp.sum(jnp.square(varr)))
+                return varr * (garr.reshape(()) / nrm)
+            axes_ = tuple(i for i in range(varr.ndim) if i != dim)
+            nrm = jnp.sqrt(jnp.sum(jnp.square(varr), axis=axes_, keepdims=True))
+            shape = [1] * varr.ndim
+            shape[dim] = -1
+            return varr * (garr.reshape(shape) / nrm)
+
+        w_t = eager_apply("weight_norm", raw, [vv, gg])
+        object.__setattr__(layer_, "_wn_" + name, w_t)
+        layer_._parameters.pop(name, None)
+        layer_.__dict__[name] = w_t
+
+    hook = layer.register_forward_pre_hook(_compute)
+    layer.__dict__["_weight_norm_hook_" + name] = hook
+    _compute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hook = layer.__dict__.pop("_weight_norm_hook_" + name, None)
+    if hook is not None:
+        hook.remove()
+    v = layer._parameters.pop(name + "_v", None)
+    g = layer._parameters.pop(name + "_g", None)
+    if v is not None and g is not None:
+        w = layer.__dict__.pop(name, None)
+        from ..core.tensor import Parameter
+
+        layer.add_parameter(name, Parameter(w._data if w is not None else v._data))
+    return layer

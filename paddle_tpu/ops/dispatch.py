@@ -71,6 +71,19 @@ def eager_apply(
     static_kwargs = static_kwargs or {}
     arrays = [t._data for t in tensor_inputs]
 
+    # AMP O1 autocast (reference: eager_gen.py:515 AMP logic in generated
+    # ad_funcs + python/paddle/amp/auto_cast.py lists): white-list ops run in
+    # the low-precision dtype, black-list ops in float32.
+    from ..amp.auto_cast import _amp_cast_arrays
+
+    arrays = _amp_cast_arrays(op_name, arrays)
+
+    from ..amp.debugging import _op_stats, _record_op
+
+    if _op_stats["enabled"]:
+        for a in arrays:
+            _record_op(op_name, a.dtype)
+
     grad_wanted = engine.is_grad_enabled() and any(
         (not t.stop_gradient) and _is_diff_dtype(t._data)
         for t in tensor_inputs

@@ -1,0 +1,11 @@
+"""paddle_tpu.optimizer — mirrors python/paddle/optimizer."""
+from . import lr  # noqa: F401
+from .adam import Adam, AdamW, Lamb  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Adadelta, Adagrad, Momentum, Optimizer, RMSProp, SGD,
+)
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
+    "Adam", "AdamW", "Lamb", "lr",
+]

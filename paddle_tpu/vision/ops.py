@@ -1,0 +1,100 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — roi_align, nms,
+deform_conv2d, box utilities). Core subset implemented with jnp."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import eager_apply, as_tensor_args
+
+__all__ = ["nms", "box_coder", "roi_align", "box_area", "box_iou"]
+
+
+def box_area(boxes):
+    def raw(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+
+    return eager_apply("box_area", raw, as_tensor_args(boxes))
+
+
+def _iou_matrix(a, b):
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area_a[:, None] + area_b[None] - inter + 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    return eager_apply("box_iou", _iou_matrix, as_tensor_args(boxes1, boxes2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (host-side loop; detection post-processing is not a TPU
+    hot path — the reference also runs it as a standalone op)."""
+    b = np.asarray(boxes.numpy() if isinstance(boxes, Tensor) else boxes)
+    s = np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores) \
+        if scores is not None else np.arange(len(b))[::-1].astype(np.float32)
+    order = np.argsort(-s)
+    iou = np.asarray(_iou_matrix(jnp.asarray(b), jnp.asarray(b)))
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = False
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bn = np.asarray(boxes_num.numpy() if isinstance(boxes_num, Tensor)
+                    else boxes_num)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def raw(feat, bxs):
+        n_roi = bxs.shape[0]
+        c = feat.shape[1]
+        off = 0.5 if aligned else 0.0
+        outs = []
+        for r in range(n_roi):
+            bi = int(batch_idx[r])
+            x1, y1, x2, y2 = [bxs[r, k] * spatial_scale - off for k in range(4)]
+            ys = y1 + (jnp.arange(oh) + 0.5) * (y2 - y1) / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * (x2 - x1) / ow
+            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, feat.shape[2] - 1)
+            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, feat.shape[3] - 1)
+            y1i = jnp.clip(y0 + 1, 0, feat.shape[2] - 1)
+            x1i = jnp.clip(x0 + 1, 0, feat.shape[3] - 1)
+            wy = jnp.clip(ys - y0, 0, 1)
+            wx = jnp.clip(xs - x0, 0, 1)
+            f = feat[bi]
+            v00 = f[:, y0][:, :, x0]
+            v01 = f[:, y0][:, :, x1i]
+            v10 = f[:, y1i][:, :, x0]
+            v11 = f[:, y1i][:, :, x1i]
+            top = v00 * (1 - wx)[None, None] + v01 * wx[None, None]
+            bot = v10 * (1 - wx)[None, None] + v11 * wx[None, None]
+            outs.append(top * (1 - wy)[None, :, None] + bot * wy[None, :, None])
+        return jnp.stack(outs) if outs else jnp.zeros((0, c, oh, ow),
+                                                      feat.dtype)
+
+    return eager_apply("roi_align", raw, as_tensor_args(x, boxes))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    raise NotImplementedError("box_coder: detection-specific; not yet built")

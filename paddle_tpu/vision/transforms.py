@@ -1,0 +1,194 @@
+"""Image transforms (reference: python/paddle/vision/transforms).
+
+numpy-based HWC transforms; Compose chains them. Only the commonly used
+subset for the anchor configs; functional forms under ``F``-style names.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "to_tensor", "normalize",
+           "resize", "hflip", "vflip", "center_crop"]
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = np.asarray(pic, np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.max() > 1.5:  # uint8 range
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (img - mean[:, None, None]) / std[:, None, None]
+    return (img - mean) / std
+
+
+def resize(img, size, interpolation="bilinear"):
+    import jax
+    import jax.numpy as jnp
+
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3)
+    if isinstance(size, int):
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    if chw:
+        target = (arr.shape[0], size[0], size[1])
+    elif arr.ndim == 3:
+        target = (size[0], size[1], arr.shape[2])
+    else:
+        target = tuple(size)
+    method = {"bilinear": "linear", "nearest": "nearest",
+              "bicubic": "cubic"}[interpolation]
+    return np.asarray(jax.image.resize(jnp.asarray(arr, jnp.float32), target,
+                                       method=method))
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    return arr[..., ::-1] if arr.ndim == 3 and arr.shape[0] in (1, 3) \
+        else arr[:, ::-1]
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    return arr[..., ::-1, :] if arr.ndim == 3 and arr.shape[0] in (1, 3) \
+        else arr[::-1]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3)
+    h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+    th, tw = output_size
+    i = (h - th) // 2
+    j = (w - tw) // 2
+    if chw:
+        return arr[:, i:i + th, j:j + tw]
+    return arr[i:i + th, j:j + tw]
+
+
+class _Transform:
+    def __call__(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(_Transform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(_Transform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(_Transform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(_Transform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(_Transform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and \
+            arr.shape[-1] not in (1, 3)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        th, tw = self.size
+        i = random.randint(0, max(h - th, 0))
+        j = random.randint(0, max(w - tw, 0))
+        if chw:
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(_Transform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        return hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(_Transform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if random.random() < self.prob else img
+
+
+class Transpose(_Transform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class BrightnessTransform(_Transform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        factor = 1 + random.uniform(-self.value, self.value)
+        return np.clip(np.asarray(img, np.float32) * factor, 0,
+                       255 if np.asarray(img).max() > 1.5 else 1.0)

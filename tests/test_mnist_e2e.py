@@ -1,0 +1,59 @@
+"""End-to-end anchor #1: MNIST LeNet dygraph training
+(BASELINE.md config anchor; reference flow = paddle dygraph train loop).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_mnist_converges():
+    paddle.seed(7)
+    train_ds = MNIST(mode="train", synthetic_size=256)
+    loader = DataLoader(train_ds, batch_size=64, shuffle=True)
+    model = LeNet(num_classes=10)
+    opt = paddle.optimizer.Adam(3e-3, parameters=model.parameters())
+
+    first_loss = None
+    last_loss = None
+    model.train()
+    for epoch in range(10):
+        for x, y in loader:
+            logits = model(x)
+            loss = F.cross_entropy(logits, y.squeeze(-1))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first_loss is None:
+                first_loss = float(loss.numpy())
+            last_loss = float(loss.numpy())
+
+    assert last_loss < first_loss * 0.7, (first_loss, last_loss)
+
+    # eval accuracy on the (learnable synthetic) train set beats chance by far
+    model.eval()
+    correct = total = 0
+    for x, y in DataLoader(train_ds, batch_size=128):
+        pred = model(x).numpy().argmax(-1)
+        correct += int((pred == y.numpy().ravel()).sum())
+        total += len(pred)
+    assert correct / total > 0.5, correct / total
+
+
+def test_lenet_amp_o1_step():
+    paddle.seed(0)
+    model = LeNet(num_classes=10)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(enable=False)  # bf16 needs no scaling
+    x = paddle.randn([8, 1, 28, 28])
+    y = paddle.to_tensor(np.random.randint(0, 10, (8,)))
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        loss = F.cross_entropy(model(x), y)
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    scaler.update()
+    assert np.isfinite(float(loss.numpy()))

@@ -1,0 +1,413 @@
+"""nn layer tests: numpy-reference comparisons + grad checks.
+
+Port of the reference's OpTest pattern for layers (SURVEY.md §4:
+test/legacy_test numpy-reference comparisons).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-5):
+    np.testing.assert_allclose(
+        a.numpy() if hasattr(a, "numpy") else a,
+        b.numpy() if hasattr(b, "numpy") else b, rtol=rtol, atol=atol)
+
+
+class TestLinear:
+    def test_forward_matches_numpy(self):
+        lin = nn.Linear(8, 3)
+        x = paddle.randn([4, 8])
+        ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+        allclose(lin(x), ref)
+
+    def test_grad(self):
+        lin = nn.Linear(5, 2)
+        x = paddle.randn([3, 5])
+        loss = lin(x).sum()
+        loss.backward()
+        # dL/dW = x^T @ ones
+        expected = x.numpy().T @ np.ones((3, 2), np.float32)
+        allclose(lin.weight.grad, expected)
+        allclose(lin.bias.grad, np.full(2, 3.0, np.float32))
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name,npfn", [
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("relu6", lambda x: np.clip(x, 0, 6)),
+        ("hardswish", lambda x: x * np.clip(x + 3, 0, 6) / 6),
+        ("softsign", lambda x: x / (1 + np.abs(x))),
+    ])
+    def test_unary(self, name, npfn):
+        x = paddle.randn([3, 7])
+        allclose(getattr(F, name)(x), npfn(x.numpy()), rtol=1e-4, atol=1e-5)
+
+    def test_softmax(self):
+        x = paddle.randn([2, 5])
+        out = F.softmax(x, axis=-1).numpy()
+        e = np.exp(x.numpy() - x.numpy().max(-1, keepdims=True))
+        allclose(out, e / e.sum(-1, keepdims=True), rtol=1e-5)
+
+    def test_gelu_grad_finite_diff(self):
+        x = paddle.randn([4, 4])
+        x.stop_gradient = False
+        F.gelu(x).sum().backward()
+        eps = 1e-3
+        xn = x.numpy()
+        num = np.zeros_like(xn)
+        for i in np.ndindex(*xn.shape):
+            xp, xm = xn.copy(), xn.copy()
+            xp[i] += eps
+            xm[i] -= eps
+
+            def f(v):
+                from scipy.special import erf  # not avail? fallback
+                return v
+            # numeric via paddle itself
+            num[i] = (F.gelu(paddle.to_tensor(xp)).sum().item()
+                      - F.gelu(paddle.to_tensor(xm)).sum().item()) / (2 * eps)
+        allclose(x.grad, num, rtol=1e-2, atol=1e-3)
+
+
+class TestConvPool:
+    def test_conv2d_matches_manual(self):
+        conv = nn.Conv2D(2, 3, 3, padding=1)
+        x = paddle.randn([1, 2, 5, 5])
+        out = conv(x)
+        assert out.shape == [1, 3, 5, 5]
+        # spot check one output position against manual correlation
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        xp = np.pad(x.numpy(), [(0, 0), (0, 0), (1, 1), (1, 1)])
+        manual = (xp[0, :, 1:4, 1:4] * w[1]).sum() + b[1]
+        allclose(out.numpy()[0, 1, 1, 1], manual, rtol=1e-4)
+
+    def test_conv_grad_shapes(self):
+        conv = nn.Conv2D(3, 4, 3, stride=2, padding=1)
+        x = paddle.randn([2, 3, 8, 8])
+        conv(x).sum().backward()
+        assert conv.weight.grad.shape == [4, 3, 3, 3]
+        assert conv.bias.grad.shape == [4]
+
+    def test_conv2d_transpose_shape(self):
+        convt = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+        x = paddle.randn([1, 4, 5, 5])
+        assert convt(x).shape == [1, 2, 9, 9]
+
+    def test_grouped_conv(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        x = paddle.randn([1, 4, 6, 6])
+        assert conv(x).shape == [1, 8, 6, 6]
+
+    def test_maxpool_avgpool(self):
+        x = paddle.to_tensor(np.arange(16, np.float32).reshape(1, 1, 4, 4)
+                             if False else
+                             np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = F.max_pool2d(x, 2)
+        ap = F.avg_pool2d(x, 2)
+        allclose(mp, [[[[5, 7], [13, 15]]]])
+        allclose(ap, [[[[2.5, 4.5], [10.5, 12.5]]]])
+
+    def test_adaptive_avg_pool(self):
+        x = paddle.randn([2, 3, 8, 8])
+        out = F.adaptive_avg_pool2d(x, 1)
+        allclose(out.numpy()[..., 0, 0], x.numpy().mean((2, 3)), rtol=1e-5)
+
+
+class TestNorm:
+    def test_layer_norm(self):
+        ln = nn.LayerNorm(6)
+        x = paddle.randn([4, 6])
+        out = ln(x).numpy()
+        xn = x.numpy()
+        ref = (xn - xn.mean(-1, keepdims=True)) / np.sqrt(
+            xn.var(-1, keepdims=True) + 1e-5)
+        allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_train_eval(self):
+        bn = nn.BatchNorm2D(3, momentum=0.5)
+        x = paddle.randn([4, 3, 5, 5])
+        bn.train()
+        out = bn(x).numpy()
+        assert abs(out.mean()) < 1e-4
+        assert abs(out.std() - 1.0) < 1e-2
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_group_norm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = paddle.randn([2, 4, 3, 3])
+        out = gn(x).numpy()
+        r = x.numpy().reshape(2, 2, 2, 3, 3)
+        ref = (r - r.mean((2, 3, 4), keepdims=True)) / np.sqrt(
+            r.var((2, 3, 4), keepdims=True) + 1e-5)
+        allclose(out, ref.reshape(2, 4, 3, 3), rtol=1e-4, atol=1e-5)
+
+    def test_rms_norm(self):
+        rn = nn.RMSNorm(8)
+        x = paddle.randn([2, 8])
+        out = rn(x).numpy()
+        xn = x.numpy()
+        ref = xn / np.sqrt((xn ** 2).mean(-1, keepdims=True) + 1e-6)
+        allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestLosses:
+    def test_cross_entropy_matches_numpy(self):
+        logits = paddle.randn([6, 5])
+        labels = paddle.to_tensor(np.array([0, 1, 2, 3, 4, 0]))
+        loss = F.cross_entropy(logits, labels)
+        z = logits.numpy()
+        logp = z - np.log(np.exp(z - z.max(-1, keepdims=True)).sum(
+            -1, keepdims=True)) - z.max(-1, keepdims=True)
+        ref = -logp[np.arange(6), labels.numpy()].mean()
+        allclose(loss, ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = paddle.randn([4, 3])
+        labels = paddle.to_tensor(np.array([0, -100, 2, -100]))
+        loss = F.cross_entropy(logits, labels, ignore_index=-100)
+        z = logits.numpy()
+        logp = z - np.log(np.exp(z - z.max(-1, keepdims=True)).sum(
+            -1, keepdims=True)) - z.max(-1, keepdims=True)
+        ref = -(logp[0, 0] + logp[2, 2]) / 2
+        allclose(loss, ref, rtol=1e-5)
+
+    def test_soft_label(self):
+        logits = paddle.randn([3, 4])
+        soft = F.softmax(paddle.randn([3, 4]), axis=-1)
+        loss = F.cross_entropy(logits, soft, soft_label=True)
+        assert loss.ndim == 0 or loss.shape == []
+
+    def test_bce_with_logits(self):
+        z = paddle.randn([8])
+        y = paddle.to_tensor(np.random.randint(0, 2, 8).astype(np.float32))
+        loss = F.binary_cross_entropy_with_logits(z, y)
+        p = 1 / (1 + np.exp(-z.numpy()))
+        ref = -(y.numpy() * np.log(p) + (1 - y.numpy()) * np.log(1 - p)).mean()
+        allclose(loss, ref, rtol=1e-4)
+
+    def test_kl_smooth_l1(self):
+        a = F.log_softmax(paddle.randn([4, 5]), axis=-1)
+        b = F.softmax(paddle.randn([4, 5]), axis=-1)
+        assert F.kl_div(a, b).ndim == 0
+        assert F.smooth_l1_loss(paddle.randn([4]), paddle.randn([4])).ndim == 0
+
+
+class TestEmbeddingDropout:
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(ids)
+        allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_embedding_grad_accumulates(self):
+        emb = nn.Embedding(5, 3)
+        ids = paddle.to_tensor(np.array([1, 1, 2]))
+        emb(ids).sum().backward()
+        g = emb.weight.grad.numpy()
+        allclose(g[1], np.full(3, 2.0))
+        allclose(g[2], np.full(3, 1.0))
+        allclose(g[0], np.zeros(3))
+
+    def test_dropout_train_eval(self):
+        x = paddle.ones([1000])
+        d = nn.Dropout(0.5)
+        d.train()
+        out = d(x)
+        kept = float((out.numpy() != 0).mean())
+        assert 0.35 < kept < 0.65
+        # upscale keeps expectation
+        assert abs(float(out.numpy().mean()) - 1.0) < 0.15
+        d.eval()
+        allclose(d(x), x.numpy())
+
+
+class TestTransformer:
+    def test_encoder_forward_backward(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                           dim_feedforward=32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.randn([2, 6, 16])
+        out = enc(x)
+        assert out.shape == [2, 6, 16]
+        out.mean().backward()
+        assert layer.self_attn.q_proj.weight.grad is not None
+
+    def test_mha_cache_decode(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        x = paddle.randn([1, 1, 8])
+        cache = mha.gen_cache(x)
+        y, cache = mha(x, x, x, cache=cache)
+        assert cache.k.shape[1] == 1
+        y2, cache = mha(x, x, x, cache=cache)
+        assert cache.k.shape[1] == 2
+
+    def test_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = paddle.randn([2, 4, 16])
+        tgt = paddle.randn([2, 3, 16])
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+
+class TestRNN:
+    def test_lstm_shapes_and_grad(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = paddle.randn([3, 5, 4])
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 5, 8]
+        assert h.shape == [2, 3, 8]
+        out.sum().backward()
+        assert lstm.weight_ih_l0.grad is not None
+
+    def test_gru_bidirectional(self):
+        gru = nn.GRU(4, 6, direction="bidirectional")
+        x = paddle.randn([2, 5, 4])
+        out, h = gru(x)
+        assert out.shape == [2, 5, 12]
+        assert h.shape == [2, 2, 6]
+
+    def test_lstm_cell_manual_parity(self):
+        cell = nn.LSTMCell(3, 4)
+        x = paddle.randn([2, 3])
+        h, (h2, c2) = cell(x)
+        # manual: gates i,f,g,o
+        xn = x.numpy()
+        w_ih, w_hh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+        b = cell.bias_ih.numpy() + cell.bias_hh.numpy()
+        z = xn @ w_ih.T + b
+        i, f, g, o = np.split(z, 4, -1)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+        c_ref = sig(i) * np.tanh(g)
+        h_ref = sig(o) * np.tanh(c_ref)
+        allclose(h, h_ref, rtol=1e-4, atol=1e-5)
+
+    def test_rnn_wrapper_matches_multilayer(self):
+        cell = nn.SimpleRNNCell(3, 5)
+        rnn = nn.RNN(cell)
+        x = paddle.randn([2, 4, 3])
+        out, h = rnn(x)
+        assert out.shape == [2, 4, 5]
+
+
+class TestContainersStateDict:
+    def test_sequential_and_state_dict(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = model.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+        model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model2.set_state_dict(sd)
+        x = paddle.randn([2, 4])
+        allclose(model(x), model2(x))
+
+    def test_layerlist_parameterlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        assert len(list(ll.parameters())) == 6
+        pl = nn.ParameterList([paddle.Parameter(paddle.randn([2]))
+                               for _ in range(2)])
+        assert len(list(pl.parameters())) == 2
+
+    def test_buffers_in_state_dict(self):
+        bn = nn.BatchNorm2D(3)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_apply_and_mode(self):
+        model = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        model.eval()
+        assert not model[1].training
+        model.train()
+        assert model[1].training
+
+    def test_named_parameters_prefix(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(2, 2)
+                self.out = nn.Linear(2, 1)
+
+        m = M()
+        names = {n for n, _ in m.named_parameters()}
+        assert names == {"fc.weight", "fc.bias", "out.weight", "out.bias"}
+
+
+class TestInitializers:
+    def test_constant_uniform_normal(self):
+        import paddle_tpu.nn.initializer as I
+        c = I.Constant(3.0)((2, 2), "float32")
+        assert float(np.asarray(c).min()) == 3.0
+        u = np.asarray(I.Uniform(-0.5, 0.5)((1000,), "float32"))
+        assert -0.5 <= u.min() and u.max() <= 0.5
+        n = np.asarray(I.Normal(0, 0.1)((1000,), "float32"))
+        assert abs(n.std() - 0.1) < 0.02
+
+    def test_xavier_kaiming_shapes(self):
+        import paddle_tpu.nn.initializer as I
+        for init in [I.XavierNormal(), I.XavierUniform(), I.KaimingNormal(),
+                     I.KaimingUniform(), I.Orthogonal()]:
+            out = init((16, 8), "float32")
+            assert tuple(out.shape) == (16, 8)
+
+    def test_orthogonal_is_orthogonal(self):
+        import paddle_tpu.nn.initializer as I
+        w = np.asarray(I.Orthogonal()((4, 4), "float32"))
+        allclose(w @ w.T, np.eye(4), rtol=1e-4, atol=1e-4)
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        p1 = paddle.Parameter(paddle.randn([4]))
+        p2 = paddle.Parameter(paddle.randn([3]))
+        g1 = paddle.to_tensor(np.full(4, 3.0, np.float32))
+        g2 = paddle.to_tensor(np.full(3, 4.0, np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, g1), (p2, g2)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        assert abs(total - 1.0) < 1e-4
+
+    def test_value_clip(self):
+        p = paddle.Parameter(paddle.randn([4]))
+        g = paddle.to_tensor(np.array([-5.0, 0.2, 5.0, 1.0], np.float32))
+        out = nn.ClipGradByValue(1.0)([(p, g)])
+        assert out[0][1].numpy().max() <= 1.0
+        assert out[0][1].numpy().min() >= -1.0
+
+
+class TestAttention:
+    def test_sdpa_matches_numpy(self):
+        q = paddle.randn([2, 4, 2, 8])
+        k = paddle.randn([2, 4, 2, 8])
+        v = paddle.randn([2, 4, 2, 8])
+        out = F.scaled_dot_product_attention(q, k, v).numpy()
+        qn, kn, vn = q.numpy(), k.numpy(), v.numpy()
+        logits = np.einsum("bqhd,bkhd->bhqk", qn, kn) / np.sqrt(8)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bkhd->bqhd", w, vn)
+        allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_causal(self):
+        q = paddle.randn([1, 5, 1, 4])
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        # first position attends only to itself → equals v[0]
+        allclose(out.numpy()[0, 0, 0], q.numpy()[0, 0, 0], rtol=1e-4)
+
+    def test_flash_attention_api(self):
+        q = paddle.randn([2, 8, 2, 16])
+        out, _ = F.flash_attention(q, q, q, causal=True)
+        assert out.shape == [2, 8, 2, 16]

@@ -1,0 +1,305 @@
+"""Optimizer + LR scheduler + AMP tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def train_quadratic(opt_factory, steps=120, tol=5e-2):
+    paddle.seed(42)
+    net = nn.Linear(4, 1)
+    opt = opt_factory(net.parameters())
+    target = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    rng = np.random.RandomState(0)
+    for _ in range(steps):
+        xb = rng.randn(32, 4).astype(np.float32)
+        x = paddle.to_tensor(xb)
+        y = paddle.to_tensor(xb @ target)
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss.numpy()), net
+
+
+class TestOptimizers:
+    def test_sgd(self):
+        loss, _ = train_quadratic(
+            lambda p: paddle.optimizer.SGD(0.05, parameters=p), steps=300)
+        assert loss < 0.05
+
+    def test_momentum(self):
+        loss, _ = train_quadratic(
+            lambda p: paddle.optimizer.Momentum(0.02, 0.9, parameters=p))
+        assert loss < 0.05
+
+    def test_adam(self):
+        loss, _ = train_quadratic(
+            lambda p: paddle.optimizer.Adam(0.1, parameters=p))
+        assert loss < 0.05
+
+    def test_adamw(self):
+        loss, _ = train_quadratic(
+            lambda p: paddle.optimizer.AdamW(0.1, parameters=p))
+        assert loss < 0.05
+
+    def test_lamb(self):
+        loss, _ = train_quadratic(
+            lambda p: paddle.optimizer.Lamb(0.05, parameters=p), steps=300)
+        assert loss < 0.2
+
+    def test_rmsprop_adagrad_adadelta(self):
+        # adadelta is scale-free and characteristically slow on tiny
+        # problems; only require clear descent for it
+        for fac, thresh in [
+            (lambda p: paddle.optimizer.RMSProp(0.05, parameters=p), 0.5),
+            (lambda p: paddle.optimizer.Adagrad(0.2, parameters=p), 0.5),
+            (lambda p: paddle.optimizer.Adadelta(2.0, parameters=p), 3.0),
+        ]:
+            loss, _ = train_quadratic(fac, steps=300, tol=0.3)
+            assert loss < thresh
+
+    def test_sgd_exact_update(self):
+        p = paddle.Parameter(paddle.to_tensor(np.ones(3, np.float32)))
+        opt = paddle.optimizer.SGD(0.1, parameters=[p])
+        p.grad = paddle.to_tensor(np.full(3, 2.0, np.float32))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), np.full(3, 0.8), rtol=1e-6)
+
+    def test_adamw_decay_shrinks_weights(self):
+        p = paddle.Parameter(paddle.to_tensor(np.full(3, 10.0, np.float32)))
+        opt = paddle.optimizer.AdamW(0.01, parameters=[p], weight_decay=0.5)
+        p.grad = paddle.to_tensor(np.zeros(3, np.float32))
+        before = p.numpy().copy()
+        opt.step()
+        assert (np.abs(p.numpy()) < np.abs(before)).all()
+
+    def test_weight_decay_l2(self):
+        import paddle_tpu.regularizer as reg
+        p = paddle.Parameter(paddle.to_tensor(np.full(2, 4.0, np.float32)))
+        opt = paddle.optimizer.SGD(0.1, parameters=[p],
+                                   weight_decay=reg.L2Decay(0.1))
+        p.grad = paddle.to_tensor(np.zeros(2, np.float32))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), 4.0 - 0.1 * 0.4, rtol=1e-5)
+
+    def test_grad_clip_in_optimizer(self):
+        clip = nn.ClipGradByGlobalNorm(0.1)
+        p = paddle.Parameter(paddle.to_tensor(np.zeros(4, np.float32)))
+        opt = paddle.optimizer.SGD(1.0, parameters=[p], grad_clip=clip)
+        p.grad = paddle.to_tensor(np.full(4, 100.0, np.float32))
+        opt.step()
+        assert np.abs(p.numpy()).max() <= 0.1
+
+    def test_param_groups(self):
+        a = paddle.Parameter(paddle.randn([2]))
+        b = paddle.Parameter(paddle.randn([2]))
+        opt = paddle.optimizer.SGD(0.1, parameters=[
+            {"params": [a]}, {"params": [b], "learning_rate": 0.0}])
+        # lr mult via optimize_attr
+        b.optimize_attr["learning_rate"] = 0.0
+        a.grad = paddle.to_tensor(np.ones(2, np.float32))
+        b.grad = paddle.to_tensor(np.ones(2, np.float32))
+        before_b = b.numpy().copy()
+        opt.step()
+        np.testing.assert_allclose(b.numpy(), before_b)
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Linear(3, 3)
+        opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+        x = paddle.randn([2, 3])
+        net(x).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+        opt2.set_state_dict(sd)
+        k = net.weight.name + "_moment1"
+        np.testing.assert_allclose(
+            opt2._accumulators[id(net.weight)]["moment1"],
+            opt._accumulators[id(net.weight)]["moment1"])
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = paddle.optimizer.lr.StepDecay(1.0, step_size=2, gamma=0.1)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.1, 0.1, 0.01], rtol=1e-6)
+
+    def test_cosine(self):
+        s = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_warmup(self):
+        s = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=10,
+                                             start_lr=0.0, end_lr=0.1)
+        first = s()
+        for _ in range(10):
+            s.step()
+        assert first < 0.05 and abs(s() - 0.1) < 1e-6
+
+    def test_noam_piecewise_poly(self):
+        n = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=100)
+        assert n() > 0
+        p = paddle.optimizer.lr.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+        p.step(4)
+        assert abs(p() - 0.01) < 1e-9
+        poly = paddle.optimizer.lr.PolynomialDecay(0.1, decay_steps=10)
+        poly.step(10)
+        assert abs(poly() - 0.0001) < 1e-6
+
+    def test_reduce_on_plateau(self):
+        s = paddle.optimizer.lr.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            s.step(loss)
+        assert s() < 1.0
+
+    def test_scheduler_in_optimizer(self):
+        net = nn.Linear(2, 2)
+        sched = paddle.optimizer.lr.ExponentialDecay(0.1, gamma=0.5)
+        opt = paddle.optimizer.SGD(sched, parameters=net.parameters())
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+class TestAMP:
+    def test_auto_cast_o1_matmul_bf16(self):
+        import jax.numpy as jnp
+        x = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            y = paddle.matmul(x, x)
+        assert y._data.dtype == jnp.bfloat16
+        # blacklist op stays f32
+        with paddle.amp.auto_cast():
+            z = F.softmax(x)
+        assert z._data.dtype == jnp.float32
+
+    def test_grad_scaler_scales_and_steps(self):
+        net = nn.Linear(3, 1)
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle.randn([4, 3])
+        loss = net(x).mean()
+        scaled = scaler.scale(loss)
+        assert abs(float(scaled.numpy()) - 128.0 * float(loss.numpy())) < 1e-3
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+
+    def test_grad_scaler_skips_on_inf(self):
+        net = nn.Linear(2, 1)
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=100.0)
+        before = net.weight.numpy().copy()
+        net.weight.grad = paddle.to_tensor(
+            np.array([[np.inf], [1.0]], np.float32))
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(net.weight.numpy(), before)
+        assert scaler.get_init_loss_scaling() < 100.0
+
+    def test_o2_decorate(self):
+        import jax.numpy as jnp
+        model = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+        opt = paddle.optimizer.AdamW(0.01, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+        assert model[0].weight._data.dtype == jnp.bfloat16
+        assert model[1].weight._data.dtype == jnp.float32  # norm excluded
+        assert opt._multi_precision
+        # master weights keep full precision across a step
+        x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        with paddle.amp.auto_cast(level="O2"):
+            loss = model(x).mean()
+        loss.backward()
+        opt.step()
+        st = opt._accumulators[id(model[0].weight)]
+        assert st["_master"].dtype == jnp.float32
+
+
+class TestIO:
+    def test_tensor_dataset_loader(self):
+        import paddle_tpu.io as io
+        xs = np.arange(20, dtype=np.float32).reshape(10, 2)
+        ys = np.arange(10, dtype=np.int64)
+        ds = io.TensorDataset([xs, ys])
+        dl = io.DataLoader(ds, batch_size=4, shuffle=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        xb, yb = batches[0]
+        assert xb.shape == [4, 2]
+        np.testing.assert_allclose(yb.numpy(), [0, 1, 2, 3])
+
+    def test_shuffle_and_drop_last(self):
+        import paddle_tpu.io as io
+        ds = io.TensorDataset([np.arange(10, dtype=np.float32)])
+        dl = io.DataLoader(ds, batch_size=3, shuffle=True, drop_last=True)
+        assert len(list(dl)) == 3
+
+    def test_distributed_batch_sampler_shards(self):
+        import paddle_tpu.io as io
+        ds = io.TensorDataset([np.arange(12, dtype=np.float32)])
+        s0 = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                        rank=0)
+        s1 = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                        rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 6
+        assert not (set(i0) & set(i1))
+
+    def test_iterable_dataset(self):
+        import paddle_tpu.io as io
+
+        class Stream(io.IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32(i)
+
+        dl = io.DataLoader(Stream(), batch_size=3)
+        sizes = [b.shape[0] for b in dl]
+        assert sizes == [3, 3, 1]
+
+    def test_random_split_concat(self):
+        import paddle_tpu.io as io
+        ds = io.TensorDataset([np.arange(10, dtype=np.float32)])
+        a, b = io.random_split(ds, [6, 4])
+        assert len(a) == 6 and len(b) == 4
+        cat = io.ConcatDataset([a, b])
+        assert len(cat) == 10
+
+    def test_prefetch_workers(self):
+        import paddle_tpu.io as io
+        ds = io.TensorDataset([np.arange(8, dtype=np.float32)])
+        dl = io.DataLoader(ds, batch_size=2, num_workers=2)
+        assert len(list(dl)) == 4
+
+
+class TestMetric:
+    def test_accuracy(self):
+        import paddle_tpu.metric as metric
+        m = metric.Accuracy()
+        pred = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+        label = paddle.to_tensor(np.array([[0], [0]]))
+        correct = m.compute(pred, label)
+        m.update(correct)
+        assert abs(m.accumulate() - 0.5) < 1e-6
+
+    def test_precision_recall(self):
+        import paddle_tpu.metric as metric
+        p = metric.Precision()
+        r = metric.Recall()
+        preds = np.array([1, 1, 0, 0], np.float32)
+        labels = np.array([1, 0, 1, 0], np.float32)
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 0.5) < 1e-6
+        assert abs(r.accumulate() - 0.5) < 1e-6
