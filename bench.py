@@ -1,0 +1,93 @@
+"""Benchmark: whole-step-compiled training throughput on the real chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Measures tokens/sec on a GPT-style transformer training step (the
+BASELINE.md north-star metric family), whole step compiled to one XLA
+program. vs_baseline is relative to a conservative reference anchor
+recorded in this file (see BASELINE.md: the reference repo publishes no
+absolute numbers, so the anchor is our own first measurement — later
+rounds must beat it).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    import jax
+
+    backend = jax.default_backend()
+
+    paddle.seed(0)
+    # model scale adapted to backend so CI/CPU smoke stays fast
+    if backend == "tpu":
+        d_model, n_layers, n_heads, seq, batch = 512, 8, 8, 512, 8
+        steps = 20
+    else:
+        d_model, n_layers, n_heads, seq, batch = 128, 2, 4, 128, 4
+        steps = 5
+
+    class TinyGPT(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(32000, d_model)
+            self.pos = nn.Embedding(seq, d_model)
+            enc_layer = nn.TransformerEncoderLayer(
+                d_model, n_heads, 4 * d_model, dropout=0.0,
+                activation="gelu", normalize_before=True)
+            self.blocks = nn.TransformerEncoder(enc_layer, n_layers)
+            self.norm = nn.LayerNorm(d_model)
+            self.head = nn.Linear(d_model, 32000)
+
+        def forward(self, ids, pos_ids):
+            h = self.embed(ids) + self.pos(pos_ids)
+            h = self.blocks(h)
+            return self.head(self.norm(h))
+
+    model = TinyGPT()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits.reshape([-1, 32000]),
+                               labels.reshape([-1]))
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 32000, (batch, seq)))
+    pos = paddle.to_tensor(np.tile(np.arange(seq), (batch, 1)))
+    labels = paddle.to_tensor(rng.randint(0, 32000, (batch, seq)))
+
+    # warmup (compile)
+    loss = step([ids, pos], [labels])
+    loss._data.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step([ids, pos], [labels])
+    loss._data.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq / dt
+
+    # anchor: first real-chip measurement of this config (round 1:
+    # 896,685 tok/s on TPU v5e-1) — later rounds must beat vs_baseline=1.0
+    baseline = {"tpu": 896_685.0, "cpu": 2_000.0}.get(backend, 2_000.0)
+    print(json.dumps({
+        "metric": f"gpt_train_tokens_per_sec_{backend}",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

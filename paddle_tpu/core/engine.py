@@ -23,8 +23,25 @@ import jax.numpy as jnp
 
 __all__ = [
     "GradNode", "run_backward", "no_grad", "enable_grad", "set_grad_enabled",
-    "is_grad_enabled",
+    "is_grad_enabled", "register_backward_final_hook",
 ]
+
+# callbacks fired after every run_backward sweep completes (the moment the
+# reference's EagerReducer finalizes bucketed allreduce — reducer.cc)
+_backward_final_hooks: Dict[int, Callable] = {}
+_bf_hook_id = [0]
+
+
+def register_backward_final_hook(fn: Callable):
+    _bf_hook_id[0] += 1
+    hid = _bf_hook_id[0]
+    _backward_final_hooks[hid] = fn
+
+    class _H:
+        def remove(self):
+            _backward_final_hooks.pop(hid, None)
+
+    return _H()
 
 
 class _GradState(threading.local):
@@ -296,4 +313,7 @@ def run_backward(
                     "allow_unused=True to get None for it")
             out.append(g)
         return out
+
+    for hook in list(_backward_final_hooks.values()):
+        hook()
     return None

@@ -88,9 +88,34 @@ def set_rng_state(state):
 class _Current(threading.local):
     def __init__(self):
         self.gen: Optional[Generator] = None
+        # jit tracing: (traced base key, draw counter). While active, draws
+        # come from fold_in(traced_key, n) so a compiled program gets fresh
+        # randomness from its key operand each call instead of baking a
+        # trace-time constant mask.
+        self.trace_key = None
+        self.trace_count = 0
 
 
 _CURRENT = _Current()
+
+
+@contextlib.contextmanager
+def use_trace_key(key):
+    prev = (_CURRENT.trace_key, _CURRENT.trace_count)
+    _CURRENT.trace_key = key
+    _CURRENT.trace_count = 0
+    try:
+        yield
+    finally:
+        _CURRENT.trace_key, _CURRENT.trace_count = prev
+
+
+def next_rng_key():
+    """Next key for an op needing randomness — trace-aware."""
+    if _CURRENT.trace_key is not None:
+        _CURRENT.trace_count += 1
+        return jax.random.fold_in(_CURRENT.trace_key, _CURRENT.trace_count)
+    return default_generator().next_key()
 
 
 class RNGStatesTracker:

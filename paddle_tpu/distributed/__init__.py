@@ -1,8 +1,35 @@
 """paddle_tpu.distributed — mirrors python/paddle/distributed.
 
-Built out incrementally; env/rank plumbing first, then collectives, mesh
-sharding, fleet, and parallel wrappers (SURVEY.md §2.3 inventory).
+SPMD core: ProcessMesh + placements + shard_tensor/reshard over
+jax.sharding (GSPMD inserts the collectives, they ride ICI). The
+imperative collective API compiles per-call; fleet layers hybrid
+parallelism on top (SURVEY.md §2.3).
 """
+from .auto_parallel import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    dtensor_from_local, get_mesh, reshard, set_mesh, shard_layer,
+    shard_tensor, unshard_dtensor,
+)
+from .communication import (  # noqa: F401
+    Group, P2POp, ReduceOp, all_gather, all_gather_object, all_reduce,
+    all_to_all, all_to_all_single, barrier, batch_isend_irecv, broadcast,
+    broadcast_object_list, destroy_process_group, gather, get_backend,
+    get_group, irecv, isend, new_group, recv, reduce, reduce_scatter,
+    scatter, scatter_object_list, send, wait,
+)
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from . import fleet  # noqa: F401
+from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F401
 
-__all__ = ["ParallelEnv", "get_rank", "get_world_size"]
+__all__ = [
+    "ProcessMesh", "Placement", "Replicate", "Shard", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
+    "dtensor_from_local", "unshard_dtensor", "get_mesh", "set_mesh",
+    "Group", "new_group", "get_group", "ReduceOp", "all_reduce",
+    "all_gather", "all_gather_object", "all_to_all", "all_to_all_single",
+    "reduce", "reduce_scatter", "broadcast", "broadcast_object_list",
+    "scatter", "scatter_object_list", "send", "recv", "isend", "irecv",
+    "P2POp", "batch_isend_irecv", "gather", "barrier", "wait",
+    "get_backend", "destroy_process_group", "ParallelEnv", "get_rank",
+    "get_world_size", "DataParallel", "init_parallel_env", "is_initialized",
+]

@@ -1,0 +1,7 @@
+from .api import (  # noqa: F401
+    dtensor_from_fn, dtensor_from_local, reshard, shard_layer, shard_tensor,
+    unshard_dtensor,
+)
+from .placement import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, get_mesh, set_mesh,
+)

@@ -1,0 +1,190 @@
+"""Placements + ProcessMesh.
+
+TPU-native equivalent of the reference's auto-parallel metadata
+(reference: paddle/phi/core/distributed/auto_parallel/placement_types.h —
+Replicate/Shard/Partial; process_mesh.h; python
+distributed/auto_parallel/process_mesh.py:71). A ProcessMesh wraps
+``jax.sharding.Mesh`` over the real device grid; placements translate to
+``PartitionSpec`` dims, with Partial tracked as pending-reduction state
+(GSPMD's partial-sum) resolved at reshard time.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["Placement", "Replicate", "Shard", "Partial", "ProcessMesh",
+           "get_mesh", "set_mesh"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Partial(Placement):
+    """Pending reduction over the mesh dim (reference: REDUCE_TYPE sum/avg/
+    max/min in placement_types.h)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+class ProcessMesh:
+    """N-D logical mesh over the device grid.
+
+    ``ProcessMesh([[0,1,2,3],[4,5,6,7]], dim_names=["dp","mp"])`` — the
+    reference's semantics (process ids in an ndarray) carried onto a
+    ``jax.sharding.Mesh`` whose axis names are the dim names.
+    """
+
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        if mesh is None and shape is not None:
+            mesh = np.asarray(process_ids if process_ids is not None
+                              else np.arange(int(np.prod(shape)))).reshape(shape)
+        arr = np.asarray(mesh)
+        self._mesh_arr = arr
+        self._shape = tuple(arr.shape)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = tuple(dim_names)
+        self._jax_mesh = None
+
+    # ---- reference API ----
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._mesh_arr.flatten().tolist()
+
+    @property
+    def mesh(self):
+        return self._mesh_arr
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        axis = self._dim_names.index(dim_name)
+        loc = np.argwhere(self._mesh_arr == process_id)
+        return int(loc[0][axis]) if len(loc) else -1
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            np.array_equal(self._mesh_arr, other._mesh_arr) and \
+            self._dim_names == other._dim_names
+
+    def __hash__(self):
+        return hash((self._mesh_arr.tobytes(), self._dim_names))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={list(self._shape)}, "
+                f"dim_names={list(self._dim_names)})")
+
+    # ---- jax bridge ----
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = np.asarray(jax.devices())
+            if devices.size < self._mesh_arr.size:
+                raise RuntimeError(
+                    f"mesh wants {self._mesh_arr.size} devices, only "
+                    f"{devices.size} available")
+            dev_grid = devices[self._mesh_arr.flatten()].reshape(self._shape)
+            self._jax_mesh = Mesh(dev_grid, self._dim_names)
+        return self._jax_mesh
+
+    def sharding_for(self, placements: Sequence[Placement], ndim: int
+                     ) -> NamedSharding:
+        """placements (one per mesh dim) → NamedSharding for an ndim array."""
+        spec: List = [None] * ndim
+        for mesh_dim, pl in enumerate(placements):
+            if isinstance(pl, Shard):
+                d = pl.dim
+                if spec[d] is None:
+                    spec[d] = self._dim_names[mesh_dim]
+                elif isinstance(spec[d], tuple):
+                    spec[d] = spec[d] + (self._dim_names[mesh_dim],)
+                else:
+                    spec[d] = (spec[d], self._dim_names[mesh_dim])
+        return NamedSharding(self.jax_mesh(), PartitionSpec(*spec))
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh

@@ -1,0 +1,10 @@
+from .collectives import (  # noqa: F401
+    P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    all_to_all_single, batch_isend_irecv, broadcast, broadcast_object_list,
+    gather, irecv, isend, recv, reduce, reduce_scatter, scatter,
+    scatter_object_list, send,
+)
+from .group import (  # noqa: F401
+    Group, barrier, destroy_process_group, get_backend, get_group,
+    is_initialized, new_group, wait,
+)

@@ -1,0 +1,332 @@
+"""Imperative collective API.
+
+TPU-native equivalent of the reference's communication ops (reference:
+python/paddle/distributed/communication/{all_reduce,all_gather,...}.py over
+ProcessGroupNCCL). Semantics by tensor kind:
+
+- dist tensors (mesh-sharded ``jax.Array``): the collective is a compiled
+  XLA collective (psum/all_gather/...) over the group's mesh axis — the
+  SPMD path that rides ICI.
+- plain tensors, world_size == 1: exact degenerate semantics (identity /
+  copy), matching the reference on a single rank.
+- plain tensors, multi-process: host-level collectives via
+  jax.experimental.multihost_utils (DCN path).
+
+Ordering: XLA programs are data-dependent; the Task/stream model degrades
+to completed futures (SURVEY.md §5.8 "no-op-with-tokens").
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from .group import Group, _get_default_group
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
+           "reduce", "reduce_scatter", "broadcast", "broadcast_object_list",
+           "scatter", "scatter_object_list", "all_to_all",
+           "all_to_all_single", "send", "recv", "isend", "irecv",
+           "batch_isend_irecv", "P2POp", "gather"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_REDUCE_FNS = {
+    ReduceOp.SUM: jnp.add,
+    ReduceOp.MAX: jnp.maximum,
+    ReduceOp.MIN: jnp.minimum,
+    ReduceOp.PROD: jnp.multiply,
+}
+
+
+class _CompletedTask:
+    """Task/Wait parity (reference process_group.h Task): XLA ordering is
+    data-dependency based so every returned task is already complete."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self):
+        if self._tensor is not None and hasattr(self._tensor, "_data"):
+            self._tensor._data.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _world(group: Optional[Group]) -> int:
+    g = group or _get_default_group()
+    return max(g.nranks, 1)
+
+
+def _is_dist(t: Tensor) -> bool:
+    return isinstance(t, Tensor) and t._dist_attr is not None
+
+
+def _multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group = None,
+               sync_op: bool = True):
+    if _is_dist(tensor):
+        from ..auto_parallel.api import reshard
+        from ..auto_parallel.placement import Replicate
+
+        mesh, placements = tensor._dist_attr
+        if any(p.is_partial() for p in placements):
+            out = reshard(tensor, mesh,
+                          [Replicate() if p.is_partial() else p
+                           for p in placements])
+            tensor._rebind(out._data)
+            tensor._dist_attr = out._dist_attr
+        return _CompletedTask(tensor)
+    if _world(group) == 1 and not _multihost():
+        return _CompletedTask(tensor)
+    if _multihost():
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(np.asarray(tensor._data))
+        fn = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
+              ReduceOp.MIN: np.min, ReduceOp.PROD: np.prod,
+              ReduceOp.AVG: np.mean}[op]
+        tensor._rebind(jnp.asarray(fn(gathered, axis=0)))
+        return _CompletedTask(tensor)
+    raise RuntimeError("all_reduce: no distributed context")
+
+
+def all_gather(tensor_list: List, tensor: Tensor, group: Group = None,
+               sync_op: bool = True):
+    n = _world(group)
+    if _is_dist(tensor):
+        # gather the per-rank shards along the group's axis
+        from ..auto_parallel.api import unshard_dtensor
+
+        mesh, placements = tensor._dist_attr
+        full = unshard_dtensor(tensor)
+        shard_dims = [p.dim for p in placements if p.is_shard()]
+        if shard_dims:
+            parts = jnp.split(full._data, n, axis=shard_dims[0])
+            tensor_list.extend(Tensor(p) for p in parts)
+        else:
+            tensor_list.extend(Tensor(full._data) for _ in range(n))
+        return _CompletedTask()
+    if n == 1 and not _multihost():
+        tensor_list.append(Tensor(tensor._data))
+        return _CompletedTask()
+    if _multihost():
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(np.asarray(tensor._data))
+        tensor_list.extend(Tensor(jnp.asarray(g)) for g in gathered)
+        return _CompletedTask()
+    raise RuntimeError("all_gather: no distributed context")
+
+
+def all_gather_object(object_list: List, obj, group: Group = None):
+    n = _world(group)
+    if n == 1 and not _multihost():
+        object_list.append(obj)
+        return
+    if _multihost():
+        import pickle
+
+        from jax.experimental import multihost_utils
+
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        # pad to a common max length via a first length exchange
+        ln = multihost_utils.process_allgather(np.asarray([payload.size]))
+        max_len = int(ln.max())
+        padded = np.zeros(max_len, np.uint8)
+        padded[: payload.size] = payload
+        datas = multihost_utils.process_allgather(padded)
+        for d, l in zip(datas, ln.ravel()):
+            object_list.append(pickle.loads(bytes(d[: int(l)])))
+        return
+    raise RuntimeError("all_gather_object: no distributed context")
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group: Group = None,
+           sync_op: bool = True):
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
+                   op=ReduceOp.SUM, group: Group = None, sync_op: bool = True):
+    n = _world(group)
+    if n == 1 and not _multihost():
+        t = tensor_list[0]
+        tensor._rebind(t._data if isinstance(t, Tensor) else jnp.asarray(t))
+        return _CompletedTask(tensor)
+    if _multihost():
+        # reduce all, keep own slice
+        stacked = jnp.stack([t._data for t in tensor_list])
+        all_reduce(Tensor(stacked), op=op, group=group)
+        tensor._rebind(stacked[jax.process_index()])
+        return _CompletedTask(tensor)
+    raise RuntimeError("reduce_scatter: no distributed context")
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Group = None,
+              sync_op: bool = True):
+    n = _world(group)
+    if n == 1 and not _multihost():
+        return _CompletedTask(tensor)
+    if _multihost():
+        from jax.experimental import multihost_utils
+
+        val = multihost_utils.broadcast_one_to_all(
+            np.asarray(tensor._data),
+            is_source=jax.process_index() == src)
+        tensor._rebind(jnp.asarray(val))
+        return _CompletedTask(tensor)
+    raise RuntimeError("broadcast: no distributed context")
+
+
+def broadcast_object_list(object_list: List, src: int = 0,
+                          group: Group = None):
+    if _world(group) == 1 and not _multihost():
+        return
+    if _multihost():
+        import pickle
+
+        from jax.experimental import multihost_utils
+
+        is_src = jax.process_index() == src
+        payload = np.frombuffer(pickle.dumps(object_list), np.uint8) \
+            if is_src else np.zeros(0, np.uint8)
+        ln = multihost_utils.broadcast_one_to_all(
+            np.asarray([payload.size]), is_source=is_src)
+        buf = np.zeros(int(ln[0]), np.uint8)
+        if is_src:
+            buf[:] = payload
+        data = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
+        object_list[:] = pickle.loads(bytes(data))
+        return
+    raise RuntimeError("broadcast_object_list: no distributed context")
+
+
+def scatter(tensor: Tensor, tensor_list: List[Tensor] = None, src: int = 0,
+            group: Group = None, sync_op: bool = True):
+    n = _world(group)
+    if n == 1 and not _multihost():
+        if tensor_list:
+            tensor._rebind(tensor_list[0]._data)
+        return _CompletedTask(tensor)
+    if _multihost():
+        from jax.experimental import multihost_utils
+
+        stacked = np.stack([np.asarray(t._data) for t in tensor_list]) \
+            if jax.process_index() == src and tensor_list else None
+        shape = (n,) + tuple(tensor._data.shape)
+        data = multihost_utils.broadcast_one_to_all(
+            stacked if stacked is not None else np.zeros(shape,
+                                                         tensor.numpy().dtype),
+            is_source=jax.process_index() == src)
+        tensor._rebind(jnp.asarray(data[jax.process_index()]))
+        return _CompletedTask(tensor)
+    raise RuntimeError("scatter: no distributed context")
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    objs = list(in_object_list or [])
+    broadcast_object_list(objs, src=src, group=group)
+    n = _world(group)
+    if n == 1:
+        out_object_list[:] = objs[:1]
+    else:
+        idx = jax.process_index() if _multihost() else 0
+        out_object_list[:] = [objs[idx]]
+
+
+def all_to_all(out_tensor_list: List, in_tensor_list: List[Tensor],
+               group: Group = None, sync_op: bool = True):
+    n = _world(group)
+    if n == 1 and not _multihost():
+        out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
+        return _CompletedTask()
+    if _multihost():
+        from jax.experimental import multihost_utils
+
+        stacked = np.stack([np.asarray(t._data) for t in in_tensor_list])
+        gathered = multihost_utils.process_allgather(stacked)  # [P, P, ...]
+        me = jax.process_index()
+        out_tensor_list.extend(
+            Tensor(jnp.asarray(gathered[p][me])) for p in range(n))
+        return _CompletedTask()
+    raise RuntimeError("all_to_all: no distributed context")
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
+                      in_split_sizes=None, group=None, sync_op=True):
+    n = _world(group)
+    if n == 1 and not _multihost():
+        out_tensor._rebind(in_tensor._data)
+        return _CompletedTask(out_tensor)
+    parts = jnp.split(in_tensor._data, n, axis=0)
+    outs: List[Tensor] = []
+    all_to_all(outs, [Tensor(p) for p in parts], group=group)
+    out_tensor._rebind(jnp.concatenate([o._data for o in outs], axis=0))
+    return _CompletedTask(out_tensor)
+
+
+def send(tensor: Tensor, dst: int = 0, group: Group = None,
+         sync_op: bool = True):
+    if _world(group) == 1 and not _multihost():
+        _P2P_BUF.setdefault(dst, []).append(jnp.asarray(tensor._data))
+        return _CompletedTask(tensor)
+    raise NotImplementedError(
+        "eager p2p send across processes: use the compiled pipeline "
+        "schedules (fleet.meta_parallel) whose ppermute rides ICI")
+
+
+_P2P_BUF = {}
+
+
+def recv(tensor: Tensor, src: int = 0, group: Group = None,
+         sync_op: bool = True):
+    if _world(group) == 1 and not _multihost():
+        buf = _P2P_BUF.get(src or 0)
+        if buf:
+            tensor._rebind(buf.pop(0))
+        return _CompletedTask(tensor)
+    raise NotImplementedError(
+        "eager p2p recv across processes: use the compiled pipeline "
+        "schedules (fleet.meta_parallel)")
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    gather_list = gather_list if gather_list is not None else []
+    return all_gather(gather_list, tensor, group=group)

@@ -1,0 +1,135 @@
+"""Communication groups (reference:
+python/paddle/distributed/communication/group.py — Group registry,
+new_group). A Group is a named set of ranks; on TPU it corresponds to a
+mesh axis (collectives over a group compile to ICI collectives along that
+axis) rather than an NCCL communicator.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..env import get_rank, get_world_size
+
+__all__ = ["Group", "new_group", "get_group", "destroy_process_group",
+           "is_initialized", "_get_default_group", "_set_default_group",
+           "wait", "barrier", "get_backend"]
+
+_group_map: Dict[int, "Group"] = {}
+_next_group_id = [0]
+_default_group: Optional["Group"] = None
+
+
+class Group:
+    def __init__(self, rank_in_group: int, gid: int, ranks: List[int],
+                 name: str = None, mesh_axis=None):
+        self._rank = rank_in_group
+        self._id = gid
+        self._ranks = list(ranks)
+        self._name = name or f"group_{gid}"
+        # (ProcessMesh, axis_name) when this group maps onto a mesh axis
+        self.mesh_axis = mesh_axis
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def id(self):
+        return self._id
+
+    @property
+    def ranks(self):
+        return self._ranks
+
+    @property
+    def nranks(self):
+        return len(self._ranks)
+
+    world_size = nranks
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return self._ranks.index(rank) if rank in self._ranks else -1
+
+    def is_member(self):
+        return get_rank() in self._ranks or self._rank >= 0
+
+    def __repr__(self):
+        return f"Group(id={self._id}, ranks={self._ranks})"
+
+
+def _set_default_group(group: Group):
+    global _default_group
+    _default_group = group
+    _group_map[0] = group
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        world = max(get_world_size(), 1)
+        _default_group = Group(get_rank(), 0, list(range(world)), "default")
+        _group_map[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks: Optional[List[int]] = None, backend=None,
+              timeout=None) -> Group:
+    _next_group_id[0] += 1
+    gid = _next_group_id[0]
+    if ranks is None:
+        ranks = list(range(max(get_world_size(), 1)))
+    my = get_rank()
+    rank_in_group = ranks.index(my) if my in ranks else -1
+    g = Group(rank_in_group, gid, ranks)
+    _group_map[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _get_default_group()
+    return _group_map[gid]
+
+
+def is_initialized() -> bool:
+    from ..env import is_initialized as env_init
+
+    return env_init() or _default_group is not None
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    global _default_group
+    if group is None:
+        _group_map.clear()
+        _default_group = None
+    else:
+        _group_map.pop(group.id, None)
+
+
+def get_backend(group=None) -> str:
+    return "xla"
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Stream-sync parity: XLA ordering is data-dependency based, so wait ≈
+    block_until_ready (reference: communication/wait — stream event)."""
+    if hasattr(tensor, "_data"):
+        tensor._data.block_until_ready()
+    return tensor
+
+
+def barrier(group=None):
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")

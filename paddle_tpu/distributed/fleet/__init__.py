@@ -1,0 +1,11 @@
+"""paddle_tpu.distributed.fleet — mirrors python/paddle/distributed/fleet."""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .fleet import (  # noqa: F401
+    Fleet, distributed_model, distributed_optimizer, fleet,
+    get_hybrid_communicate_group, init, is_first_worker, worker_index,
+    worker_num,
+)
+from . import meta_parallel  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,65 @@
+"""DistributedStrategy (reference:
+python/paddle/distributed/fleet/base/distributed_strategy.py:175 over
+protobuf distributed_strategy.proto:359). Plain-python config object with
+the same field surface; hybrid_configs drives the topology."""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class _Bunch(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel degrees (reference hybrid_configs)
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+            "mp_configs": _Bunch(),
+            "pp_configs": _Bunch(
+                micro_batch_size=1, accumulate_steps=1,
+                schedule_mode="1F1B"),
+        }
+        # feature toggles (subset of distributed_strategy.proto)
+        self.amp = False
+        self.amp_configs = _Bunch(
+            init_loss_scaling=32768.0, use_pure_fp16=False,
+            custom_white_list=[], custom_black_list=[], use_bf16=True)
+        self.recompute = False
+        self.recompute_configs = _Bunch(checkpoints=[])
+        self.sharding = False
+        self.sharding_configs = _Bunch(stage=1, degree=8)
+        self.gradient_merge = False
+        self.gradient_merge_configs = _Bunch(k_steps=1, avg=True)
+        self.pipeline = False
+        self.pipeline_configs = _Bunch(accumulate_steps=1,
+                                       micro_batch_size=1)
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = _Bunch(tensor_parallel_degree=1)
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.find_unused_parameters = False
+        self.heter_ccl_mode = False
+        self.without_graph_optimization = True
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items()
+                  if not k.startswith("_")}
+        return f"DistributedStrategy({fields})"

@@ -1,0 +1,116 @@
+"""Fleet facade.
+
+TPU-native equivalent of the reference's fleet (reference:
+python/paddle/distributed/fleet/fleet.py — Fleet:100, init:167,
+distributed_model via fleet/model.py:32, distributed_optimizer:1306 →
+HybridParallelOptimizer). ``fleet.init`` builds the hybrid topology as a
+ProcessMesh; ``distributed_model`` wraps per parallel mode;
+``distributed_optimizer`` adds TP-aware grad clip + sharding.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..env import get_rank, get_world_size
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["Fleet", "fleet", "init", "get_hybrid_communicate_group",
+           "distributed_model", "distributed_optimizer", "worker_index",
+           "worker_num", "is_first_worker"]
+
+_hcg: Optional[HybridCommunicateGroup] = None
+_strategy: Optional[DistributedStrategy] = None
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        global _hcg, _strategy
+        strategy = strategy or DistributedStrategy()
+        _strategy = strategy
+        hc = strategy.hybrid_configs
+        dims = [hc["pp_degree"], hc["mp_degree"], hc.get("sep_degree", 1),
+                hc["sharding_degree"], hc["dp_degree"]]
+        names = ["pp", "mp", "sep", "sharding", "dp"]
+        topo = CommunicateTopology(names, dims)
+        _hcg = HybridCommunicateGroup(topo)
+        self._is_initialized = True
+        return self
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+    def get_hybrid_communicate_group(self):
+        return _hcg
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return max(get_world_size(), 1)
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def barrier_worker(self):
+        from ..communication.group import barrier
+
+        barrier()
+
+    def distributed_model(self, model):
+        """Wrap per topology (fleet/model.py:32)."""
+        hcg = _hcg
+        if hcg is None:
+            raise RuntimeError("call fleet.init first")
+        if hcg.get_pipe_parallel_world_size() > 1:
+            from .meta_parallel.pipeline_parallel import PipelineParallel
+
+            return PipelineParallel(model, hcg, _strategy)
+        if hcg.get_model_parallel_world_size() > 1 or \
+                hcg.get_sep_parallel_world_size() > 1:
+            from .meta_parallel.tensor_parallel import TensorParallel
+
+            return TensorParallel(model, hcg, _strategy)
+        if hcg.get_data_parallel_world_size() > 1 and get_world_size() > 1:
+            from ..parallel import DataParallel
+
+            return DataParallel(model, group=hcg.get_data_parallel_group())
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .meta_optimizers.hybrid_parallel_optimizer import (
+            HybridParallelOptimizer,
+        )
+
+        if _hcg is None:
+            return optimizer
+        return HybridParallelOptimizer(optimizer, _hcg,
+                                       strategy or _strategy)
+
+    # static-graph-era APIs kept as informative stubs
+    def minimize(self, *a, **k):
+        raise NotImplementedError(
+            "static-graph fleet.minimize: use distributed_optimizer + "
+            "dygraph/TrainStep flow on TPU")
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    global _hcg
+    if _hcg is None:
+        # implicit single-axis topology (world of 1): everything degree 1
+        fleet.init()
+    return _hcg

@@ -1,0 +1,45 @@
+"""mp comm ops (reference: fleet/layers/mpu/mp_ops.py — _c_identity,
+_c_concat, _c_split, _mp_allreduce over NCCL). On TPU these are reshard
+annotations over the mp mesh axis."""
+from .....core.tensor import Tensor
+from ....auto_parallel.api import reshard, shard_tensor
+from ....auto_parallel.placement import Replicate, Shard
+
+__all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce"]
+
+
+def _mesh():
+    from ... import fleet
+
+    return fleet.get_hybrid_communicate_group().mesh
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    return tensor
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    mesh = _mesh()
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    if t._dist_attr is None:
+        return t
+    return reshard(t, mesh, [Replicate()] * mesh.ndim)
+
+
+def _c_split(tensor, group=None):
+    mesh = _mesh()
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    pls = [Replicate()] * mesh.ndim
+    pls[mesh.dim_names.index("mp")] = Shard(t.ndim - 1)
+    if t._dist_attr is None:
+        t = shard_tensor(t, mesh, [Replicate()] * mesh.ndim)
+    return reshard(t, mesh, pls)
+
+
+def _c_concat(tensor, group=None):
+    mesh = _mesh()
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    if t._dist_attr is None:
+        return t
+    return reshard(t, mesh, [Replicate()] * mesh.ndim)

@@ -1,0 +1,13 @@
+"""TP RNG state tracking (reference: fleet/layers/mpu/random.py:34
+RNGStatesTracker) — re-export of the core tracker."""
+from .....core.generator import (  # noqa: F401
+    RNGStatesTracker, get_rng_tracker, rng_state,
+)
+
+def get_rng_state_tracker():
+    return get_rng_tracker()
+
+model_parallel_random_seed = None
+
+def determinate_seed(rng_name="global_seed"):
+    return 0

@@ -1,0 +1,12 @@
+from .parallel_layers.pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .sharding.sharding_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer, GroupShardedOptimizerStage2, GroupShardedStage2,
+    GroupShardedStage3,
+)
+from ..layers.mpu.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..layers.mpu.random import get_rng_state_tracker  # noqa: F401

@@ -1,0 +1,1 @@
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401

@@ -1,0 +1,4 @@
+from .sharding_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer, GroupShardedOptimizerStage2, GroupShardedStage2,
+    GroupShardedStage3, shard_optimizer_states, shard_parameters,
+)

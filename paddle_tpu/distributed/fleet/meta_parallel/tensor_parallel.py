@@ -1,0 +1,31 @@
+"""TensorParallel model wrapper (reference:
+fleet/meta_parallel/tensor_parallel.py — broadcasts non-distributed
+params across the mp group at init; with dist tensors the mesh placement
+already guarantees consistency, so this wrapper is thin)."""
+from __future__ import annotations
+
+from ....nn.layer_base import Layer
+
+__all__ = ["TensorParallel"]
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

@@ -1,0 +1,1 @@
+from .recompute import recompute, recompute_hybrid, recompute_sequential  # noqa: F401

@@ -1,0 +1,98 @@
+"""Recompute (activation checkpointing).
+
+TPU-native equivalent of the reference's recompute (reference:
+fleet/recompute/recompute.py — RecomputeFunction:108 PyLayer with RNG
+state replay, recompute:404, recompute_sequential:542; offload variant
+recompute_hybrid.py). The mechanism here is ``jax.checkpoint``: the
+recomputed region's vjp saves only its inputs and rematerialises forward
+during backward — identical memory/compute trade, scheduled by XLA.
+RNG replay comes free: the region draws from a fold_in'd key captured at
+forward time, so the recompute sees identical dropout masks.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ....core import engine
+from ....core.generator import next_rng_key, use_trace_key
+from ....core.tensor import Tensor
+from ....nn.layer_base import Layer
+from ....ops.dispatch import eager_apply
+
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]
+
+
+def recompute(function: Callable, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, **kwargs):
+    """(recompute.py:404 parity)"""
+    layer = function if isinstance(function, Layer) else \
+        getattr(function, "__self__", None)
+    params = [p for _, p in layer.named_parameters()] if layer is not None \
+        else []
+    buffers = [b for _, b in layer.named_buffers()] if layer is not None \
+        else []
+
+    tensor_args = [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+                   for a in args]
+    n_args = len(tensor_args)
+    key = next_rng_key()  # captured once → deterministic replay
+
+    from ...fleet import fleet  # noqa: F401  (import side effects none)
+    from ....jit.static_function import _SwappedState
+
+    def raw(*arrays):
+        arg_arrays = arrays[:n_args]
+        param_arrays = arrays[n_args:]
+        with _SwappedState(params, list(param_arrays)), \
+                use_trace_key(key), engine.no_grad():
+            out = function(*[Tensor(a) for a in arg_arrays], **kwargs)
+        if isinstance(out, tuple):
+            return tuple(o._data for o in out)
+        return out._data
+
+    ckpt = jax.checkpoint(raw)
+    return eager_apply("recompute", ckpt, tensor_args + params,
+                       n_outputs=None)
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """(recompute.py:542) — checkpoint a Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    preserve = ctx.get("preserve_rng_state", True) if isinstance(ctx, dict) \
+        else True
+    if isinstance(functions, Layer):
+        functions = list(functions)
+    n = len(functions)
+    seg_size = max(n // max(segments, 1), 1)
+    out = args
+    i = 0
+    while i < n:
+        chunk = functions[i: i + seg_size]
+
+        class _Chunk(Layer):
+            def __init__(self, layers_):
+                super().__init__()
+                from ....nn.layer_base import LayerList
+
+                self.ls = LayerList(layers_)
+
+            def forward(self, *xs):
+                y = xs if len(xs) > 1 else xs[0]
+                for l in self.ls:
+                    y = l(*(y if isinstance(y, tuple) else (y,)))
+                return y
+
+        out = recompute(_Chunk(chunk),
+                        *(out if isinstance(out, tuple) else (out,)),
+                        preserve_rng_state=preserve)
+        i += seg_size
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """(recompute_hybrid.py) — offload variant; on TPU remat already frees
+    HBM so offload reduces to plain recompute."""
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,153 @@
+"""Sequence parallelism (Megatron-style SP).
+
+TPU-native equivalent of the reference's SP utils (reference:
+fleet/utils/sequence_parallel_utils.py — ScatterOp:85/GatherOp/
+AllGatherOp:111/ReduceScatterOp:127 PyLayers;
+ColumnSequenceParallelLinear:230, RowSequenceParallelLinear:340). On TPU
+these transitions are reshard annotations along the sequence dim over the
+mp axis — GSPMD emits the all-gather / reduce-scatter pairs, and because
+they're inside the compiled program XLA overlaps them with compute.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer_base import Layer
+from ...auto_parallel.api import reshard, shard_tensor
+from ...auto_parallel.placement import Replicate, Shard
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+def _mp_mesh():
+    from .. import fleet
+
+    hcg = fleet.get_hybrid_communicate_group()
+    return hcg.mesh
+
+
+def _seq_placements(mesh, seq_dim=0, shard=True):
+    pls = [Replicate()] * mesh.ndim
+    if shard:
+        pls[mesh.dim_names.index("mp")] = Shard(seq_dim)
+    return pls
+
+
+class ScatterOp:
+    """Split activations along seq over mp (ScatterOp:85). The sequence
+    dim convention follows the reference: [s, b, h]."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        mesh = _mp_mesh()
+        t = x if isinstance(x, Tensor) else Tensor(x)
+        if t._dist_attr is None:
+            t = shard_tensor(t, mesh, [Replicate()] * mesh.ndim)
+        return reshard(t, mesh, _seq_placements(mesh, axis))
+
+
+class GatherOp:
+    """Gather seq-sharded activations (inverse of Scatter)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        mesh = _mp_mesh()
+        t = x if isinstance(x, Tensor) else Tensor(x)
+        if t._dist_attr is None:
+            return t
+        return reshard(t, mesh, [Replicate()] * mesh.ndim)
+
+
+class AllGatherOp:
+    """(AllGatherOp:111) — forward all-gather, backward reduce-scatter;
+    the adjoint pair falls out of differentiating the reshard."""
+
+    @staticmethod
+    def apply(x):
+        return GatherOp.apply(x, axis=0)
+
+
+class ReduceScatterOp:
+    """(ReduceScatterOp:127) — forward reduce-scatter over seq."""
+
+    @staticmethod
+    def apply(x):
+        return ScatterOp.apply(x, axis=0)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """(sequence_parallel_utils.py:192) — with dist tensors the SP-param
+    grad allreduce is emitted by GSPMD inside the compiled step; nothing to
+    hook eagerly."""
+    return None
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """(:230) input arrives seq-sharded; all-gather seq → column matmul →
+    output feature-sharded."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        mesh = _mp_mesh()
+        self._mesh = mesh
+        self.gather_output = gather_output
+        w = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        pls = [Replicate()] * mesh.ndim
+        pls[mesh.dim_names.index("mp")] = Shard(1)
+        self.weight = shard_tensor(w, mesh, pls)
+        if has_bias or has_bias is None:
+            b = self.create_parameter(shape=[out_features], is_bias=True)
+            bpl = [Replicate()] * mesh.ndim
+            bpl[mesh.dim_names.index("mp")] = Shard(0)
+            self.bias = shard_tensor(b, mesh, bpl)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = reshard(out, self._mesh,
+                          [Replicate()] * self._mesh.ndim)
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """(:340) row matmul (input feature-sharded) → reduce-scatter over seq."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        mesh = _mp_mesh()
+        self._mesh = mesh
+        w = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        pls = [Replicate()] * mesh.ndim
+        pls[mesh.dim_names.index("mp")] = Shard(0)
+        self.weight = shard_tensor(w, mesh, pls)
+        if has_bias:
+            b = self.create_parameter(shape=[out_features], is_bias=True)
+            self.bias = shard_tensor(b, mesh, [Replicate()] * mesh.ndim)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        return ReduceScatterOp.apply(out)

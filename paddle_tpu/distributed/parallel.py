@@ -1,0 +1,170 @@
+"""Process bootstrap + dygraph DataParallel.
+
+TPU-native equivalent of the reference's parallel bootstrap (reference:
+python/paddle/distributed/parallel.py — init_parallel_env:943 builds
+TCPStore + default NCCL group; DataParallel:202 with EagerReducer bucketed
+allreduce, reducer.cc). Here bootstrap = ``jax.distributed.initialize``
+(the coordinator service is JAX's TCPStore equivalent); the default group
+maps onto the full device set. DataParallel syncs grads at backward end
+with bucketed host-collectives in the multi-process case; in the compiled
+path (TrainStep over a dp mesh axis) GSPMD inserts the gradient psum and
+the wrapper is transparent.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import Layer
+from . import env as _env
+from .communication.collectives import ReduceOp, all_reduce
+from .communication.group import Group, _get_default_group, _set_default_group
+
+__all__ = ["init_parallel_env", "DataParallel", "get_rank", "get_world_size",
+           "is_initialized"]
+
+get_rank = _env.get_rank
+get_world_size = _env.get_world_size
+
+
+def is_initialized() -> bool:
+    return _env.is_initialized()
+
+
+def init_parallel_env(*args, **kwargs) -> Group:
+    """Initialize the distributed context (parallel.py:943 parity).
+
+    Env contract matches the reference launcher: MASTER_ADDR/MASTER_PORT,
+    PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM. With >1 processes this calls
+    ``jax.distributed.initialize`` (coordinator = rank 0, the TCPStore
+    equivalent at tcp_store.h:121); single process is a no-op that still
+    registers the default group over local devices.
+    """
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if world > 1 and not _env.is_initialized():
+        addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = os.environ.get("MASTER_PORT", "8701")
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port}",
+            num_processes=world, process_id=rank)
+    _env._mark_initialized()
+    g = Group(rank, 0, list(range(max(world, 1))), "default")
+    _set_default_group(g)
+    return g
+
+
+class DataParallel(Layer):
+    """Dygraph data parallel (parallel.py:202).
+
+    Gradient sync happens once per backward at the last grad hook — grads
+    are flattened into fused buckets (EagerReducer's bucketing,
+    reducer.cc) and all-reduced; `no_sync` defers sync for gradient
+    accumulation. With one process (TPU SPMD style), sync is a no-op and
+    parallelism comes from the compiled step over the dp mesh axis.
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1, find_unused_parameters=False,
+                 group: Group = None, **kw):
+        super().__init__()
+        self._layers = layers
+        self.group = group or _get_default_group()
+        self.comm_buffer_size_mb = comm_buffer_size
+        self.find_unused_parameters = find_unused_parameters
+        self._grads_synced = False
+        self._sync_enabled = True
+        self._hooked = []
+        if self.group.nranks > 1:
+            self._register_hooks()
+
+    # ---- reference API ----
+    @property
+    def _sublayer(self):
+        return self._layers
+
+    def forward(self, *inputs, **kwargs):
+        self._grads_synced = False
+        return self._layers(*inputs, **kwargs)
+
+    def no_sync(self):
+        import contextlib
+
+        dp = self
+
+        @contextlib.contextmanager
+        def ctx():
+            prev = dp._sync_enabled
+            dp._sync_enabled = False
+            try:
+                yield
+            finally:
+                dp._sync_enabled = prev
+
+        return ctx()
+
+    def scale_loss(self, loss):
+        return loss
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    # ---- grad sync (EagerReducer equivalent) ----
+    def _register_hooks(self):
+        from ..core.engine import register_backward_final_hook
+
+        self._tracked = [p for p in self._layers.parameters()
+                         if not p.stop_gradient]
+        self._needs_sync = False
+        self.register_forward_pre_hook(
+            lambda l, i: setattr(self, "_needs_sync", True))
+
+        def on_backward_done():
+            # fires at the end of every backward sweep — robust to unused
+            # parameters (find_unused_parameters is implicit: only params
+            # that actually received grads participate)
+            if self._needs_sync and self._sync_enabled:
+                self._needs_sync = False
+                self._sync_all_grads()
+
+        self._bf_hook = register_backward_final_hook(on_backward_done)
+
+    def _sync_all_grads(self):
+        """Bucketed allreduce of all grads (fused flat buffers,
+        reducer.cc / group_sharded_storage.py pattern)."""
+        params = [p for p in self._tracked if p.grad is not None]
+        if not params:
+            return
+        nranks = self.group.nranks
+        flat = jnp.concatenate([p.grad._data.reshape(-1).astype(jnp.float32)
+                                for p in params])
+        t = Tensor(flat)
+        all_reduce(t, op=ReduceOp.SUM, group=self.group)
+        flat = t._data / nranks
+        offset = 0
+        for p in params:
+            n = p.grad.size
+            p.grad._rebind(flat[offset:offset + n].reshape(
+                p.grad._data.shape).astype(p.grad._data.dtype))
+            offset += n
+
+    def sync_params_buffers(self):
+        from .communication.collectives import broadcast
+
+        for p in self._layers.parameters():
+            broadcast(p, src=0, group=self.group)

@@ -1,0 +1,1 @@
+from .moe_layer import ExpertFFN, GShardGate, MoELayer, NaiveGate, SwitchGate  # noqa: F401

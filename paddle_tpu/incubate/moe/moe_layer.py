@@ -1,0 +1,221 @@
+"""Mixture-of-Experts layer.
+
+TPU-native equivalent of the reference's MoE (reference:
+python/paddle/incubate/distributed/models/moe/moe_layer.py:263 MoELayer,
+gates gshard_gate.py/switch_gate.py/naive_gate.py; expert-parallel
+dispatch via global_scatter/global_gather all-to-all
+fluid/operators/collective/global_scatter_op.cu; cutlass grouped-GEMM
+moe_kernel.cu). The TPU formulation is the GShard einsum algebra:
+top-k gate → capacity-bounded one-hot dispatch/combine tensors → einsum
+dispatch → per-expert FFN (stacked weights; one batched matmul on the
+MXU = the grouped GEMM) → einsum combine. Expert parallelism = shard the
+expert dim of the stacked weights over the mesh's ep/mp axis; GSPMD emits
+the all-to-all the reference launches by hand.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer_base import Layer, LayerList
+from ...ops.dispatch import as_tensor_args, eager_apply
+
+__all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate", "ExpertFFN"]
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_experts: int, top_k: int):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.weight = self.create_parameter(
+            shape=[d_model, num_experts],
+            default_initializer=I.XavierUniform())
+
+
+class NaiveGate(BaseGate):
+    """top-k softmax gate, no auxiliary loss (naive_gate.py)."""
+
+    aux_loss_weight = 0.0
+
+
+class GShardGate(BaseGate):
+    """GShard gate: top-2 + load-balancing aux loss (gshard_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.25):
+        super().__init__(d_model, num_experts, top_k)
+        self.capacity_factor = capacity_factor
+        self.aux_loss_weight = 1e-2
+
+
+class SwitchGate(BaseGate):
+    """Switch Transformer gate: top-1 (switch_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=1, capacity_factor=1.25):
+        super().__init__(d_model, num_experts, top_k)
+        self.capacity_factor = capacity_factor
+        self.aux_loss_weight = 1e-2
+
+
+class ExpertFFN(Layer):
+    """Stacked-expert FFN: weights [E, d, d_ff] / [E, d_ff, d] so the whole
+    expert bank is two batched matmuls (the grouped-GEMM form)."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.w1 = self.create_parameter(
+            shape=[num_experts, d_model, d_hidden],
+            default_initializer=I.XavierUniform())
+        self.b1 = self.create_parameter(
+            shape=[num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter(
+            shape=[num_experts, d_hidden, d_model],
+            default_initializer=I.XavierUniform())
+        self.b2 = self.create_parameter(
+            shape=[num_experts, 1, d_model], is_bias=True)
+        self.activation = activation
+
+
+class MoELayer(Layer):
+    """(moe_layer.py:263 parity, GShard algebra)
+
+    Args follow the reference loosely: ``experts`` may be an ExpertFFN
+    (fast stacked path) or a list of per-expert Layers (generic path).
+    """
+
+    def __init__(self, d_model: int, experts=None, gate="gshard",
+                 num_experts: Optional[int] = None, top_k: int = 2,
+                 d_hidden: Optional[int] = None, capacity_factor=1.25,
+                 moe_group=None, mp_group=None, recompute_interval=0,
+                 name=None):
+        super().__init__()
+        if isinstance(experts, (list, LayerList)):
+            self.experts = LayerList(list(experts))
+            num_experts = len(self.experts)
+            self.stacked = None
+        else:
+            assert num_experts is not None
+            self.stacked = experts if isinstance(experts, ExpertFFN) else \
+                ExpertFFN(num_experts, d_model,
+                          d_hidden or 4 * d_model)
+            self.experts = None
+        self.num_experts = num_experts
+        self.d_model = d_model
+
+        if isinstance(gate, str):
+            gate_cls = {"naive": NaiveGate, "gshard": GShardGate,
+                        "switch": SwitchGate}[gate]
+            if gate_cls is SwitchGate:
+                top_k = 1
+            self.gate = gate_cls(d_model, num_experts, top_k) \
+                if gate_cls is NaiveGate else \
+                gate_cls(d_model, num_experts, top_k=top_k,
+                         capacity_factor=capacity_factor)
+        else:
+            self.gate = gate
+        self.top_k = self.gate.top_k
+        self.capacity_factor = getattr(self.gate, "capacity_factor",
+                                       capacity_factor)
+        self.aux_loss: Optional[Tensor] = None
+
+    def forward(self, x):
+        orig_shape = x.shape
+        d = self.d_model
+        tokens = int(np.prod(orig_shape[:-1]))
+        E, K = self.num_experts, self.top_k
+        capacity = max(int(math.ceil(tokens * K * self.capacity_factor / E)),
+                       1)
+        aux_w = getattr(self.gate, "aux_loss_weight", 0.0)
+
+        if self.stacked is not None:
+            st = self.stacked
+            act = st.activation
+            tensors = as_tensor_args(x, self.gate.weight, st.w1, st.b1,
+                                     st.w2, st.b2)
+
+            def raw(xa, wg, w1, b1, w2, b2):
+                xt = xa.reshape(tokens, d)
+                logits = xt @ wg                               # [T, E]
+                probs = jax.nn.softmax(logits, -1)
+                combine, dispatch, aux = _gshard_dispatch(
+                    probs, E, K, capacity)
+                # dispatch: [T, E, C] → expert inputs [E, C, d]
+                exp_in = jnp.einsum("tec,td->ecd", dispatch, xt)
+                h = exp_in @ w1 + b1                           # [E, C, ff]
+                h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
+                exp_out = h @ w2 + b2                          # [E, C, d]
+                out = jnp.einsum("tec,ecd->td", combine, exp_out)
+                return out.reshape(xa.shape), aux
+
+            out, aux = eager_apply("moe_layer", raw, tensors, n_outputs=2)
+            self.aux_loss = aux * aux_w if aux_w else aux
+            return out
+
+        # generic per-expert path (heterogeneous experts); gate grads flow
+        # through the combine weights produced by the dispatch op
+        xt = x.reshape([tokens, d])
+
+        def raw_dispatch(xa, wg):
+            logits = xa @ wg
+            probs = jax.nn.softmax(logits, -1)
+            combine, dispatch, aux = _gshard_dispatch(probs, E, K, capacity)
+            exp_in = jnp.einsum("tec,td->ecd", dispatch, xa)
+            return exp_in, combine, aux
+
+        exp_in_all, combine_t, aux = eager_apply(
+            "moe_dispatch", raw_dispatch,
+            as_tensor_args(xt, self.gate.weight), n_outputs=3)
+        outs = []
+        for e, expert in enumerate(self.experts):
+            outs.append(expert(exp_in_all[e]))
+        import paddle_tpu as paddle
+
+        exp_out = paddle.stack(outs, axis=0)
+        out = eager_apply(
+            "moe_combine",
+            lambda c, eo: jnp.einsum("tec,ecd->td", c, eo),
+            as_tensor_args(combine_t, exp_out))
+        self.aux_loss = aux * aux_w if aux_w else aux
+        return out.reshape(orig_shape)
+
+
+def _gshard_dispatch(probs, E, K, capacity):
+    """GShard top-K dispatch with capacity (pure jnp; differentiable
+    through the combine weights)."""
+    T = probs.shape[0]
+    topk_val, topk_idx = jax.lax.top_k(probs, K)              # [T, K]
+    # normalize selected probabilities
+    topk_val = topk_val / jnp.sum(topk_val, -1, keepdims=True)
+
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    dispatch = jnp.zeros((T, E, capacity), probs.dtype)
+    for k in range(K):
+        idx = topk_idx[:, k]                                  # [T]
+        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)    # [T, E]
+        # position within expert buffer (running count per expert)
+        pos_in_e = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [T, E]
+        pos = jnp.sum(pos_in_e, axis=-1).astype(jnp.int32)    # [T]
+        keep = pos < capacity
+        pos_cap = jnp.clip(pos, 0, capacity - 1)
+        cap_onehot = jax.nn.one_hot(pos_cap, capacity,
+                                    dtype=probs.dtype)        # [T, C]
+        mask = (onehot * keep[:, None].astype(probs.dtype))
+        disp_k = mask[:, :, None] * cap_onehot[:, None, :]    # [T, E, C]
+        dispatch = dispatch + disp_k
+        combine = combine + disp_k * topk_val[:, k][:, None, None]
+
+    # load-balance aux loss (gshard): E * sum_e(frac_tokens_e * mean_prob_e)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(
+        jax.nn.one_hot(topk_idx[:, 0], E, dtype=probs.dtype), axis=0)
+    aux = jnp.sum(me * ce) * E
+    return combine, dispatch, aux

@@ -1,5 +1,19 @@
-def to_static(function=None, **kwargs):
-    """placeholder — replaced by full jit module."""
-    def deco(fn):
-        return fn
-    return deco(function) if callable(function) else deco
+"""paddle_tpu.jit — mirrors python/paddle/jit (to_static, save, load) plus
+the TPU-native whole-step compiler (TrainStep)."""
+from .api import TranslatedLayer, load, save  # noqa: F401
+from .static_function import (  # noqa: F401
+    StaticFunction, not_to_static, to_static,
+)
+from .train_step import TrainStep  # noqa: F401
+
+__all__ = ["to_static", "not_to_static", "save", "load", "StaticFunction",
+           "TranslatedLayer", "TrainStep"]
+
+
+def enable_to_static(flag: bool = True):
+    StaticFunction._globally_enabled = bool(flag)
+
+
+def ignore_module(modules):
+    """SOT-compat no-op (we trace through everything)."""
+    return None

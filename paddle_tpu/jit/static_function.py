@@ -1,0 +1,292 @@
+"""@to_static: trace the eager program into one compiled XLA executable.
+
+TPU-native equivalent of the reference's dy2static stack (reference:
+python/paddle/jit/api.py:171 ``to_static``; ProgramTranslator
+dy2static/program_translator.py:1724; PartialProgramLayer
+dy2static/partial_program.py:151 running the traced program as one op).
+
+Design (SURVEY.md §7.0 "functional core, imperative shell"): instead of
+AST/bytecode rewriting, the eager ops already run over jax arrays — so
+"to static" = swap Layer state for traced arrays, run the SAME Python
+forward once under ``jax.jit`` tracing, and cache the compiled program per
+input signature (the ``_ExecutorCache`` equivalent). Mutated buffers
+(BN running stats) become explicit program outputs. Backward through a
+compiled forward is a single tape GradNode whose vjp is a second cached
+compiled program that rematerialises the forward (jax.vjp inside jit) —
+remat keeps memory flat, XLA fuses fwd+bwd.
+
+Randomness: the program takes a PRNG key operand; in-trace draws are
+``fold_in(key, counter)`` (core/generator.use_trace_key), so each call gets
+fresh dropout masks without recompilation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import engine
+from ..core.generator import default_generator, use_trace_key
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["StaticFunction", "to_static", "not_to_static"]
+
+
+class _TensorIndex:
+    """Placeholder marking a Tensor leaf's position in an output pytree."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+    def __repr__(self):
+        # stable repr — participates in the program-cache signature
+        return f"T{self.i}"
+
+
+def _flatten_tensors(obj, out: List[Tensor]):
+    if isinstance(obj, Tensor):
+        out.append(obj)
+        return _TensorIndex(len(out) - 1)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_flatten_tensors(v, out) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _flatten_tensors(v, out) for k, v in obj.items()}
+    return obj
+
+
+def _unflatten_tensors(tmpl, tensors):
+    if isinstance(tmpl, _TensorIndex):
+        return tensors[tmpl.i]
+    if isinstance(tmpl, (list, tuple)):
+        return type(tmpl)(_unflatten_tensors(v, tensors) for v in tmpl)
+    if isinstance(tmpl, dict):
+        return {k: _unflatten_tensors(v, tensors) for k, v in tmpl.items()}
+    return tmpl
+
+
+class _SwappedState:
+    """Temporarily rebind a list of Tensors' buffers (trace-time)."""
+
+    def __init__(self, tensors, arrays):
+        self.tensors = tensors
+        self.arrays = arrays
+
+    def __enter__(self):
+        self.saved = [t._data for t in self.tensors]
+        for t, a in zip(self.tensors, self.arrays):
+            t._data = a
+        return self
+
+    def __exit__(self, *exc):
+        for t, s in zip(self.tensors, self.saved):
+            t._data = s
+        return False
+
+
+class _Program:
+    """One (signature → compiled fwd/bwd) entry; ≈ PartialProgramLayer."""
+
+    def __init__(self, sf: "StaticFunction", args_tmpl, kwargs_tmpl,
+                 n_args: int):
+        self.sf = sf
+        self.args_tmpl = args_tmpl
+        self.kwargs_tmpl = kwargs_tmpl
+        self.n_args = n_args
+        self.out_tmpl = None
+        self._fwd = jax.jit(self._pure_fwd)
+        self._bwd = jax.jit(self._pure_bwd, static_argnums=4)
+
+    # ---- the pure functions (traced by jax.jit) ----
+    def _run_python(self, param_arrays, buffer_arrays, arg_arrays, key):
+        sf = self.sf
+        arg_tensors = [Tensor(a) for a in arg_arrays]
+        args = _unflatten_tensors(self.args_tmpl, arg_tensors)
+        kwargs = _unflatten_tensors(self.kwargs_tmpl, arg_tensors)
+        with _SwappedState(sf._params + sf._buffers,
+                           list(param_arrays) + list(buffer_arrays)), \
+                use_trace_key(key), engine.no_grad():
+            out = sf._orig_fn(*args, **kwargs)
+            # read mutated buffers (BN running stats) BEFORE state restore
+            new_buffers = [b._data for b in sf._buffers]
+        out_tensors: List[Tensor] = []
+        out_tmpl = _flatten_tensors(out, out_tensors)
+        return out_tmpl, [t._data for t in out_tensors], new_buffers
+
+    def _pure_fwd(self, param_arrays, buffer_arrays, arg_arrays, key):
+        out_tmpl, out_arrays, new_buffers = self._run_python(
+            param_arrays, buffer_arrays, arg_arrays, key)
+        self.out_tmpl = out_tmpl  # structure is trace-invariant
+        return out_arrays, new_buffers
+
+    def _pure_bwd(self, param_arrays, buffer_arrays, arg_arrays, key,
+                  diff_arg_idx, cots):
+        """Recompute-forward vjp wrt (params, selected args)."""
+        diff_arg_idx = tuple(diff_arg_idx)
+
+        def f(p_arrays, d_args):
+            full_args = list(arg_arrays)
+            for i, a in zip(diff_arg_idx, d_args):
+                full_args[i] = a
+            _, out_arrays, _ = self._run_python(p_arrays, buffer_arrays,
+                                                full_args, key)
+            return tuple(out_arrays)
+
+        d_arg_arrays = [arg_arrays[i] for i in diff_arg_idx]
+        _, vjp_fn = jax.vjp(f, list(param_arrays), d_arg_arrays)
+        p_grads, a_grads = vjp_fn(tuple(cots))
+        return p_grads, a_grads
+
+    # ---- execution ----
+    def run(self, arg_tensors: List[Tensor]):
+        sf = self.sf
+        p_arrays = [p._data for p in sf._params]
+        b_arrays = [b._data for b in sf._buffers]
+        a_arrays = [t._data for t in arg_tensors]
+        key = default_generator().next_key()
+
+        out_arrays, new_buffers = self._fwd(p_arrays, b_arrays, a_arrays, key)
+        for b, nb in zip(sf._buffers, new_buffers):
+            if nb is not b._data:
+                b._rebind(nb)
+
+        grad_wanted = engine.is_grad_enabled() and (
+            any(not p.stop_gradient for p in sf._params)
+            or any(not t.stop_gradient for t in arg_tensors))
+
+        out_tensors = [Tensor(a) for a in out_arrays]
+        if grad_wanted:
+            diff_params = [p for p in sf._params if not p.stop_gradient
+                           and jnp.issubdtype(p._data.dtype, jnp.inexact)]
+            diff_arg_idx = tuple(
+                i for i, t in enumerate(arg_tensors)
+                if not t.stop_gradient
+                and jnp.issubdtype(t._data.dtype, jnp.inexact))
+            diff_p_idx = [i for i, p in enumerate(sf._params)
+                          if not p.stop_gradient
+                          and jnp.issubdtype(p._data.dtype, jnp.inexact)]
+            bwd = self._bwd
+
+            def vjp_fn(cots, _p=p_arrays, _b=b_arrays, _a=a_arrays, _k=key):
+                p_grads, a_grads = bwd(_p, _b, _a, _k, diff_arg_idx, cots)
+                return tuple(p_grads[i] for i in diff_p_idx) + tuple(a_grads)
+
+            edges = []
+            for p in diff_params:
+                edges.append(("leaf", p))
+            for i in diff_arg_idx:
+                t = arg_tensors[i]
+                if t._grad_node is not None:
+                    edges.append(("node", t._grad_node, t._out_idx))
+                else:
+                    edges.append(("leaf", t))
+            out_avals = [(o.shape, o.dtype) for o in out_arrays]
+            node = engine.GradNode(f"to_static[{sf._name}]", vjp_fn, edges,
+                                   out_avals)
+            for idx, t in enumerate(out_tensors):
+                if jnp.issubdtype(t._data.dtype, jnp.inexact):
+                    t.stop_gradient = False
+                    t._grad_node = node
+                    t._out_idx = idx
+        return _unflatten_tensors(self.out_tmpl, out_tensors)
+
+
+class StaticFunction:
+    """≈ dy2static StaticFunction (program_translator.py:324)."""
+
+    def __init__(self, function: Callable, layer=None, input_spec=None,
+                 build_strategy=None, backend=None, full_graph=True):
+        self._orig_fn = function
+        self._layer = layer if layer is not None else getattr(
+            function, "__self__", None)
+        self._input_spec = input_spec
+        self._name = getattr(function, "__name__", "fn")
+        self._programs: Dict[Any, _Program] = {}
+        self._enabled = True
+        functools.update_wrapper(self, function,
+                                 assigned=("__name__", "__doc__"))
+
+    # state snapshot (ordered, stable across calls)
+    @property
+    def _params(self) -> List[Parameter]:
+        if self._layer is None:
+            return []
+        return [p for _, p in self._layer.named_parameters()]
+
+    @property
+    def _buffers(self) -> List[Tensor]:
+        if self._layer is None:
+            return []
+        return [b for _, b in self._layer.named_buffers()]
+
+    def _signature(self, arg_tensors, args_tmpl, kwargs_tmpl):
+        avals = tuple((tuple(t._data.shape), str(t._data.dtype),
+                       bool(t.stop_gradient)) for t in arg_tensors)
+        training = self._layer.training if self._layer is not None else None
+        static_repr = repr((args_tmpl, kwargs_tmpl))
+        n_state = (len(self._params), len(self._buffers))
+        return (avals, training, static_repr, n_state,
+                engine.is_grad_enabled())
+
+    def __call__(self, *args, **kwargs):
+        if not self._enabled:
+            return self._orig_fn(*args, **kwargs)
+        arg_tensors: List[Tensor] = []
+        args_tmpl = _flatten_tensors(list(args), arg_tensors)
+        kwargs_tmpl = _flatten_tensors(dict(kwargs), arg_tensors)
+        sig = self._signature(arg_tensors, args_tmpl, kwargs_tmpl)
+        prog = self._programs.get(sig)
+        if prog is None:
+            prog = _Program(self, args_tmpl, kwargs_tmpl, len(arg_tensors))
+            self._programs[sig] = prog
+        return prog.run(arg_tensors)
+
+    # paddle API surface
+    @property
+    def program_cache(self):
+        return self._programs
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    def rollback(self):
+        """Restore the original eager function (paddle API)."""
+        self._enabled = False
+        if self._layer is not None:
+            self._layer.forward = self._orig_fn
+        return self._orig_fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """``paddle.jit.to_static`` (reference jit/api.py:171).
+
+    Accepts a plain function, a Layer method, or a Layer instance (wraps its
+    ``forward``); usable as decorator or call.
+    """
+    from ..nn import Layer
+
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, layer=layer,
+                                input_spec=input_spec,
+                                build_strategy=build_strategy)
+            layer.forward = sf
+            return layer
+        if isinstance(fn, StaticFunction):
+            return fn
+        return StaticFunction(fn, input_spec=input_spec,
+                              build_strategy=build_strategy)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

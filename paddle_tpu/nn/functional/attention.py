@@ -17,7 +17,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ...core.generator import default_generator
+from ...core.generator import next_rng_key
 from ...ops.dispatch import eager_apply, as_tensor_args
 
 __all__ = [
@@ -84,7 +84,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     tensors = as_tensor_args(*((query, key, value, attn_mask)
                                if attn_mask is not None
                                else (query, key, value)))
-    dkey = default_generator().next_key() if (dropout_p > 0.0 and training) else None
+    dkey = next_rng_key() if (dropout_p > 0.0 and training) else None
 
     def raw(*arrs):
         return _attention_raw(

@@ -16,7 +16,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...core.generator import default_generator
+from ...core.generator import next_rng_key
 from ...core.tensor import Tensor
 from ...ops.dispatch import defun, eager_apply, as_tensor_args
 
@@ -49,7 +49,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     if p == 1.0:
         return eager_apply("dropout", lambda a: jnp.zeros_like(a),
                           as_tensor_args(x))
-    key = default_generator().next_key()
+    key = next_rng_key()
     t = as_tensor_args(x)[0]
     shape = list(t._data.shape)
     if axis is not None:
@@ -84,7 +84,7 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
-    key = default_generator().next_key()
+    key = next_rng_key()
     t = as_tensor_args(x)[0]
     keep = jax.random.bernoulli(key, 1.0 - p, tuple(t._data.shape))
     a_coef = (1.0 - p + p * alpha_p ** 2) ** -0.5

@@ -318,8 +318,8 @@ class _MultiLayerRNN(Layer):
         dropout = self.dropout if self.training else 0.0
         dkeys = None
         if dropout > 0.0 and nl > 1:
-            from ...core.generator import default_generator
-            dkeys = [default_generator().next_key() for _ in range(nl - 1)]
+            from ...core.generator import next_rng_key
+            dkeys = [next_rng_key() for _ in range(nl - 1)]
 
         def raw(x, *rest):
             n_par = len(params)

@@ -60,7 +60,7 @@ def eager_apply(
     raw_fn: Callable,
     tensor_inputs: Sequence[Tensor],
     static_kwargs: Optional[Dict[str, Any]] = None,
-    n_outputs: int = 1,
+    n_outputs: Optional[int] = 1,
 ):
     """Run one eager op.
 
@@ -92,6 +92,8 @@ def eager_apply(
     if not grad_wanted:
         out = raw_fn(*arrays, **static_kwargs)
         outs = out if isinstance(out, tuple) else (out,)
+        if n_outputs is None:  # auto: single unless raw returned a tuple
+            n_outputs = len(outs) if isinstance(out, tuple) else 1
         if flag("check_nan_inf"):
             _check_finite(op_name, outs)
         tensors = tuple(Tensor(o) for o in outs)
@@ -104,15 +106,20 @@ def eager_apply(
     diff_set = set(diff_idx)
     const_arrays = {i: a for i, a in enumerate(arrays) if i not in diff_set}
 
+    was_tuple = [False]
+
     def f(*diff_arrays):
         full = []
         it = iter(diff_arrays)
         for i in range(len(arrays)):
             full.append(const_arrays[i] if i in const_arrays else next(it))
         out = raw_fn(*full, **static_kwargs)
+        was_tuple[0] = isinstance(out, tuple)
         return out if isinstance(out, tuple) else (out,)
 
     primals_out, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
+    if n_outputs is None:  # auto: single unless raw returned a tuple
+        n_outputs = len(primals_out) if was_tuple[0] else 1
 
     if flag("check_nan_inf"):
         _check_finite(op_name, primals_out)

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dtype import get_default_dtype, to_jax_dtype
-from ..core.generator import default_generator
+from ..core.generator import default_generator, next_rng_key
 from ..core.tensor import Tensor
 from .registry import register_op
 
@@ -36,13 +36,13 @@ def _shape(shape):
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
-    key = (jax.random.key(seed) if seed else default_generator().next_key())
+    key = (jax.random.key(seed) if seed else next_rng_key())
     return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
                                      minval=float(min), maxval=float(max)))
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
-    x._rebind(jax.random.uniform(default_generator().next_key(),
+    x._rebind(jax.random.uniform(next_rng_key(),
                                  tuple(x._data.shape), x._data.dtype,
                                  minval=float(min), maxval=float(max)))
     return x
@@ -53,23 +53,23 @@ def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
         m = mean._data if isinstance(mean, Tensor) else mean
         s = std._data if isinstance(std, Tensor) else std
         out_shape = np.broadcast_shapes(np.shape(m), np.shape(s))
-        eps = jax.random.normal(default_generator().next_key(), out_shape,
+        eps = jax.random.normal(next_rng_key(), out_shape,
                                 get_default_dtype().np_dtype)
         return Tensor(m + s * eps)
-    eps = jax.random.normal(default_generator().next_key(), _shape(shape),
+    eps = jax.random.normal(next_rng_key(), _shape(shape),
                             get_default_dtype().np_dtype)
     return Tensor(mean + std * eps)
 
 
 def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
-    eps = jax.random.normal(default_generator().next_key(),
+    eps = jax.random.normal(next_rng_key(),
                             tuple(x._data.shape), x._data.dtype)
     x._rebind(mean + std * eps)
     return x
 
 
 def standard_normal(shape, dtype=None, name=None) -> Tensor:
-    return Tensor(jax.random.normal(default_generator().next_key(),
+    return Tensor(jax.random.normal(next_rng_key(),
                                     _shape(shape), _dt(dtype)))
 
 
@@ -78,14 +78,14 @@ def randn(shape, dtype=None, name=None) -> Tensor:
 
 
 def rand(shape, dtype=None, name=None) -> Tensor:
-    return Tensor(jax.random.uniform(default_generator().next_key(),
+    return Tensor(jax.random.uniform(next_rng_key(),
                                      _shape(shape), _dt(dtype)))
 
 
 def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
     if high is None:
         low, high = 0, low
-    return Tensor(jax.random.randint(default_generator().next_key(),
+    return Tensor(jax.random.randint(next_rng_key(),
                                      _shape(shape), int(low), int(high),
                                      to_jax_dtype(dtype)))
 
@@ -95,25 +95,25 @@ def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
 
 
 def randperm(n, dtype="int64", name=None) -> Tensor:
-    return Tensor(jax.random.permutation(default_generator().next_key(),
+    return Tensor(jax.random.permutation(next_rng_key(),
                                          int(n)).astype(to_jax_dtype(dtype)))
 
 
 def bernoulli(x, p=None, name=None) -> Tensor:
     probs = x._data if p is None else p
     return Tensor(
-        jax.random.bernoulli(default_generator().next_key(),
+        jax.random.bernoulli(next_rng_key(),
                              probs, tuple(np.shape(probs))).astype(
                                  x._data.dtype if p is None else jnp.float32))
 
 
 def poisson(x, name=None) -> Tensor:
-    return Tensor(jax.random.poisson(default_generator().next_key(),
+    return Tensor(jax.random.poisson(next_rng_key(),
                                      x._data).astype(x._data.dtype))
 
 
 def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
-    key = default_generator().next_key()
+    key = next_rng_key()
     probs = x._data
     if probs.ndim == 1:
         out = jax.random.choice(key, probs.shape[0], (int(num_samples),),
@@ -127,7 +127,7 @@ def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
 
 
 def exponential_(x, lam=1.0, name=None) -> Tensor:
-    e = jax.random.exponential(default_generator().next_key(),
+    e = jax.random.exponential(next_rng_key(),
                                tuple(x._data.shape), x._data.dtype)
     x._rebind(e / lam)
     return x
@@ -145,7 +145,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from .dispatch import eager_apply
 
     g = -jnp.log(-jnp.log(
-        jax.random.uniform(default_generator().next_key(),
+        jax.random.uniform(next_rng_key(),
                            tuple(x.shape), x._data.dtype) + 1e-20) + 1e-20)
 
     def raw(a):

@@ -162,33 +162,32 @@ class Optimizer:
     def _per_param_hyper(self, p: Parameter) -> Dict[str, float]:
         return {"lr_mult": p.optimize_attr.get("learning_rate", 1.0)}
 
+    def _update_arrays(self, ps, gs, sts, hyp, pps):
+        """Pure pytree update over raw arrays — usable both from the eager
+        jitted path and traced inside a whole-step compiled program."""
+        new_ps, new_sts = [], []
+        for p, g, st, pp in zip(ps, gs, sts, pps):
+            h = dict(hyp)
+            h.update(pp)
+            h["lr"] = h["lr"] * h.pop("lr_mult", 1.0)
+            st = dict(st)
+            master = st.pop("_master", None)
+            p_eff = master if master is not None else p
+            g_eff = g.astype(p_eff.dtype) if g.dtype != p_eff.dtype else g
+            np_, nst = self._rule(p_eff, g_eff, st, h)
+            if master is not None:
+                nst = dict(nst)
+                nst["_master"] = np_
+            new_ps.append(np_.astype(p.dtype))
+            new_sts.append(nst)
+        return new_ps, new_sts
+
     def _fused_update(self, p_arrays, g_arrays, states, hyper, per_param):
         """One compiled XLA program updating every parameter (the fused
         multi-tensor path); cached by pytree structure via jax.jit."""
         if self._jit_update is None:
-            rule = self._rule
-
-            @functools.partial(jax.jit, donate_argnums=(0, 2))
-            def update(ps, gs, sts, hyp, pps):
-                new_ps, new_sts = [], []
-                for p, g, st, pp in zip(ps, gs, sts, pps):
-                    h = dict(hyp)
-                    h.update(pp)
-                    h["lr"] = h["lr"] * h.pop("lr_mult", 1.0)
-                    st = dict(st)
-                    master = st.pop("_master", None)
-                    p_eff = master if master is not None else p
-                    g_eff = g.astype(p_eff.dtype) if g.dtype != p_eff.dtype \
-                        else g
-                    np_, nst = rule(p_eff, g_eff, st, h)
-                    if master is not None:
-                        nst = dict(nst)
-                        nst["_master"] = np_
-                    new_ps.append(np_.astype(p.dtype))
-                    new_sts.append(nst)
-                return new_ps, new_sts
-
-            self._jit_update = update
+            self._jit_update = functools.partial(
+                jax.jit, donate_argnums=(0, 2))(self._update_arrays)
         return self._jit_update(p_arrays, g_arrays, states, hyper, per_param)
 
     def clear_grad(self, set_to_zero: bool = False):

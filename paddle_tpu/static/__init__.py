@@ -1,0 +1,10 @@
+"""paddle_tpu.static — static-graph compat surface.
+
+The reference's static graph (Program/Executor) maps onto traced+compiled
+XLA programs here (SURVEY.md §7.0); InputSpec is the shared signature type.
+Static-graph user APIs are provided for compat where they have a natural
+traced equivalent.
+"""
+from .input_spec import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec"]

@@ -1,0 +1,36 @@
+"""InputSpec (reference: python/paddle/static/input_spec.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtype import convert_dtype
+
+__all__ = ["InputSpec"]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def batch(self, batch_size):
+        self.shape = (batch_size,) + self.shape
+        return self
+
+    def unbatch(self):
+        self.shape = self.shape[1:]
+        return self
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype.name}, "
+                f"name={self.name})")

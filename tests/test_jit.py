@@ -1,0 +1,192 @@
+"""jit/to_static tests + ResNet AMP anchor (BASELINE.md config #2)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.static import InputSpec
+
+
+class TestToStatic:
+    def test_forward_parity(self):
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        x = paddle.randn([4, 8])
+        eager = model(x).numpy()
+        static_model = paddle.jit.to_static(model)
+        out = static_model(x).numpy()
+        np.testing.assert_allclose(eager, out, rtol=1e-5)
+
+    def test_backward_through_compiled(self):
+        model = nn.Linear(6, 3)
+        sm = paddle.jit.to_static(model)
+        x = paddle.randn([5, 6])
+        sm(x).sum().backward()
+        expected = x.numpy().T @ np.ones((5, 3), np.float32)
+        np.testing.assert_allclose(model.weight.grad.numpy(), expected,
+                                   rtol=1e-4)
+
+    def test_arg_gradient(self):
+        model = paddle.jit.to_static(nn.Linear(4, 2))
+        x = paddle.randn([3, 4])
+        x.stop_gradient = False
+        model(x).sum().backward()
+        assert x.grad is not None
+        assert x.grad.shape == [3, 4]
+
+    def test_program_cache_hit(self):
+        model = paddle.jit.to_static(nn.Linear(4, 2))
+        model(paddle.randn([2, 4]))
+        assert len(model.forward.program_cache) == 1
+        model(paddle.randn([2, 4]))
+        assert len(model.forward.program_cache) == 1
+        model(paddle.randn([8, 4]))  # new shape → new program
+        assert len(model.forward.program_cache) == 2
+
+    def test_bn_buffers_update(self):
+        model = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1),
+                              nn.BatchNorm2D(2))
+        sm = paddle.jit.to_static(model)
+        before = model[1]._mean.numpy().copy()
+        sm(paddle.randn([4, 1, 6, 6]))
+        assert not np.allclose(before, model[1]._mean.numpy())
+
+    def test_dropout_fresh_masks(self):
+        model = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5))
+        model.train()
+        sm = paddle.jit.to_static(model)
+        o1 = sm(paddle.ones([2, 8])).numpy()
+        o2 = sm(paddle.ones([2, 8])).numpy()
+        assert not np.allclose(o1, o2)
+
+    def test_decorator_and_function_form(self):
+        @paddle.jit.to_static
+        def f(a, b):
+            return paddle.matmul(a, b) + 1.0
+
+        x = paddle.randn([3, 3])
+        np.testing.assert_allclose(
+            f(x, x).numpy(), x.numpy() @ x.numpy() + 1.0, rtol=1e-5)
+
+    def test_rollback(self):
+        model = paddle.jit.to_static(nn.Linear(2, 2))
+        model(paddle.randn([1, 2]))
+        model.forward.rollback()
+        out = model(paddle.randn([1, 2]))
+        assert out.shape == [1, 2]
+
+    def test_train_eval_programs_distinct(self):
+        model = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.9))
+        sm = paddle.jit.to_static(model)
+        model.train()
+        sm(paddle.ones([2, 4]))
+        model.eval()
+        o1 = sm(paddle.ones([2, 4])).numpy()
+        o2 = sm(paddle.ones([2, 4])).numpy()
+        np.testing.assert_allclose(o1, o2)
+
+
+class TestTrainStep:
+    def test_whole_step_converges(self):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+        opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, F.mse_loss, opt)
+        target = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+        rng = np.random.RandomState(0)
+        for _ in range(150):
+            xb = rng.randn(32, 4).astype(np.float32)
+            loss = step([paddle.to_tensor(xb)],
+                        [paddle.to_tensor(xb @ target)])
+        assert float(loss.numpy()) < 0.05
+
+    def test_matches_eager_step(self):
+        def build():
+            paddle.seed(11)
+            net = nn.Linear(3, 2)
+            opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+            return net, opt
+
+        xb = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+        yb = np.zeros((4, 2), np.float32)
+
+        net_e, opt_e = build()
+        loss_e = F.mse_loss(net_e(paddle.to_tensor(xb)), paddle.to_tensor(yb))
+        loss_e.backward()
+        opt_e.step()
+
+        net_c, opt_c = build()
+        step = paddle.jit.TrainStep(net_c, F.mse_loss, opt_c)
+        loss_c = step([paddle.to_tensor(xb)], [paddle.to_tensor(yb)])
+
+        np.testing.assert_allclose(loss_e.numpy(), loss_c.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(net_e.weight.numpy(), net_c.weight.numpy(),
+                                   rtol=1e-5)
+
+    def test_grad_clip_and_scheduler(self):
+        net = nn.Linear(2, 2)
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+        opt = paddle.optimizer.SGD(sched, parameters=net.parameters(),
+                                   grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        step = paddle.jit.TrainStep(net, F.mse_loss, opt)
+        x = paddle.randn([4, 2])
+        y = paddle.zeros([4, 2])
+        step([x], [y])
+        sched.step()
+        step([x], [y])  # lr change must not retrigger compile errors
+
+
+class TestSaveLoad:
+    def test_roundtrip(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 3), nn.Tanh())
+        m.eval()
+        path = str(tmp_path / "model")
+        paddle.jit.save(m, path, input_spec=[InputSpec([1, 4], "float32")])
+        loaded = paddle.jit.load(path)
+        x = paddle.randn([1, 4])
+        np.testing.assert_allclose(m(x).numpy(), loaded(x).numpy(), rtol=1e-5)
+
+    def test_state_only_save(self, tmp_path):
+        m = nn.Linear(2, 2)
+        path = str(tmp_path / "m2")
+        paddle.jit.save(m, path)
+        loaded = paddle.jit.load(path)
+        sd = loaded.state_dict()
+        assert "weight" in sd
+
+
+class TestResNetAMPAnchor:
+    """Config anchor #2: ResNet to_static + AMP O2 (scaled-down input)."""
+
+    def test_resnet18_static_amp_o2_step(self):
+        from paddle_tpu.vision.models import resnet18
+
+        paddle.seed(0)
+        model = resnet18(num_classes=10)
+        opt = paddle.optimizer.Momentum(0.01, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+        model = paddle.jit.to_static(model)
+        x = paddle.randn([2, 3, 32, 32]).astype("bfloat16")
+        y = paddle.to_tensor(np.random.randint(0, 10, (2,)))
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            logits = model(x)
+            loss = F.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert np.isfinite(float(loss.numpy()))
+
+    def test_resnet18_train_step_compiled(self):
+        from paddle_tpu.vision.models import resnet18
+
+        paddle.seed(0)
+        model = resnet18(num_classes=4)
+        opt = paddle.optimizer.Momentum(0.05, parameters=model.parameters())
+        step = paddle.jit.TrainStep(
+            model, lambda logits, y: F.cross_entropy(logits, y), opt)
+        x = paddle.randn([2, 3, 32, 32])
+        y = paddle.to_tensor(np.array([0, 1]))
+        l1 = float(step([x], [y]).numpy())
+        for _ in range(8):
+            l2 = float(step([x], [y]).numpy())
+        assert l2 < l1  # memorizes a 2-sample batch quickly
