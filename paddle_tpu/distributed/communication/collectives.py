@@ -160,7 +160,23 @@ def all_gather_object(object_list: List, obj, group: Group = None):
 
 def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group: Group = None,
            sync_op: bool = True):
-    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+    """Reduce to rank ``dst``; non-dst ranks keep their input unchanged
+    (reference: communication/reduce.py semantics — only dst receives the
+    reduced value). For mesh-sharded dist tensors the SPMD program is the
+    same on every device, so reduce degenerates to all_reduce (every shard
+    holds the reduced value — a superset of the dst-only guarantee)."""
+    if _is_dist(tensor):
+        return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+    n = _world(group)
+    if n == 1 and not _multihost():
+        return _CompletedTask(tensor)
+    if _multihost():
+        before = tensor._data
+        all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+        if jax.process_index() != dst:
+            tensor._rebind(before)
+        return _CompletedTask(tensor)
+    raise RuntimeError("reduce: no distributed context")
 
 
 def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
@@ -172,9 +188,9 @@ def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
         return _CompletedTask(tensor)
     if _multihost():
         # reduce all, keep own slice
-        stacked = jnp.stack([t._data for t in tensor_list])
-        all_reduce(Tensor(stacked), op=op, group=group)
-        tensor._rebind(stacked[jax.process_index()])
+        reduced = Tensor(jnp.stack([t._data for t in tensor_list]))
+        all_reduce(reduced, op=op, group=group)
+        tensor._rebind(reduced._data[jax.process_index()])
         return _CompletedTask(tensor)
     raise RuntimeError("reduce_scatter: no distributed context")
 

@@ -43,19 +43,38 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True,
     from ...fleet import fleet  # noqa: F401  (import side effects none)
     from ....jit.static_function import _SwappedState
 
+    n_params = len(params)
+    n_fn_outs = []  # set at trace time; output structure is trace-invariant
+
     def raw(*arrays):
         arg_arrays = arrays[:n_args]
-        param_arrays = arrays[n_args:]
-        with _SwappedState(params, list(param_arrays)), \
+        param_arrays = arrays[n_args: n_args + n_params]
+        buffer_arrays = arrays[n_args + n_params:]
+        # Buffers are swapped like params (same pattern as
+        # static_function._Program) so a buffer-mutating layer (e.g.
+        # BatchNorm updating running stats) mutates the swapped trace
+        # value, not the live eager buffer; the mutated values are
+        # surfaced as extra outputs and rebound after the call.
+        with _SwappedState(params + buffers,
+                           list(param_arrays) + list(buffer_arrays)), \
                 use_trace_key(key), engine.no_grad():
             out = function(*[Tensor(a) for a in arg_arrays], **kwargs)
-        if isinstance(out, tuple):
-            return tuple(o._data for o in out)
-        return out._data
+            new_buffer_arrays = [b._data for b in buffers]
+        outs = tuple(o._data for o in out) if isinstance(out, tuple) \
+            else (out._data,)
+        if not n_fn_outs:
+            n_fn_outs.append(len(outs))
+        return outs + tuple(new_buffer_arrays)
 
     ckpt = jax.checkpoint(raw)
-    return eager_apply("recompute", ckpt, tensor_args + params,
-                       n_outputs=None)
+    res = eager_apply("recompute", ckpt, tensor_args + params + buffers,
+                      n_outputs=None)
+    res = res if isinstance(res, tuple) else (res,)
+    n_out = n_fn_outs[0]
+    outs, new_bufs = res[:n_out], res[n_out:]
+    for b, nb in zip(buffers, new_bufs):
+        b._rebind(nb._data)
+    return outs if len(outs) > 1 else outs[0]
 
 
 def recompute_sequential(ctx: dict, functions, *args, **kwargs):

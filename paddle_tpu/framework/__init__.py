@@ -1,2 +1,3 @@
 from . import io  # noqa: F401
+from . import unique_name  # noqa: F401
 from .io import load, save  # noqa: F401

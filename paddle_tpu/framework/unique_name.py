@@ -1,0 +1,43 @@
+"""Unique-name generation + reset guard.
+
+TPU-native equivalent of the reference's unique_name module (reference:
+python/paddle/base/unique_name.py — per-key counters and ``guard()``
+context resetting them). Structured parameter names
+("linear_0.weight") come from per-class construction counters in
+``nn.layer_base``; ``guard()`` resets those counters so a checkpoint
+written by one process can be restored by another that constructs extra
+layers first (wrap model construction in ``guard()`` on both sides).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+from typing import Dict, Iterator
+
+_generators: Dict[str, "itertools.count"] = {}
+
+
+def generate(key: str = "tmp") -> str:
+    c = _generators.setdefault(key, itertools.count())
+    return f"{key}_{next(c)}"
+
+
+@contextlib.contextmanager
+def guard(new_generator=None) -> Iterator[None]:
+    """Reset naming counters for the enclosed scope (reference:
+    unique_name.guard). Layers constructed inside two separate
+    ``guard()`` scopes get identical structured names, making
+    optimizer/checkpoint state keys reproducible across processes."""
+    from ..nn import layer_base
+
+    saved_layers = dict(layer_base._layer_instance_counters)
+    saved_gens = {k: v for k, v in _generators.items()}
+    layer_base._layer_instance_counters.clear()
+    _generators.clear()
+    try:
+        yield
+    finally:
+        layer_base._layer_instance_counters.clear()
+        layer_base._layer_instance_counters.update(saved_layers)
+        _generators.clear()
+        _generators.update(saved_gens)

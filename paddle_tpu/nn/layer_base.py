@@ -64,11 +64,20 @@ class HookRemoveHelper:
         self._hooks.pop(self._hid, None)
 
 
+_layer_instance_counters: Dict[str, int] = {}
+
+
 class Layer:
     def __init__(self, name_scope: str = None, dtype: str = "float32"):
         self.training = True
         self._dtype = dtype
-        self._full_name = name_scope or self.__class__.__name__.lower()
+        cls_tag = (name_scope or self.__class__.__name__).lower()
+        idx = _layer_instance_counters.get(cls_tag, 0)
+        _layer_instance_counters[cls_tag] = idx + 1
+        # stable structured name, reference-style ("linear_0"): derived
+        # from per-class construction order, reproducible across processes
+        # (reference: base/unique_name.py + Layer.full_name)
+        self._full_name = f"{cls_tag}_{idx}"
         self._parameters: Dict[str, Optional[Parameter]] = OrderedDict()
         self._sub_layers: Dict[str, Optional["Layer"]] = OrderedDict()
         self._buffers: Dict[str, Optional[Tensor]] = OrderedDict()
@@ -108,6 +117,7 @@ class Layer:
             for d in (layers, buffers):
                 if d is not None:
                     d.pop(name, None)
+            self._assign_structured_name(name, value)
             params[name] = value
             self.__dict__.pop(name, None)
         elif isinstance(value, Layer):
@@ -153,10 +163,19 @@ class Layer:
         return list(super().__dir__()) + list(self._parameters) + \
             list(self._sub_layers) + list(self._buffers)
 
+    def _assign_structured_name(self, attr_name: str, p: Parameter):
+        """Replace an auto-generated tensor name with a stable structured
+        one ("linear_0.weight") so optimizer/checkpoint state keyed by
+        p.name survives process restarts (reference: stable param names
+        like linear_0.w_0 from unique_name generators)."""
+        if p is not None and p.name.startswith("generated_tensor_"):
+            p.name = f"{getattr(self, '_full_name', 'layer')}.{attr_name}"
+
     # ------------- explicit registration -------------
     def add_parameter(self, name: str, parameter: Optional[Parameter]):
         if parameter is not None and not isinstance(parameter, Parameter):
             raise TypeError("add_parameter expects a Parameter")
+        self._assign_structured_name(name, parameter)
         self._parameters[name] = parameter
         return parameter
 

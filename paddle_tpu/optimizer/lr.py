@@ -135,8 +135,13 @@ class LinearWarmup(LRScheduler):
             return (self.end_lr - self.start_lr) * (
                 self.last_epoch / self.warmup_steps) + self.start_lr
         if self.lr_sched is not None:
-            self.lr_sched.step()
-            return self.lr_sched()
+            # drive the child from an explicit epoch offset; no mutation
+            # side effect, so repeated get_lr calls can't desync it
+            # (reference: lr.py LinearWarmup steps the inner scheduler by
+            # an epoch offset)
+            self.lr_sched.last_epoch = self.last_epoch - self.warmup_steps
+            self.lr_sched.last_lr = self.lr_sched.get_lr()
+            return self.lr_sched.last_lr
         return self.base_lr
 
     def state_dict(self):

@@ -148,6 +148,13 @@ class Optimizer:
         p_arrays = [p._data for p in params]
         g_arrays = [g._data for _, g in params_grads]
         states = [self._state_for(p) for p in params]
+        if self._multi_precision:
+            # lazy O2 master creation: restored state without a saved
+            # master gets one derived from the (by now restored) param
+            for p, st in zip(params, states):
+                if "_master" not in st and p._data.dtype in (
+                        jnp.float16, jnp.bfloat16):
+                    st["_master"] = p._data.astype(jnp.float32)
         per_param = [self._per_param_hyper(p) for p in params]
 
         new_ps, new_states = self._fused_update(
@@ -220,21 +227,52 @@ class Optimizer:
         return sd
 
     def set_state_dict(self, state_dict):
+        import warnings
+
         self._global_step = int(state_dict.get("global_step", 0))
-        if "LR_Scheduler" in state_dict and \
-                isinstance(self._learning_rate, LRScheduler):
-            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        consumed = {"global_step"}
+        if "LR_Scheduler" in state_dict:
+            consumed.add("LR_Scheduler")
+            if isinstance(self._learning_rate, LRScheduler):
+                self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
         for p in self._parameter_list:
             st = self._init_state(p)
             found = False
+            missing = []
             for k in list(st.keys()):
                 sk = f"{p.name}_{k}"
                 if sk in state_dict:
                     v = state_dict[sk]
                     st[k] = v._data if isinstance(v, Tensor) else v
+                    consumed.add(sk)
                     found = True
+                else:
+                    missing.append(sk)
+            # fp32 master weights from multi_precision (O2) runs are keyed
+            # "{name}__master" (state key "_master" never appears in
+            # _init_state, so restore it explicitly; re-derive from the
+            # param when absent so resumed O2 training keeps a master)
+            mk = f"{p.name}__master"
+            if mk in state_dict:
+                v = state_dict[mk]
+                st["_master"] = v._data if isinstance(v, Tensor) \
+                    else jnp.asarray(v)
+                consumed.add(mk)
+                found = True
+            # when the checkpoint lacks a master, _apply_optimize derives
+            # one lazily at the first step — after model weights load, so
+            # a stale pre-restore param value is never captured
             if found:
                 self._accumulators[id(p)] = st
+                if missing:
+                    warnings.warn(
+                        f"optimizer state for '{p.name}' partially restored;"
+                        f" missing keys: {missing}")
+        unexpected = [k for k in state_dict if k not in consumed]
+        if unexpected:
+            warnings.warn(
+                f"optimizer set_state_dict: unexpected keys {unexpected[:8]}"
+                + ("..." if len(unexpected) > 8 else ""))
 
     set_dict = set_state_dict
 
