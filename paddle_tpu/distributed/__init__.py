@@ -18,6 +18,8 @@ from .communication import (  # noqa: F401
     scatter, scatter_object_list, send, wait,
 )
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from . import fleet  # noqa: F401
 from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F401
 

@@ -87,7 +87,7 @@ def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements) -> Tensor:
 
 def _reduce_partial(arr, mesh: ProcessMesh, placements, target_placements):
     """Resolve Partial → concrete via a compiled psum over partial axes."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     partial_axes = [mesh.dim_names[i] for i, p in enumerate(placements)
                     if p.is_partial()]

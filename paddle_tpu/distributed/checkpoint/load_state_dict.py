@@ -1,0 +1,113 @@
+"""Distributed checkpoint load with load-time resharding.
+
+TPU-native equivalent of the reference's
+``load_state_dict`` (reference:
+python/paddle/distributed/checkpoint/load_state_dict.py:365): build a
+read plan from the saved shard metadata, read only the slices each
+device needs, and assemble them directly into the CURRENT tensor's
+sharding — so a checkpoint written on mesh [8] loads onto [2,4], [4],
+or a single replicated host unchanged (elastic resume across parallel
+configs).
+
+The jax twist: the per-device assembly is a
+``jax.make_array_from_callback`` whose callback slices the saved shards
+— each device materializes only its own piece.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict
+
+import numpy as np
+
+import jax
+
+from ...core.tensor import Tensor
+from .save_state_dict import _safe
+
+__all__ = ["load_state_dict"]
+
+
+class _ShardReader:
+    """Assembles arbitrary global slices from saved shard files."""
+
+    def __init__(self, path: str, entry: dict):
+        self.path = path
+        self.entry = entry
+        self.shape = tuple(entry["shape"])
+        self.dtype = np.dtype(entry["dtype"])
+        self._cache: Dict[str, np.ndarray] = {}
+
+    def _shard(self, fn: str) -> np.ndarray:
+        if fn not in self._cache:
+            self._cache[fn] = np.load(os.path.join(self.path, fn))
+        return self._cache[fn]
+
+    def read(self, index) -> np.ndarray:
+        """index: tuple of slices (global coords) → assembled ndarray."""
+        bounds = []
+        for dim, sl in enumerate(index):
+            start = 0 if sl.start is None else int(sl.start)
+            stop = self.shape[dim] if sl.stop is None else int(sl.stop)
+            bounds.append((start, stop))
+        out = np.empty([b - a for a, b in bounds], self.dtype)
+        filled = np.zeros(out.shape, bool) if self.entry["shards"] else None
+        for sh in self.entry["shards"]:
+            s_idx = sh["index"]
+            # intersection of the request with this shard
+            inter = []
+            ok = True
+            for (ra, rb), (sa, sb) in zip(bounds, s_idx):
+                a, b = max(ra, sa), min(rb, sb)
+                if a >= b:
+                    ok = False
+                    break
+                inter.append((a, b))
+            if not ok:
+                continue
+            data = self._shard(sh["file"])
+            src = tuple(slice(a - sa, b - sa) for (a, b), (sa, _sb)
+                        in zip(inter, s_idx))
+            dst = tuple(slice(a - ra, b - ra) for (a, b), (ra, _rb)
+                        in zip(inter, bounds))
+            out[dst] = data[src]
+            filled[dst] = True
+        if filled is not None and not filled.all():
+            raise ValueError(
+                f"checkpoint shards do not cover requested slice "
+                f"{bounds} of shape {self.shape}")
+        return out
+
+
+def load_state_dict(state_dict: Dict[str, Tensor], path: str,
+                    process_group=None, coordinator_rank: int = 0) -> None:
+    """Fill ``state_dict``'s Tensors in place from ``path``, resharding
+    each saved tensor to the target Tensor's CURRENT sharding
+    (load_state_dict.py:365 parity)."""
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+
+    missing = [n for n in state_dict if n not in meta["tensors"]]
+    if missing:
+        raise KeyError(
+            f"checkpoint at {path!r} lacks tensors: {missing[:8]}")
+
+    for name, target in state_dict.items():
+        entry = meta["tensors"][name]
+        reader = _ShardReader(path, entry)
+        saved_shape = tuple(entry["shape"])
+        if isinstance(target, Tensor):
+            tgt_arr = target._data
+            if tuple(int(s) for s in tgt_arr.shape) != saved_shape:
+                raise ValueError(
+                    f"{name}: saved shape {saved_shape} != target "
+                    f"{tuple(tgt_arr.shape)}")
+            sharding = tgt_arr.sharding
+            new = jax.make_array_from_callback(
+                saved_shape, sharding,
+                lambda idx, r=reader: r.read(idx).astype(r.dtype))
+            new = new.astype(tgt_arr.dtype)
+            target._rebind(new)
+        else:
+            raise TypeError(f"{name}: load target must be a Tensor")

@@ -173,7 +173,11 @@ def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group: Group = None,
     if _multihost():
         before = tensor._data
         all_reduce(tensor, op=op, group=group, sync_op=sync_op)
-        if jax.process_index() != dst:
+        # dst is a group-relative rank: translate to the global process id
+        g = group or _get_default_group()
+        dst_global = g._ranks[dst] if getattr(g, "_ranks", None) and \
+            dst < len(g._ranks) else dst
+        if jax.process_index() != dst_global:
             tensor._rebind(before)
         return _CompletedTask(tensor)
     raise RuntimeError("reduce: no distributed context")

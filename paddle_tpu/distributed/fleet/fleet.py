@@ -68,8 +68,11 @@ class Fleet:
         if hcg is None:
             raise RuntimeError("call fleet.init first")
         if hcg.get_pipe_parallel_world_size() > 1:
-            from .meta_parallel.pipeline_parallel import PipelineParallel
+            from .meta_parallel.pipeline_parallel import (
+                PipelineParallel, PipelineParallelWithInterleave)
 
+            if getattr(model, "_num_virtual", 1) > 1:
+                return PipelineParallelWithInterleave(model, hcg, _strategy)
             return PipelineParallel(model, hcg, _strategy)
         if hcg.get_model_parallel_world_size() > 1 or \
                 hcg.get_sep_parallel_world_size() > 1:

@@ -53,6 +53,7 @@ class PipelineLayer(Layer):
         super().__init__()
         self._loss_fn = loss_fn
         self._topo = topology
+        self._num_virtual = num_virtual_pipeline_stages or 1
         self._recompute_interval = recompute_interval
         if num_stages is None and topology is not None:
             num_stages = topology.get_dim("pipe") if "pipe" in \

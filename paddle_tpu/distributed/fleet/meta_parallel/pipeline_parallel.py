@@ -1,34 +1,88 @@
-"""Pipeline-parallel execution.
+"""Pipeline-parallel execution over the ``pp`` mesh axis.
 
 TPU-native equivalent of the reference's PipelineParallel (reference:
 fleet/meta_parallel/pipeline_parallel.py — PipelineParallel:150, 1F1B
 forward_backward_pipeline:440, train_batch:657; interleave variant :906;
 p2p via batch_isend_irecv pp_utils/p2p_communication.py:313).
 
-Single-controller JAX formulation: the 1F1B schedule interleaves
-micro-batch forwards and backwards per stage to bound live activations —
-warmup forwards (pp_degree - stage - 1 deep), steady 1F1B, cooldown.
-Stage handoffs are ordinary array dependencies (the compiled path lowers
-them to ICI transfers); gradients accumulate across micro-batches on the
-tape. The compiled-overlap schedule (stacked stage weights + shard_map +
-ppermute) is the planned follow-up; this class fixes API + numerics.
+Design (see pp_utils/spmd_pipeline.py for the engines): stages are
+placed on the ``pp`` mesh axis — the uniform repeated region of the
+PipelineLayer (e.g. the transformer blocks) has its parameters STACKED
+into [pp, ...] arrays sharded over that axis; every stage handoff is a
+``lax.ppermute`` (collective-permute over ICI) inside one compiled XLA
+program. Non-uniform head/tail layers (embedding, final norm + head +
+loss) run replicated across pp under GSPMD, exactly like the reference
+keeps embedding/head on the first/last stage.
+
+Schedules:
+- ``1F1B`` (default): true one-forward-one-backward macro-tick schedule
+  with vjp-residual ring buffers of depth 2*pp — live activations stay
+  O(pp_depth) regardless of accumulate_steps.
+- ``FThenB``: differentiable circular rotation (GPipe order), residuals
+  bounded by jax.checkpoint on the stage body.
+- interleave (``PipelineParallelWithInterleave``): circular rotation
+  with num_virtual_pipeline_stages chunks per device (chunk c on device
+  c mod pp), matching the reference's virtual-stage placement.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
 
-from ....core.tensor import Tensor
+from ....core import engine
+from ....core.generator import next_rng_key, use_trace_key
+from ....core.tensor import Parameter, Tensor
 from ....nn.layer_base import Layer
 from .parallel_layers.pp_layers import PipelineLayer
+from .pp_utils.spmd_pipeline import (circular_pipeline_fwd,
+                                     pipeline_1f1b_grads)
 
 __all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
 
 
+def _scalar_config(layer: Layer):
+    """Non-parameter configuration that changes compute (dropout rate,
+    eps, activation name, ...) — layers whose config differs must not be
+    stacked under one template."""
+    out = []
+    stack = [("", layer)]
+    seen = set()
+    while stack:
+        prefix, l = stack.pop()
+        if id(l) in seen:
+            continue
+        seen.add(id(l))
+        for k in sorted(l.__dict__):
+            if k in ("training", "_full_name") or k.startswith("__"):
+                continue
+            v = l.__dict__[k]
+            if isinstance(v, (int, float, bool, str, type(None))):
+                out.append((prefix, k, v))
+        subs = l.__dict__.get("_sub_layers") or {}
+        for name, sub in subs.items():
+            if sub is not None:
+                stack.append((f"{prefix}.{name}", sub))
+    return tuple(sorted(out))
+
+
+def _layer_sig(layer: Layer):
+    """Structural signature: stages must be built from layers with
+    identical signatures to be stackable."""
+    params = [(n, tuple(p.shape), str(p.dtype))
+              for n, p in layer.named_parameters()]
+    buffers = [n for n, _ in layer.named_buffers()]
+    return (type(layer).__name__, tuple(params), tuple(buffers),
+            _scalar_config(layer))
+
+
 class PipelineParallel(Layer):
+    _num_virtual = 1
+
     def __init__(self, layers, hcg, strategy):
         super().__init__()
         if not isinstance(layers, PipelineLayer):
@@ -42,65 +96,277 @@ class PipelineParallel(Layer):
             if pp_cfg else 1
         self.micro_batch_size = getattr(pp_cfg, "micro_batch_size", 1) \
             if pp_cfg else 1
+        self.schedule = getattr(pp_cfg, "schedule_mode", "1F1B") \
+            if pp_cfg else "1F1B"
         self.num_stages = hcg.get_pipe_parallel_world_size()
         self.stage_id = hcg.get_stage_id()
         self.total_loss = None
+        self._pp_axis = "pp"
+        self._step_fn = None
+        if self._num_virtual == 1:
+            self._num_virtual = getattr(layers, "_num_virtual", 1) or 1
+        self._partition_and_stack()
 
-    def parameters(self, include_sublayers=True):
-        return self._layers.parameters(include_sublayers)
+    # ------------------------------------------------------------------
+    # stage extraction: pre | uniform run (stacked over pp) | post
+    # ------------------------------------------------------------------
+    def _partition_and_stack(self):
+        built = list(self._layers.run_function)
+        sigs = [_layer_sig(l) for l in built]
+        n = len(built)
+        chunks = self.num_stages * self._num_virtual
 
-    def named_parameters(self, prefix="", include_sublayers=True):
-        return self._layers.named_parameters(prefix, include_sublayers)
+        def _stackable_group(lo, q):
+            group = sigs[lo:lo + q]
+            has_params = any(s[1] for s in group)
+            no_buffers = all(not s[2] for s in group)
+            return has_params and no_buffers
 
-    def state_dict(self, *a, **k):
-        return self._layers.state_dict(*a, **k)
-
-    def set_state_dict(self, *a, **k):
-        return self._layers.set_state_dict(*a, **k)
-
-    def forward(self, x):
-        return self._layers(x)
-
-    # ---- the schedule ----
-    def _split_micro(self, data):
-        """Split the global batch into accumulate_steps micro-batches."""
-        if isinstance(data, (tuple, list)):
-            splits = [self._split_micro(d) for d in data]
-            return list(zip(*splits))
-        n = self.accumulate_steps
-        arr = data._data if isinstance(data, Tensor) else jnp.asarray(data)
-        if arr.shape[0] % n != 0:
+        # longest run of period-q repeating signatures (q=1 is the plain
+        # identical-layer case; q>1 covers e.g. alternating Attn/MLP
+        # LayerDescs — the reference's common decomposition)
+        best = None  # (usable_layers, -q, lo)
+        for q in range(1, n // chunks + 1):
+            for lo in range(n - q * chunks + 1):
+                if not _stackable_group(lo, q):
+                    continue
+                j = lo + q
+                while j + q <= n and sigs[j:j + q] == sigs[lo:lo + q]:
+                    j += q
+                ngroups = (j - lo) // q
+                gpc = ngroups // chunks      # groups per chunk
+                usable = gpc * chunks * q
+                if gpc >= 1 and (best is None or
+                                 (usable, -q) > (best[0], best[1])):
+                    best = (usable, -q, lo)
+        if best is None:
             raise ValueError(
-                f"batch dim {arr.shape[0]} not divisible by "
-                f"accumulate_steps {n}")
-        return [Tensor(p) for p in jnp.split(arr, n, axis=0)]
+                f"PipelineParallel: no repeating layer run long enough "
+                f"for pp_degree*virtual ({chunks}); stage stacking over "
+                f"the pp mesh axis needs at least one structurally "
+                f"identical (same class/shape/config) layer group per "
+                f"stage")
+        usable, negq, lo = best
+        k = usable // chunks                 # layers per chunk
+        self._chunk_size = k
+        self._pre_layers = built[:lo]
+        run = built[lo:lo + usable]
+        self._post_layers = built[lo + usable:]
+        self._template = run[:k]  # chunk 0: the trace template
+        self._template_params = [p for l in self._template
+                                 for _, p in l.named_parameters()]
 
-    def forward_backward_pipeline(self, data, scaler=None):
-        """1F1B (forward_backward_pipeline:440): per-micro forward then
-        backward in schedule order; grads accumulate on the tape."""
-        inputs, labels = data
-        micro_inputs = self._split_micro(inputs)
-        micro_labels = self._split_micro(labels)
-        n_micro = self.accumulate_steps
-        total = None
+        # stack chunk params device-major: slot j on device p = chunk j*P+p
+        import numpy as onp
 
-        # single-controller: each micro's backward follows its forward
-        # (identical accumulated grads to the staged 1F1B ordering)
-        for mb in range(n_micro):
-            x = micro_inputs[mb]
-            y = micro_labels[mb]
-            out = self._layers(x if not isinstance(x, tuple) else x)
-            loss = self._layers._loss_fn(out, y)
-            loss = loss / n_micro
-            if scaler is not None:
-                scaled = scaler.scale(loss)
-                scaled.backward()
+        P_, v = self.num_stages, self._num_virtual
+        mesh = self._hcg.mesh.jax_mesh()
+        per_chunk: List[List[Any]] = []
+        for c in range(chunks):
+            ps = [p for l in run[c * k:(c + 1) * k]
+                  for _, p in l.named_parameters()]
+            per_chunk.append(ps)
+        self._stacked_params: List[Parameter] = []
+        tmpl_names = [f"{l._full_name}.{pn}" for l in self._template
+                      for pn, _ in l.named_parameters()]
+        for q in range(len(self._template_params)):
+            tmpl_p = self._template_params[q]
+            # build only the local shards (no transient full replica on
+            # device): host-side stack, per-shard callback
+            host = onp.stack(
+                [onp.asarray(per_chunk[j * P_ + p][q]._data)
+                 for p in range(P_) for j in range(v)])
+            sh = NamedSharding(
+                mesh, PartitionSpec(self._pp_axis,
+                                    *([None] * (host.ndim - 1))))
+            arr = jax.make_array_from_callback(
+                host.shape, sh, lambda idx, h=host: h[idx])
+            sp = Parameter(arr, name=f"pp_stack.{q}.{tmpl_names[q]}",
+                           trainable=not tmpl_p.stop_gradient)
+            # preserve optimizer-relevant attributes (per-param lr,
+            # regularizer, clip) from the template parameter
+            sp.optimize_attr = dict(tmpl_p.optimize_attr)
+            sp.regularizer = tmpl_p.regularizer
+            sp.need_clip = tmpl_p.need_clip
+            self._stacked_params.append(sp)
+        # release non-template originals — the stacked pp-sharded arrays
+        # are now the single source of truth; keeping every per-chunk
+        # replica alive would double body-parameter HBM. (The wrapped
+        # PipelineLayer must no longer be used directly for compute.)
+        from .parallel_layers.pp_layers import _SharedLayerView
+
+        for l in run[k:]:
+            if isinstance(l, _SharedLayerView):
+                continue
+            for _, p in l.named_parameters():
+                p._rebind(jnp.zeros((0,), p._data.dtype))
+        self._pre_params = [p for l in self._pre_layers
+                            for _, p in l.named_parameters()]
+        self._post_params = [p for l in self._post_layers
+                             for _, p in l.named_parameters()]
+
+    # ------------------------------------------------------------------
+    # pure functions over raw arrays (trace-time, _SwappedState pattern)
+    # ------------------------------------------------------------------
+    def _stage_fn(self):
+        from ....jit.static_function import _SwappedState
+
+        template, params = self._template, self._template_params
+        tick_counter = [0]
+
+        def stage_fn(stage_param_leaves, x):
+            from ....core.generator import _CURRENT
+
+            base = _CURRENT.trace_key
+            tick_counter[0] += 1
+            if base is not None:
+                # decorrelate dropout per (tick, stage): fold the trace
+                # key with the python tick count and the stage index
+                key = jax.random.fold_in(base, tick_counter[0])
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(self._pp_axis))
+                ctx = use_trace_key(key)
             else:
-                loss.backward()
-            total = loss if total is None else Tensor(
-                total._data + loss._data)
-        self.total_loss = total
-        return total
+                import contextlib
+
+                ctx = contextlib.nullcontext()
+            with _SwappedState(params, list(stage_param_leaves)), ctx, \
+                    engine.no_grad():
+                h = Tensor(x)
+                for l in template:
+                    h = l(h)
+            return h._data
+
+        return stage_fn
+
+    def _head_loss_fn(self):
+        from ....jit.static_function import _SwappedState
+
+        post_layers, post_params = self._post_layers, self._post_params
+        loss_fn = self._layers._loss_fn
+
+        def head_loss(post_leaves, y, label):
+            with _SwappedState(post_params, list(post_leaves)), \
+                    engine.no_grad():
+                h = Tensor(y)
+                for l in post_layers:
+                    h = l(h)
+                loss = loss_fn(h, Tensor(label))
+            return loss._data
+
+        return head_loss
+
+    def _pre_fn(self):
+        from ....jit.static_function import _SwappedState
+
+        pre_layers, pre_params = self._pre_layers, self._pre_params
+
+        def pre_apply(pre_leaves, xs):
+            with _SwappedState(pre_params, list(pre_leaves)), \
+                    engine.no_grad():
+                h = tuple(Tensor(x) for x in xs)
+                for l in pre_layers:
+                    h = l(*(h if isinstance(h, tuple) else (h,)))
+                    if not isinstance(h, tuple):
+                        h = (h,)
+                out = h[0] if len(h) == 1 else h
+            if isinstance(out, tuple):
+                raise ValueError("pipeline stage input must be a single "
+                                 "tensor after the pre layers")
+            return out._data
+
+        return pre_apply
+
+    # ------------------------------------------------------------------
+    # the compiled step
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        mesh = self._hcg.mesh.jax_mesh()
+        P_, v = self.num_stages, self._num_virtual
+        stage_fn = self._stage_fn()
+        head_loss = self._head_loss_fn()
+        pre_apply = self._pre_fn()
+        schedule = self.schedule
+
+        def step(pre_arrays, stacked_leaves, post_arrays, key,
+                 x_all: Tuple, labels_all):
+            M = labels_all.shape[0]
+            with use_trace_key(key):
+                h_all, pre_vjp = jax.vjp(
+                    lambda pa: jnp.stack([
+                        pre_apply(pa, [x[m] for x in x_all])
+                        for m in range(M)]), list(pre_arrays))
+
+                if schedule == "1F1B" and v == 1:
+                    loss, d_stacked, d_post, dh_all = pipeline_1f1b_grads(
+                        stage_fn, head_loss, list(stacked_leaves),
+                        list(post_arrays), h_all, labels_all,
+                        mesh=mesh, num_stages=P_, pp_axis=self._pp_axis)
+                else:
+                    def circ_loss(st, pa, ha):
+                        y_all = circular_pipeline_fwd(
+                            stage_fn, st, ha, mesh=mesh, num_stages=P_,
+                            num_virtual=v, pp_axis=self._pp_axis)
+                        ls = [head_loss(pa, y_all[m], labels_all[m])
+                              for m in range(M)]
+                        return jnp.mean(jnp.stack(ls))
+
+                    loss, (d_stacked, d_post, dh_all) = \
+                        jax.value_and_grad(circ_loss, argnums=(0, 1, 2))(
+                            list(stacked_leaves), list(post_arrays), h_all)
+                (d_pre,) = pre_vjp(dh_all)
+            return loss, list(d_pre), list(d_stacked), list(d_post)
+
+        return jax.jit(step)
+
+    def _split_micro_arrays(self, data):
+        """Global batch tensor(s) → [M, micro_batch, ...] arrays."""
+        n = self.accumulate_steps
+
+        def one(d):
+            arr = d._data if isinstance(d, Tensor) else jnp.asarray(d)
+            if arr.shape[0] % n != 0:
+                raise ValueError(
+                    f"batch dim {arr.shape[0]} not divisible by "
+                    f"accumulate_steps {n}")
+            return arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
+
+        if isinstance(data, (tuple, list)):
+            return tuple(one(d) for d in data)
+        return (one(data),)
+
+    # ------------------------------------------------------------------
+    # public API (reference parity)
+    # ------------------------------------------------------------------
+    def forward_backward_pipeline(self, data, scaler=None):
+        """One pipelined forward+backward sweep over accumulate_steps
+        micro-batches (forward_backward_pipeline:440). Leaves accumulated
+        grads on the parameters; returns the mean loss."""
+        inputs, labels = data
+        x_all = self._split_micro_arrays(inputs)
+        (labels_all,) = self._split_micro_arrays(labels)
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        key = next_rng_key()
+        loss, d_pre, d_stacked, d_post = self._step_fn(
+            [p._data for p in self._pre_params],
+            [p._data for p in self._stacked_params],
+            [p._data for p in self._post_params],
+            key, x_all, labels_all)
+        for plist, glist in ((self._pre_params, d_pre),
+                             (self._stacked_params, d_stacked),
+                             (self._post_params, d_post)):
+            for p, g in zip(plist, glist):
+                if scaler is not None:
+                    # grads here are unscaled (manual vjp); pre-scale so
+                    # scaler.step's unscale_ sees its usual invariant
+                    g = g * scaler._scale
+                if p.grad is None:
+                    p.grad = Tensor(g)
+                else:
+                    p.grad = Tensor(p.grad._data + g)
+        self.total_loss = Tensor(loss)
+        return self.total_loss
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """(train_batch:657)"""
@@ -119,19 +385,77 @@ class PipelineParallel(Layer):
     def eval_batch(self, data, compute_loss=True):
         self._layers.eval()
         inputs, labels = data
-        from ....core.engine import no_grad
-
-        with no_grad():
-            out = self._layers(inputs)
+        with engine.no_grad():
+            out = self._apply_sequential(inputs)
             if compute_loss:
-                return self._layers._loss_fn(out, labels)
+                return self._layers._loss_fn(
+                    out, labels if isinstance(labels, Tensor)
+                    else Tensor(jnp.asarray(labels)))
         return out
+
+    def _apply_sequential(self, x):
+        """Replicated sequential execution (eval / debugging): applies
+        pre, every chunk in order (slicing the stacked params), post."""
+        from ....jit.static_function import _SwappedState
+
+        P_, v, k = self.num_stages, self._num_virtual, self._chunk_size
+        h = x if isinstance(x, tuple) else (x,)
+        for l in self._pre_layers:
+            out = l(*(h if isinstance(h, tuple) else (h,)))
+            h = out if isinstance(out, tuple) else (out,)
+        h = h[0]
+        for c in range(P_ * v):
+            p_, j = c % P_, c // P_
+            row = p_ * v + j
+            leaves = [sp._data[row] for sp in self._stacked_params]
+            with _SwappedState(self._template_params, leaves):
+                for l in self._template:
+                    h = l(h)
+        for l in self._post_layers:
+            h = l(h)
+        return h
+
+    def forward(self, x):
+        return self._apply_sequential(x)
+
+    # ------------------------------------------------------------------
+    # parameters / state
+    # ------------------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return (self._pre_params + self._stacked_params +
+                self._post_params)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        out = []
+        for p in self._pre_params + self._post_params:
+            out.append((p.name, p))
+        for sp in self._stacked_params:
+            out.append((sp.name, sp))
+        return out
+
+    def state_dict(self, *a, **k):
+        sd = {}
+        for name, p in self.named_parameters():
+            sd[name] = p
+        return sd
+
+    def set_state_dict(self, state_dict, *a, **k):
+        for name, p in self.named_parameters():
+            if name in state_dict:
+                v = state_dict[name]
+                p._rebind(v._data if isinstance(v, Tensor)
+                          else jnp.asarray(v))
+        return self
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """VPP (pipeline_parallel.py:906): virtual stages interleave on each
-    rank. Single-controller execution is schedule-equivalent; kept as a
-    distinct type for API parity and the compiled-schedule follow-up."""
+    """VPP (pipeline_parallel.py:906): num_virtual_pipeline_stages chunks
+    per device, chunk c placed on device c mod pp (the reference's
+    interleave placement), executed by the circular-rotation engine with
+    wrap-around collective-permute."""
 
     def __init__(self, layers, hcg, strategy):
+        self._num_virtual = max(int(getattr(layers, "_num_virtual", 1) or 1),
+                                2)
         super().__init__(layers, hcg, strategy)
+        self.schedule = "FThenB"  # circular engine; see module docstring

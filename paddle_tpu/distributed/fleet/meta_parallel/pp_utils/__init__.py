@@ -1,0 +1,1 @@
+from . import spmd_pipeline  # noqa: F401

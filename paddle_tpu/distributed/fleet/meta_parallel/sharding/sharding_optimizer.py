@@ -42,6 +42,30 @@ def _shardable(shape, degree, dim=0):
     return len(shape) > 0 and shape[dim] % degree == 0 and degree > 1
 
 
+def _find_shard_dim(shape, degree):
+    """First dimension divisible by the sharding degree, else None.
+
+    The reference pads flat grad/param buffers to the degree
+    (group_sharded_storage.py); here tensors stay unflattened and GSPMD
+    shards a whole dimension, so the fallback for a non-divisible dim-0
+    is another divisible dim — and a WARNING (not silence) when no dim
+    qualifies."""
+    if degree <= 1:
+        return None
+    for d, s in enumerate(shape):
+        if s >= degree and s % degree == 0:
+            return d
+    return None
+
+
+def _warn_unshardable(kind, name, shape, degree):
+    import warnings
+
+    warnings.warn(
+        f"sharding: {kind} {name!r} shape {tuple(shape)} has no dimension "
+        f"divisible by degree {degree}; it stays replicated")
+
+
 def shard_optimizer_states(optimizer, hcg, axis: str = "sharding"):
     """Stage-1: lay optimizer states out sharded over the axis."""
     mesh = hcg.mesh
@@ -54,10 +78,15 @@ def shard_optimizer_states(optimizer, hcg, axis: str = "sharding"):
         st = orig_init(p)
         out = {}
         for k, v in st.items():
-            if hasattr(v, "shape") and _shardable(v.shape, degree):
+            d = _find_shard_dim(v.shape, degree) \
+                if hasattr(v, "shape") else None
+            if d is not None:
                 out[k] = jax.device_put(
-                    v, _axis_sharding(mesh, axis, v.ndim))
+                    v, _axis_sharding(mesh, axis, v.ndim, dim=d))
             else:
+                if hasattr(v, "shape") and v.ndim > 0:
+                    _warn_unshardable("optimizer state", f"{p.name}/{k}",
+                                      v.shape, degree)
                 out[k] = v
         return out
 
@@ -79,12 +108,15 @@ def shard_parameters(layer, hcg, axis: str = "sharding"):
                 continue
             if p._dist_attr is not None:
                 continue  # already TP-sharded; don't double-shard
-            if _shardable(p._data.shape, degree):
+            d = _find_shard_dim(p._data.shape, degree)
+            if d is not None:
                 placements = [Replicate()] * mesh.ndim
-                placements[mesh.dim_names.index(axis)] = Shard(0)
+                placements[mesh.dim_names.index(axis)] = Shard(d)
                 p._rebind(jax.device_put(
                     p._data, mesh.sharding_for(placements, p._data.ndim)))
                 p._dist_attr = (mesh, placements)
+            elif p._data.ndim > 0:
+                _warn_unshardable("parameter", name, p._data.shape, degree)
     return layer
 
 
@@ -109,16 +141,46 @@ class DygraphShardingOptimizer:
         self._inner_opt.clear_grad(set_to_zero)
 
 
+def _stage2_annotate(optimizer, hcg, axis: str = "sharding"):
+    """Stage-2 = stage-1 + reduce-scattered gradients: sharded optimizer
+    states plus a grad-shard annotation consumed by TrainStep._shard_grads
+    (the compiled step constrains grads to Shard over the axis, so GSPMD
+    emits reduce-scatter instead of all-reduce for the dp grad sync —
+    reference: dygraph_sharding_optimizer.py:470 reduce_scatter)."""
+    shard_optimizer_states(optimizer, hcg, axis)
+    mesh = hcg.mesh
+    if mesh.get_dim_size(axis) > 1:
+        optimizer._grad_shard = (mesh, axis)
+    return optimizer
+
+
 class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
-    """group_sharded_optimizer_stage2.py parity — same annotation model."""
+    """group_sharded_optimizer_stage2.py parity: sharded states + grad
+    reduce-scatter annotation."""
+
+    def __init__(self, params=None, optim=None, group=None, hcg=None,
+                 **kw):
+        optimizer = optim if optim is not None else params
+        if hcg is None:
+            from ... import fleet as _fleet
+
+            hcg = _fleet.get_hybrid_communicate_group()
+        self._inner_opt = _stage2_annotate(optimizer, hcg)
+        self._hcg = hcg
 
 
 class GroupShardedStage2:
-    """Gradient-sharded model wrapper (group_sharded_stage2.py)."""
+    """Gradient-sharded model wrapper (group_sharded_stage2.py): the
+    layer passes through; the real stage-2 behavior lives on the
+    optimizer annotation (grads reduce-scattered, states sharded)."""
 
-    def __init__(self, layer, optimizer, group=None, **kw):
+    def __init__(self, layer, optimizer, group=None, hcg=None, **kw):
+        if hcg is None:
+            from ... import fleet as _fleet
+
+            hcg = _fleet.get_hybrid_communicate_group()
         self._layer = layer
-        self._optimizer = optimizer
+        self._optimizer = _stage2_annotate(optimizer, hcg)
 
     def __call__(self, *args, **kwargs):
         return self._layer(*args, **kwargs)

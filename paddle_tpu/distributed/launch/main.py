@@ -1,0 +1,153 @@
+"""Distributed launcher CLI.
+
+TPU-native equivalent of the reference's launcher (reference:
+python/paddle/distributed/launch/main.py:20 ``launch()``;
+controllers/collective.py:22 CollectiveController builds per-rank envs +
+log dirs and watches processes; controllers/watcher.py). Usage:
+
+    python -m paddle_tpu.distributed.launch \
+        --nproc_per_node 2 --log_dir ./logs train.py --my-arg ...
+
+Sets the PADDLE_* env contract consumed by ``init_parallel_env``
+(MASTER_ADDR/PORT, PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM,
+PADDLE_LOCAL_RANK, PADDLE_TRAINER_ENDPOINTS) plus JAX process env. On a
+TPU pod each host usually runs ONE process owning its local chips
+(jax.distributed), unlike the reference's one-process-per-GPU model —
+``--nproc_per_node`` defaults to 1 for that reason but can be raised for
+CPU-simulated multi-process tests.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List
+
+__all__ = ["launch", "main"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training (launch/main.py:20 parity)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--master", default=None,
+                   help="host:port of the coordinator (rank-0 host)")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--devices", default=None,
+                   help="comma-separated local device ids to expose")
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help=">0: relaunch failed workers up to "
+                        "--max_restarts times (elastic/manager.py parity)")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _spawn(args, global_rank: int, local_rank: int, world: int,
+           master: str, endpoints: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    addr, port = master.rsplit(":", 1)
+    env.update({
+        "MASTER_ADDR": addr,
+        "MASTER_PORT": port,
+        "PADDLE_TRAINER_ID": str(global_rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_RANK_IN_NODE": str(local_rank),
+        "PADDLE_TRAINER_ENDPOINTS": endpoints,
+        "PADDLE_CURRENT_ENDPOINT":
+            endpoints.split(",")[global_rank],
+    })
+    if args.devices:
+        env["CUDA_VISIBLE_DEVICES"] = args.devices  # compat no-op on TPU
+    stdout = stderr = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        logf = open(os.path.join(args.log_dir,
+                                 f"workerlog.{global_rank}"), "w")
+        stdout = stderr = logf
+    cmd = [sys.executable, args.script] + list(args.script_args)
+    return subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr)
+
+
+def launch(argv: List[str] = None) -> int:
+    """(main.py:20) spawn per-rank workers, watch, propagate failure."""
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    world = args.nnodes * args.nproc_per_node
+    if args.master is None:
+        if args.nnodes > 1:
+            raise SystemExit("--master host:port is required for "
+                             "multi-node launches")
+        args.master = f"127.0.0.1:{_free_port()}"
+    addr = args.master.rsplit(":", 1)[0]
+    base_port = int(args.master.rsplit(":", 1)[1])
+    endpoints = ",".join(
+        f"{addr}:{base_port + i}" for i in range(world))
+
+    restarts = 0
+    while True:
+        procs = []
+        for local_rank in range(args.nproc_per_node):
+            global_rank = args.node_rank * args.nproc_per_node + local_rank
+            procs.append(_spawn(args, global_rank, local_rank, world,
+                                args.master, endpoints))
+
+        # watcher (controllers/watcher.py parity): poll until all exit or
+        # one fails
+        rc = 0
+        try:
+            while procs:
+                alive = []
+                for p in procs:
+                    r = p.poll()
+                    if r is None:
+                        alive.append(p)
+                    elif r != 0:
+                        rc = r
+                if rc != 0:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.send_signal(signal.SIGTERM)
+                    deadline = time.time() + 10
+                    for p in procs:
+                        try:
+                            p.wait(max(0.1, deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                    break
+                procs = alive
+                if procs:
+                    time.sleep(0.2)
+        except KeyboardInterrupt:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            raise
+        if rc == 0:
+            return 0
+        restarts += 1
+        if args.elastic_level <= 0 or restarts > args.max_restarts:
+            return rc
+        print(f"launch: worker failed (rc={rc}); elastic relaunch "
+              f"{restarts}/{args.max_restarts}", file=sys.stderr)
+
+
+def main():
+    raise SystemExit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,2 @@
+from . import callbacks  # noqa: F401
 from .model import Model  # noqa: F401

@@ -1,3 +1,266 @@
-class Model:  # placeholder — replaced by full hapi
-    def __init__(self, *a, **k):
-        raise NotImplementedError("hapi.Model lands with the hapi module")
+"""hapi.Model — the Keras-style high-level training API.
+
+TPU-native equivalent of the reference's ``paddle.Model`` (reference:
+python/paddle/hapi/model.py:1054 — ``fit:1756``, ``evaluate``,
+``predict``, ``save/load``, callbacks). The TPU twist: ``fit`` drives
+``paddle.jit.TrainStep`` — the whole train step (forward + backward +
+optimizer) is ONE compiled XLA program, so the python loop only feeds
+batches and reads the scalar loss.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from ..core import engine
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_tensor(x):
+    import jax.numpy as jnp
+
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(np.asarray(x)))
+
+
+class Model:
+    """(model.py:1054 parity)"""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """(model.py prepare)"""
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        self._train_step = None
+        if isinstance(amp_configs, (str, dict)):
+            level = amp_configs if isinstance(amp_configs, str) \
+                else amp_configs.get("level", "O1")
+            if level == "O2" and optimizer is not None:
+                from ..amp import decorate
+
+                decorate(self.network, optimizer, level="O2")
+        return self
+
+    def _ensure_step(self):
+        if self._train_step is None:
+            if self._optimizer is None or self._loss is None:
+                raise RuntimeError("call Model.prepare(optimizer, loss) "
+                                   "before fit()")
+            from ..jit.train_step import TrainStep
+
+            self._train_step = TrainStep(self.network, self._loss,
+                                         self._optimizer)
+        return self._train_step
+
+    @staticmethod
+    def _loader(data, batch_size, shuffle, drop_last, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # any iterable of batches
+
+    @staticmethod
+    def _split_batch(batch):
+        """(inputs, labels) from a DataLoader batch: last element is the
+        label (reference feed convention)."""
+        if isinstance(batch, (list, tuple)):
+            bs = [_to_tensor(b) for b in batch]
+            if len(bs) == 1:
+                return bs, []
+            return bs[:-1], bs[-1:]
+        return [_to_tensor(batch)], []
+
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        step = self._ensure_step()
+        inputs = [_to_tensor(i) for i in (
+            inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+        labels = [_to_tensor(l) for l in (
+            labels if isinstance(labels, (list, tuple)) else
+            ([labels] if labels is not None else []))]
+        loss = step(inputs, labels)
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = [_to_tensor(i) for i in (
+            inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+        labels = [_to_tensor(l) for l in (
+            labels if isinstance(labels, (list, tuple)) else
+            ([labels] if labels is not None else []))]
+        with engine.no_grad():
+            out = self.network(*inputs)
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            loss = self._loss(*outs, *labels) if self._loss else None
+            for m in self._metrics:
+                m.update(np.asarray(m.compute(outs[0], *labels)._data))
+        self.network.train()
+        res = [float(loss.numpy())] if loss is not None else []
+        return res, [m.accumulate() for m in self._metrics]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [_to_tensor(i) for i in (
+            inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+        with engine.no_grad():
+            out = self.network(*inputs)
+        self.network.train()
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        return [np.asarray(o._data) for o in outs]
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        """(model.py fit:1756)"""
+        loader = self._loader(train_data, batch_size, shuffle, drop_last,
+                              num_workers)
+        eval_loader = self._loader(eval_data, batch_size, False, False,
+                                   num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=[m.name() for m in self._metrics])
+        self._ensure_step()
+        self.stop_training = False
+        self.network.train()
+
+        cbks.on_train_begin()
+        history_logs = {}
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            losses = []
+            for step_i, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step_i)
+                inputs, labels = self._split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                losses.append(loss[0])
+                cbks.on_train_batch_end(step_i, {"loss": loss[0]})
+            history_logs = {"loss": float(np.mean(losses))
+                            if losses else 0.0}
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(
+                    eval_loader, batch_size=batch_size, verbose=0,
+                    num_workers=num_workers, _cbks=cbks)
+                history_logs.update(
+                    {f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, history_logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(history_logs)
+        hist = [c for c in cbks.callbacks if type(c).__name__ == "History"]
+        return hist[0].history if hist else {}
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _cbks=None):
+        """(model.py evaluate)"""
+        loader = self._loader(eval_data, batch_size, False, False,
+                              num_workers)
+        cbks = _cbks or config_callbacks(
+            callbacks, model=self, epochs=1,
+            steps=len(loader) if hasattr(loader, "__len__") else None,
+            verbose=verbose)
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        losses = []
+        for step_i, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step_i)
+            inputs, labels = self._split_batch(batch)
+            res, _ = self.eval_batch(inputs, labels)
+            if res:
+                losses.append(res[0])
+            cbks.on_eval_batch_end(step_i,
+                                   {"loss": res[0] if res else None})
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        """(model.py predict)"""
+        loader = self._loader(test_data, batch_size, False, False,
+                              num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        grouped = [[o[i] for o in outputs] for i in range(n_out)]
+        if stack_outputs:
+            return [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        """(model.py save): '<path>.pdparams' + '<path>.pdopt'."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..framework.io import save as fsave
+
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        """(hapi summary): parameter-count table."""
+        rows = []
+        total = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            rows.append((name, tuple(p.shape), n))
+        width = max((len(r[0]) for r in rows), default=10) + 2
+        lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Params':>12}"]
+        lines += [f"{n:<{width}}{str(s):<20}{c:>12,}" for n, s, c in rows]
+        lines.append(f"Total params: {total:,}")
+        print("\n".join(lines))
+        return {"total_params": total}

@@ -98,6 +98,7 @@ class TrainStep:
         trainable = [param_arrays[i] for i in t_idx]
         (loss, new_bufs), grads = jax.value_and_grad(
             loss_of, has_aux=True)(trainable)
+        grads = self._shard_grads(grads)
         grads = self._apply_regularizers(trainable, grads)
         grads = self._clip_grads(grads)
 
@@ -108,6 +109,30 @@ class TrainStep:
         for i, a in zip(t_idx, new_trainable):
             new_params[i] = a
         return loss, new_params, new_sts, new_bufs
+
+    def _shard_grads(self, grads):
+        """ZeRO stage-2 (os_g): when the optimizer carries a grad-shard
+        annotation (set by GroupShardedStage2/DygraphShardingOptimizerV2),
+        constrain each gradient to Shard over the sharding axis — GSPMD
+        then fuses the dp grad all-reduce with the shard into a
+        reduce-scatter (reference: dygraph_sharding_optimizer.py:470)."""
+        gs = getattr(self.optimizer, "_grad_shard", None)
+        if gs is None:
+            return grads
+        mesh, axis = gs
+        from ..distributed.fleet.meta_parallel.sharding.sharding_optimizer \
+            import _axis_sharding, _find_shard_dim
+
+        degree = mesh.get_dim_size(axis)
+        out = []
+        for g in grads:
+            d = _find_shard_dim(g.shape, degree)
+            if d is None:
+                out.append(g)
+            else:
+                out.append(jax.lax.with_sharding_constraint(
+                    g, _axis_sharding(mesh, axis, g.ndim, dim=d)))
+        return out
 
     def _apply_regularizers(self, p_arrays, grads):
         opt = self.optimizer

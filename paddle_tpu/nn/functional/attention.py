@@ -34,16 +34,60 @@ def _on_tpu() -> bool:
         return False
 
 
+def _fa_mod():
+    from jax.experimental.pallas.ops.tpu import flash_attention as m
+
+    return m
+
+
+def _fa_blocks(m, b, h, sq, sk, d):
+    return m.BlockSizes.get_default(b, h, sq, sk, d)
+
+
+# Own custom_vjp shell around the pallas kernel: both rules trace the
+# kernel under enable_x64(False) — paddle_tpu turns x64 on globally (for
+# int64 tensor parity) and the kernel's block index maps mix int32/int64
+# under that flag. Wrapping only the primal call is not enough because
+# custom-vjp fwd/bwd re-enter python during outer vjp tracing.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_core(q, k, v, causal, scale):
+    m = _fa_mod()
+    with jax.enable_x64(False), \
+            jax.default_matmul_precision("default"):
+        return m._flash_attention(
+            q, k, v, None, None, False, causal, scale,
+            _fa_blocks(m, q.shape[0], q.shape[1], q.shape[2], q.shape[2], q.shape[3]), False)
+
+
+def _flash_core_fwd(q, k, v, causal, scale):
+    m = _fa_mod()
+    with jax.enable_x64(False), \
+            jax.default_matmul_precision("default"):
+        out, res = m._flash_attention_fwd(
+            q, k, v, None, None, False, causal, scale,
+            _fa_blocks(m, q.shape[0], q.shape[1], q.shape[2], q.shape[2], q.shape[3]), False)
+    return out, res
+
+
+def _flash_core_bwd(causal, scale, res, do):
+    m = _fa_mod()
+    q = res[0]
+    with jax.enable_x64(False), \
+            jax.default_matmul_precision("default"):
+        dq, dk, dv, _ds, _dseg = m._flash_attention_bwd(
+            False, causal, scale, _fa_blocks(m, q.shape[0], q.shape[1], q.shape[2], q.shape[2], q.shape[3]), False, res, do)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
 def _pallas_flash(q, k, v, causal: bool, scale: float):
     """[b, s, h, d] in/out; pallas kernel wants [b, h, s, d]."""
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        flash_attention as _fa,
-    )
-
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _fa(qt, kt, vt, causal=causal, sm_scale=scale)
+    out = _flash_core(qt, kt, vt, causal, scale)
     return jnp.swapaxes(out, 1, 2)
 
 
@@ -72,10 +116,34 @@ def _attention_raw(q, k, v, *maybe_mask, causal=False, scale=None,
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, w.shape)
         w = w * keep.astype(w.dtype) / (1.0 - dropout_p)
         return jnp.einsum("bhqk,bkhd->bqhd", w, v)
-    if _on_tpu() and bias is None and head_dim % 128 == 0 \
-            and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0:
+    if _use_pallas(head_dim, q.shape[1], k.shape[1], bias is not None):
+        _record_backend("pallas_flash")
         return _pallas_flash(q, k, v, causal, scale)
+    _record_backend("xla")
     return _xla_attention(q, k, v, bias, causal, scale)
+
+
+def _use_pallas(head_dim: int, seq_q: int, seq_k: int,
+                has_bias: bool) -> bool:
+    """Gate for the Pallas flash kernel — its real constraints: lane-dim
+    alignment (head_dim % 8; 64/96/128 all verified on v5e) and seq
+    divisibility by the 128-wide q/k blocks. (Round-1 gate wrongly
+    required head_dim % 128, so head_dim 64/96 models never hit flash.)"""
+    return (_on_tpu() and not has_bias and head_dim % 8 == 0
+            and seq_q % 128 == 0 and seq_k % 128 == 0)
+
+
+_LAST_BACKEND = [None]
+
+
+def _record_backend(name: str):
+    _LAST_BACKEND[0] = name
+
+
+def last_attention_backend():
+    """Which backend the most recent attention dispatch picked
+    ('pallas_flash' | 'xla') — observability for tests and the bench."""
+    return _LAST_BACKEND[0]
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

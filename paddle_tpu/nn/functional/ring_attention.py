@@ -98,7 +98,7 @@ def ring_attention(q, k, v, mesh=None, seq_axis: str = "sep",
     ``seq_axis`` (or dense, in which case they're sharded here). Output is
     sharded the same way.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from ...distributed.auto_parallel.placement import (
         ProcessMesh, Replicate, Shard,

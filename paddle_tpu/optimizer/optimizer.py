@@ -86,9 +86,16 @@ class Optimizer:
         if key not in self._accumulators:
             st = self._init_state(p)
             # O2 master weights (reference: multi_precision fused adam —
-            # fp32 shadow params for fp16/bf16 models)
+            # fp32 shadow params for fp16/bf16 models). Moments must be
+            # fp32 from step 0: the update rule runs on the fp32 master,
+            # so bf16-initialized moments would flip to fp32 after the
+            # first step and force a full recompile of the train step.
             if self._multi_precision and p._data.dtype in (jnp.float16,
                                                            jnp.bfloat16):
+                st = {k: (v.astype(jnp.float32)
+                          if hasattr(v, "dtype") and
+                          jnp.issubdtype(v.dtype, jnp.floating) else v)
+                      for k, v in st.items()}
                 st["_master"] = p._data.astype(jnp.float32)
             self._accumulators[key] = st
         return self._accumulators[key]

@@ -1,0 +1,9 @@
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent,
+    export_chrome_tracing, load_profiler_result, make_scheduler,
+)
+from .timer import Benchmark, benchmark  # noqa: F401
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result", "Benchmark", "benchmark"]

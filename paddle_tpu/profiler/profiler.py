@@ -1,0 +1,256 @@
+"""Profiler: spans, scheduler windows, chrome-trace export.
+
+TPU-native equivalent of the reference's profiler (reference:
+python/paddle/profiler/profiler.py — ``Profiler`` with states
+``profiler.py:79``, window scheduler ``make_scheduler``, chrome trace
+``export_chrome_tracing:215``; C++ host tracer
+platform/profiler/host_tracer.cc RecordEvent spans). Two layers:
+
+- host spans: ``RecordEvent`` context managers collected into a tree,
+  exported in the chrome-trace JSON format the reference emits;
+- device trace: ``jax.profiler`` start/stop around the profiled window
+  (XLA's own profiler session → TensorBoard/XPlane dump directory).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, List, Optional
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerState(Enum):
+    """(profiler.py:79)"""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    """(profiler.py:99) — CPU=host spans, GPU→TPU device trace."""
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+class _SpanStore(threading.local):
+    def __init__(self):
+        self.events: List[dict] = []
+        self.enabled = False
+
+
+_SPANS = _SpanStore()
+
+
+class RecordEvent:
+    """Host span (reference RecordEvent, event_tracing.h): context
+    manager / begin-end pair collected into the chrome trace."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        if self._t0 is None or not _SPANS.enabled:
+            return
+        t1 = time.perf_counter_ns()
+        _SPANS.events.append({
+            "name": self.name, "ph": "X", "pid": os.getpid(),
+            "tid": threading.get_ident() % 2 ** 31,
+            "ts": self._t0 / 1e3, "dur": (t1 - self._t0) / 1e3,
+            "cat": "host",
+        })
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0) -> Callable:
+    """(profiler.py make_scheduler): step → ProfilerState window fn."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_on_trace_ready(prof: "Profiler"):
+    d = prof.log_dir or "./profiler_log"
+    os.makedirs(d, exist_ok=True)
+    prof.export(os.path.join(
+        d, f"paddle_tpu_trace_{int(time.time())}.json"))
+
+
+class Profiler:
+    """(profiler.py Profiler parity)."""
+
+    def __init__(self, *, targets=None, scheduler=None,
+                 on_trace_ready=None, timer_only: bool = False,
+                 log_dir: Optional[str] = None):
+        self.targets = list(targets or [ProfilerTarget.CPU])
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo,
+                                       repeat=1)
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready or _default_on_trace_ready
+        self.timer_only = timer_only
+        self.log_dir = log_dir
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self._events: List[dict] = []
+        self._device_active = False
+        from .timer import Benchmark
+
+        self.benchmark = Benchmark()
+
+    # ---- device (XLA) session ----
+    def _device_start(self):
+        if self.timer_only or self._device_active:
+            return
+        want_device = any(t in (ProfilerTarget.GPU, ProfilerTarget.TPU,
+                                ProfilerTarget.CUSTOM_DEVICE)
+                          for t in self.targets)
+        if not want_device:
+            return
+        try:
+            import jax.profiler
+
+            d = self.log_dir or "./profiler_log"
+            os.makedirs(d, exist_ok=True)
+            jax.profiler.start_trace(d)
+            self._device_active = True
+        except Exception:
+            self._device_active = False
+
+    def _device_stop(self):
+        if self._device_active:
+            try:
+                import jax.profiler
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_active = False
+
+    # ---- lifecycle ----
+    def start(self):
+        self.benchmark.begin()
+        _SPANS.enabled = True
+        _SPANS.events = []
+        self.state = self.scheduler(self.step_num) if self.scheduler \
+            else ProfilerState.RECORD
+        if self.state in (ProfilerState.RECORD,
+                          ProfilerState.RECORD_AND_RETURN):
+            self._device_start()
+        return self
+
+    def stop(self):
+        self._device_stop()
+        _SPANS.enabled = False
+        self._events.extend(_SPANS.events)
+        _SPANS.events = []
+        self.state = ProfilerState.CLOSED
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples: int = 1, sync_value=None):
+        self.benchmark.step(num_samples, sync_value=sync_value)
+        self._events.extend(_SPANS.events)
+        _SPANS.events = []
+        self.step_num += 1
+        if self.scheduler is None:
+            return
+        new = self.scheduler(self.step_num)
+        if new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                and self.state not in (ProfilerState.RECORD,
+                                       ProfilerState.RECORD_AND_RETURN):
+            self._device_start()
+        if new == ProfilerState.CLOSED and self._device_active:
+            self._device_stop()
+        self.state = new
+
+    def step_info(self, unit: str = "samples") -> str:
+        return self.benchmark.step_info(unit)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ---- export ----
+    def export(self, path: str, format: str = "json"):
+        """(export_chrome_tracing:215): chrome-trace JSON."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Aggregate span table (profiler_statistic.py parity)."""
+        agg = {}
+        for e in self._events:
+            a = agg.setdefault(e["name"], [0.0, 0])
+            a[0] += e["dur"] / 1e3
+            a[1] += 1
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+        for name, (tot, cnt) in sorted(agg.items(), key=lambda x: -x[1][0]):
+            lines.append(f"{name:<40}{cnt:>8}{tot:>12.3f}"
+                         f"{tot / cnt:>12.3f}")
+        out = "\n".join(lines)
+        print(out)
+        return agg
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None):
+    """(profiler.py export_chrome_tracing:215): returns an
+    on_trace_ready callback writing into ``dir_name``."""
+    def handler(prof: Profiler):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        prof.export(os.path.join(
+            dir_name, f"{name}_time_{int(time.time())}"
+                      f".paddle_trace.json"))
+
+    return handler
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)

@@ -1,0 +1,94 @@
+"""Benchmark step timer / throughput meter.
+
+TPU-native equivalent of the reference's benchmark timer (reference:
+python/paddle/profiler/timer.py — ``benchmark()`` with reader-cost /
+batch-cost / ips). The TPU twist: a step's device work completes only
+when a host value is fetched, so ``step()`` optionally takes the loss
+tensor and forces the scalar fetch before timestamping (see bench.py —
+naive timers measure dispatch, not compute, on async transports).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+__all__ = ["Benchmark", "benchmark"]
+
+
+class _EventAverager:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+
+    def record(self, v: float):
+        self.total += v
+        self.count += 1
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class Benchmark:
+    """(timer.py Benchmark parity): reader cost, batch cost, ips."""
+
+    def __init__(self):
+        self.reader = _EventAverager()
+        self.batch = _EventAverager()
+        self._last = None
+        self._reader_t0 = None
+        self._samples = 0
+
+    def begin(self):
+        self._last = time.perf_counter()
+        self.reader.reset()
+        self.batch.reset()
+        self._samples = 0
+
+    def before_reader(self):
+        self._reader_t0 = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_t0 is not None:
+            self.reader.record(time.perf_counter() - self._reader_t0)
+
+    def step(self, num_samples: int = 1, sync_value=None):
+        """End of one step. ``sync_value``: a Tensor/array whose host
+        fetch forces device completion (pass the loss)."""
+        if sync_value is not None:
+            import numpy as np
+
+            arr = getattr(sync_value, "_data", sync_value)
+            np.asarray(arr.ravel()[0] if hasattr(arr, "ravel") else arr)
+        now = time.perf_counter()
+        if self._last is not None:
+            self.batch.record(now - self._last)
+        self._last = now
+        self._samples += num_samples
+
+    def step_info(self, unit: str = "samples") -> str:
+        ips = (1.0 / self.batch.avg) if self.batch.avg else 0.0
+        return (f"reader_cost: {self.reader.avg:.5f} s "
+                f"batch_cost: {self.batch.avg:.5f} s "
+                f"ips: {ips * (self._samples / max(self.batch.count, 1)):.2f}"
+                f" {unit}/s")
+
+    @property
+    def ips(self) -> float:
+        if not self.batch.avg or not self.batch.count:
+            return 0.0
+        per_step = self._samples / self.batch.count
+        return per_step / self.batch.avg
+
+
+_bench: Optional[Benchmark] = None
+
+
+def benchmark() -> Benchmark:
+    global _bench
+    if _bench is None:
+        _bench = Benchmark()
+    return _bench
