@@ -140,6 +140,33 @@ def run_config(name, d_model, n_layers, n_heads, seq, batch, steps):
     return tokens_per_sec, n_params, flops_per_token
 
 
+def run_decode_bench(batch=8, prompt=128, new_tokens=64,
+                     d_model=1024, n_layers=16, n_heads=8):
+    # n_heads=8 -> head_dim 128: the Pallas paged-attention kernel's
+    # lane-dim constraint (see nn/functional/paged_attention.py)
+    """Serving decode throughput: paged-KV greedy decode (Pallas paged
+    attention on TPU) through inference.GenerationEngine. Returns
+    generated tokens/sec across the batch (decode phase only)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import FusedCausalLM, GenerationEngine
+
+    paddle.seed(0)
+    model = FusedCausalLM(
+        vocab_size=VOCAB, embed_dim=d_model, num_heads=n_heads,
+        dim_feedforward=4 * d_model, num_layers=n_layers,
+        max_position=prompt + new_tokens + 1)
+    engine = GenerationEngine(model, page_size=16,
+                              max_length=prompt + new_tokens)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, VOCAB, (batch, prompt))
+    engine.generate(ids, max_new_tokens=4)  # compile prefill + decode
+    t0 = time.perf_counter()
+    out = engine.generate(ids, max_new_tokens=new_tokens)
+    dt = time.perf_counter() - t0
+    assert out.shape == (batch, prompt + new_tokens)
+    return batch * new_tokens / dt
+
+
 def _run_one(name):
     """Run a single ladder rung (used in a fresh subprocess so a failed
     bigger config leaves no stale HBM buffers behind)."""
@@ -151,6 +178,10 @@ def _run_one(name):
     tps, n_params, fpt = run_config(name, d, L, h, s, b, steps=10)
     from paddle_tpu.nn.functional.attention import last_attention_backend
 
+    try:
+        decode_tps = round(run_decode_bench(), 1)
+    except Exception as e:  # secondary metric must not kill the headline
+        decode_tps = f"failed: {e}"
     mfu = tps * fpt / peak
     print(json.dumps({
         "metric": "gpt_train_tokens_per_sec_tpu",
@@ -163,6 +194,7 @@ def _run_one(name):
         "target_mfu": TARGET_MFU,
         "attention_backend": last_attention_backend(),
         "amp": "O2-bf16",
+        "decode_tokens_per_sec": decode_tps,
     }))
 
 

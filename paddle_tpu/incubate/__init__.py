@@ -1,4 +1,5 @@
 """paddle_tpu.incubate — experimental features (reference:
 python/paddle/incubate: MoE, fused ops, autotune)."""
 from . import moe  # noqa: F401
+from . import nn  # noqa: F401
 from .moe import MoELayer  # noqa: F401

@@ -1,0 +1,6 @@
+"""incubate.nn — fused layers (reference: python/paddle/incubate/nn)."""
+from .fused_transformer import (  # noqa: F401
+    FusedMultiTransformer, PagedKV, qkv_split_rope_fused, rope_table)
+
+__all__ = ["FusedMultiTransformer", "PagedKV", "qkv_split_rope_fused",
+           "rope_table"]

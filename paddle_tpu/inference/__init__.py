@@ -1,0 +1,169 @@
+"""paddle_tpu.inference — deployment predictor API + serving engine.
+
+TPU-native equivalent of the reference's inference stack (reference:
+paddle/fluid/inference/api/analysis_predictor.h:100 AnalysisPredictor;
+Python wrapper python/paddle/inference). The reference pipeline is
+load program+params → IR pass pipeline → optimized executor; here it is
+load jit-saved StableHLO + params → XLA compile (XLA *is* the pass
+pipeline) → PJRT executable, with device-resident handles standing in
+for zero-copy tensors.
+
+Serving extras (paged-KV attention + fused decode) live in
+``inference.engine`` / ``inference.kv_cache``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .engine import FusedCausalLM, GenerationEngine
+from .kv_cache import BlockKVCacheManager
+
+__all__ = [
+    "Config", "create_predictor", "Predictor", "PredictorTensor",
+    "FusedCausalLM", "GenerationEngine", "BlockKVCacheManager",
+]
+
+
+class Config:
+    """Predictor configuration (reference: AnalysisConfig,
+    paddle/fluid/inference/api/paddle_analysis_config.h; Python
+    paddle.inference.Config). Device/precision toggles are recorded;
+    graph-optimization switches are accepted for compatibility — XLA
+    always optimizes, there is no unoptimized executor to fall back to."""
+
+    def __init__(self, prog_file: str, params_file: Optional[str] = None):
+        # accept either the jit.save prefix or the .pdmodel path
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._use_tpu = True
+        self._precision = "float32"
+        self._memory_optim = True
+        self._ir_optim = True
+
+    def model_path(self) -> str:
+        return self._prefix
+
+    # --- device toggles (reference: enable_use_gpu/disable_gpu) ---
+    def enable_tpu(self):
+        self._use_tpu = True
+
+    def disable_tpu(self):
+        self._use_tpu = False
+
+    def enable_use_gpu(self, *a, **k):  # API-compat alias
+        self.enable_tpu()
+
+    def disable_gpu(self):
+        self.disable_tpu()
+
+    def use_tpu(self) -> bool:
+        return self._use_tpu
+
+    # --- precision / optimization toggles ---
+    def enable_bf16(self):
+        self._precision = "bfloat16"
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        pass  # XLA owns its threadpool
+
+    def summary(self) -> str:
+        return (f"Config(model={self._prefix!r}, tpu={self._use_tpu}, "
+                f"precision={self._precision})")
+
+
+class PredictorTensor:
+    """Device-resident I/O handle (reference: ZeroCopyTensor,
+    paddle/fluid/inference/api/details/zero_copy_tensor.cc). copy_from_cpu
+    stages a host array; after run(), copy_to_cpu materializes the output
+    without an intermediate framework tensor."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._array = None
+        self._shape = None
+
+    def reshape(self, shape):
+        self._shape = tuple(int(s) for s in shape)
+        if self._array is not None:
+            self._array = jnp.reshape(self._array, self._shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        a = jnp.asarray(arr)
+        if self._shape is not None:
+            a = jnp.reshape(a, self._shape)  # reshape-then-copy order
+        self._array = a
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._array is None:
+            raise RuntimeError(f"output {self.name!r} not computed yet")
+        return np.asarray(self._array)
+
+    def shape(self):
+        return None if self._array is None else tuple(self._array.shape)
+
+
+class Predictor:
+    """Compiled predictor over a jit.save artifact (reference:
+    AnalysisPredictor::Run, analysis_predictor.h:100)."""
+
+    def __init__(self, config: Config):
+        from .. import jit
+
+        self._config = config
+        self._layer = jit.load(config.model_path())
+        n_in = None
+        if self._layer._exported is not None:
+            # exported signature: (params, buffers, *args)
+            n_total = len(self._layer._exported.in_avals)
+            n_state = len(self._layer._meta["param_names"])
+            n_in = n_total - n_state
+        self._input_names = [f"input_{i}" for i in range(n_in or 1)]
+        self._inputs: Dict[str, PredictorTensor] = {
+            n: PredictorTensor(n) for n in self._input_names}
+        self._outputs: Dict[str, PredictorTensor] = {}
+        self._output_names: List[str] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return self._inputs[name]
+
+    def run(self) -> bool:
+        args = []
+        for n in self._input_names:
+            h = self._inputs[n]
+            if h._array is None:
+                raise RuntimeError(f"input {n!r} was not set")
+            args.append(Tensor(h._array))
+        out = self._layer(*args)
+        outs = out if isinstance(out, tuple) else (out,)
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        self._outputs = {}
+        for n, o in zip(self._output_names, outs):
+            h = PredictorTensor(n)
+            h._array = o._data
+            self._outputs[n] = h
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names) or ["output_0"]
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer::CreatePredictor."""
+    return Predictor(config)

@@ -1,0 +1,73 @@
+"""Paged KV-cache block manager for continuous-batching serving.
+
+TPU-native equivalent of the block-table machinery behind the reference's
+block_multi_head_attention serving kernel (reference:
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu — its
+``block_tables`` input; allocation policy lives in serving frontends).
+Pages are rows of a preallocated [n_kv_heads, num_pages, page_size,
+head_dim] pool per layer; the manager hands out page ids from a free
+list so sequences of different lengths share one pool with no copies.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..incubate.nn.fused_transformer import PagedKV
+
+__all__ = ["BlockKVCacheManager"]
+
+
+class BlockKVCacheManager:
+    """Owns the page pool + free list; builds per-batch block tables."""
+
+    def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
+                 page_size: int = 16, num_pages: int = 512,
+                 dtype=jnp.float32):
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.dtype = dtype
+        self._free: List[int] = list(range(num_pages))
+        self._owned: dict = {}
+
+    def fresh_cache(self) -> PagedKV:
+        shape = (self.num_layers, self.num_kv_heads, self.num_pages,
+                 self.page_size, self.head_dim)
+        return PagedKV(jnp.zeros(shape, self.dtype),
+                       jnp.zeros(shape, self.dtype))
+
+    def pages_needed(self, length: int) -> int:
+        return -(-length // self.page_size)
+
+    def allocate(self, seq_id, max_length: int) -> List[int]:
+        """Reserve pages covering max_length tokens for one sequence."""
+        n = self.pages_needed(max_length)
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted: need {n} pages, "
+                f"{len(self._free)} free (of {self.num_pages})")
+        pages = [self._free.pop() for _ in range(n)]
+        self._owned.setdefault(seq_id, []).extend(pages)
+        return pages
+
+    def free(self, seq_id) -> None:
+        self._free.extend(self._owned.pop(seq_id, []))
+
+    def block_tables(self, seq_ids, pages_per_seq: int = None):
+        """[batch, pages_per_seq] int32 table (padded with page 0 — padded
+        entries are masked out by seq_lens in the attention)."""
+        rows = [self._owned[s] for s in seq_ids]
+        width = pages_per_seq or max(len(r) for r in rows)
+        table = np.zeros((len(rows), width), np.int32)
+        for i, r in enumerate(rows):
+            table[i, : len(r)] = r
+        return jnp.asarray(table)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)

@@ -1,0 +1,139 @@
+"""Paged-KV block attention — the serving attention path.
+
+TPU-native equivalent of the reference's paged-KV serving kernel
+(reference: paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
+and the decode kernel family masked_multihead_attention_kernel.cu). The KV
+cache lives in fixed-size pages addressed through per-sequence block
+tables, so sequences grow without reallocation/copy and memory is shared
+across a continuous batch.
+
+On TPU the hot path is the Pallas paged-attention kernel
+(jax.experimental.pallas.ops.tpu.paged_attention — MXU-tiled online
+softmax reading pages straight from HBM); elsewhere an XLA gather +
+masked dense attention computes the same thing (fake-device test
+precedent, SURVEY §4).
+
+Layouts (match the Pallas kernel):
+  q            [batch, num_q_heads, head_dim]        one decode token/seq
+  key_cache    [num_kv_heads, num_pages, page_size, head_dim]
+  value_cache  [num_kv_heads, num_pages, page_size, head_dim]
+  seq_lens     [batch] int32   tokens already in cache (incl. current)
+  block_tables [batch, pages_per_seq] int32          page ids per sequence
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention", "write_kv_pages", "write_prefill_kv_pages"]
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _pallas_paged(q, key_cache, value_cache, seq_lens, block_tables):
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention as kernel,
+    )
+
+    page_size = key_cache.shape[2]
+    pages_per_seq = block_tables.shape[1]
+    # one compute block ≥ 512 tokens of K keeps the MXU fed
+    ppcb = max(1, min(pages_per_seq, 512 // max(page_size, 1)))
+    while pages_per_seq % ppcb:
+        ppcb -= 1
+    # the kernel computes raw q·k logits — fold the 1/sqrt(d) scale into q
+    out_dtype = q.dtype
+    q = q.astype(jnp.float32) * (q.shape[-1] ** -0.5)
+    with jax.enable_x64(False), jax.default_matmul_precision("default"):
+        return kernel(
+            q, key_cache, value_cache,
+            seq_lens.astype(jnp.int32), block_tables.astype(jnp.int32),
+            pages_per_compute_block=ppcb,
+        ).astype(out_dtype)
+
+
+def _xla_paged(q, key_cache, value_cache, seq_lens, block_tables):
+    b, n_q, d = q.shape
+    n_kv, _, page_size, _ = key_cache.shape
+    pages_per_seq = block_tables.shape[1]
+    max_len = pages_per_seq * page_size
+
+    # gather pages: [n_kv, b, pages, page, d] -> [b, n_kv, max_len, d]
+    k = key_cache[:, block_tables]
+    v = value_cache[:, block_tables]
+    k = jnp.transpose(k, (1, 0, 2, 3, 4)).reshape(b, n_kv, max_len, d)
+    v = jnp.transpose(v, (1, 0, 2, 3, 4)).reshape(b, n_kv, max_len, d)
+
+    group = n_q // n_kv  # GQA: q heads per kv head
+    qh = q.reshape(b, n_kv, group, d)
+    logits = jnp.einsum("bngd,bnkd->bngk", qh.astype(jnp.float32),
+                        k.astype(jnp.float32)) * (d ** -0.5)
+    pos = jnp.arange(max_len)
+    mask = pos[None, :] < seq_lens[:, None]           # [b, max_len]
+    logits = jnp.where(mask[:, None, None, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    w = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bngk,bnkd->bngd", w, v.astype(jnp.float32))
+    return out.reshape(b, n_q, d).astype(q.dtype)
+
+
+def paged_attention(q, key_cache, value_cache, seq_lens, block_tables):
+    """Single-token decode attention over a paged KV cache.
+
+    Raw-array functional op (used inside compiled decode steps). The Pallas
+    kernel's mosaic lowering requires the lane dim (head_dim) to be a
+    multiple of 128 (verified on v5e); other head dims take the XLA path,
+    which on TPU still compiles to a fused gather + masked attention.
+    """
+    if _on_tpu() and q.shape[-1] % 128 == 0:
+        return _pallas_paged(q, key_cache, value_cache, seq_lens,
+                             block_tables)
+    return _xla_paged(q, key_cache, value_cache, seq_lens, block_tables)
+
+
+def write_kv_pages(key_cache, value_cache, new_k, new_v, positions,
+                   block_tables):
+    """Scatter one new token's K/V per sequence into the paged cache.
+
+    new_k/new_v: [batch, num_kv_heads, head_dim]; positions: [batch] slot
+    index of the new token (0-based). Returns updated caches. This is the
+    cache-write half of the reference's block_multi_head_attention (which
+    fuses append + attend); under XLA the scatter fuses into the decode
+    program so the split costs nothing.
+    """
+    page_size = key_cache.shape[2]
+    b = positions.shape[0]
+    page_ids = block_tables[jnp.arange(b), positions // page_size]  # [b]
+    slots = positions % page_size                                   # [b]
+    # index pattern [h, b-page, b-slot] -> positions [n_kv, b, d]
+    k_t = jnp.transpose(new_k, (1, 0, 2)).astype(key_cache.dtype)
+    v_t = jnp.transpose(new_v, (1, 0, 2)).astype(value_cache.dtype)
+    key_cache = key_cache.at[:, page_ids, slots].set(k_t)
+    value_cache = value_cache.at[:, page_ids, slots].set(v_t)
+    return key_cache, value_cache
+
+
+def write_prefill_kv_pages(key_cache, value_cache, k, v, block_tables):
+    """Write a whole prompt's K/V ([batch, seq, n_kv, d]) into pages.
+
+    Assumes the prompt starts at position 0 (fresh sequences).
+    """
+    b, s, n_kv, d = k.shape
+    page_size = key_cache.shape[2]
+    pos = jnp.arange(s)
+    page_ids = block_tables[:, pos // page_size]      # [b, s]
+    slots = pos % page_size                           # [s]
+    bcast_slots = jnp.broadcast_to(slots, (b, s))
+    k_t = jnp.transpose(k, (2, 0, 1, 3)).astype(key_cache.dtype)
+    v_t = jnp.transpose(v, (2, 0, 1, 3)).astype(value_cache.dtype)
+    key_cache = key_cache.at[:, page_ids, bcast_slots].set(k_t)
+    value_cache = value_cache.at[:, page_ids, bcast_slots].set(v_t)
+    return key_cache, value_cache
