@@ -67,6 +67,8 @@ from .framework.io import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402
 from . import hapi  # noqa: E402
 from . import distribution  # noqa: E402
+from . import quantization  # noqa: E402
+from . import inference  # noqa: E402
 
 # `paddle.disable_static()/enable_static()` parity: we are always dynamic
 # with jit-compiled regions, so these are state toggles kept for API compat.

@@ -1,0 +1,260 @@
+"""paddle_tpu.distribution — probability distributions.
+
+TPU-native equivalent of the reference's distribution package (reference:
+python/paddle/distribution — Distribution base distribution/distribution.py,
+Normal normal.py, Uniform uniform.py, Categorical categorical.py,
+Bernoulli bernoulli.py, kl_divergence kl.py with a registered-pair
+dispatch table). Sampling draws keys from the framework's stateful
+Generator (core/generator.py) so paddle.seed governs it; log_prob/entropy
+are pure jnp and differentiable through the tape.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.generator import next_rng_key
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "kl_divergence", "register_kl",
+]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32) if isinstance(
+        x, (int, float, list, tuple, np.ndarray)) else x
+
+
+class Distribution:
+    """Base class (reference: distribution/distribution.py:40)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution"):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Gaussian (reference: distribution/normal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        eps = jax.random.normal(next_rng_key(),
+                                tuple(shape) + self.batch_shape)
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample  # reparameterized by construction
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale)
+                      - 0.5 * jnp.log(2 * jnp.pi))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * jnp.log(2 * jnp.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference: distribution/uniform.py)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.low), jnp.shape(self.high)))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to((self.low + self.high) / 2,
+                                       self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to((self.high - self.low) ** 2 / 12,
+                                       self.batch_shape))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_rng_key(),
+                               tuple(shape) + self.batch_shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self.batch_shape))
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of ``logits`` (reference:
+    distribution/categorical.py)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+        self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+        super().__init__(jnp.shape(self.logits)[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jnp.exp(self._log_p))
+
+    def sample(self, shape=()):
+        idx = jax.random.categorical(next_rng_key(), self.logits,
+                                     shape=tuple(shape) + self.batch_shape)
+        return Tensor(idx)
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        lp = jnp.broadcast_to(self._log_p,
+                              v.shape + self._log_p.shape[-1:])
+        return Tensor(jnp.take_along_axis(lp, v[..., None],
+                                          axis=-1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self._log_p)
+        return Tensor(-jnp.sum(p * self._log_p, axis=-1))
+
+
+class Bernoulli(Distribution):
+    """Bernoulli(p) (reference: distribution/bernoulli.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = jnp.clip(_arr(probs), 1e-7, 1 - 1e-7)
+        super().__init__(jnp.shape(self.probs_))
+
+    @property
+    def mean(self):
+        return Tensor(self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_rng_key(),
+                               tuple(shape) + self.batch_shape)
+        return Tensor((u < self.probs_).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(v * jnp.log(self.probs_)
+                      + (1 - v) * jnp.log1p(-self.probs_))
+
+    def entropy(self):
+        p = self.probs_
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+# ------------- KL dispatch (reference: distribution/kl.py) -------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(type_p: Type, type_q: Type):
+    """Decorator registering a KL(p||q) rule for a distribution pair
+    (reference: kl.py register_kl)."""
+
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (tp, tq), fn in _KL_REGISTRY.items():
+        if isinstance(p, tp) and isinstance(q, tq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL rule registered for ({type(p).__name__}, "
+        f"{type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p: Normal, q: Normal):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p: Uniform, q: Uniform):
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return Tensor(jnp.where(inside, kl, jnp.inf))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p: Categorical, q: Categorical):
+    pp = jnp.exp(p._log_p)
+    return Tensor(jnp.sum(pp * (p._log_p - q._log_p), axis=-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p: Bernoulli, q: Bernoulli):
+    a, b = p.probs_, q.probs_
+    return Tensor(a * (jnp.log(a) - jnp.log(b))
+                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))

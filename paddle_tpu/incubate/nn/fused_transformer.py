@@ -148,25 +148,34 @@ class FusedMultiTransformer(Layer):
         var = jnp.var(x, -1, keepdims=True)
         return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
 
-    def prefill_raw(self, weights, x, cache: PagedKV, block_tables,
-                    prompt_lens, cos_t, sin_t):
+    def prefill_raw(self, weights, x, cache, block_tables, cos_t, sin_t):
         """Prompt pass: x [b, s, d] → (hidden [b, s, d], filled cache).
 
         Causal dense attention (flash-fusable by XLA/Pallas); each layer's
-        K/V written into its page slice.
+        K/V written into its page slice. ``cache=None`` runs the pure
+        dense forward (training/eval parity path) with no KV writes.
+        Ragged batches are NOT masked here — pad prompts to a common
+        length (dense attention over padding is causal-safe for the
+        suffix tokens actually decoded).
         """
         b, s, d = x.shape
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
         eps = self.epsilon
+        with_cache = cache is not None
 
         def body(h, per_layer):
-            w, ck, cv = per_layer
+            if with_cache:
+                w, ck, cv = per_layer
+            else:
+                w, ck, cv = per_layer, None, None
             hn = self._ln(h, w["ln1_scale"], w["ln1_bias"], eps)
             q, k, v = qkv_split_rope_fused(
                 hn, w["qkv_weight"], w["qkv_bias"], positions,
                 self.num_heads, self.num_kv_heads, self.head_dim,
                 cos_t, sin_t)
-            ck, cv = write_prefill_kv_pages(ck, cv, k, v, block_tables)
+            if with_cache:
+                ck, cv = write_prefill_kv_pages(ck, cv, k, v,
+                                                block_tables)
             group = self.num_heads // self.num_kv_heads
             kq = jnp.repeat(k, group, axis=-2)
             vq = jnp.repeat(v, group, axis=-2)
@@ -178,10 +187,14 @@ class FusedMultiTransformer(Layer):
             hn = self._ln(h, w["ln2_scale"], w["ln2_bias"], eps)
             ff = self._act(hn @ w["ffn1_weight"] + w["ffn1_bias"])
             h = h + ff @ w["ffn2_weight"] + w["ffn2_bias"]
-            return h, (ck, cv)
+            return h, ((ck, cv) if with_cache else None)
 
-        h, (nk, nv) = jax.lax.scan(body, x, (weights, cache.k, cache.v))
-        return h, PagedKV(nk, nv)
+        if with_cache:
+            h, (nk, nv) = jax.lax.scan(body, x,
+                                       (weights, cache.k, cache.v))
+            return h, PagedKV(nk, nv)
+        h, _ = jax.lax.scan(body, x, weights)
+        return h, None
 
     def decode_raw(self, weights, x, cache: PagedKV, block_tables,
                    seq_lens, cos_t, sin_t):
@@ -215,19 +228,17 @@ class FusedMultiTransformer(Layer):
     # ---------- eager Layer API ----------
 
     def forward(self, x, cache=None, block_tables=None, seq_lens=None):
-        """Eager wrapper: prefill when x is [b, s, d] (cache may be None →
-        allocated densely), decode step when x is [b, d]."""
+        """Eager wrapper: prefill when x is [b, s, d] (cache=None → pure
+        dense forward, no KV writes), decode step when x is [b, d]."""
         cos_t, sin_t = rope_table(self.max_position, self.head_dim,
                                   self.rope_theta)
         w = self._stack()
         xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
         if xd.ndim == 3:
-            if cache is None or block_tables is None:
-                raise ValueError("prefill needs a PagedKV cache and "
-                                 "block_tables (see inference.engine)")
             h, cache = self.prefill_raw(
-                w, xd, cache, jnp.asarray(block_tables),
-                seq_lens, cos_t, sin_t)
+                w, xd, cache,
+                None if block_tables is None else jnp.asarray(block_tables),
+                cos_t, sin_t)
         else:
             h, cache = self.decode_raw(
                 w, xd, cache, jnp.asarray(block_tables),

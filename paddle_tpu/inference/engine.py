@@ -62,19 +62,8 @@ class FusedCausalLM(Layer):
         cos_t, sin_t = rope_table(self.stack.max_position,
                                   self.stack.head_dim,
                                   self.stack.rope_theta)
-        # dense path: run prefill against a throwaway 1-page-per-128-tok
-        # cache (writes are dead code XLA eliminates when cache is unused)
-        b, s = ids_d.shape
-        mgr = BlockKVCacheManager(
-            self.stack.num_layers, self.stack.num_kv_heads,
-            self.stack.head_dim, page_size=128,
-            num_pages=max(b * -(-s // 128), 1))
-        for i in range(b):
-            mgr.allocate(i, s)
-        cache = mgr.fresh_cache()
-        tables = mgr.block_tables(range(b))
         h, _ = self.stack.prefill_raw(
-            self.stack._stack(), x, cache, tables, None, cos_t, sin_t)
+            self.stack._stack(), x, None, None, cos_t, sin_t)
         return Tensor(self._final(h))
 
 
@@ -94,8 +83,9 @@ class GenerationEngine:
         self.page_size = page_size
         self._cos, self._sin = rope_table(st.max_position, st.head_dim,
                                           st.rope_theta)
-        self._decode_compiled = {}
-        self._prefill_compiled = {}
+        # one jitted program each — jax.jit retraces per input shape
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(6, 7))
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(5, 6))
         self._num_pages = num_pages
         self._mgr = None
 
@@ -106,7 +96,7 @@ class GenerationEngine:
         st = self.model.stack
         x = embed[ids]
         h, cache = st.prefill_raw(
-            weights, x, PagedKV(cache_k, cache_v), tables, None,
+            weights, x, PagedKV(cache_k, cache_v), tables,
             self._cos, self._sin)
         hl = h[:, -1]
         logits = FusedMultiTransformer._ln(
@@ -123,13 +113,6 @@ class GenerationEngine:
         logits = FusedMultiTransformer._ln(
             h, lnf_s, lnf_b, st.epsilon) @ embed.T
         return logits, cache.k, cache.v
-
-    def _get_decode(self, batch):
-        if batch not in self._decode_compiled:
-            # donate the cache: decode updates it in place in HBM
-            self._decode_compiled[batch] = jax.jit(
-                self._decode_fn, donate_argnums=(6, 7))
-        return self._decode_compiled[batch]
 
     # ---------- serving API ----------
 
@@ -162,17 +145,13 @@ class GenerationEngine:
         lnf_s, lnf_b = (self.model.lnf_scale._data,
                         self.model.lnf_bias._data)
 
-        key = (b, s)
-        if key not in self._prefill_compiled:
-            self._prefill_compiled[key] = jax.jit(
-                self._prefill_fn, donate_argnums=(5, 6))
-        logits, ck, cv = self._prefill_compiled[key](
+        logits, ck, cv = self._prefill(
             weights, embed, lnf_s, lnf_b, jnp.asarray(ids), cache.k,
             cache.v, tables)
 
         out = np.concatenate(
             [ids, np.zeros((b, max_new_tokens), ids.dtype)], axis=1)
-        decode = self._get_decode(b)
+        decode = self._decode
         seq_lens = jnp.full((b,), s, jnp.int32)
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         finished = np.zeros((b,), bool)

@@ -1,0 +1,241 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors + sparse ops.
+
+TPU-native equivalent of the reference's sparse package (reference:
+python/paddle/sparse — sparse_coo_tensor creation/creation.py, CSR
+variant, unary/binary/matmul ops backed by
+paddle/phi/kernels/sparse/*). The TPU design rides
+``jax.experimental.sparse.BCOO`` — XLA's batched-COO format whose
+matmuls lower to gather/segment-sum programs the TPU pipelines well —
+instead of hand-written scatter kernels; CSR is stored natively and
+converted to COO for compute.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "matmul", "add", "multiply", "relu", "nn",
+    "is_sparse_coo", "is_sparse_csr",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference: phi SparseCooTensor,
+    paddle/phi/core/sparse_coo_tensor.h). indices(): [sparse_ndim, nnz]."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self.shape) != 2:
+            raise ValueError("to_sparse_csr supports 2-D tensors")
+        rows = np.asarray(self._bcoo.indices[:, 0])
+        order = np.argsort(rows, kind="stable")
+        crows = np.zeros(self.shape[0] + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(
+            jnp.asarray(crows),
+            jnp.asarray(np.asarray(self._bcoo.indices[:, 1])[order]),
+            jnp.asarray(np.asarray(self._bcoo.data)[order]), self.shape)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (reference: phi SparseCsrTensor,
+    paddle/phi/core/sparse_csr_tensor.h)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _arr(crows)
+        self._cols = _arr(cols)
+        self._values = _arr(values)
+        self._shape = [int(s) for s in shape]
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return Tensor(self._values)
+
+    def to_sparse_coo(self) -> SparseCooTensor:
+        counts = jnp.diff(self._crows)
+        rows = jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self.nnz())
+        idx = jnp.stack([rows, self._cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO((self._values, idx),
+                                            shape=tuple(self._shape)))
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Create a COO tensor (reference: sparse/creation.py
+    sparse_coo_tensor). indices: [sparse_ndim, nnz]."""
+    idx = _arr(indices).astype(jnp.int32)
+    vals = _arr(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype).np_dtype)
+    idx_t = jnp.swapaxes(idx, 0, 1)  # BCOO wants [nnz, ndim]
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+    return SparseCooTensor(
+        jsparse.BCOO((vals, idx_t), shape=tuple(int(s) for s in shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """Create a CSR tensor (reference: sparse/creation.py
+    sparse_csr_tensor)."""
+    vals = _arr(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype).np_dtype)
+    return SparseCsrTensor(_arr(crows).astype(jnp.int64),
+                           _arr(cols).astype(jnp.int64), vals, shape)
+
+
+def is_sparse_coo(x) -> bool:
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x) -> bool:
+    return isinstance(x, SparseCsrTensor)
+
+
+def _as_bcoo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()._bcoo
+    return None
+
+
+def matmul(x, y):
+    """sparse @ dense → dense (reference: sparse/binary.py matmul,
+    phi/kernels/sparse/matmul_kernel.h). Lowers to BCOO dot_general —
+    a gather + segment-sum XLA program."""
+    xs, ys = _as_bcoo(x), _as_bcoo(y)
+    if xs is not None and ys is None:
+        return Tensor(xs @ _arr(y))
+    if xs is None and ys is not None:
+        return Tensor(_arr(x) @ ys)
+    if xs is not None and ys is not None:
+        return Tensor(xs @ ys.todense())
+    return Tensor(_arr(x) @ _arr(y))
+
+
+def add(x, y):
+    """sparse + sparse → sparse (duplicate indices summed);
+    sparse + dense → dense."""
+    xs, ys = _as_bcoo(x), _as_bcoo(y)
+    if xs is not None and ys is not None:
+        summed = jsparse.BCOO(
+            (jnp.concatenate([xs.data, ys.data]),
+             jnp.concatenate([xs.indices, ys.indices])),
+            shape=xs.shape).sum_duplicates(nse=xs.nse + ys.nse)
+        return SparseCooTensor(summed)
+    if xs is not None:
+        return Tensor(xs.todense() + _arr(y))
+    if ys is not None:
+        return Tensor(_arr(x) + ys.todense())
+    return Tensor(_arr(x) + _arr(y))
+
+
+def multiply(x, y):
+    """Elementwise multiply. sparse * dense keeps the sparsity pattern
+    (dense entries gathered at the nonzeros)."""
+    xs = _as_bcoo(x)
+    if xs is None:
+        ys = _as_bcoo(y)
+        if ys is not None:  # dense * sparse — sparsity wins either way
+            return multiply(y, x)
+        return Tensor(_arr(x) * _arr(y))
+    other = _as_bcoo(y)
+    dense = other.todense() if other is not None else _arr(y)
+    gathered = dense[tuple(xs.indices[:, i]
+                           for i in range(xs.indices.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO(
+        (xs.data * gathered, xs.indices), shape=xs.shape))
+
+
+def relu(x):
+    """Unary op on values only (reference: sparse/unary.py relu —
+    sparsity pattern is preserved)."""
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(jsparse.BCOO(
+            (jax.nn.relu(x._bcoo.data), x._bcoo.indices),
+            shape=x._bcoo.shape))
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x._crows, x._cols,
+                               jax.nn.relu(x._values), x._shape)
+    return Tensor(jax.nn.relu(_arr(x)))
+
+
+class _SparseNN:
+    """sparse.nn namespace (reference: python/paddle/sparse/nn)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+
+nn = _SparseNN()
