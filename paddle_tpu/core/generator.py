@@ -35,15 +35,24 @@ class Generator:
     def manual_seed(self, seed: int) -> "Generator":
         with getattr(self, "_lock", threading.Lock()):
             self._seed = int(seed)
-            self._key = jax.random.key(int(seed))
+            # key creation is lazy: materializing it would initialize the
+            # XLA backend, which must not happen at import time (it would
+            # break a later jax.distributed.initialize in
+            # init_parallel_env — the reference's import-then-init order)
+            self._key = None
             self._offset = 0
         return self
 
     def initial_seed(self) -> int:
         return self._seed
 
+    def _materialize(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
     def next_key(self):
         with self._lock:
+            self._materialize()
             self._key, sub = jax.random.split(self._key)
             self._offset += 1
             return sub
@@ -60,7 +69,9 @@ class Generator:
 
     def spawn_key(self, data: int):
         """Deterministic fold-in (no state mutation) — for per-step keys."""
-        return jax.random.fold_in(self._key, data)
+        with self._lock:
+            self._materialize()
+            return jax.random.fold_in(self._key, data)
 
 
 _default_generator = Generator(0)

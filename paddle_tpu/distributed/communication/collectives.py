@@ -79,6 +79,122 @@ def _multihost() -> bool:
     return jax.process_count() > 1
 
 
+def _full_world(group: Optional[Group]) -> bool:
+    g = group or _get_default_group()
+    return g is None or g.nranks in (0, jax.process_count())
+
+
+# ---- compiled cross-process data plane --------------------------------
+# One device per process forms a global 1-D mesh; collectives are jitted
+# XLA programs over it, so multi-host traffic rides ICI/DCN through the
+# runtime instead of numpy host gathers (reference: the NCCL data plane
+# under ProcessGroupNCCL; SURVEY §5.8 TPU-equivalent mapping). The mesh
+# and jitted programs are built once per (op, world) and cached —
+# all_reduce is the per-step gradient hot path, so every call after the
+# first must hit jit's function-identity cache.
+
+_COLL_CACHE: dict = {}
+
+_REDUCERS = None
+
+
+def _reducers():
+    global _REDUCERS
+    if _REDUCERS is None:
+        _REDUCERS = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+                     ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+                     ReduceOp.AVG: jnp.mean}
+    return _REDUCERS
+
+
+def _cached(key, builder):
+    ck = (key, jax.process_count())
+    if ck not in _COLL_CACHE:
+        _COLL_CACHE[ck] = builder()
+    return _COLL_CACHE[ck]
+
+
+def _proc_mesh():
+    def build():
+        devs = [next(d for d in jax.devices() if d.process_index == p)
+                for p in range(jax.process_count())]
+        return jax.sharding.Mesh(np.array(devs), ("p",))
+
+    return _cached("mesh", build)
+
+
+def _my_mesh_device(mesh):
+    return next(d for d in mesh.devices.flat
+                if d.process_index == jax.process_index())
+
+
+def _global_stack(local, mesh):
+    """Each process contributes its local value as one slice of a global
+    [P, ...] array sharded along the process axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = jax.process_count()
+    sharding = NamedSharding(mesh, P("p"))
+    shard = jax.device_put(local[None], _my_mesh_device(mesh))
+    return jax.make_array_from_single_device_arrays(
+        (n,) + tuple(local.shape), sharding, [shard])
+
+
+def _local_value(garr):
+    return jnp.asarray(garr.addressable_shards[0].data)
+
+
+def _compiled_allreduce(local, op):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _proc_mesh()
+    red = _reducers()[op]
+    fn = _cached(("allreduce", op), lambda: jax.jit(
+        lambda x: red(x, axis=0),
+        out_shardings=NamedSharding(mesh, P())))
+    return _local_value(fn(_global_stack(local, mesh)))
+
+
+def _compiled_allgather(local):
+    """Returns the [P, ...] stack, fully replicated on every process."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _proc_mesh()
+    fn = _cached("allgather", lambda: jax.jit(
+        lambda x: x, out_shardings=NamedSharding(mesh, P())))
+    return _local_value(fn(_global_stack(local, mesh)))
+
+
+def _compiled_broadcast(local, src):
+    """One-to-all: only the src shard travels (XLA lowers the sharded
+    x[src] + replicated output to a broadcast from src's device, not a
+    P-fold allgather)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _proc_mesh()
+    fn = _cached(("broadcast", src), lambda: jax.jit(
+        lambda x: x[src], out_shardings=NamedSharding(mesh, P())))
+    return _local_value(fn(_global_stack(local, mesh)))
+
+
+def _compiled_reducescatter(stacked, op):
+    """stacked: local [P, ...] contributions; returns this process's
+    reduced slice (XLA reduce-scatter over the process mesh)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _proc_mesh()
+    n = jax.process_count()
+    shard = jax.device_put(stacked[None], _my_mesh_device(mesh))
+    garr = jax.make_array_from_single_device_arrays(
+        (n,) + tuple(stacked.shape),
+        jax.sharding.NamedSharding(mesh, P("p")), [shard])
+    red = _reducers()[op]
+    fn = _cached(("reducescatter", op), lambda: jax.jit(
+        lambda x: red(x, axis=0),
+        out_shardings=NamedSharding(mesh, P("p"))))
+    return _local_value(fn(garr))[0]
+
+
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group = None,
                sync_op: bool = True):
     if _is_dist(tensor):
@@ -96,13 +212,22 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group = None,
     if _world(group) == 1 and not _multihost():
         return _CompletedTask(tensor)
     if _multihost():
+        if _full_world(group):
+            tensor._rebind(_compiled_allreduce(tensor._data, op))
+            return _CompletedTask(tensor)
         from jax.experimental import multihost_utils
 
+        # subset group: host-level fallback masked to the group's ranks
+        g = group or _get_default_group()
         gathered = multihost_utils.process_allgather(np.asarray(tensor._data))
+        sel = gathered[list(g._ranks)] if getattr(g, "_ranks", None) \
+            else gathered
         fn = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
               ReduceOp.MIN: np.min, ReduceOp.PROD: np.prod,
               ReduceOp.AVG: np.mean}[op]
-        tensor._rebind(jnp.asarray(fn(gathered, axis=0)))
+        if jax.process_index() in (getattr(g, "_ranks", None)
+                                   or range(jax.process_count())):
+            tensor._rebind(jnp.asarray(fn(sel, axis=0)))
         return _CompletedTask(tensor)
     raise RuntimeError("all_reduce: no distributed context")
 
@@ -127,10 +252,19 @@ def all_gather(tensor_list: List, tensor: Tensor, group: Group = None,
         tensor_list.append(Tensor(tensor._data))
         return _CompletedTask()
     if _multihost():
+        if _full_world(group):
+            stack = _compiled_allgather(tensor._data)
+            tensor_list.extend(Tensor(stack[i])
+                               for i in range(stack.shape[0]))
+            return _CompletedTask()
         from jax.experimental import multihost_utils
 
+        # subset group: gather world-wide, keep only member rows
+        g = group or _get_default_group()
         gathered = multihost_utils.process_allgather(np.asarray(tensor._data))
-        tensor_list.extend(Tensor(jnp.asarray(g)) for g in gathered)
+        members = getattr(g, "_ranks", None) or range(len(gathered))
+        tensor_list.extend(Tensor(jnp.asarray(gathered[r]))
+                           for r in members)
         return _CompletedTask()
     raise RuntimeError("all_gather: no distributed context")
 
@@ -191,10 +325,18 @@ def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
         tensor._rebind(t._data if isinstance(t, Tensor) else jnp.asarray(t))
         return _CompletedTask(tensor)
     if _multihost():
-        # reduce all, keep own slice
-        reduced = Tensor(jnp.stack([t._data for t in tensor_list]))
+        stacked = jnp.stack([t._data for t in tensor_list])
+        if _full_world(group):
+            tensor._rebind(_compiled_reducescatter(stacked, op))
+            return _CompletedTask(tensor)
+        # subset fallback: reduce within the group, keep own group-rank
+        # slice (stacked has nranks chunks, indexed by group rank)
+        g = group or _get_default_group()
+        reduced = Tensor(stacked)
         all_reduce(reduced, op=op, group=group)
-        tensor._rebind(reduced._data[jax.process_index()])
+        my_gr = g.get_group_rank(jax.process_index())
+        if my_gr >= 0:
+            tensor._rebind(reduced._data[my_gr])
         return _CompletedTask(tensor)
     raise RuntimeError("reduce_scatter: no distributed context")
 
@@ -205,12 +347,19 @@ def broadcast(tensor: Tensor, src: int = 0, group: Group = None,
     if n == 1 and not _multihost():
         return _CompletedTask(tensor)
     if _multihost():
+        if _full_world(group):
+            tensor._rebind(_compiled_broadcast(tensor._data, src))
+            return _CompletedTask(tensor)
         from jax.experimental import multihost_utils
 
+        g = group or _get_default_group()
+        src_global = g._ranks[src] if getattr(g, "_ranks", None) else src
         val = multihost_utils.broadcast_one_to_all(
             np.asarray(tensor._data),
-            is_source=jax.process_index() == src)
-        tensor._rebind(jnp.asarray(val))
+            is_source=jax.process_index() == src_global)
+        if jax.process_index() in (getattr(g, "_ranks", None)
+                                   or range(jax.process_count())):
+            tensor._rebind(jnp.asarray(val))
         return _CompletedTask(tensor)
     raise RuntimeError("broadcast: no distributed context")
 
@@ -279,13 +428,32 @@ def all_to_all(out_tensor_list: List, in_tensor_list: List[Tensor],
         out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
         return _CompletedTask()
     if _multihost():
+        stacked = jnp.stack([t._data for t in in_tensor_list])
+        if _full_world(group):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = _proc_mesh()
+            shard = jax.device_put(stacked[None], _my_mesh_device(mesh))
+            garr = jax.make_array_from_single_device_arrays(
+                (n,) + tuple(stacked.shape),
+                NamedSharding(mesh, P("p")), [shard])
+            # [src, dst, ...] -> [dst, src, ...]; my row = my inbox
+            out = jax.jit(lambda x: jnp.swapaxes(x, 0, 1),
+                          out_shardings=NamedSharding(mesh, P("p")))(garr)
+            inbox = _local_value(out)[0]
+            out_tensor_list.extend(Tensor(inbox[p]) for p in range(n))
+            return _CompletedTask()
         from jax.experimental import multihost_utils
 
-        stacked = np.stack([np.asarray(t._data) for t in in_tensor_list])
-        gathered = multihost_utils.process_allgather(stacked)  # [P, P, ...]
-        me = jax.process_index()
-        out_tensor_list.extend(
-            Tensor(jnp.asarray(gathered[p][me])) for p in range(n))
+        # subset group: rows/columns are indexed by GROUP rank
+        g = group or _get_default_group()
+        gathered = multihost_utils.process_allgather(np.asarray(stacked))
+        members = list(getattr(g, "_ranks", None)
+                       or range(len(gathered)))
+        my_gr = g.get_group_rank(jax.process_index())
+        if my_gr >= 0:
+            out_tensor_list.extend(
+                Tensor(jnp.asarray(gathered[r][my_gr])) for r in members)
         return _CompletedTask()
     raise RuntimeError("all_to_all: no distributed context")
 
@@ -303,29 +471,71 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
     return _CompletedTask(out_tensor)
 
 
+_P2P_BUF = {}
+_P2P_SEQ = {}
+_P2P_TIMEOUT_MS = 120_000
+
+
+def _coord_client():
+    """The JAX coordination-service KV client — the control-plane
+    TCPStore equivalent (reference: phi/core/distributed/store/
+    tcp_store.h:121). Eager cross-process p2p is brokered through it;
+    the data-plane p2p (pipeline stage handoff) is the compiled
+    ppermute in fleet.meta_parallel, which rides ICI."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "p2p across processes needs jax.distributed to be "
+            "initialized (call init_parallel_env first)")
+    return client
+
+
+def _p2p_seq(a: int, b: int) -> int:
+    key = (a, b)
+    _P2P_SEQ[key] = _P2P_SEQ.get(key, 0) + 1
+    return _P2P_SEQ[key]
+
+
 def send(tensor: Tensor, dst: int = 0, group: Group = None,
          sync_op: bool = True):
+    """Point-to-point send (reference: communication/send.py over
+    ProcessGroup::Send). Cross-process path serializes through the
+    coordination service — matched send/recv pairs use a per-(src,dst)
+    sequence number so repeated transfers don't collide."""
     if _world(group) == 1 and not _multihost():
         _P2P_BUF.setdefault(dst, []).append(jnp.asarray(tensor._data))
         return _CompletedTask(tensor)
-    raise NotImplementedError(
-        "eager p2p send across processes: use the compiled pipeline "
-        "schedules (fleet.meta_parallel) whose ppermute rides ICI")
+    import pickle
 
-
-_P2P_BUF = {}
+    me = jax.process_index()
+    seq = _p2p_seq(me, dst)
+    payload = pickle.dumps(np.asarray(tensor._data), protocol=4)
+    _coord_client().key_value_set_bytes(
+        f"paddle_tpu/p2p/{me}->{dst}/{seq}", payload)
+    return _CompletedTask(tensor)
 
 
 def recv(tensor: Tensor, src: int = 0, group: Group = None,
          sync_op: bool = True):
+    """Point-to-point recv matching ``send`` (reference:
+    communication/recv.py). Blocks up to 120s for the matching key."""
     if _world(group) == 1 and not _multihost():
         buf = _P2P_BUF.get(src or 0)
         if buf:
             tensor._rebind(buf.pop(0))
         return _CompletedTask(tensor)
-    raise NotImplementedError(
-        "eager p2p recv across processes: use the compiled pipeline "
-        "schedules (fleet.meta_parallel)")
+    import pickle
+
+    me = jax.process_index()
+    seq = _p2p_seq(src, me)
+    client = _coord_client()
+    key = f"paddle_tpu/p2p/{src}->{me}/{seq}"
+    blob = client.blocking_key_value_get_bytes(key, _P2P_TIMEOUT_MS)
+    client.key_value_delete(key)  # keep the coordinator store bounded
+    tensor._rebind(jnp.asarray(pickle.loads(blob)))
+    return _CompletedTask(tensor)
 
 
 isend = send

@@ -185,7 +185,8 @@ class FakeQuant(Layer):
         from ..ops.dispatch import eager_apply, as_tensor_args
 
         (t,) = as_tensor_args(x)
-        self.observer.observe(t._data)
+        if self.training:  # eval passes must not shift the statistics
+            self.observer.observe(t._data)
         scale = self.observer.scale()
 
         def raw(arr):
