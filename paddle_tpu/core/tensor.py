@@ -41,7 +41,7 @@ class Tensor:
     __slots__ = (
         "_data", "stop_gradient", "grad", "_grad_node", "_out_idx",
         "name", "persistable", "_grad_hooks", "_version", "__weakref__",
-        "_dist_attr",
+        "_dist_attr", "_static_program",
     )
 
     def __init__(self, data, stop_gradient: bool = True, name: str = None):

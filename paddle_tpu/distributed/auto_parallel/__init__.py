@@ -5,3 +5,4 @@ from .api import (  # noqa: F401
 from .placement import (  # noqa: F401
     Partial, Placement, ProcessMesh, Replicate, Shard, get_mesh, set_mesh,
 )
+from .engine import Engine  # noqa: F401

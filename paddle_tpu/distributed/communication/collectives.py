@@ -80,8 +80,46 @@ def _multihost() -> bool:
 
 
 def _full_world(group: Optional[Group]) -> bool:
+    """True only for the identity-ordered whole-world group — the
+    compiled paths index src/dst by GLOBAL rank, so a permuted or subset
+    group must take the group-aware store path instead."""
     g = group or _get_default_group()
-    return g is None or g.nranks in (0, jax.process_count())
+    if g is None or g.nranks == 0:
+        return True
+    return list(g._ranks) == list(range(jax.process_count()))
+
+
+_STORE_SEQ = {}
+
+
+def _store_gather_group(arr, g: Group):
+    """Members-only allgather through the coordination-service KV store
+    (reference: TCPStore-brokered group ops). Only the group's processes
+    participate — world-wide barriers would deadlock non-members. All
+    keys (data + ack counter) are deleted by the last reader, so the
+    store stays bounded."""
+    import pickle
+
+    client = _coord_client()
+    me = jax.process_index()
+    gid = g.id if g.id is not None else 0
+    seq = _STORE_SEQ[gid] = _STORE_SEQ.get(gid, 0) + 1
+    base = f"paddle_tpu/coll/{gid}/{seq}"
+    client.key_value_set_bytes(f"{base}/{me}",
+                               pickle.dumps(np.asarray(arr), protocol=4))
+    out = []
+    for r in g._ranks:
+        blob = client.blocking_key_value_get_bytes(f"{base}/{r}",
+                                                   _P2P_TIMEOUT_MS)
+        out.append(pickle.loads(blob))
+    # ack barrier: the member whose increment completes the count cleans
+    # up (everyone has read every data key before acking)
+    done = client.key_value_increment(f"{base}/ack", 1)
+    if done == g.nranks:
+        for r in g._ranks:
+            client.key_value_delete(f"{base}/{r}")
+        client.key_value_delete(f"{base}/ack")
+    return out
 
 
 # ---- compiled cross-process data plane --------------------------------
@@ -215,19 +253,13 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group = None,
         if _full_world(group):
             tensor._rebind(_compiled_allreduce(tensor._data, op))
             return _CompletedTask(tensor)
-        from jax.experimental import multihost_utils
-
-        # subset group: host-level fallback masked to the group's ranks
+        # subset/permuted group: members-only store-brokered path
         g = group or _get_default_group()
-        gathered = multihost_utils.process_allgather(np.asarray(tensor._data))
-        sel = gathered[list(g._ranks)] if getattr(g, "_ranks", None) \
-            else gathered
+        parts = _store_gather_group(tensor._data, g)
         fn = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
               ReduceOp.MIN: np.min, ReduceOp.PROD: np.prod,
               ReduceOp.AVG: np.mean}[op]
-        if jax.process_index() in (getattr(g, "_ranks", None)
-                                   or range(jax.process_count())):
-            tensor._rebind(jnp.asarray(fn(sel, axis=0)))
+        tensor._rebind(jnp.asarray(fn(np.stack(parts), axis=0)))
         return _CompletedTask(tensor)
     raise RuntimeError("all_reduce: no distributed context")
 
@@ -257,14 +289,10 @@ def all_gather(tensor_list: List, tensor: Tensor, group: Group = None,
             tensor_list.extend(Tensor(stack[i])
                                for i in range(stack.shape[0]))
             return _CompletedTask()
-        from jax.experimental import multihost_utils
-
-        # subset group: gather world-wide, keep only member rows
+        # subset/permuted group: members-only store-brokered path
         g = group or _get_default_group()
-        gathered = multihost_utils.process_allgather(np.asarray(tensor._data))
-        members = getattr(g, "_ranks", None) or range(len(gathered))
-        tensor_list.extend(Tensor(jnp.asarray(gathered[r]))
-                           for r in members)
+        parts = _store_gather_group(tensor._data, g)
+        tensor_list.extend(Tensor(jnp.asarray(p)) for p in parts)
         return _CompletedTask()
     raise RuntimeError("all_gather: no distributed context")
 
@@ -329,14 +357,16 @@ def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
         if _full_world(group):
             tensor._rebind(_compiled_reducescatter(stacked, op))
             return _CompletedTask(tensor)
-        # subset fallback: reduce within the group, keep own group-rank
-        # slice (stacked has nranks chunks, indexed by group rank)
+        # subset/permuted group: reduce within the group, keep own
+        # group-rank slice (stacked has nranks chunks by group rank)
         g = group or _get_default_group()
-        reduced = Tensor(stacked)
-        all_reduce(reduced, op=op, group=group)
+        parts = _store_gather_group(stacked, g)
+        red = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
+               ReduceOp.MIN: np.min, ReduceOp.PROD: np.prod,
+               ReduceOp.AVG: np.mean}[op](np.stack(parts), axis=0)
         my_gr = g.get_group_rank(jax.process_index())
         if my_gr >= 0:
-            tensor._rebind(reduced._data[my_gr])
+            tensor._rebind(jnp.asarray(red[my_gr]))
         return _CompletedTask(tensor)
     raise RuntimeError("reduce_scatter: no distributed context")
 
@@ -350,16 +380,10 @@ def broadcast(tensor: Tensor, src: int = 0, group: Group = None,
         if _full_world(group):
             tensor._rebind(_compiled_broadcast(tensor._data, src))
             return _CompletedTask(tensor)
-        from jax.experimental import multihost_utils
-
+        # subset/permuted group: src is group-relative
         g = group or _get_default_group()
-        src_global = g._ranks[src] if getattr(g, "_ranks", None) else src
-        val = multihost_utils.broadcast_one_to_all(
-            np.asarray(tensor._data),
-            is_source=jax.process_index() == src_global)
-        if jax.process_index() in (getattr(g, "_ranks", None)
-                                   or range(jax.process_count())):
-            tensor._rebind(jnp.asarray(val))
+        parts = _store_gather_group(tensor._data, g)
+        tensor._rebind(jnp.asarray(parts[src]))
         return _CompletedTask(tensor)
     raise RuntimeError("broadcast: no distributed context")
 
@@ -443,17 +467,13 @@ def all_to_all(out_tensor_list: List, in_tensor_list: List[Tensor],
             inbox = _local_value(out)[0]
             out_tensor_list.extend(Tensor(inbox[p]) for p in range(n))
             return _CompletedTask()
-        from jax.experimental import multihost_utils
-
-        # subset group: rows/columns are indexed by GROUP rank
+        # subset/permuted group: rows/columns indexed by GROUP rank
         g = group or _get_default_group()
-        gathered = multihost_utils.process_allgather(np.asarray(stacked))
-        members = list(getattr(g, "_ranks", None)
-                       or range(len(gathered)))
+        parts = _store_gather_group(stacked, g)
         my_gr = g.get_group_rank(jax.process_index())
         if my_gr >= 0:
             out_tensor_list.extend(
-                Tensor(jnp.asarray(gathered[r][my_gr])) for r in members)
+                Tensor(jnp.asarray(p[my_gr])) for p in parts)
         return _CompletedTask()
     raise RuntimeError("all_to_all: no distributed context")
 

@@ -97,6 +97,8 @@ def eager_apply(
         if flag("check_nan_inf"):
             _check_finite(op_name, outs)
         tensors = tuple(Tensor(o) for o in outs)
+        _maybe_record(op_name, raw_fn, static_kwargs, tensor_inputs,
+                      tensors)
         return tensors if n_outputs != 1 else tensors[0]
 
     diff_idx = [
@@ -142,7 +144,28 @@ def eager_apply(
         t._out_idx = idx
         tensors.append(t)
     tensors = tuple(tensors)
+    _maybe_record(op_name, raw_fn, static_kwargs, tensor_inputs, tensors)
     return tensors if n_outputs != 1 else tensors[0]
+
+
+_STATIC_STATE = None
+
+
+def _maybe_record(op_name, raw_fn, static_kwargs, tensor_inputs, tensors):
+    """Static-graph recording hook: under static.program_guard every
+    dispatched op is appended to the active Program (the ProgramDesc
+    build step of the reference's static mode — base/framework.py
+    append_op); eager execution proceeds unchanged. The thread-local is
+    cached after first use so the common no-guard case costs one
+    attribute check per dispatch."""
+    global _STATIC_STATE
+    if _STATIC_STATE is None:
+        from ..static.program import _STATE as _STATIC_STATE_MOD
+
+        _STATIC_STATE = _STATIC_STATE_MOD
+    prog = _STATIC_STATE.main
+    if prog is not None:
+        prog.record(op_name, raw_fn, static_kwargs, tensor_inputs, tensors)
 
 
 def defun(op_name: str, n_tensor_args: int = 1, n_outputs: int = 1):

@@ -6,5 +6,14 @@ Static-graph user APIs are provided for compat where they have a natural
 traced equivalent.
 """
 from .input_spec import InputSpec  # noqa: F401
+from .program import (  # noqa: F401
+    CompiledProgram, Executor, Program, data, default_main_program,
+    default_startup_program, load_inference_model, program_guard,
+    save_inference_model, scope_guard,
+)
 
-__all__ = ["InputSpec"]
+__all__ = [
+    "InputSpec", "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "scope_guard",
+    "save_inference_model", "load_inference_model", "CompiledProgram",
+]

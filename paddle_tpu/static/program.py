@@ -1,0 +1,357 @@
+"""static.Program / Executor — static-graph compat over op recording.
+
+TPU-native equivalent of the reference's static graph stack (reference:
+python/paddle/base/framework.py Program/Block; executor.py Executor:1152
++ _ExecutorCache:854 over the C++ StandaloneExecutor,
+new_executor/standalone_executor.h:34). The reference builds a
+ProgramDesc of op protos and runs it through an instruction interpreter;
+here ``program_guard`` records every dispatched op (op name, functional
+impl, operand slots) into a Program — the ProgramDesc equivalent — and
+``Executor.run`` replays the op list as ONE jitted XLA program per feed
+signature (the _ExecutorCache role), with placeholder feeds and fetches.
+
+The op list IS the IR: XLA does the pass pipeline the reference's
+interpreter + IR passes do.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "scope_guard",
+    "save_inference_model", "load_inference_model", "CompiledProgram",
+]
+
+
+class _OpRecord:
+    __slots__ = ("op_name", "raw_fn", "static_kwargs", "in_keys",
+                 "out_keys")
+
+    def __init__(self, op_name, raw_fn, static_kwargs, in_keys, out_keys):
+        self.op_name = op_name
+        self.raw_fn = raw_fn
+        self.static_kwargs = static_kwargs
+        self.in_keys = in_keys
+        self.out_keys = out_keys
+
+
+class Program:
+    """Recorded op-list program (reference: base/framework.py Program;
+    C++ ProgramDesc). Variables are slot keys; feeds bind placeholder
+    slots, every other external operand is captured by reference at
+    record time (parameters update in place between runs, like the
+    reference's scope variables)."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self._id = Program._counter
+        self.ops: List[_OpRecord] = []
+        # name -> slot key for placeholders created by static.data
+        self.feed_slots: Dict[str, int] = {}
+        self.feed_specs: Dict[str, tuple] = {}
+        # slot key -> producing Tensor (keeps arrays alive + identity)
+        self._slot_of_tensor: Dict[int, int] = {}   # id(Tensor) -> slot
+        self._tensor_refs: List[Tensor] = []        # strong refs
+        self._captured: Dict[int, Tensor] = {}      # slot -> external in
+        self._next_slot = 0
+        self._exec_cache: Dict[tuple, Any] = {}
+
+    # ---- recording ----
+    def _slot_for(self, t: Tensor, create_external: bool) -> Optional[int]:
+        key = self._slot_of_tensor.get(id(t))
+        if key is not None:
+            return key
+        if not create_external:
+            return None
+        key = self._new_slot()
+        self._slot_of_tensor[id(t)] = key
+        self._tensor_refs.append(t)
+        self._captured[key] = t  # late-bound: read t._data at run time
+        return key
+
+    def _new_slot(self) -> int:
+        self._next_slot += 1
+        return self._next_slot
+
+    def _register_output(self, t: Tensor) -> int:
+        key = self._new_slot()
+        self._slot_of_tensor[id(t)] = key
+        self._tensor_refs.append(t)
+        t._static_program = self  # back-pointer for fetch-var resolution
+        return key
+
+    def record(self, op_name, raw_fn, static_kwargs, inputs, outputs):
+        in_keys = [self._slot_for(t, create_external=True) for t in inputs]
+        out_keys = [self._register_output(t) for t in outputs]
+        self.ops.append(_OpRecord(op_name, raw_fn, dict(static_kwargs or {}),
+                                  in_keys, out_keys))
+
+    def add_placeholder(self, name, shape, dtype) -> Tensor:
+        np_dtype = convert_dtype(dtype).np_dtype
+        orig_shape = tuple(None if (s is None or (isinstance(s, int)
+                                                  and s < 0)) else int(s)
+                           for s in shape)
+        shape = tuple(1 if s is None else s for s in orig_shape)
+        self.feed_orig_shapes = getattr(self, "feed_orig_shapes", {})
+        self.feed_orig_shapes[name] = orig_shape
+        t = Tensor(jnp.zeros(shape, np_dtype), name=name)
+        key = self._new_slot()
+        self._slot_of_tensor[id(t)] = key
+        self._tensor_refs.append(t)
+        t._static_program = self
+        self.feed_slots[name] = key
+        self.feed_specs[name] = (shape, np_dtype)
+        return t
+
+    # ---- execution ----
+    def _fetch_key(self, var) -> int:
+        if isinstance(var, Tensor):
+            key = self._slot_of_tensor.get(id(var))
+            if key is None:
+                raise ValueError("fetch target was not produced inside "
+                                 "this Program")
+            return key
+        if isinstance(var, str):
+            for t in self._tensor_refs:
+                if t.name == var:
+                    return self._slot_of_tensor[id(t)]
+            raise ValueError(f"no variable named {var!r} in Program")
+        raise TypeError(f"bad fetch target {type(var)}")
+
+    def _replay(self, env: Dict[int, Any], fetch_keys):
+        env = dict(env)
+        for op in self.ops:
+            out = op.raw_fn(*[env[k] for k in op.in_keys],
+                            **op.static_kwargs)
+            outs = out if isinstance(out, tuple) else (out,)
+            for k, o in zip(op.out_keys, outs):
+                env[k] = o
+        return tuple(env[k] for k in fetch_keys)
+
+    def run(self, feed: Dict[str, Any], fetch_list: Sequence) -> List:
+        fetch_keys = tuple(self._fetch_key(v) for v in fetch_list)
+        feed = feed or {}
+        feed_arrays = {}
+        for name, val in feed.items():
+            if name not in self.feed_slots:
+                raise KeyError(f"feed {name!r} is not a placeholder of "
+                               f"this Program")
+            feed_arrays[self.feed_slots[name]] = jnp.asarray(
+                val._data if isinstance(val, Tensor) else val)
+        # captured externals (parameters etc.) travel as jit ARGUMENTS so
+        # mutations between runs are visible (reference scope semantics),
+        # not baked-in constants; cache key covers the op list and
+        # capture set so mutating the Program invalidates stale programs
+        cap_keys = tuple(sorted(self._captured))
+        sig = (tuple(sorted((k, a.shape, str(a.dtype))
+                            for k, a in feed_arrays.items())), fetch_keys,
+               len(self.ops), cap_keys)
+        if sig not in self._exec_cache:
+            feed_keys = tuple(sorted(feed_arrays))
+
+            def compiled(feed_vals, cap_vals):
+                env = dict(zip(feed_keys, feed_vals))
+                env.update(zip(cap_keys, cap_vals))
+                return self._replay(env, fetch_keys)
+
+            self._exec_cache[sig] = (feed_keys, jax.jit(compiled))
+        feed_keys, fn = self._exec_cache[sig]
+        outs = fn([feed_arrays[k] for k in feed_keys],
+                  [self._captured[k]._data for k in cap_keys])
+        return [np.asarray(o) for o in outs]
+
+    def clone(self, for_test: bool = False) -> "Program":
+        return self  # recorded program has no train/test divergence
+
+    def global_block(self):
+        return self
+
+    @property
+    def num_ops(self):
+        return len(self.ops)
+
+    def __repr__(self):
+        return f"Program(id={self._id}, ops={len(self.ops)})"
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.main: Optional[Program] = None
+        self.startup: Optional[Program] = None
+        self.default_main = Program()
+        self.default_startup = Program()
+
+
+_STATE = _State()
+
+
+def current_program() -> Optional[Program]:
+    return _STATE.main
+
+
+def default_main_program() -> Program:
+    return _STATE.main if _STATE.main is not None else _STATE.default_main
+
+
+def default_startup_program() -> Program:
+    return (_STATE.startup if _STATE.startup is not None
+            else _STATE.default_startup)
+
+
+class program_guard:
+    """Records dispatched ops into ``main_program`` (reference:
+    base/framework.py program_guard)."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        self._prev = (_STATE.main, _STATE.startup)
+        _STATE.main = self._main
+        _STATE.startup = self._startup
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.main, _STATE.startup = self._prev
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Declare a feed placeholder (reference: static/input.py data).
+
+    Must run under ``program_guard`` — op recording is guard-scoped
+    (paddle_tpu is dygraph-first; the guard is the enable_static
+    equivalent), so a placeholder outside it would silently record
+    nothing."""
+    prog = current_program()
+    if prog is None:
+        raise RuntimeError(
+            "static.data() outside program_guard: wrap graph "
+            "construction in `with static.program_guard(prog):` — ops "
+            "are only recorded inside the guard")
+    return prog.add_placeholder(name, shape, dtype)
+
+
+class scope_guard:
+    def __init__(self, scope=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Executor:
+    """Program runner (reference: base/executor.py Executor:1152). The
+    per-(program, feed-signature, fetch) jit cache plays the
+    _ExecutorCache:854 role; place is accepted for API parity (XLA owns
+    placement)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy: bool = True):
+        program = program or default_main_program()
+        outs = program.run(feed or {}, fetch_list or [])
+        if return_numpy:
+            return outs
+        return [Tensor(jnp.asarray(o)) for o in outs]
+
+    def close(self):
+        pass
+
+
+CompiledProgram = Program  # reference CompiledProgram: already compiled
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, program: Optional[Program] = None):
+    """Export a pruned inference program (reference: static/io.py
+    save_inference_model:510). The artifact is the same StableHLO +
+    params format jit.save produces, so paddle_tpu.inference.Predictor
+    loads it — mirroring the reference's static-save → AnalysisPredictor
+    pipeline."""
+    import os
+    import pickle
+
+    from jax import export as jexport
+
+    feed_vars = list(feed_vars)
+    if program is None:
+        # resolve the owning Program from the fetch vars (the guard may
+        # have exited by now — reference passes program explicitly)
+        program = getattr(list(fetch_vars)[0], "_static_program", None) \
+            or default_main_program()
+    fetch_keys = tuple(program._fetch_key(v) for v in fetch_vars)
+    feed_keys = []
+    for v in feed_vars:
+        key = program._slot_of_tensor.get(id(v))
+        if key is None:
+            raise ValueError("feed var not part of the program")
+        feed_keys.append(key)
+
+    # signature matches TranslatedLayer's (params, buffers, *args)
+    # convention so jit.load / inference.Predictor can call it
+    def fwd(params, buffers, *arrays):
+        env = dict(zip(feed_keys, arrays))
+        # deployment artifact: captured params ARE baked in as constants
+        env.update({k: t._data for k, t in program._captured.items()})
+        return program._replay(env, fetch_keys)
+
+    # dynamic dims (declared None/-1 in static.data) export as symbolic
+    # dimensions so the artifact accepts any batch size (reference
+    # save_inference_model preserves dynamic batch)
+    orig = getattr(program, "feed_orig_shapes", {})
+    avals = []
+    n_sym = 0
+    for v in feed_vars:
+        oshape = orig.get(v.name, tuple(v.shape))
+        if any(s is None for s in oshape):
+            dims = []
+            for s in oshape:
+                if s is None:
+                    dims.append(f"_b{n_sym}")
+                    n_sym += 1
+                else:
+                    dims.append(str(s))
+            sym = jexport.symbolic_shape("(" + ", ".join(dims) + ")")
+            avals.append(jax.ShapeDtypeStruct(sym, v._data.dtype))
+        else:
+            avals.append(jax.ShapeDtypeStruct(tuple(v.shape),
+                                              v._data.dtype))
+    exp = jexport.export(jax.jit(fwd))([], [], *avals)
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({}, f, protocol=4)  # params are baked into the export
+    meta = {"class_name": "StaticProgram", "exported": [exp.serialize()],
+            "param_names": [], "n_params": 0}
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+
+
+def load_inference_model(path_prefix: str, executor=None):
+    """reference: static/io.py load_inference_model:820 — returns
+    (program-like callable, feed_names, fetch_names)."""
+    from ..jit.api import load as jit_load
+
+    layer = jit_load(path_prefix)
+    n_in = len(layer._exported.in_avals)
+    return layer, [f"input_{i}" for i in range(n_in)], ["output_0"]

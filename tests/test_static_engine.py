@@ -1,0 +1,183 @@
+"""static.Program/Executor + auto-parallel Engine tests.
+
+Mirrors the reference's static-graph and engine tests (reference:
+test/legacy_test executor tests; test/auto_parallel engine API tests).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+
+
+class TestStaticProgram:
+    def test_program_records_and_runs(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 3], "float32")
+            y = paddle.matmul(x, paddle.to_tensor(
+                np.ones((3, 2), np.float32)))
+            z = y + 1.0
+        assert prog.num_ops >= 2
+        exe = static.Executor()
+        xv = np.arange(12).reshape(4, 3).astype(np.float32)
+        (out,) = exe.run(prog, feed={"x": xv}, fetch_list=[z])
+        np.testing.assert_allclose(out, xv @ np.ones((3, 2)) + 1.0)
+
+    def test_layers_under_program_guard(self):
+        paddle.seed(0)
+        net = nn.Linear(5, 2)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3, 5], "float32")
+            out = net(x)
+        exe = static.Executor()
+        xv = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        (got,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+        want = net(paddle.to_tensor(xv)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_captured_params_are_live(self):
+        """Parameters are captured by reference: mutating them between
+        runs changes the program's result (reference scope semantics)."""
+        net = nn.Linear(2, 2, bias_attr=False)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [1, 2], "float32")
+            out = net(x)
+        exe = static.Executor()
+        xv = np.ones((1, 2), np.float32)
+        (a,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+        net.weight.set_value(paddle.to_tensor(
+            np.zeros((2, 2), np.float32)))
+        (b,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(b, np.zeros((1, 2)))
+        assert not np.allclose(a, b)
+
+    def test_fetch_by_name_and_bad_feed(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2], "float32")
+            y = x * 2.0
+        exe = static.Executor()
+        with pytest.raises(KeyError):
+            exe.run(prog, feed={"bogus": np.ones(2, np.float32)},
+                    fetch_list=[y])
+
+    def test_data_outside_guard_raises(self):
+        with pytest.raises(RuntimeError):
+            static.data("oops", [2], "float32")
+
+    def test_dynamic_batch_export(self, tmp_path):
+        """A None batch dim survives export: the saved artifact accepts
+        any batch size (reference save_inference_model dynamic batch)."""
+        paddle.seed(2)
+        net = nn.Linear(4, 2)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            out = net(x)
+        path = str(tmp_path / "dyn")
+        static.save_inference_model(path, [x], [out])
+        layer, _, _ = static.load_inference_model(path)
+        for bs in (1, 5, 9):
+            xv = np.random.RandomState(bs).randn(bs, 4).astype(np.float32)
+            got = layer(paddle.to_tensor(xv)).numpy()
+            want = net(paddle.to_tensor(xv)).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_default_main_program(self):
+        before = static.default_main_program()
+        prog = static.Program()
+        with static.program_guard(prog):
+            assert static.default_main_program() is prog
+        assert static.default_main_program() is before
+
+    def test_save_load_inference_model(self, tmp_path):
+        paddle.seed(1)
+        net = nn.Linear(4, 3)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            out = net(x)
+        path = str(tmp_path / "static_model")
+        static.save_inference_model(path, [x], [out])
+
+        # loadable both via static.load_inference_model and the Predictor
+        layer, feeds, fetches = static.load_inference_model(path)
+        xv = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        got = layer(paddle.to_tensor(xv))
+        want = net(paddle.to_tensor(xv)).numpy()
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-6)
+
+        from paddle_tpu.inference import Config, create_predictor
+
+        pred = create_predictor(Config(path))
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(xv)
+        pred.run()
+        got2 = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(got2, want, rtol=1e-5, atol=1e-6)
+
+
+class TestAutoParallelEngine:
+    def _data(self, n=64):
+        from paddle_tpu.io import Dataset
+
+        rng = np.random.RandomState(0)
+        xs = rng.randn(n, 8).astype(np.float32)
+        w = rng.randn(8, 1).astype(np.float32)
+        ys = (xs @ w).astype(np.float32)
+
+        class DS(Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return xs[i], ys[i]
+
+        return DS()
+
+    def test_engine_fit_converges(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.distributed.auto_parallel import Engine
+
+        paddle.seed(0)
+        model = nn.Linear(8, 1)
+        opt = paddle.optimizer.Adam(0.05, parameters=model.parameters())
+        engine = Engine(model=model, loss=F.mse_loss, optimizer=opt)
+        hist = engine.fit(self._data(), epochs=20, batch_size=16)
+        assert hist["loss"][-1] < hist["loss"][0] * 0.01
+
+    def test_engine_evaluate_predict(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.distributed.auto_parallel import Engine
+        from paddle_tpu.metric import Accuracy
+
+        paddle.seed(0)
+        model = nn.Linear(8, 1)
+        opt = paddle.optimizer.Adam(0.01, parameters=model.parameters())
+        engine = Engine(model=model, loss=F.mse_loss, optimizer=opt)
+        engine.fit(self._data(), epochs=1, batch_size=16)
+        res = engine.evaluate(self._data(), batch_size=16)
+        assert res["loss"] is not None and np.isfinite(res["loss"])
+        preds = engine.predict(self._data(), batch_size=16)
+        assert len(preds) == 4 and preds[0].shape == (16, 1)
+
+    def test_engine_save_load(self, tmp_path):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.distributed.auto_parallel import Engine
+
+        paddle.seed(0)
+        model = nn.Linear(8, 1)
+        opt = paddle.optimizer.Adam(0.01, parameters=model.parameters())
+        engine = Engine(model=model, loss=F.mse_loss, optimizer=opt)
+        engine.fit(self._data(), epochs=1, batch_size=16)
+        w_before = model.weight.numpy().copy()
+        engine.save(str(tmp_path / "ckpt"))
+        model.weight.set_value(paddle.to_tensor(
+            np.zeros_like(w_before)))
+        engine.load(str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(model.weight.numpy(), w_before)
