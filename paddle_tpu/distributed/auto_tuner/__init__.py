@@ -1,0 +1,7 @@
+"""distributed.auto_tuner (reference: python/paddle/distributed/auto_tuner)."""
+from .tuner import AutoTuner  # noqa: F401
+from .search import GridSearch  # noqa: F401
+from .cost_model import estimate_step_cost, estimate_memory_bytes  # noqa: F401
+
+__all__ = ["AutoTuner", "GridSearch", "estimate_step_cost",
+           "estimate_memory_bytes"]

@@ -1,0 +1,100 @@
+"""Candidate generation + pruned grid search.
+
+TPU-native equivalent of the reference's search algorithms (reference:
+python/paddle/distributed/auto_tuner/search.py GridSearch;
+prune.py divisibility/memory pruning; utils.py default_candidates).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from .cost_model import estimate_memory_bytes, estimate_step_cost
+
+__all__ = ["GridSearch", "default_candidates", "prune_config"]
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg: Dict) -> Dict[str, List[int]]:
+    """Per-axis candidate lists (reference: utils.py
+    default_candidates)."""
+    n = int(tuner_cfg.get("num_devices", 8))
+
+    def pick(key, default):
+        v = tuner_cfg.get(key)
+        return default if v is None else v  # falsy scalars are pins
+
+    cands = {
+        "dp_degree": pick("dp_degree", _divisors(n)),
+        "mp_degree": pick("mp_degree", _divisors(n)),
+        "pp_degree": pick("pp_degree", _divisors(n)),
+        "sharding_degree": pick("sharding_degree", _divisors(n)),
+        "micro_batch_size": pick("micro_batch_size", [1, 2, 4, 8]),
+        "recompute": pick("recompute", [True, False]),
+    }
+    return {k: (v if isinstance(v, list) else [v]) for k, v in cands.items()}
+
+
+def prune_config(cfg: Dict, tuner_cfg: Dict) -> Optional[str]:
+    """Return a reason string if cfg is invalid/hopeless, else None
+    (reference: prune.py prune_by_* registry)."""
+    n = int(tuner_cfg.get("num_devices", 8))
+    dp, mp, pp = cfg["dp_degree"], cfg["mp_degree"], cfg["pp_degree"]
+    sh = cfg["sharding_degree"]
+    if dp * mp * pp != n:
+        return f"dp*mp*pp={dp * mp * pp} != num_devices={n}"
+    if sh > dp:
+        return f"sharding_degree={sh} > dp_degree={dp}"
+    gbs = int(tuner_cfg.get("global_batch_size", 32))
+    if gbs % (dp * cfg["micro_batch_size"]):
+        return "global_batch_size not divisible by dp*micro_bs"
+    layers = int(tuner_cfg.get("num_layers", 24))
+    if layers % pp:
+        return f"num_layers={layers} not divisible by pp={pp}"
+    heads = int(tuner_cfg.get("num_attention_heads", 16))
+    if heads % mp:
+        return f"num_attention_heads={heads} not divisible by mp={mp}"
+    mem_cap = float(tuner_cfg.get("memory_limit_bytes", 0))
+    if mem_cap:
+        full = dict(tuner_cfg)
+        full.update(cfg)
+        if estimate_memory_bytes(full) > mem_cap:
+            return "estimated memory exceeds limit"
+    return None
+
+
+class GridSearch:
+    """Pruned cartesian grid, cheapest analytic cost first (reference:
+    search.py GridSearch.search_once)."""
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = tuner_cfg
+        cands = tuner_cfg.get("candidates") or default_candidates(tuner_cfg)
+        keys = list(cands)
+        configs = []
+        self.pruned: List[Dict] = []
+        for combo in itertools.product(*(cands[k] for k in keys)):
+            cfg = dict(zip(keys, combo))
+            reason = prune_config(cfg, tuner_cfg)
+            if reason is None:
+                configs.append(cfg)
+            else:
+                self.pruned.append({**cfg, "pruned": reason})
+        full = dict(tuner_cfg)
+        configs.sort(key=lambda c: estimate_step_cost({**full, **c}))
+        self._queue = configs
+        self._idx = 0
+
+    def search_once(self) -> Optional[Dict]:
+        if self._idx >= len(self._queue):
+            return None
+        cfg = self._queue[self._idx]
+        self._idx += 1
+        return dict(cfg)
+
+    @property
+    def all_tasks(self) -> List[Dict]:
+        return [dict(c) for c in self._queue]

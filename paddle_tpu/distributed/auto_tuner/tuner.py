@@ -1,0 +1,95 @@
+"""AutoTuner driver: propose → trial → record → best.
+
+TPU-native equivalent of the reference's tuner (reference:
+python/paddle/distributed/auto_tuner/tuner.py AutoTuner:21 — the launch
+CLI runs short trials per candidate and records history; recorder.py
+keeps (cfg, metric) rows and sorts). Trials here are run by a
+user-supplied ``runner(cfg) -> metric`` callback (the launcher wiring the
+reference has lives in its CLI layer); with no runner, candidates are
+ranked by the analytic cost model alone.
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional
+
+from .cost_model import estimate_step_cost
+from .search import GridSearch
+
+__all__ = ["AutoTuner"]
+
+
+class AutoTuner:
+    """reference: auto_tuner/tuner.py:21."""
+
+    def __init__(self, tuner_cfg: Dict):
+        if "n_params" not in tuner_cfg:
+            raise ValueError(
+                "tuner_cfg needs 'n_params' (total model parameters) — "
+                "the cost/memory models rank candidates by it")
+        self.tuner_cfg = dict(tuner_cfg)
+        self.task_limit = int(tuner_cfg.get("task_limit", 100))
+        algo = tuner_cfg.get("search_algo", {"name": "grid"})
+        if isinstance(algo, dict):
+            algo = algo.get("name", "grid")
+        if algo != "grid":
+            raise NotImplementedError(f"search_algo {algo!r}; grid only")
+        self.algo = GridSearch(self.tuner_cfg)
+        self.history: List[Dict] = []
+        self.cur_task_id = 0
+
+    def search_once(self) -> Optional[Dict]:
+        """Next candidate config, or None when exhausted/limit reached."""
+        if self.cur_task_id >= self.task_limit:
+            return None
+        cfg = self.algo.search_once()
+        if cfg is not None:
+            self.cur_task_id += 1
+        return cfg
+
+    def add_cfg(self, cfg: Dict, metric: Optional[float],
+                error: Optional[str] = None) -> None:
+        """Record a trial result (reference: recorder.py add_cfg);
+        metric convention: higher is better (tokens/s); None = failed."""
+        self.history.append({"cfg": dict(cfg), "metric": metric,
+                             "error": error})
+
+    def get_best(self) -> Optional[Dict]:
+        ok = [h for h in self.history if h["metric"] is not None]
+        if not ok:
+            return None
+        return max(ok, key=lambda h: h["metric"])
+
+    def tune(self, runner: Optional[Callable[[Dict], float]] = None,
+             max_trials: Optional[int] = None) -> Dict:
+        """Drive the whole loop. ``runner(cfg)`` returns the measured
+        metric (higher better) or raises on OOM/failure. Returns the best
+        record. Without a runner, returns the analytically-cheapest
+        candidate (cost-model-only mode)."""
+        if runner is None:
+            cands = self.algo.all_tasks
+            if not cands:
+                raise RuntimeError("no valid candidate configs")
+            full = dict(self.tuner_cfg)
+            best = min(cands,
+                       key=lambda c: estimate_step_cost({**full, **c}))
+            return {"cfg": best, "metric": None, "error": None}
+        trials = 0
+        while True:
+            if max_trials is not None and trials >= max_trials:
+                break
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            trials += 1
+            try:
+                metric = float(runner(cfg))
+                self.add_cfg(cfg, metric)
+            except Exception as e:  # OOM/compile failure → recorded skip
+                self.add_cfg(cfg, None, error=str(e))
+        best = self.get_best()
+        if best is None:
+            raise RuntimeError(
+                "auto-tune: every trial failed; history: "
+                + json.dumps(self.history, default=str)[:2000])
+        return best

@@ -1,0 +1,169 @@
+"""Collective sanity checks + communication watchdog.
+
+TPU-native equivalent of the reference's communication safety layer:
+- static checks (reference: paddle/phi/core/distributed/check/
+  static_check.cc — same-place/shape/dtype validation of collective
+  inputs; check/nccl_dynamic_check.h — cross-rank metadata agreement
+  via a broadcast before the real collective);
+- hang watchdog (reference: paddle/phi/core/distributed/
+  comm_task_manager.h:37 CommTaskManager + nccl_comm_task.cc — tracks
+  in-flight collectives and surfaces stuck ranks on timeout).
+
+Dynamic checks are flag-gated (`FLAGS_check_collective`, mirroring
+FLAGS_enable_nccl_dynamic_check) because the metadata exchange costs a
+store round-trip per collective.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..core.flags import define_flag, flag
+
+__all__ = ["check_tensor_list", "dynamic_check", "CommWatchdog",
+           "watchdog"]
+
+define_flag("check_collective", False,
+            "cross-rank shape/dtype agreement check before each "
+            "multi-process collective (nccl_dynamic_check equivalent)")
+# Must be BELOW the 120s store blocking-get timeout (_P2P_TIMEOUT_MS):
+# the watchdog's stuck-rank report has to fire while the op is still in
+# flight, before the raw coordination-service timeout kills it.
+define_flag("comm_timeout_sec", 60,
+            "watchdog timeout for in-flight eager collectives")
+
+
+def check_tensor_list(tensor_list, tensor=None, op_name: str = "") -> None:
+    """Local static checks (static_check.cc CheckShape/CheckDataType):
+    every tensor in a scatter/gather list must agree in shape+dtype."""
+    if not tensor_list:
+        return
+    datas = [t._data if hasattr(t, "_data") else t for t in tensor_list]
+    shape0, dtype0 = datas[0].shape, datas[0].dtype
+    for i, d in enumerate(datas[1:], 1):
+        if d.shape != shape0 or d.dtype != dtype0:
+            raise ValueError(
+                f"{op_name}: tensor_list[{i}] has shape {d.shape}/"
+                f"{d.dtype}, expected {shape0}/{dtype0} "
+                "(collective inputs must agree across slots)")
+    if tensor is not None:
+        td = tensor._data if hasattr(tensor, "_data") else tensor
+        if td.dtype != dtype0:
+            raise ValueError(
+                f"{op_name}: output dtype {td.dtype} != input {dtype0}")
+
+
+def dynamic_check(tensor, op_name: str, group=None) -> None:
+    """Cross-rank agreement check (nccl_dynamic_check.h equivalent):
+    every participating process posts (shape, dtype) to the coordination
+    store and verifies all match before the data-plane collective runs.
+    Flag-gated; call sites are the multi-process collectives."""
+    if not flag("check_collective"):
+        return
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from .communication.collectives import _store_gather_group
+    from .communication.group import _get_default_group
+    import numpy as np
+
+    g = group or _get_default_group()
+    meta = np.frombuffer(
+        (str(tuple(tensor._data.shape)) + "|"
+         + str(tensor._data.dtype)).encode().ljust(128), dtype=np.uint8)
+    metas = _store_gather_group(meta, g)
+    mine = bytes(meta).rstrip()
+    for r, m in zip(g._ranks, metas):
+        if bytes(m).rstrip() != mine:
+            raise RuntimeError(
+                f"{op_name}: rank {r} metadata "
+                f"{bytes(m).rstrip().decode()} != local "
+                f"{mine.decode()} — collective would corrupt data "
+                "(nccl_dynamic_check parity)")
+
+
+class CommWatchdog:
+    """In-flight collective tracker (comm_task_manager.h:37).
+
+    ``with watchdog.track(op, group):`` registers the op; a daemon
+    thread scans for entries older than FLAGS_comm_timeout_sec and
+    invokes ``on_timeout`` (default: print a stuck-rank report, once per
+    offender). XLA has no stream to cancel — surfacing WHERE training is
+    stuck is the actionable part (matches the reference, which also only
+    surfaces + optionally aborts)."""
+
+    def __init__(self, on_timeout: Optional[Callable] = None,
+                 scan_interval: float = 5.0):
+        self._inflight: Dict[int, dict] = {}
+        self._lock = threading.Lock()
+        self._next = 0
+        self._reported: set = set()
+        self._on_timeout = on_timeout or self._default_report
+        self._scan_interval = scan_interval
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.timeouts: List[dict] = []  # observability for tests/tools
+
+    def _default_report(self, entry: dict) -> None:
+        import sys
+
+        print(f"[comm watchdog] collective `{entry['op']}` in flight for "
+              f"{time.time() - entry['start']:.0f}s "
+              f"(group ranks {entry['ranks']}) — a peer is likely stuck "
+              "or dead; check the launcher's per-rank logs",
+              file=sys.stderr)
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._scan_loop,
+                                            daemon=True)
+            self._thread.start()
+
+    def _scan_loop(self):
+        while not self._stop.wait(self._scan_interval):
+            timeout = float(flag("comm_timeout_sec"))
+            now = time.time()
+            with self._lock:
+                entries = list(self._inflight.items())
+            for token, e in entries:
+                if now - e["start"] > timeout and token not in \
+                        self._reported:
+                    self._reported.add(token)
+                    self.timeouts.append(dict(e))
+                    self._on_timeout(e)
+
+    class _Span:
+        def __init__(self, wd, op, ranks):
+            self._wd = wd
+            self._op = op
+            self._ranks = ranks
+            self._token = None
+
+        def __enter__(self):
+            wd = self._wd
+            with wd._lock:
+                wd._next += 1
+                self._token = wd._next
+                wd._inflight[self._token] = {
+                    "op": self._op, "ranks": self._ranks,
+                    "start": time.time()}
+            wd._ensure_thread()
+            return self
+
+        def __exit__(self, *exc):
+            with self._wd._lock:
+                self._wd._inflight.pop(self._token, None)
+            return False
+
+    def track(self, op: str, group=None) -> "_Span":
+        ranks = list(getattr(group, "_ranks", []) or [])
+        return self._Span(self, op, ranks)
+
+    def stop(self):
+        self._stop.set()
+
+
+watchdog = CommWatchdog()

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
+from ..check import check_tensor_list, dynamic_check, watchdog
 from .group import Group, _get_default_group
 
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
@@ -108,10 +109,11 @@ def _store_gather_group(arr, g: Group):
     client.key_value_set_bytes(f"{base}/{me}",
                                pickle.dumps(np.asarray(arr), protocol=4))
     out = []
-    for r in g._ranks:
-        blob = client.blocking_key_value_get_bytes(f"{base}/{r}",
-                                                   _P2P_TIMEOUT_MS)
-        out.append(pickle.loads(blob))
+    with watchdog.track("store_allgather", g):
+        for r in g._ranks:
+            blob = client.blocking_key_value_get_bytes(f"{base}/{r}",
+                                                       _P2P_TIMEOUT_MS)
+            out.append(pickle.loads(blob))
     # ack barrier: the member whose increment completes the count cleans
     # up (everyone has read every data key before acking)
     done = client.key_value_increment(f"{base}/ack", 1)
@@ -120,6 +122,41 @@ def _store_gather_group(arr, g: Group):
             client.key_value_delete(f"{base}/{r}")
         client.key_value_delete(f"{base}/ack")
     return out
+
+
+def _store_broadcast(arr, g: Group, src_group_rank: int):
+    """One-to-group broadcast through the store: only src uploads; the
+    others block on that single key (no n-fold gather). Cleanup via the
+    same ack-counter pattern as _store_gather_group."""
+    import pickle
+
+    client = _coord_client()
+    gid = g.id if g.id is not None else 0
+    seq_key = ("bcast", gid)
+    seq = _STORE_SEQ[seq_key] = _STORE_SEQ.get(seq_key, 0) + 1
+    base = f"paddle_tpu/bcast/{gid}/{seq}"
+    me_gr = g.get_group_rank(jax.process_index())
+    if me_gr == src_group_rank:
+        client.key_value_set_bytes(base,
+                                   pickle.dumps(np.asarray(arr),
+                                                protocol=4))
+    with watchdog.track("store_broadcast", g):
+        blob = client.blocking_key_value_get_bytes(base, _P2P_TIMEOUT_MS)
+    val = pickle.loads(blob)
+    done = client.key_value_increment(f"{base}/ack", 1)
+    if done == g.nranks:
+        client.key_value_delete(base)
+        client.key_value_delete(f"{base}/ack")
+    return val
+
+
+def _my_group_rank(g: Optional[Group]) -> int:
+    """Group rank of this process, -1 for non-members (non-members must
+    no-op: they neither post store keys nor join ack barriers)."""
+    g = g or _get_default_group()
+    if g is None or not getattr(g, "_ranks", None):
+        return jax.process_index()
+    return g.get_group_rank(jax.process_index())
 
 
 # ---- compiled cross-process data plane --------------------------------
@@ -250,11 +287,14 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group = None,
     if _world(group) == 1 and not _multihost():
         return _CompletedTask(tensor)
     if _multihost():
+        dynamic_check(tensor, "all_reduce", group)
         if _full_world(group):
             tensor._rebind(_compiled_allreduce(tensor._data, op))
             return _CompletedTask(tensor)
         # subset/permuted group: members-only store-brokered path
         g = group or _get_default_group()
+        if _my_group_rank(g) < 0:
+            return _CompletedTask(tensor)  # non-member no-op
         parts = _store_gather_group(tensor._data, g)
         fn = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
               ReduceOp.MIN: np.min, ReduceOp.PROD: np.prod,
@@ -284,6 +324,7 @@ def all_gather(tensor_list: List, tensor: Tensor, group: Group = None,
         tensor_list.append(Tensor(tensor._data))
         return _CompletedTask()
     if _multihost():
+        dynamic_check(tensor, "all_gather", group)
         if _full_world(group):
             stack = _compiled_allgather(tensor._data)
             tensor_list.extend(Tensor(stack[i])
@@ -291,6 +332,8 @@ def all_gather(tensor_list: List, tensor: Tensor, group: Group = None,
             return _CompletedTask()
         # subset/permuted group: members-only store-brokered path
         g = group or _get_default_group()
+        if _my_group_rank(g) < 0:
+            return _CompletedTask()  # non-member no-op
         parts = _store_gather_group(tensor._data, g)
         tensor_list.extend(Tensor(jnp.asarray(p)) for p in parts)
         return _CompletedTask()
@@ -347,6 +390,7 @@ def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group: Group = None,
 
 def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
                    op=ReduceOp.SUM, group: Group = None, sync_op: bool = True):
+    check_tensor_list(tensor_list, tensor, "reduce_scatter")
     n = _world(group)
     if n == 1 and not _multihost():
         t = tensor_list[0]
@@ -360,6 +404,8 @@ def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
         # subset/permuted group: reduce within the group, keep own
         # group-rank slice (stacked has nranks chunks by group rank)
         g = group or _get_default_group()
+        if _my_group_rank(g) < 0:
+            return _CompletedTask(tensor)  # non-member no-op
         parts = _store_gather_group(stacked, g)
         red = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
                ReduceOp.MIN: np.min, ReduceOp.PROD: np.prod,
@@ -377,13 +423,21 @@ def broadcast(tensor: Tensor, src: int = 0, group: Group = None,
     if n == 1 and not _multihost():
         return _CompletedTask(tensor)
     if _multihost():
+        dynamic_check(tensor, "broadcast", group)
         if _full_world(group):
             tensor._rebind(_compiled_broadcast(tensor._data, src))
             return _CompletedTask(tensor)
-        # subset/permuted group: src is group-relative
+        # subset/permuted group: translate global src to group rank
+        # (matches the compiled path's global-rank convention)
         g = group or _get_default_group()
-        parts = _store_gather_group(tensor._data, g)
-        tensor._rebind(jnp.asarray(parts[src]))
+        if _my_group_rank(g) < 0:
+            return _CompletedTask(tensor)  # non-member no-op
+        src_gr = g.get_group_rank(src)
+        if src_gr < 0:
+            raise ValueError(f"broadcast src={src} is not in group "
+                             f"{g._ranks}")
+        tensor._rebind(jnp.asarray(
+            _store_broadcast(tensor._data, g, src_gr)))
         return _CompletedTask(tensor)
     raise RuntimeError("broadcast: no distributed context")
 
@@ -413,6 +467,8 @@ def broadcast_object_list(object_list: List, src: int = 0,
 
 def scatter(tensor: Tensor, tensor_list: List[Tensor] = None, src: int = 0,
             group: Group = None, sync_op: bool = True):
+    if tensor_list:
+        check_tensor_list(tensor_list, tensor, "scatter")
     n = _world(group)
     if n == 1 and not _multihost():
         if tensor_list:
@@ -447,6 +503,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
 
 def all_to_all(out_tensor_list: List, in_tensor_list: List[Tensor],
                group: Group = None, sync_op: bool = True):
+    check_tensor_list(in_tensor_list, None, "all_to_all")
     n = _world(group)
     if n == 1 and not _multihost():
         out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
@@ -469,6 +526,8 @@ def all_to_all(out_tensor_list: List, in_tensor_list: List[Tensor],
             return _CompletedTask()
         # subset/permuted group: rows/columns indexed by GROUP rank
         g = group or _get_default_group()
+        if _my_group_rank(g) < 0:
+            return _CompletedTask()  # non-member no-op
         parts = _store_gather_group(stacked, g)
         my_gr = g.get_group_rank(jax.process_index())
         if my_gr >= 0:
@@ -552,7 +611,8 @@ def recv(tensor: Tensor, src: int = 0, group: Group = None,
     seq = _p2p_seq(src, me)
     client = _coord_client()
     key = f"paddle_tpu/p2p/{src}->{me}/{seq}"
-    blob = client.blocking_key_value_get_bytes(key, _P2P_TIMEOUT_MS)
+    with watchdog.track(f"recv(src={src})", group):
+        blob = client.blocking_key_value_get_bytes(key, _P2P_TIMEOUT_MS)
     client.key_value_delete(key)  # keep the coordinator store bounded
     tensor._rebind(jnp.asarray(pickle.loads(blob)))
     return _CompletedTask(tensor)

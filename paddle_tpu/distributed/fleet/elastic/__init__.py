@@ -1,0 +1,8 @@
+"""fleet.elastic (reference: python/paddle/distributed/fleet/elastic)."""
+from .manager import (  # noqa: F401
+    ELASTIC_AUTO_PARALLEL_EXIT_CODE, ELASTIC_EXIT_CODE, CoordinationStore,
+    ElasticManager, ElasticStatus, LocalFileStore)
+
+__all__ = ["ElasticManager", "ElasticStatus", "LocalFileStore",
+           "CoordinationStore", "ELASTIC_EXIT_CODE",
+           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]

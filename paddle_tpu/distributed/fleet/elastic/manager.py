@@ -1,0 +1,206 @@
+"""Elastic training manager: membership, heartbeats, relaunch decisions.
+
+TPU-native equivalent of the reference's elastic manager (reference:
+python/paddle/distributed/fleet/elastic/manager.py:126 ElasticManager —
+etcd node registration with TTL, scale-event watching, fault-tolerance
+levels, relaunch via exit codes ELASTIC_EXIT_CODE=101 / auto-parallel
+102 at manager.py:32-33). The store is pluggable: the JAX
+coordination-service KV (multi-host jobs) or a local file store
+(single-host tests / the launcher's watch loop) — both give the same
+registration/heartbeat/watch semantics etcd gives the reference.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["ElasticManager", "ElasticStatus", "LocalFileStore",
+           "CoordinationStore", "ELASTIC_EXIT_CODE",
+           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101                 # manager.py:32
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102   # manager.py:33
+ELASTIC_TTL = 60                        # manager.py:39 default
+ELASTIC_TIMEOUT = 120
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"       # waiting for np to recover
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class LocalFileStore:
+    """File-backed KV for single-host elastic tests (etcd stand-in)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.replace("/", "__"))
+
+    def set(self, key: str, value: str) -> None:
+        with open(self._path(key), "w") as f:
+            f.write(value)
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with open(self._path(key)) as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def keys(self, prefix: str) -> List[str]:
+        p = prefix.replace("/", "__")
+        return [f.replace("__", "/") for f in os.listdir(self.root)
+                if f.startswith(p)]
+
+
+class CoordinationStore:
+    """KV over the JAX coordination service (multi-host path)."""
+
+    def __init__(self):
+        from ...communication.collectives import _coord_client
+
+        self._client = _coord_client()
+        self._known: List[str] = []
+
+    def set(self, key: str, value: str) -> None:
+        self._client.key_value_set(key, value, allow_overwrite=True)
+        if key not in self._known:
+            self._known.append(key)
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            return self._client.key_value_try_get(key)
+        except Exception:
+            return None
+
+    def delete(self, key: str) -> None:
+        self._client.key_value_delete(key)
+
+    def keys(self, prefix: str) -> List[str]:
+        try:
+            return [k for k, _ in self._client.key_value_dir_get(prefix)]
+        except Exception:
+            return [k for k in self._known if k.startswith(prefix)]
+
+
+class ElasticManager:
+    """reference: elastic/manager.py:126.
+
+    np spec "min" or "min:max" (PADDLE_ELASTIC_NP contract): the job
+    holds while live hosts ∈ [min, max] differs from the launched world,
+    restarts when membership changed but is still viable, exits when it
+    can't recover within elastic_timeout.
+    """
+
+    def __init__(self, job_id: str = None, np: str = None,
+                 host: str = None, store=None,
+                 ttl: int = None, elastic_timeout: int = None):
+        self.job_id = job_id or os.getenv("PADDLE_ELASTIC_JOB_ID",
+                                          "default")
+        np = np or os.getenv("PADDLE_ELASTIC_NP", "1")
+        self.min_np, self.max_np = self._parse_np(np)
+        self.host = host or os.getenv("POD_IP", f"host-{os.getpid()}")
+        self.ttl = ttl or int(os.getenv("PADDLE_ELASTIC_TTL",
+                                        str(ELASTIC_TTL)))
+        self.elastic_timeout = elastic_timeout or int(
+            os.getenv("PADDLE_ELASTIC_TIMEOUT", str(ELASTIC_TIMEOUT)))
+        self.store = store if store is not None else LocalFileStore(
+            os.path.join("/tmp", f"paddle_tpu_elastic_{self.job_id}"))
+        self.enable = self.min_np > 0
+        self._beat_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._launched_hosts: List[str] = []
+
+    @staticmethod
+    def _parse_np(np_spec: str):
+        """"4" -> (4, 4); "2:8" -> (2, 8) (manager.py _parse_np)."""
+        if ":" in str(np_spec):
+            lo, hi = str(np_spec).split(":")
+            return int(lo), int(hi)
+        n = int(np_spec)
+        return n, n
+
+    # ---- registration + heartbeat (etcd lease equivalent) ----
+    def _key(self, host: str) -> str:
+        return f"elastic/{self.job_id}/nodes/{host}"
+
+    def register(self) -> None:
+        self._heartbeat()
+        if self._beat_thread is None:
+            self._beat_thread = threading.Thread(
+                target=self._beat_loop, daemon=True)
+            self._beat_thread.start()
+
+    def _heartbeat(self) -> None:
+        self.store.set(self._key(self.host),
+                       json.dumps({"ts": time.time()}))
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(max(self.ttl / 3, 0.05)):
+            self._heartbeat()
+
+    def deregister(self) -> None:
+        self._stop.set()
+        self.store.delete(self._key(self.host))
+
+    # ---- membership ----
+    def hosts(self) -> List[str]:
+        """Hosts whose heartbeat is within TTL."""
+        now = time.time()
+        live = []
+        for key in self.store.keys(f"elastic/{self.job_id}/nodes/"):
+            raw = self.store.get(key)
+            if raw is None:
+                continue
+            try:
+                ts = json.loads(raw)["ts"]
+            except Exception:
+                continue
+            if now - ts <= self.ttl:
+                live.append(key.rsplit("/", 1)[-1])
+        return sorted(live)
+
+    def snapshot_launched(self) -> None:
+        self._launched_hosts = self.hosts()
+
+    # ---- decisions (manager.py watch loop) ----
+    def need_scale(self) -> bool:
+        return set(self.hosts()) != set(self._launched_hosts)
+
+    def viable(self) -> bool:
+        return self.min_np <= len(self.hosts()) <= self.max_np
+
+    def watch_once(self) -> str:
+        """One decision tick: HOLD (unchanged), RESTART (membership
+        changed but viable), or HOLD-until-timeout→EXIT handled by
+        wait_viable."""
+        if not self.need_scale():
+            return ElasticStatus.HOLD
+        if self.viable():
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def wait_viable(self, poll: float = 0.1) -> bool:
+        """Block until membership is viable or elastic_timeout passes
+        (False → caller should exit with ELASTIC_EXIT_CODE)."""
+        deadline = time.time() + self.elastic_timeout
+        while time.time() < deadline:
+            if self.viable():
+                return True
+            time.sleep(poll)
+        return False

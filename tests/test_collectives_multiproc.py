@@ -86,6 +86,14 @@ WORKER = textwrap.dedent("""
                      for p in range(2)])
     np.testing.assert_allclose(got, want)
 
+    # flag-gated cross-rank dynamic check (nccl_dynamic_check parity):
+    # matching metadata passes and the collective still reduces right
+    paddle.set_flags({"check_collective": True})
+    t = paddle.to_tensor(np.full(2, rank + 1.0, np.float32))
+    all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), np.full(2, 3.0))
+    paddle.set_flags({"check_collective": False})
+
     # cross-process send/recv through the coordination-service store
     if rank == 0:
         send(paddle.to_tensor(np.arange(6, dtype=np.float32)), dst=1)
