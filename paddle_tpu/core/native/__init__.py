@@ -1,0 +1,165 @@
+"""Native runtime components (C++), bound via ctypes.
+
+The reference keeps its rendezvous store in C++
+(paddle/phi/core/distributed/store/tcp_store.h:121); so do we:
+``tcp_store.cc`` compiles on first use into a cached shared library
+(g++, no pybind11 dependency — plain C ABI + ctypes per the
+environment's binding guidance).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+__all__ = ["TCPStore", "lib"]
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _build_lib() -> str:
+    src = os.path.join(os.path.dirname(__file__), "tcp_store.cc")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "PADDLE_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+    os.makedirs(cache_dir, exist_ok=True)
+    out = os.path.join(cache_dir, f"libpts_{digest}.so")
+    if not os.path.exists(out):
+        tmp = out + f".tmp{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             src, "-o", tmp],
+            check=True, capture_output=True)
+        os.replace(tmp, out)  # atomic: concurrent builders race safely
+    return out
+
+
+def lib() -> ctypes.CDLL:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            path = _build_lib()
+            L = ctypes.CDLL(path)
+            L.pts_server_start.restype = ctypes.c_void_p
+            L.pts_server_start.argtypes = [ctypes.c_int]
+            L.pts_server_stop.argtypes = [ctypes.c_void_p]
+            L.pts_client_connect.restype = ctypes.c_void_p
+            L.pts_client_connect.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_int, ctypes.c_int]
+            L.pts_client_close.argtypes = [ctypes.c_void_p]
+            L.pts_set.restype = ctypes.c_int
+            L.pts_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p, ctypes.c_uint32]
+            L.pts_add.restype = ctypes.c_longlong
+            L.pts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_longlong]
+            L.pts_get.restype = ctypes.c_int
+            L.pts_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_uint32)]
+            L.pts_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+            L.pts_wait.restype = ctypes.c_int
+            L.pts_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+            L.pts_check.restype = ctypes.c_int
+            L.pts_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            L.pts_delete.restype = ctypes.c_int
+            L.pts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            _LIB = L
+        return _LIB
+
+
+class TCPStore:
+    """KV rendezvous store over the native server (reference:
+    paddle.distributed.TCPStore / tcp_store.h:121 API: set/get/add/
+    wait/delete_key; is_master hosts the map)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 120.0):
+        self._lib = lib()
+        self._server = None
+        self.host = host
+        self.port = port
+        self.timeout_ms = int(timeout * 1000)
+        if is_master:
+            if port == 0:
+                import socket as _s
+
+                with _s.socket() as s:
+                    s.bind(("", 0))
+                    self.port = s.getsockname()[1]
+            self._server = self._lib.pts_server_start(self.port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: bind failed on port "
+                                   f"{self.port}")
+        self._client = self._lib.pts_client_connect(
+            self.host.encode(), self.port, self.timeout_ms)
+        if not self._client:
+            raise RuntimeError(
+                f"TCPStore: cannot reach {self.host}:{self.port}")
+
+    def set(self, key: str, value) -> None:
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        rc = self._lib.pts_set(self._client, key.encode(), data,
+                               len(data))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        """Blocks until the key exists (reference TCPStore::get)."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint32()
+        t = self.timeout_ms if timeout is None else int(timeout * 1000)
+        rc = self._lib.pts_get(self._client, key.encode(), t,
+                               ctypes.byref(out), ctypes.byref(out_len))
+        if rc == 1:
+            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) failed")
+        data = ctypes.string_at(out, out_len.value)
+        self._lib.pts_free(out)
+        return data
+
+    def add(self, key: str, amount: int = 1) -> int:
+        v = self._lib.pts_add(self._client, key.encode(), amount)
+        if v == -0x7FFFFFFFFFFFFFFF:
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return int(v)
+
+    def wait(self, keys: List[str], timeout: Optional[float] = None):
+        t = self.timeout_ms if timeout is None else int(timeout * 1000)
+        rc = self._lib.pts_wait(self._client,
+                                "\n".join(keys).encode(), t)
+        if rc == 1:
+            raise TimeoutError(f"TCPStore.wait({keys}) timed out")
+        if rc != 0:
+            raise RuntimeError("TCPStore.wait failed")
+
+    def check(self, key: str) -> bool:
+        rc = self._lib.pts_check(self._client, key.encode())
+        if rc < 0:
+            raise RuntimeError("TCPStore.check failed")
+        return bool(rc)
+
+    def delete_key(self, key: str) -> None:
+        if self._lib.pts_delete(self._client, key.encode()) != 0:
+            raise RuntimeError(f"TCPStore.delete_key({key!r}) failed")
+
+    def __del__(self):
+        try:
+            if getattr(self, "_client", None):
+                self._lib.pts_client_close(self._client)
+                self._client = None
+            if getattr(self, "_server", None):
+                self._lib.pts_server_stop(self._server)
+                self._server = None
+        except Exception:
+            pass

@@ -1,0 +1,380 @@
+// TCPStore — native key-value rendezvous store.
+//
+// TPU-native equivalent of the reference's C++ TCPStore
+// (reference: paddle/phi/core/distributed/store/tcp_store.h:121 +
+// socket.cpp): rank 0 hosts an in-memory map over TCP; clients
+// set/get/add/wait/check/delete. get/wait BLOCK server-side on a
+// condition variable until the key exists (the rendezvous primitive the
+// reference brokers ncclUniqueId through; here it brokers launcher
+// rendezvous, elastic membership, and eager p2p payloads).
+//
+// Wire protocol (all little-endian):
+//   request:  u8 op | u32 klen | key | u32 vlen | value
+//     op: 'S' set, 'G' get(blocking), 'A' add(i64 in value),
+//         'W' wait(keys joined by '\n'), 'C' check, 'D' delete
+//     timeout for G/W rides in vlen==4 payload (ms) when op=='G'/'W'.
+//   response: u8 status (0 ok, 1 timeout/missing) | u32 len | payload
+//
+// Built as a shared library; Python binds via ctypes
+// (paddle_tpu/core/native/__init__.py) — the pybind11-free binding path.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<uint8_t>> data;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_resp(int fd, uint8_t status, const uint8_t* payload,
+               uint32_t len) {
+  if (!write_full(fd, &status, 1)) return false;
+  if (!write_full(fd, &len, 4)) return false;
+  if (len && !write_full(fd, payload, len)) return false;
+  return true;
+}
+
+struct Server {
+  Store store;
+  int listen_fd = -1;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  std::mutex workers_mu;
+  std::vector<std::thread> workers;
+  std::vector<int> conn_fds;  // guarded by workers_mu
+
+  void handle(int fd) {
+    for (;;) {
+      uint8_t op;
+      uint32_t klen, vlen;
+      if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !read_full(fd, key.data(), klen)) break;
+      if (!read_full(fd, &vlen, 4)) break;
+      std::vector<uint8_t> val(vlen);
+      if (vlen && !read_full(fd, val.data(), vlen)) break;
+
+      if (op == 'S') {
+        {
+          std::lock_guard<std::mutex> lk(store.mu);
+          store.data[key] = std::move(val);
+        }
+        store.cv.notify_all();
+        if (!send_resp(fd, 0, nullptr, 0)) break;
+      } else if (op == 'A') {
+        int64_t amount = 0;
+        if (vlen == 8) std::memcpy(&amount, val.data(), 8);
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> lk(store.mu);
+          auto& slot = store.data[key];
+          int64_t cur = 0;
+          if (slot.size() == 8) std::memcpy(&cur, slot.data(), 8);
+          now = cur + amount;
+          slot.resize(8);
+          std::memcpy(slot.data(), &now, 8);
+        }
+        store.cv.notify_all();
+        if (!send_resp(fd, 0, reinterpret_cast<uint8_t*>(&now), 8)) break;
+      } else if (op == 'G' || op == 'W') {
+        int32_t timeout_ms = 120000;
+        // key carries "key" (G) or "k1\nk2" (W); val carries timeout
+        if (vlen == 4) std::memcpy(&timeout_ms, val.data(), 4);
+        std::vector<std::string> keys;
+        size_t pos = 0;
+        while (pos <= key.size()) {
+          size_t nl = key.find('\n', pos);
+          if (nl == std::string::npos) {
+            keys.push_back(key.substr(pos));
+            break;
+          }
+          keys.push_back(key.substr(pos, nl - pos));
+          pos = nl + 1;
+        }
+        std::unique_lock<std::mutex> lk(store.mu);
+        auto have_all = [&] {
+          for (auto& k : keys)
+            if (store.data.find(k) == store.data.end()) return false;
+          return true;
+        };
+        bool ok = store.cv.wait_for(
+            lk, std::chrono::milliseconds(timeout_ms),
+            [&] { return have_all() || stopping.load(); });
+        if (!ok || stopping.load()) {
+          lk.unlock();
+          if (!send_resp(fd, 1, nullptr, 0)) break;
+          continue;
+        }
+        if (op == 'G') {
+          auto payload = store.data[keys[0]];  // copy under lock
+          lk.unlock();
+          if (!send_resp(fd, 0, payload.data(),
+                         static_cast<uint32_t>(payload.size())))
+            break;
+        } else {
+          lk.unlock();
+          if (!send_resp(fd, 0, nullptr, 0)) break;
+        }
+      } else if (op == 'C') {
+        uint8_t exists;
+        {
+          std::lock_guard<std::mutex> lk(store.mu);
+          exists = store.data.count(key) ? 1 : 0;
+        }
+        if (!send_resp(fd, 0, &exists, 1)) break;
+      } else if (op == 'D') {
+        {
+          std::lock_guard<std::mutex> lk(store.mu);
+          store.data.erase(key);
+        }
+        if (!send_resp(fd, 0, nullptr, 0)) break;
+      } else {
+        break;  // unknown op: drop connection
+      }
+    }
+    {
+      // deregister before close so stop() never shuts down a reused fd
+      std::lock_guard<std::mutex> lk(workers_mu);
+      conn_fds.erase(std::remove(conn_fds.begin(), conn_fds.end(), fd),
+                     conn_fds.end());
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping.load()) return;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(workers_mu);
+      if (stopping.load()) {
+        ::close(fd);
+        return;
+      }
+      conn_fds.push_back(fd);
+      workers.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one outstanding request per client
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pts_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) < 0 ||
+      ::listen(s->listen_fd, 128) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+void pts_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stopping.store(true);
+  s->store.cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // Wake every handler: shut down its connection fd so blocked recv()
+  // returns, then JOIN (never detach — a detached handler could touch
+  // the Store after delete).
+  {
+    std::lock_guard<std::mutex> lk(s->workers_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->workers)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+void* pts_client_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  std::snprintf(portstr, sizeof(portstr), "%d", port);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  // retry until the server is up (rank-0 races are normal at bootstrap)
+  for (;;) {
+    if (::getaddrinfo(host, portstr, &hints, &res) == 0) {
+      int fd = ::socket(res->ai_family, res->ai_socktype,
+                        res->ai_protocol);
+      if (fd >= 0 &&
+          ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        ::freeaddrinfo(res);
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        auto* c = new Client();
+        c->fd = fd;
+        return c;
+      }
+      if (fd >= 0) ::close(fd);
+      ::freeaddrinfo(res);
+      res = nullptr;
+    }
+    if (std::chrono::steady_clock::now() > deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void pts_client_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+static int request(Client* c, uint8_t op, const char* key,
+                   const uint8_t* val, uint32_t vlen, uint8_t* status,
+                   std::vector<uint8_t>* payload) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  if (!write_full(c->fd, &op, 1) || !write_full(c->fd, &klen, 4) ||
+      !write_full(c->fd, key, klen) || !write_full(c->fd, &vlen, 4) ||
+      (vlen && !write_full(c->fd, val, vlen)))
+    return -1;
+  uint32_t rlen;
+  if (!read_full(c->fd, status, 1) || !read_full(c->fd, &rlen, 4))
+    return -1;
+  payload->resize(rlen);
+  if (rlen && !read_full(c->fd, payload->data(), rlen)) return -1;
+  return 0;
+}
+
+int pts_set(void* h, const char* key, const uint8_t* val, uint32_t len) {
+  uint8_t status;
+  std::vector<uint8_t> payload;
+  if (request(static_cast<Client*>(h), 'S', key, val, len, &status,
+              &payload) != 0)
+    return -1;
+  return status;
+}
+
+long long pts_add(void* h, const char* key, long long amount) {
+  uint8_t status;
+  std::vector<uint8_t> payload;
+  int64_t amt = amount;
+  if (request(static_cast<Client*>(h), 'A', key,
+              reinterpret_cast<uint8_t*>(&amt), 8, &status,
+              &payload) != 0 ||
+      status != 0 || payload.size() != 8)
+    return -0x7FFFFFFFFFFFFFFFLL;
+  int64_t v;
+  std::memcpy(&v, payload.data(), 8);
+  return v;
+}
+
+int pts_get(void* h, const char* key, int timeout_ms, uint8_t** out,
+            uint32_t* out_len) {
+  uint8_t status;
+  std::vector<uint8_t> payload;
+  int32_t t = timeout_ms;
+  if (request(static_cast<Client*>(h), 'G', key,
+              reinterpret_cast<uint8_t*>(&t), 4, &status, &payload) != 0)
+    return -1;
+  if (status != 0) return 1;  // timeout
+  *out_len = static_cast<uint32_t>(payload.size());
+  *out = static_cast<uint8_t*>(std::malloc(payload.size()));
+  std::memcpy(*out, payload.data(), payload.size());
+  return 0;
+}
+
+void pts_free(uint8_t* p) { std::free(p); }
+
+int pts_wait(void* h, const char* keys_nl, int timeout_ms) {
+  uint8_t status;
+  std::vector<uint8_t> payload;
+  int32_t t = timeout_ms;
+  if (request(static_cast<Client*>(h), 'W', keys_nl,
+              reinterpret_cast<uint8_t*>(&t), 4, &status, &payload) != 0)
+    return -1;
+  return status;  // 0 ok, 1 timeout
+}
+
+int pts_check(void* h, const char* key) {
+  uint8_t status;
+  std::vector<uint8_t> payload;
+  if (request(static_cast<Client*>(h), 'C', key, nullptr, 0, &status,
+              &payload) != 0 ||
+      payload.size() != 1)
+    return -1;
+  return payload[0];
+}
+
+int pts_delete(void* h, const char* key) {
+  uint8_t status;
+  std::vector<uint8_t> payload;
+  if (request(static_cast<Client*>(h), 'D', key, nullptr, 0, &status,
+              &payload) != 0)
+    return -1;
+  return status;
+}
+
+}  // extern "C"

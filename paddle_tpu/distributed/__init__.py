@@ -22,6 +22,8 @@ from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from . import fleet  # noqa: F401
 from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F401
+from ..core.native import TCPStore  # noqa: F401  (native C++ store)
+from .check import CommWatchdog, watchdog  # noqa: F401
 
 __all__ = [
     "ProcessMesh", "Placement", "Replicate", "Shard", "Partial",

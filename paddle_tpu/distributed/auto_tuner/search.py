@@ -72,7 +72,11 @@ class GridSearch:
 
     def __init__(self, tuner_cfg: Dict):
         self.tuner_cfg = tuner_cfg
-        cands = tuner_cfg.get("candidates") or default_candidates(tuner_cfg)
+        # user candidates overlay the defaults axis-by-axis, so a
+        # partial dict pins some axes without dropping the rest
+        cands = dict(default_candidates(tuner_cfg))
+        for k, v in (tuner_cfg.get("candidates") or {}).items():
+            cands[k] = v if isinstance(v, list) else [v]
         keys = list(cands)
         configs = []
         self.pruned: List[Dict] = []

@@ -70,6 +70,9 @@ def dynamic_check(tensor, op_name: str, group=None) -> None:
     import numpy as np
 
     g = group or _get_default_group()
+    if getattr(g, "_ranks", None) and \
+            g.get_group_rank(jax.process_index()) < 0:
+        return  # non-members must not join the group's store barrier
     meta = np.frombuffer(
         (str(tuple(tensor._data.shape)) + "|"
          + str(tensor._data.dtype)).encode().ljust(128), dtype=np.uint8)

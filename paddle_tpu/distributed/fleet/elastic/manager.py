@@ -46,8 +46,12 @@ class LocalFileStore:
         return os.path.join(self.root, key.replace("/", "__"))
 
     def set(self, key: str, value: str) -> None:
-        with open(self._path(key), "w") as f:
+        # write-then-rename: readers never observe a truncated heartbeat
+        path = self._path(key)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
             f.write(value)
+        os.replace(tmp, path)
 
     def get(self, key: str) -> Optional[str]:
         try:
@@ -65,7 +69,7 @@ class LocalFileStore:
     def keys(self, prefix: str) -> List[str]:
         p = prefix.replace("/", "__")
         return [f.replace("__", "/") for f in os.listdir(self.root)
-                if f.startswith(p)]
+                if f.startswith(p) and ".tmp" not in f]
 
 
 class CoordinationStore:

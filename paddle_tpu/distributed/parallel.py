@@ -166,5 +166,9 @@ class DataParallel(Layer):
     def sync_params_buffers(self):
         from .communication.collectives import broadcast
 
+        # src is a GLOBAL rank (reference broadcast.py: "source rank in
+        # global view") — use the group's first member, not literal 0
+        src = (self.group._ranks[0]
+               if getattr(self.group, "_ranks", None) else 0)
         for p in self._layers.parameters():
-            broadcast(p, src=0, group=self.group)
+            broadcast(p, src=src, group=self.group)

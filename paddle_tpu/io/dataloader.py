@@ -89,6 +89,127 @@ class _PrefetchIter:
         return item
 
 
+def _worker_loop(dataset, index_q, data_q, worker_id, num_workers, seed,
+                 init_fn):
+    """Worker process body (reference: io/dataloader/worker.py
+    _worker_loop): pull (batch_idx, indices), fetch samples, push raw
+    results; collation happens in the parent so only plain numpy/python
+    crosses the queue."""
+    import traceback
+
+    _worker_info.info = WorkerInfo(worker_id, num_workers, seed, dataset)
+    if init_fn is not None:
+        init_fn(worker_id)
+    while True:
+        item = index_q.get()
+        if item is None:
+            break
+        bidx, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            data_q.put((bidx, samples, None))
+        except Exception:
+            data_q.put((bidx, None, traceback.format_exc()))
+
+
+class _MultiprocessIter:
+    """Multi-process fetch with ordered reassembly (reference:
+    dataloader_iter.py _DataLoaderIterMultiProcess — per-worker index
+    queues, shared data queue, out-of-order results reordered by batch
+    index). Workers are forked: they only run dataset.__getitem__ (host
+    numpy work), never jax."""
+
+    def __init__(self, loader: "DataLoader"):
+        import multiprocessing as mp
+
+        self._loader = loader
+        self._ctx = mp.get_context("fork")
+        n = loader.num_workers
+        self._index_queues = [self._ctx.Queue() for _ in range(n)]
+        self._data_queue = self._ctx.Queue()
+        self._workers = []
+        for wid in range(n):
+            w = self._ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self._index_queues[wid],
+                      self._data_queue, wid, n, wid,
+                      loader.worker_init_fn),
+                daemon=True)
+            w.start()
+            self._workers.append(w)
+        self._batches = enumerate(iter(loader.batch_sampler))
+        self._prefetch = max(loader.prefetch_factor, 1) * n
+        self._sent = 0
+        self._next_yield = 0
+        self._rcvd = {}
+        self._exhausted = False
+        self._shutdown_done = False
+        for _ in range(self._prefetch):
+            self._dispatch_one()
+
+    def _dispatch_one(self):
+        if self._exhausted:
+            return
+        try:
+            bidx, indices = next(self._batches)
+        except StopIteration:
+            self._exhausted = True
+            return
+        self._index_queues[bidx % len(self._workers)].put((bidx, indices))
+        self._sent += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next_yield >= self._sent and self._exhausted:
+            self._shutdown()
+            raise StopIteration
+        while self._next_yield not in self._rcvd:
+            try:
+                bidx, samples, err = self._data_queue.get(timeout=5.0)
+            except queue.Empty:
+                # liveness check: a worker killed abnormally (OOM,
+                # segfault) never posts its batch — hang-proof the wait
+                # (reference dataloader_iter.py monitors worker death)
+                dead = [w.pid for w in self._workers if not w.is_alive()]
+                if dead:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} died "
+                        "unexpectedly (killed?) — batch "
+                        f"{self._next_yield} will never arrive")
+                continue
+            if err is not None:
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            self._rcvd[bidx] = samples
+        samples = self._rcvd.pop(self._next_yield)
+        self._next_yield += 1
+        self._dispatch_one()
+        return self._loader.collate_fn(samples)
+
+    def _shutdown(self):
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
+        for q in self._index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for w in self._workers:
+            w.join(timeout=5)
+            if w.is_alive():
+                w.terminate()
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -152,6 +273,12 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers and self.num_workers > 0:
+            # map-style + sampler → real multiprocess workers (the
+            # reference's one-process-per-worker model); iterable-style
+            # keeps the thread prefetcher (sample streams don't split
+            # by index)
+            if not self._iterable_mode and self.batch_sampler is not None:
+                return _MultiprocessIter(self)
             return _PrefetchIter(self._gen,
                                  self.prefetch_factor * self.num_workers)
         return self._gen()
