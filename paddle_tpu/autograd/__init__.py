@@ -38,21 +38,21 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          no_grad_vars=None, name=None) -> List[Optional[Tensor]]:
     """``paddle.grad``: grads of outputs wrt inputs without polluting .grad.
 
-    ``create_graph`` (double grad) is supported by re-running the tape's
-    closures under jax differentiation — deferred to the functional
-    ``jax.grad`` escape hatch for now (raises if requested).
+    ``create_graph=True`` (double grad, reference: eager double-grad via
+    generated higher-order GradNodes) runs every node's backward as a
+    dispatched op over (primals, cotangents), so the returned grads carry
+    their own GradNodes and can be differentiated again.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.incubate.autograd functional "
-            "transforms (jax.grad composition) for higher-order derivatives")
     outputs = _listify(outputs)
     inputs = _listify(inputs)
     grad_outputs = _listify(grad_outputs)
-    retain = bool(retain_graph) if retain_graph is not None else False
+    retain = bool(retain_graph) if retain_graph is not None \
+        else bool(create_graph)
     raws = engine.run_backward(outputs, grad_outputs, retain_graph=retain,
-                               inputs=inputs, allow_unused=allow_unused)
-    return [None if g is None else Tensor(g) for g in raws]
+                               inputs=inputs, allow_unused=allow_unused,
+                               create_graph=create_graph)
+    return [None if g is None else
+            (g if isinstance(g, Tensor) else Tensor(g)) for g in raws]
 
 
 class PyLayerContext:

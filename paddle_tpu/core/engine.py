@@ -19,6 +19,7 @@ import contextlib
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 __all__ = [
@@ -104,7 +105,7 @@ class GradNode:
 
     __slots__ = (
         "name", "vjp_fn", "edges", "out_avals", "grad_buffer",
-        "retain_map", "post_hooks",
+        "retain_map", "post_hooks", "second",
     )
 
     def __init__(self, name: str, vjp_fn: Callable, edges: List[Tuple],
@@ -113,6 +114,12 @@ class GradNode:
         self.vjp_fn = vjp_fn
         self.edges = edges
         self.out_avals = out_avals  # [(shape, dtype), ...] per output slot
+        # (raw_fn, static_kwargs, tensor_inputs, diff_idx) when the op
+        # supports create_graph: the backward can then be re-expressed as
+        # a differentiable function of primals AND cotangents (the vjp
+        # closure alone bakes primals in as constants, which would make
+        # d(grad)/d(primal) unreachable)
+        self.second: Optional[Tuple] = None
         self.grad_buffer: List[Optional[Any]] = [None] * len(out_avals)
         # slot -> list of observers: Tensor (retain_grads) or
         # ("capture", key) entries added temporarily by paddle.grad
@@ -124,7 +131,7 @@ class GradNode:
 
     def accumulate(self, slot: int, grad) -> None:
         cur = self.grad_buffer[slot]
-        self.grad_buffer[slot] = grad if cur is None else cur + grad
+        self.grad_buffer[slot] = grad if cur is None else _gadd(cur, grad)
 
     def assembled_cotangents(self):
         import numpy as _np
@@ -133,7 +140,8 @@ class GradNode:
 
         cots = []
         for slot, (shape, dt) in enumerate(self.out_avals):
-            g = self.grad_buffer[slot]
+            g = _graw(self.grad_buffer[slot]) \
+                if self.grad_buffer[slot] is not None else None
             if g is None:
                 if jnp.issubdtype(dt, jnp.inexact):
                     g = jnp.zeros(shape, dt)
@@ -150,6 +158,7 @@ class GradNode:
 
     def release(self):
         self.vjp_fn = None
+        self.second = None
         self.grad_buffer = [None] * len(self.out_avals)
 
     def __repr__(self):
@@ -159,20 +168,111 @@ class GradNode:
 def _wrap(array):
     from .tensor import Tensor
 
+    if isinstance(array, Tensor):
+        return array  # create_graph grads stay graph-connected
     return Tensor(array, stop_gradient=True)
+
+
+def _gadd(a, b):
+    """Accumulate two grads; Tensor operands (create_graph mode) go
+    through dispatched ops so the sum itself is differentiable."""
+    from .tensor import Tensor
+
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        ta = a if isinstance(a, Tensor) else Tensor(a)
+        tb = b if isinstance(b, Tensor) else Tensor(b)
+        return ta + tb
+    return a + b
+
+
+def _graw(g):
+    """Raw array view of a grad that may be a Tensor."""
+    return g._data if hasattr(g, "_data") else g
 
 
 def _accumulate_leaf(tensor, grad) -> None:
     # tensor-level hooks fire as the grad finalizes
     # (reference: egr hooks, reducer marks vars ready here)
+    from .tensor import Tensor
+
     for hook in list(tensor._grad_hooks.values()):
         out = hook(_wrap(grad))
         if out is not None:
             grad = out._data if hasattr(out, "_data") else out
     if tensor.grad is None:
         tensor.grad = _wrap(grad)
+    elif isinstance(grad, Tensor):
+        tensor.grad = tensor.grad + grad  # keep graph (create_graph)
     else:
         tensor.grad = _wrap(tensor.grad._data + grad)
+
+
+def _assemble_cot_tensors(node: "GradNode"):
+    """Cotangents as Tensors (create_graph mode): missing slots are
+    graph-free zeros; existing Tensor grads keep their graph."""
+    from .tensor import Tensor
+
+    cots = []
+    for slot, (shape, dt) in enumerate(node.out_avals):
+        g = node.grad_buffer[slot]
+        if g is None:
+            g = Tensor(jnp.zeros(shape, dt))
+        elif not isinstance(g, Tensor):
+            g = Tensor(g)
+        if jnp.issubdtype(dt, jnp.inexact) and g._data.dtype != dt:
+            g = g.astype(str(jnp.dtype(dt)))
+        cots.append(g)
+    return cots
+
+
+def _apply_node(node: "GradNode", create_graph: bool):
+    """Run one node's backward. With create_graph and recorded primal
+    info, the backward runs as a dispatched op over (primals,
+    cotangents) — its outputs get their own GradNodes, so a second
+    backward can differentiate through it (double grad; reference:
+    generated higher-order GradNodes / prim composite VJPs)."""
+    if not create_graph:
+        return node.vjp_fn(node.assembled_cotangents())
+    if node.second is None:
+        # PyLayer / traced-program nodes record no primal recipe —
+        # severing the graph here would return silently WRONG second
+        # derivatives, so refuse loudly
+        raise NotImplementedError(
+            f"create_graph=True through `{node.name}`: this node records "
+            "no primal recipe (PyLayer/to_static graphs don't support "
+            "double grad yet); restructure the model so the "
+            "differentiated path uses built-in ops")
+    from ..ops.dispatch import _interleave, eager_apply
+
+    recipe_fn, in_tensors, diff_idx = node.second
+    cot_tensors = _assemble_cot_tensors(node)
+    diff_tensors = [in_tensors[i] for i in diff_idx]
+    const = {i: in_tensors[i]._data for i in range(len(in_tensors))
+             if i not in set(diff_idx)}
+    k = len(diff_idx)
+    n_in = len(in_tensors)
+    out_avals = node.out_avals
+
+    def second_raw(*arrs):
+        prim, cots_ = arrs[:k], arrs[k:]
+
+        def f(*diff_arrays):
+            return recipe_fn(*_interleave(const, n_in, diff_arrays))
+
+        _, vjp = jax.vjp(f, *prim)
+        fixed = []
+        for c, (shape, dt) in zip(cots_, out_avals):
+            if not jnp.issubdtype(dt, jnp.inexact):
+                import numpy as _np
+
+                c = _np.zeros(shape, jax.dtypes.float0)
+            fixed.append(c)
+        return vjp(tuple(fixed))
+
+    res = eager_apply(node.name + "_grad", second_raw,
+                      list(diff_tensors) + cot_tensors,
+                      n_outputs=len(diff_idx))
+    return res if isinstance(res, tuple) else (res,)
 
 
 def run_backward(
@@ -181,6 +281,7 @@ def run_backward(
     retain_graph: bool = False,
     inputs: Optional[Sequence] = None,
     allow_unused: bool = False,
+    create_graph: bool = False,
 ) -> Optional[List[Optional[Any]]]:
     """Reverse-mode sweep from ``tensors``.
 
@@ -250,12 +351,14 @@ def run_backward(
             for target in targets:
                 if isinstance(target, tuple) and target[0] == "capture":
                     k = target[1]
-                    captured[k] = g if k not in captured else captured[k] + g
+                    captured[k] = g if k not in captured \
+                        else _gadd(captured[k], g)
                 elif inputs is None:
                     # a Tensor with retain_grads(); paddle.grad passes must
                     # not touch .grad of anything
                     _accumulate_leaf(target, g)
 
+    keep_graph = retain_graph or create_graph
     while ready:
         node = ready.pop()
         if node.vjp_fn is None:
@@ -263,11 +366,10 @@ def run_backward(
                 f"the grad graph through {node.name} has been freed; use "
                 "backward(retain_graph=True) to backward through it twice")
         _observe_retained(node)
-        cots = node.assembled_cotangents()
-        in_grads = node.vjp_fn(cots)
+        in_grads = _apply_node(node, create_graph)
         for hook in node.post_hooks:
             hook()
-        if not retain_graph:
+        if not keep_graph:
             node.release()
         else:
             node.grad_buffer = [None] * len(node.out_avals)
@@ -279,7 +381,8 @@ def run_backward(
                 if inputs is not None:
                     if id(t) in capture_leaf_ids:
                         k = id(t)
-                        captured[k] = g if k not in captured else captured[k] + g
+                        captured[k] = g if k not in captured \
+                            else _gadd(captured[k], g)
                     # paddle.grad never pollutes other leaves' .grad
                 else:
                     _accumulate_leaf(t, g)

@@ -48,6 +48,8 @@ def lib() -> ctypes.CDLL:
             L = ctypes.CDLL(path)
             L.pts_server_start.restype = ctypes.c_void_p
             L.pts_server_start.argtypes = [ctypes.c_int]
+            L.pts_server_port.restype = ctypes.c_int
+            L.pts_server_port.argtypes = [ctypes.c_void_p]
             L.pts_server_stop.argtypes = [ctypes.c_void_p]
             L.pts_client_connect.restype = ctypes.c_void_p
             L.pts_client_connect.argtypes = [ctypes.c_char_p,
@@ -90,16 +92,12 @@ class TCPStore:
         self.port = port
         self.timeout_ms = int(timeout * 1000)
         if is_master:
-            if port == 0:
-                import socket as _s
-
-                with _s.socket() as s:
-                    s.bind(("", 0))
-                    self.port = s.getsockname()[1]
+            # port 0 → kernel picks; read it back (no TOCTOU rebind race)
             self._server = self._lib.pts_server_start(self.port)
             if not self._server:
                 raise RuntimeError(f"TCPStore: bind failed on port "
                                    f"{self.port}")
+            self.port = self._lib.pts_server_port(self._server)
         self._client = self._lib.pts_client_connect(
             self.host.encode(), self.port, self.timeout_ms)
         if not self._client:

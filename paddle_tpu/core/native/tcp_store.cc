@@ -235,6 +235,18 @@ void* pts_server_start(int port) {
   return s;
 }
 
+int pts_server_port(void* h) {
+  // actual bound port (port=0 requests let the kernel choose — no
+  // probe-then-rebind TOCTOU race)
+  auto* s = static_cast<Server*>(h);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+                    &len) != 0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
 void pts_server_stop(void* h) {
   auto* s = static_cast<Server*>(h);
   s->stopping.store(true);

@@ -67,9 +67,12 @@ class LocalFileStore:
             pass
 
     def keys(self, prefix: str) -> List[str]:
+        import re
+
         p = prefix.replace("/", "__")
         return [f.replace("__", "/") for f in os.listdir(self.root)
-                if f.startswith(p) and ".tmp" not in f]
+                if f.startswith(p)
+                and not re.search(r"\.tmp\d+$", f)]  # our own tmp files
 
 
 class CoordinationStore:

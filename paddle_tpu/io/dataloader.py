@@ -128,11 +128,14 @@ class _MultiprocessIter:
         self._index_queues = [self._ctx.Queue() for _ in range(n)]
         self._data_queue = self._ctx.Queue()
         self._workers = []
+        # fresh base seed per epoch/iterator so per-worker augmentation
+        # RNGs differ across epochs (reference: base_seed + worker_id)
+        base_seed = int(np.random.randint(0, 2 ** 31 - 1))
         for wid in range(n):
             w = self._ctx.Process(
                 target=_worker_loop,
                 args=(loader.dataset, self._index_queues[wid],
-                      self._data_queue, wid, n, wid,
+                      self._data_queue, wid, n, base_seed + wid,
                       loader.worker_init_fn),
                 daemon=True)
             w.start()

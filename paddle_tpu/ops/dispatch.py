@@ -34,6 +34,16 @@ def _is_diff_dtype(arr) -> bool:
     return jnp.issubdtype(arr.dtype, jnp.inexact)
 
 
+def _interleave(const_map, n, diff_arrays):
+    """Rebuild the full positional array list from constants + the
+    differentiable subset (shared by the forward vjp closure and the
+    double-grad replay in engine._apply_node)."""
+    full, it = [], iter(diff_arrays)
+    for i in range(n):
+        full.append(const_map[i] if i in const_map else next(it))
+    return full
+
+
 def as_tensor_args(*args) -> List[Tensor]:
     out = []
     for a in args:
@@ -111,10 +121,7 @@ def eager_apply(
     was_tuple = [False]
 
     def f(*diff_arrays):
-        full = []
-        it = iter(diff_arrays)
-        for i in range(len(arrays)):
-            full.append(const_arrays[i] if i in const_arrays else next(it))
+        full = _interleave(const_arrays, len(arrays), diff_arrays)
         out = raw_fn(*full, **static_kwargs)
         was_tuple[0] = isinstance(out, tuple)
         return out if isinstance(out, tuple) else (out,)
@@ -136,6 +143,20 @@ def eager_apply(
 
     out_avals = [(o.shape, o.dtype) for o in primals_out]
     node = engine.GradNode(op_name, vjp_fn, edges, out_avals)
+    # double-grad support: keep the primal recipe so create_graph can
+    # re-express this backward as a differentiable op (engine._apply_node).
+    # The recipe bakes in the dtypes the forward actually ran with (AMP
+    # may have cast them, and may be OFF at backward time), so the replay
+    # reproduces the same out_avals.
+    cast_dtypes = [a.dtype for a in arrays]
+
+    def recipe_fn(*full):
+        full = [x.astype(dt) if x.dtype != dt else x
+                for x, dt in zip(full, cast_dtypes)]
+        out = raw_fn(*full, **static_kwargs)
+        return out if isinstance(out, tuple) else (out,)
+
+    node.second = (recipe_fn, list(tensor_inputs), diff_idx)
 
     tensors = []
     for idx, o in enumerate(primals_out):

@@ -235,3 +235,70 @@ def test_retain_grads_survives_paddle_grad():
     z.backward()
     np.testing.assert_allclose(gy.numpy(), 12.0, rtol=1e-6)
     np.testing.assert_allclose(y.grad.numpy(), 12.0, rtol=1e-6)
+
+
+class TestDoubleGrad:
+    """create_graph double-grad (reference: eager double-grad via
+    generated higher-order GradNodes; engine._apply_node here)."""
+
+    def test_second_derivative_cubic(self):
+        import numpy as np
+
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        assert g1._grad_node is not None  # differentiable grad
+        np.testing.assert_allclose(g1.numpy(), 3 * np.array([4.0, 9.0]),
+                                   rtol=1e-5)
+        ones = paddle.to_tensor(np.ones(2, np.float32))
+        (g2,) = paddle.grad(g1, x, grad_outputs=ones)
+        np.testing.assert_allclose(g2.numpy(), 6 * np.array([2.0, 3.0]),
+                                   rtol=1e-5)
+
+    def test_third_derivative(self):
+        import numpy as np
+
+        x = paddle.to_tensor(np.array([1.5], np.float32),
+                             stop_gradient=False)
+        y = x * x * x * x  # y = x^4
+        (g1,) = paddle.grad(y, x, create_graph=True)   # 4x^3
+        (g2,) = paddle.grad(g1, x, create_graph=True)  # 12x^2
+        (g3,) = paddle.grad(g2, x)                     # 24x
+        np.testing.assert_allclose(g3.numpy(), [24 * 1.5], rtol=1e-4)
+
+    def test_gradient_penalty_vs_numeric(self):
+        """WGAN-GP pattern: d/dW ||dL/dx||^2 against finite differences."""
+        import numpy as np
+
+        W0 = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        X0 = np.array([[0.5, -1.0]], np.float32)
+        w = paddle.to_tensor(W0, stop_gradient=False)
+        x = paddle.to_tensor(X0, stop_gradient=False)
+        out = paddle.matmul(x, w)
+        loss = (out * out).sum()
+        (gx,) = paddle.grad(loss, x, create_graph=True)
+        penalty = (gx * gx).sum()
+        (gw,) = paddle.grad(penalty, w)
+
+        def penalty_np(Wm):
+            g = 2 * X0 @ Wm @ Wm.T
+            return float((g * g).sum())
+
+        eps, num = 1e-3, np.zeros_like(W0)
+        for i in range(2):
+            for j in range(2):
+                Wp, Wm_ = W0.copy(), W0.copy()
+                Wp[i, j] += eps
+                Wm_[i, j] -= eps
+                num[i, j] = (penalty_np(Wp) - penalty_np(Wm_)) / (2 * eps)
+        np.testing.assert_allclose(gw.numpy(), num, rtol=1e-2)
+
+    def test_first_order_unaffected(self):
+        import numpy as np
+
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        (g,) = paddle.grad(x * x, x)  # default create_graph=False
+        assert g._grad_node is None   # plain grad carries no graph
+        np.testing.assert_allclose(g.numpy(), [6.0])
