@@ -140,13 +140,17 @@ def run_config(name, d_model, n_layers, n_heads, seq, batch, steps):
     return tokens_per_sec, n_params, flops_per_token
 
 
-def run_decode_bench(batch=8, prompt=128, new_tokens=64,
-                     d_model=1024, n_layers=16, n_heads=8):
+def run_decode_bench(batch=8, prompt=128, new_tokens=65,
+                     d_model=1024, n_layers=16, n_heads=8,
+                     decode_chunk=16):
     # n_heads=8 -> head_dim 128: the Pallas paged-attention kernel's
-    # lane-dim constraint (see nn/functional/paged_attention.py)
+    # lane-dim constraint (see nn/functional/paged_attention.py).
+    # new_tokens = 1 (prefill) + N*decode_chunk so the timed run uses
+    # exactly the chunk programs the warmup compiled.
     """Serving decode throughput: paged-KV greedy decode (Pallas paged
-    attention on TPU) through inference.GenerationEngine. Returns
-    generated tokens/sec across the batch (decode phase only)."""
+    attention on TPU, scan-chunked steps) through
+    inference.GenerationEngine. Returns generated tokens/sec across the
+    batch (decode phase only)."""
     import paddle_tpu as paddle
     from paddle_tpu.inference import FusedCausalLM, GenerationEngine
 
@@ -156,10 +160,12 @@ def run_decode_bench(batch=8, prompt=128, new_tokens=64,
         dim_feedforward=4 * d_model, num_layers=n_layers,
         max_position=prompt + new_tokens + 1)
     engine = GenerationEngine(model, page_size=16,
-                              max_length=prompt + new_tokens)
+                              max_length=prompt + new_tokens,
+                              decode_chunk=decode_chunk)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, VOCAB, (batch, prompt))
-    engine.generate(ids, max_new_tokens=4)  # compile prefill + decode
+    # warmup with the SAME token count: compiles prefill + every chunk-k
+    engine.generate(ids, max_new_tokens=new_tokens)
     t0 = time.perf_counter()
     out = engine.generate(ids, max_new_tokens=new_tokens)
     dt = time.perf_counter() - t0

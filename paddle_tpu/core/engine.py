@@ -295,6 +295,10 @@ def run_backward(
         node = t._grad_node
         if g is None:
             g_arr = jnp.ones(t._data.shape, t._data.dtype)
+        elif create_graph and hasattr(g, "_data"):
+            # keep the cotangent's own graph: d(grad)/d(grad_outputs)
+            # must stay reachable through the seeded Tensor
+            g_arr = g
         else:
             g_arr = g._data if hasattr(g, "_data") else jnp.asarray(g)
         if node is None:

@@ -67,6 +67,8 @@ def flag(name: str):
 # ---- core flags (subset of reference's paddle/phi/core/flags.cc) ----
 define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf in eager mode")
 define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: report stats only")
+define_flag("record_double_grad", True,
+            "record primal recipes on the tape for paddle.grad(create_graph=True); disable to save memory in first-order-only runs")
 define_flag("benchmark", False, "synchronize after each op for timing")
 define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
 define_flag("eager_jit_ops", True, "dispatch eager ops through cached jit computations")

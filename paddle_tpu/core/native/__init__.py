@@ -98,6 +98,9 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore: bind failed on port "
                                    f"{self.port}")
             self.port = self._lib.pts_server_port(self._server)
+            if self.port < 0:
+                raise RuntimeError(
+                    "TCPStore: could not read back the bound port")
         self._client = self._lib.pts_client_connect(
             self.host.encode(), self.port, self.timeout_ms)
         if not self._client:

@@ -76,18 +76,30 @@ class GenerationEngine:
     """
 
     def __init__(self, model: FusedCausalLM, page_size: int = 16,
-                 max_length: int = 1024, num_pages: Optional[int] = None):
+                 max_length: int = 1024, num_pages: Optional[int] = None,
+                 decode_chunk: int = 8):
         self.model = model
         st = model.stack
         self.max_length = max_length
         self.page_size = page_size
+        self.decode_chunk = max(int(decode_chunk), 1)
         self._cos, self._sin = rope_table(st.max_position, st.head_dim,
                                           st.rope_theta)
-        # one jitted program each — jax.jit retraces per input shape
-        self._decode = jax.jit(self._decode_fn, donate_argnums=(6, 7))
+        # one jitted prefill; decode programs are per-chunk-size (k=1
+        # is the single-token step)
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=(5, 6))
+        self._decode_k_jit = {}
         self._num_pages = num_pages
         self._mgr = None
+
+    def _get_decode_k(self, k: int):
+        if k not in self._decode_k_jit:
+            import functools
+
+            self._decode_k_jit[k] = jax.jit(
+                functools.partial(self._decode_k_fn, k=k),
+                donate_argnums=(6, 7))
+        return self._decode_k_jit[k]
 
     # ---------- pure programs ----------
 
@@ -103,16 +115,28 @@ class GenerationEngine:
             hl, lnf_s, lnf_b, st.epsilon) @ embed.T
         return logits, cache.k, cache.v
 
-    def _decode_fn(self, weights, embed, lnf_s, lnf_b, tok, seq_lens,
-                   cache_k, cache_v, tables):
+    def _decode_k_fn(self, weights, embed, lnf_s, lnf_b, tok, seq_lens,
+                     cache_k, cache_v, tables, *, k):
+        """K greedy steps as ONE XLA program: the argmax feeds back into
+        the next step inside lax.scan, so the host syncs once per chunk
+        instead of once per token (the per-token dispatch round-trip is
+        what bounds serving latency on a remote/tunneled chip)."""
         st = self.model.stack
-        x = embed[tok]
-        h, cache = st.decode_raw(
-            weights, x, PagedKV(cache_k, cache_v), tables, seq_lens,
-            self._cos, self._sin)
-        logits = FusedMultiTransformer._ln(
-            h, lnf_s, lnf_b, st.epsilon) @ embed.T
-        return logits, cache.k, cache.v
+
+        def step(carry, _):
+            tok, lens, ck, cv = carry
+            x = embed[tok]
+            h, cache = st.decode_raw(
+                weights, x, PagedKV(ck, cv), tables, lens,
+                self._cos, self._sin)
+            logits = FusedMultiTransformer._ln(
+                h, lnf_s, lnf_b, st.epsilon) @ embed.T
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, lens + 1, cache.k, cache.v), nxt
+
+        (tok, seq_lens, ck, cv), toks = jax.lax.scan(
+            step, (tok, seq_lens, cache_k, cache_v), None, length=k)
+        return jnp.swapaxes(toks, 0, 1), ck, cv  # [b, k]
 
     # ---------- serving API ----------
 
@@ -123,6 +147,8 @@ class GenerationEngine:
         ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
                          else input_ids)
         b, s = ids.shape
+        if max_new_tokens <= 0:
+            return ids.copy()
         st = self.model.stack
         if s + max_new_tokens > self.max_length:
             raise ValueError(
@@ -151,26 +177,35 @@ class GenerationEngine:
 
         out = np.concatenate(
             [ids, np.zeros((b, max_new_tokens), ids.dtype)], axis=1)
-        decode = self._decode
-        seq_lens = jnp.full((b,), s, jnp.int32)
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         finished = np.zeros((b,), bool)
-        for t in range(max_new_tokens):
-            tok_np = np.asarray(tok)
-            if eos_token_id is not None:
-                tok_np = np.where(finished, eos_token_id, tok_np)
-                finished |= tok_np == eos_token_id
-            out[:, s + t] = tok_np
-            if eos_token_id is not None and finished.all():
-                out[:, s + t + 1:] = eos_token_id
-                break
-            if t == max_new_tokens - 1:
-                break
-            logits, ck, cv = decode(weights, embed, lnf_s, lnf_b,
-                                    jnp.asarray(tok_np), seq_lens, ck, cv,
-                                    tables)
-            seq_lens = seq_lens + 1
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        # first generated token comes from prefill's last-position logits
+        tok_np = np.asarray(jnp.argmax(logits, axis=-1)).astype(ids.dtype)
+        if eos_token_id is not None:
+            finished |= tok_np == eos_token_id
+        out[:, s] = tok_np
+        emitted = 1
+
+        # remaining tokens in scan-chunks: one device program + ONE host
+        # sync per chunk instead of per token (tunnel-latency bound)
+        while emitted < max_new_tokens and not (
+                eos_token_id is not None and finished.all()):
+            k = min(self.decode_chunk, max_new_tokens - emitted)
+            last_pos = s + emitted - 1  # position of the token we feed
+            toks, ck, cv = self._get_decode_k(k)(
+                weights, embed, lnf_s, lnf_b,
+                jnp.asarray(out[:, last_pos].astype(np.int32)),
+                jnp.full((b,), last_pos, jnp.int32), ck, cv, tables)
+            toks_np = np.asarray(toks)
+            for j in range(k):
+                col = toks_np[:, j].astype(ids.dtype)
+                if eos_token_id is not None:
+                    col = np.where(finished, eos_token_id, col)
+                    finished |= col == eos_token_id
+                out[:, s + emitted] = col
+                emitted += 1
+        if eos_token_id is not None and finished.all():
+            out[:, s + emitted:] = eos_token_id
         for i in range(b):
             self._mgr.free(i)
         return out

@@ -147,16 +147,20 @@ def eager_apply(
     # re-express this backward as a differentiable op (engine._apply_node).
     # The recipe bakes in the dtypes the forward actually ran with (AMP
     # may have cast them, and may be OFF at backward time), so the replay
-    # reproduces the same out_avals.
-    cast_dtypes = [a.dtype for a in arrays]
+    # reproduces the same out_avals. Recording holds refs to ALL primal
+    # inputs (the vjp residuals usually hold most of them anyway);
+    # memory-critical first-order-only runs can turn it off via
+    # FLAGS_record_double_grad (create_graph then raises).
+    if flag("record_double_grad"):
+        cast_dtypes = [a.dtype for a in arrays]
 
-    def recipe_fn(*full):
-        full = [x.astype(dt) if x.dtype != dt else x
-                for x, dt in zip(full, cast_dtypes)]
-        out = raw_fn(*full, **static_kwargs)
-        return out if isinstance(out, tuple) else (out,)
+        def recipe_fn(*full):
+            full = [x.astype(dt) if x.dtype != dt else x
+                    for x, dt in zip(full, cast_dtypes)]
+            out = raw_fn(*full, **static_kwargs)
+            return out if isinstance(out, tuple) else (out,)
 
-    node.second = (recipe_fn, list(tensor_inputs), diff_idx)
+        node.second = (recipe_fn, list(tensor_inputs), diff_idx)
 
     tensors = []
     for idx, o in enumerate(primals_out):

@@ -302,3 +302,16 @@ class TestDoubleGrad:
         (g,) = paddle.grad(x * x, x)  # default create_graph=False
         assert g._grad_node is None   # plain grad carries no graph
         np.testing.assert_allclose(g.numpy(), [6.0])
+
+    def test_grad_outputs_differentiable(self):
+        """d(grad)/d(grad_outputs): the seeded cotangent keeps its graph
+        under create_graph."""
+        import numpy as np
+
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        v = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        (g,) = paddle.grad(x * x, x, grad_outputs=v, create_graph=True)
+        (dv,) = paddle.grad(g, v)
+        np.testing.assert_allclose(dv.numpy(), [6.0])  # d(2xv)/dv = 2x

@@ -204,3 +204,12 @@ class TestPredictorAPI:
 
         want = net(paddle.to_tensor(x)).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_generate_zero_tokens():
+    paddle.seed(0)
+    lm = FusedCausalLM(32, 16, 2, 32, 1, max_position=64)
+    eng = GenerationEngine(lm, page_size=4, max_length=32)
+    ids = np.array([[1, 2, 3]])
+    np.testing.assert_array_equal(eng.generate(ids, max_new_tokens=0),
+                                  ids)
