@@ -234,9 +234,17 @@ def _apply_node(node: "GradNode", create_graph: bool):
     if not create_graph:
         return node.vjp_fn(node.assembled_cotangents())
     if node.second is None:
-        # PyLayer / traced-program nodes record no primal recipe —
-        # severing the graph here would return silently WRONG second
-        # derivatives, so refuse loudly
+        # Severing the graph here would return silently WRONG second
+        # derivatives, so refuse loudly — naming the actual cause.
+        from .flags import flag as _flag
+
+        if not _flag("record_double_grad"):
+            raise NotImplementedError(
+                f"create_graph=True through `{node.name}`: primal-recipe "
+                "recording is disabled "
+                "(FLAGS_record_double_grad=False); re-enable it via "
+                "paddle.set_flags({'record_double_grad': True}) before "
+                "the forward pass")
         raise NotImplementedError(
             f"create_graph=True through `{node.name}`: this node records "
             "no primal recipe (PyLayer/to_static graphs don't support "

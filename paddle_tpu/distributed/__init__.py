@@ -37,3 +37,4 @@ __all__ = [
     "get_backend", "destroy_process_group", "ParallelEnv", "get_rank",
     "get_world_size", "DataParallel", "init_parallel_env", "is_initialized",
 ]
+from . import ps  # noqa: F401  (raise-stub surface, SURVEY §7.3)

@@ -1,0 +1,38 @@
+"""Parameter-server surface — deliberately not rebuilt (SURVEY §7.3).
+
+The reference's brpc parameter server (reference:
+paddle/fluid/distributed/ps — BrpcPsClient/Server, sparse tables,
+GeoSGD; python/paddle/distributed/ps TheOnePSRuntime) targets CPU
+recsys clusters; on TPU the same workloads run SPMD with sharded
+embedding tables. The public entry points exist and raise with that
+guidance so reference code fails loudly, not mysteriously.
+"""
+from __future__ import annotations
+
+__all__ = ["TheOnePSRuntime", "DistributedInfer", "PsProgramBuilder"]
+
+_MSG = ("the parameter-server stack is not part of the TPU build "
+        "(SURVEY §7.3): brpc PS targets CPU recsys clusters; use SPMD "
+        "sharded embeddings (fleet.layers.mpu.VocabParallelEmbedding / "
+        "distributed.shard_tensor) instead")
+
+
+class TheOnePSRuntime:
+    """reference: python/paddle/distributed/ps/the_one_ps.py."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)
+
+
+class DistributedInfer:
+    """reference: python/paddle/distributed/ps/utils/ps_infer_utils."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)
+
+
+class PsProgramBuilder:
+    """reference: python/paddle/distributed/ps/utils/ps_program_builder."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)

@@ -3,3 +3,4 @@ python/paddle/incubate: MoE, fused ops, autotune)."""
 from . import moe  # noqa: F401
 from . import nn  # noqa: F401
 from .moe import MoELayer  # noqa: F401
+from . import autograd  # noqa: F401

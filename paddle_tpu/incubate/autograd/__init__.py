@@ -1,0 +1,151 @@
+"""incubate.autograd — functional differentiation transforms.
+
+TPU-native equivalent of the reference's functional autograd (reference:
+python/paddle/incubate/autograd — jvp/vjp primitives, Jacobian/Hessian
+lazy matrices, forward_grad over the primitive program). Here the
+transforms delegate to jax's (the decomposition/primitive machinery the
+reference builds by hand IS jax's trace-and-transform core); inputs and
+outputs stay paddle Tensors.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core import engine
+from ...core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "jacobian", "hessian", "Jacobian", "Hessian",
+           "grad_fn"]
+
+
+def _tensorize(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _listify(xs):
+    if isinstance(xs, Tensor) or not isinstance(xs, (list, tuple)):
+        return [_tensorize(xs)]  # single Tensor or raw array/scalar
+    return [_tensorize(x) for x in xs]
+
+
+def _functionalize(func: Callable, xs: List[Tensor]):
+    """func over Tensors -> pure fn over raw arrays (no_grad inside:
+    the transform owns differentiation, the tape must not record)."""
+
+    def raw(*arrays):
+        with engine.no_grad():
+            out = func(*[Tensor(a) for a in arrays])
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        res = tuple(o._data for o in outs)
+        return res if len(res) > 1 else res[0]
+
+    return raw
+
+
+def vjp(func: Callable, xs, v=None):
+    """(outputs, vjp_result) — reference: incubate/autograd/primapi.py
+    vjp. v defaults to ones like the output."""
+    xs = _listify(xs)
+    raw = _functionalize(func, xs)
+    primals, vjp_fn = jax.vjp(raw, *[x._data for x in xs])
+    outs = primals if isinstance(primals, tuple) else (primals,)
+    if v is None:
+        cots = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+    else:
+        vs = _listify(v)
+        cots = tuple(t._data for t in vs)
+    grads = vjp_fn(cots if len(outs) > 1 else cots[0])
+    out_t = tuple(Tensor(o) for o in outs)
+    grad_t = [Tensor(g) for g in grads]
+    return (out_t[0] if len(out_t) == 1 else out_t,
+            grad_t[0] if len(grad_t) == 1 else grad_t)
+
+
+def jvp(func: Callable, xs, v=None):
+    """(outputs, jvp_result) — forward-mode (reference: primapi.py jvp,
+    forward_grad)."""
+    xs = _listify(xs)
+    raw = _functionalize(func, xs)
+    prim = [x._data for x in xs]
+    if v is None:
+        tans = [jnp.ones(p.shape, p.dtype) for p in prim]
+    else:
+        tans = [t._data for t in _listify(v)]
+    primals, tangents = jax.jvp(raw, tuple(prim), tuple(tans))
+    outs = primals if isinstance(primals, tuple) else (primals,)
+    touts = tangents if isinstance(tangents, tuple) else (tangents,)
+    o = tuple(Tensor(x) for x in outs)
+    t = tuple(Tensor(x) for x in touts)
+    return (o[0] if len(o) == 1 else o, t[0] if len(t) == 1 else t)
+
+
+def jacobian(func: Callable, xs) -> Union[Tensor, List]:
+    """Dense Jacobian(s) of func at xs (reference: functional Jacobian).
+    Single input -> Tensor [*out_shape, *in_shape]."""
+    xs = _listify(xs)
+    raw = _functionalize(func, xs)
+    jac = jax.jacrev(raw, argnums=tuple(range(len(xs))))(
+        *[x._data for x in xs])
+    if len(xs) == 1:
+        jac = jac[0] if isinstance(jac, tuple) else jac
+        return Tensor(jac)
+    return [Tensor(j) for j in jac]
+
+
+def hessian(func: Callable, xs) -> Tensor:
+    """Dense Hessian of a scalar-output func (reference: functional
+    Hessian)."""
+    xs = _listify(xs)
+    if len(xs) != 1:
+        raise NotImplementedError("hessian supports a single input")
+    raw = _functionalize(func, xs)
+    h = jax.hessian(raw)(xs[0]._data)
+    return Tensor(h)
+
+
+# lazy-matrix API parity (reference returns lazily-evaluated objects)
+class Jacobian:
+    def __init__(self, func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "is_batched=True (per-batch Jacobian) is not supported; "
+                "vmap the function over the batch dim instead")
+        if isinstance(xs, (list, tuple)) and len(xs) > 1:
+            raise NotImplementedError(
+                "the lazy-matrix API supports a single input; use "
+                "jacobian() for the multi-input list form")
+        self._val = jacobian(func, xs)
+
+    def __getitem__(self, idx):
+        return Tensor(self._val._data[idx])
+
+    @property
+    def shape(self):
+        return self._val.shape
+
+
+class Hessian(Jacobian):
+    def __init__(self, func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "is_batched=True is not supported; vmap over the batch "
+                "dim instead")
+        self._val = hessian(func, xs)
+
+
+def grad_fn(func: Callable):
+    """Convenience: df/dx as a callable (jax.grad over Tensor fns)."""
+
+    def g(*xs):
+        xs_t = [_tensorize(x) for x in xs]
+        raw = _functionalize(func, xs_t)
+        grads = jax.grad(lambda *a: jnp.sum(raw(*a)),
+                         argnums=tuple(range(len(xs_t))))(
+            *[x._data for x in xs_t])
+        out = [Tensor(g_) for g_ in grads]
+        return out[0] if len(out) == 1 else out
+
+    return g

@@ -1,0 +1,88 @@
+"""BASELINE config anchor #5: ERNIE-style MoE + sharding stage-3 +
+expert all_to_all, end-to-end on the 8-device CPU mesh.
+
+(reference anchors: BASELINE.md configs[4]; mechanism parity:
+incubate/distributed/models/moe/moe_layer.py:263 MoE dispatch,
+group_sharded_stage3.py:85 ZeRO-3, global_scatter/global_gather expert
+all-to-all. Here EP = expert-dim sharding over the mesh so XLA inserts
+the all-to-all; ZeRO-3 = GroupShardedStage3 param sharding over dp.)
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+
+
+def test_moe_sharding3_trains():
+    paddle.seed(0)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        **strategy.hybrid_configs,
+        "dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+        "sharding_degree": 1, "sep_degree": 1,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    mesh = hcg.mesh
+
+    from paddle_tpu.incubate.moe import MoELayer
+
+    d_model, vocab, seq = 16, 64, 8
+
+    class ErnieMoEBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(vocab, d_model)
+            self.norm = nn.LayerNorm(d_model)
+            self.moe = MoELayer(d_model=d_model, num_experts=4,
+                                gate="gshard", d_hidden=32)
+            self.head = nn.Linear(d_model, vocab)
+
+        def forward(self, ids):
+            h = self.embed(ids)
+            h = h + self.moe(self.norm(h))
+            return self.head(h)
+
+    model = ErnieMoEBlock()
+    # EP: shard the stacked expert dim over the mp axis → XLA inserts
+    # the expert all-to-all (global_scatter/global_gather equivalent)
+    st = model.moe.stacked
+    for pname in ("w1", "b1", "w2", "b2"):
+        pls = [dist.Replicate()] * mesh.ndim
+        pls[mesh.dim_names.index("mp")] = dist.Shard(0)
+        st._parameters[pname] = dist.shard_tensor(
+            getattr(st, pname), mesh, pls)
+
+    opt = paddle.optimizer.AdamW(5e-2, parameters=model.parameters())
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding \
+        .sharding_optimizer import GroupShardedStage3
+
+    wrapped = GroupShardedStage3(model, optimizer=opt, hcg=hcg)
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits.reshape([-1, vocab]),
+                               labels.reshape([-1]))
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (8, seq))
+    labels = rng.randint(0, vocab, (8, seq))
+
+    def dp_shard(t):
+        pls = [dist.Replicate()] * mesh.ndim
+        pls[mesh.dim_names.index("dp")] = dist.Shard(0)
+        return dist.shard_tensor(t, mesh, pls)
+
+    losses = []
+    for _ in range(6):
+        loss = step([dp_shard(paddle.to_tensor(ids))],
+                    [dp_shard(paddle.to_tensor(labels))])
+        losses.append(float(loss.numpy()))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]  # learns the fixed batch
+    # wrapped forward works too (stage-3 wrapper delegates)
+    out = wrapped(paddle.to_tensor(ids))
+    assert out.shape == [8, seq, vocab]

@@ -1,0 +1,75 @@
+"""incubate.autograd functional transforms (reference:
+python/paddle/incubate/autograd jvp/vjp/Jacobian/Hessian tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.autograd import (Hessian, Jacobian, grad_fn,
+                                          hessian, jacobian, jvp, vjp)
+
+
+def _x(v):
+    return paddle.to_tensor(np.asarray(v, np.float32))
+
+
+class TestFunctionalTransforms:
+    def test_vjp(self):
+        def f(x):
+            return (x * x).sum()
+
+        out, g = vjp(f, _x([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(float(out.numpy()), 14.0)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0, 6.0])
+
+    def test_vjp_with_cotangent(self):
+        def f(x):
+            return x * 3.0
+
+        _, g = vjp(f, _x([1.0, 1.0]), v=_x([2.0, 5.0]))
+        np.testing.assert_allclose(g.numpy(), [6.0, 15.0])
+
+    def test_jvp(self):
+        def f(x):
+            return x * x
+
+        out, t = jvp(f, _x([2.0, 3.0]), v=_x([1.0, 0.0]))
+        np.testing.assert_allclose(out.numpy(), [4.0, 9.0])
+        np.testing.assert_allclose(t.numpy(), [4.0, 0.0])  # 2x * v
+
+    def test_jacobian(self):
+        def f(x):
+            import paddle_tpu
+
+            return paddle_tpu.matmul(
+                _x([[1.0, 2.0], [3.0, 4.0]]), x)
+
+        j = jacobian(f, _x([1.0, 1.0]))
+        np.testing.assert_allclose(j.numpy(), [[1, 2], [3, 4]])
+
+    def test_hessian(self):
+        def f(x):
+            return (x * x * x).sum()  # H = diag(6x)
+
+        h = hessian(f, _x([1.0, 2.0]))
+        np.testing.assert_allclose(h.numpy(), [[6.0, 0.0], [0.0, 12.0]])
+
+    def test_lazy_matrix_api(self):
+        def f(x):
+            return (x * x).sum()
+
+        H = Hessian(f, _x([3.0]))
+        np.testing.assert_allclose(H[0].numpy(), [2.0])
+        J = Jacobian(lambda x: x * 2.0, _x([1.0, 2.0]))
+        assert tuple(J.shape) == (2, 2)
+
+    def test_grad_fn(self):
+        g = grad_fn(lambda x: x * x)
+        np.testing.assert_allclose(g(_x([3.0])).numpy(), [6.0])
+
+
+class TestPSStubs:
+    def test_ps_raises_with_guidance(self):
+        from paddle_tpu.distributed.ps import TheOnePSRuntime
+
+        with pytest.raises(NotImplementedError, match="SPMD"):
+            TheOnePSRuntime()
