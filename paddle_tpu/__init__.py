@@ -66,6 +66,7 @@ from . import framework  # noqa: E402
 from .framework.io import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402
 from . import hapi  # noqa: E402
+from .hapi.dynamic_flops import flops, summary  # noqa: E402
 from . import distribution  # noqa: E402
 from . import quantization  # noqa: E402
 from . import inference  # noqa: E402

@@ -240,11 +240,12 @@ def _apply_node(node: "GradNode", create_graph: bool):
 
         if not _flag("record_double_grad"):
             raise NotImplementedError(
-                f"create_graph=True through `{node.name}`: primal-recipe "
-                "recording is disabled "
-                "(FLAGS_record_double_grad=False); re-enable it via "
-                "paddle.set_flags({'record_double_grad': True}) before "
-                "the forward pass")
+                f"create_graph=True through `{node.name}`: no primal "
+                "recipe was recorded. If this is a built-in dispatched "
+                "op, recording was disabled — re-enable via "
+                "paddle.set_flags({'record_double_grad': True}) BEFORE "
+                "the forward pass; PyLayer/to_static nodes never record "
+                "one and don't support double grad regardless")
         raise NotImplementedError(
             f"create_graph=True through `{node.name}`: this node records "
             "no primal recipe (PyLayer/to_static graphs don't support "

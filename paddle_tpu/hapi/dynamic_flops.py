@@ -1,0 +1,160 @@
+"""paddle.flops / paddle.summary — model cost inspection.
+
+TPU-native equivalent of the reference's dynamic flops counter
+(reference: python/paddle/hapi/dynamic_flops.py ``flops``— forward
+hooks per leaf layer accumulating multiply-accumulate counts;
+hapi/model_summary.py ``summary``). Counts follow the reference's
+convention (MACs-style: conv = kernel_ops * out_elems, linear = in*out).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["flops", "summary"]
+
+
+def _prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def _count_layer(layer: Layer, x: Tensor, y) -> Optional[int]:
+    from ..nn.layers.common import Linear
+    from ..nn.layers.conv import Conv2D
+    from ..nn.layers.norm import _BatchNormBase, LayerNorm
+
+    out = y[0] if isinstance(y, (tuple, list)) else y
+    if isinstance(layer, Conv2D):
+        kernel_ops = _prod(layer._kernel_size) * (
+            layer._in_channels // layer._groups)
+        bias_ops = 1 if layer.bias is not None else 0
+        return _prod(out.shape) * (kernel_ops + bias_ops)
+    if isinstance(layer, Linear):
+        return _prod(out.shape[:-1]) * layer._in_features \
+            * layer._out_features
+    if isinstance(layer, (_BatchNormBase, LayerNorm)):
+        return 2 * _prod(x.shape)
+    return None
+
+
+def flops(net: Layer, input_size, custom_ops: Optional[Dict] = None,
+          print_detail: bool = False) -> int:
+    """Total FLOPs (MACs convention) of one forward at ``input_size``
+    (reference: hapi/dynamic_flops.py:flops). ``custom_ops`` maps layer
+    type -> fn(layer, x, y) -> count."""
+    import jax.numpy as jnp
+
+    from ..core import engine
+
+    custom_ops = custom_ops or {}
+    records = []
+    handles = []
+
+    def make_hook(layer):
+        def hook(lyr, inputs, outputs):
+            x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            fn = custom_ops.get(type(lyr))
+            cnt = fn(lyr, x, outputs) if fn is not None \
+                else _count_layer(lyr, x, outputs)
+            if cnt:
+                records.append((lyr.full_name()
+                                if hasattr(lyr, "full_name")
+                                else type(lyr).__name__, int(cnt)))
+
+        return hook
+
+    for sub in net.sublayers(include_self=True):
+        if not list(sub.children()):  # leaves only
+            handles.append(sub.register_forward_post_hook(
+                make_hook(sub)))
+    was_training = net.training
+    net.eval()
+    try:
+        x = Tensor(jnp.zeros(tuple(int(s) for s in input_size),
+                             jnp.float32))
+        with engine.no_grad():
+            net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+    total = sum(c for _, c in records)
+    if print_detail:
+        for name, c in records:
+            print(f"{name:<40}{c:>16,}")
+        print(f"{'Total FLOPs:':<40}{total:>16,}")
+    return total
+
+
+def summary(net: Layer, input_size=None, dtypes=None) -> Dict:
+    """Standalone layer/param summary (reference:
+    hapi/model_summary.py:summary). With ``input_size`` a forward runs
+    under hooks and per-layer OUTPUT shapes are reported, like the
+    reference; without it only the parameter table prints."""
+    out_shapes = {}
+    if input_size is not None:
+        import jax.numpy as jnp
+
+        from ..core import engine
+
+        handles = []
+
+        def make_hook(name):
+            def hook(lyr, inputs, outputs):
+                o = outputs[0] if isinstance(outputs, (tuple, list)) \
+                    else outputs
+                out_shapes[name] = tuple(o.shape)
+
+            return hook
+
+        for name, sub in net.named_sublayers(include_self=False):
+            if not list(sub.children()):
+                handles.append(sub.register_forward_post_hook(
+                    make_hook(name)))
+        was_training = net.training
+        net.eval()
+        try:
+            np_dtype = jnp.float32 if not dtypes else \
+                jnp.dtype(dtypes[0] if isinstance(dtypes, (list, tuple))
+                          else dtypes)
+            x = Tensor(jnp.zeros(tuple(int(s) for s in input_size),
+                                 np_dtype))
+            with engine.no_grad():
+                net(x)
+        finally:
+            for h in handles:
+                h.remove()
+            if was_training:
+                net.train()
+
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=12) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<22}{'Params':>14}"]
+    lines += [f"{n:<{width}}{str(s):<22}{c:>14,}" for n, s, c in rows]
+    if out_shapes:
+        lines.append("-" * (width + 36))
+        owidth = max(len(k) for k in out_shapes) + 2
+        lines.append(f"{'Layer':<{owidth}}{'Output shape':<24}")
+        lines += [f"{k:<{owidth}}{str(v):<24}"
+                  for k, v in out_shapes.items()]
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable,
+            "output_shapes": out_shapes}

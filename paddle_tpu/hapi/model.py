@@ -251,16 +251,9 @@ class Model:
         return self.network.parameters(*args, **kwargs)
 
     def summary(self, input_size=None, dtype=None):
-        """(hapi summary): parameter-count table."""
-        rows = []
-        total = 0
-        for name, p in self.network.named_parameters():
-            n = int(np.prod(p.shape)) if p.shape else 1
-            total += n
-            rows.append((name, tuple(p.shape), n))
-        width = max((len(r[0]) for r in rows), default=10) + 2
-        lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Params':>12}"]
-        lines += [f"{n:<{width}}{str(s):<20}{c:>12,}" for n, s, c in rows]
-        lines.append(f"Total params: {total:,}")
-        print("\n".join(lines))
-        return {"total_params": total}
+        """(hapi summary) — delegates to the standalone report so both
+        entry points stay consistent."""
+        from .dynamic_flops import summary as _summary
+
+        return _summary(self.network, input_size=input_size,
+                        dtypes=dtype)

@@ -84,15 +84,31 @@ def jvp(func: Callable, xs, v=None):
 
 def jacobian(func: Callable, xs) -> Union[Tensor, List]:
     """Dense Jacobian(s) of func at xs (reference: functional Jacobian).
-    Single input -> Tensor [*out_shape, *in_shape]."""
+
+    Single input + single output -> Tensor [*out_shape, *in_shape];
+    multiple inputs -> list over inputs; multiple outputs -> list over
+    outputs (nested [output][input] when both are multiple)."""
     xs = _listify(xs)
     raw = _functionalize(func, xs)
+    # probe output arity without differentiating
+    probe = raw(*[x._data for x in xs])
+    multi_out = isinstance(probe, tuple)
     jac = jax.jacrev(raw, argnums=tuple(range(len(xs))))(
         *[x._data for x in xs])
-    if len(xs) == 1:
-        jac = jac[0] if isinstance(jac, tuple) else jac
-        return Tensor(jac)
-    return [Tensor(j) for j in jac]
+    # jacrev mirrors f's output structure; per output there is a tuple
+    # over argnums
+    if not multi_out:
+        per_in = jac
+        if len(xs) == 1:
+            return Tensor(per_in[0])
+        return [Tensor(j) for j in per_in]
+    rows = []
+    for per_in in jac:  # one entry per output
+        if len(xs) == 1:
+            rows.append(Tensor(per_in[0]))
+        else:
+            rows.append([Tensor(j) for j in per_in])
+    return rows
 
 
 def hessian(func: Callable, xs) -> Tensor:
@@ -142,6 +158,10 @@ def grad_fn(func: Callable):
     def g(*xs):
         xs_t = [_tensorize(x) for x in xs]
         raw = _functionalize(func, xs_t)
+        if isinstance(raw(*[x._data for x in xs_t]), tuple):
+            raise NotImplementedError(
+                "grad_fn supports single-output functions; sum or "
+                "select one output, or use vjp() for multi-output")
         grads = jax.grad(lambda *a: jnp.sum(raw(*a)),
                          argnums=tuple(range(len(xs_t))))(
             *[x._data for x in xs_t])

@@ -1,0 +1,47 @@
+"""AlexNet (reference: python/paddle/vision/models/alexnet.py)."""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, kernel_size=11, stride=4, padding=2),
+            nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2),
+            nn.Conv2D(64, 192, kernel_size=5, padding=2),
+            nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2),
+            nn.Conv2D(192, 384, kernel_size=3, padding=1),
+            nn.ReLU(),
+            nn.Conv2D(384, 256, kernel_size=3, padding=1),
+            nn.ReLU(),
+            nn.Conv2D(256, 256, kernel_size=3, padding=1),
+            nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2),
+        )
+        self.avgpool = nn.AdaptiveAvgPool2D((6, 6))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5),
+            nn.Linear(256 * 6 * 6, 4096),
+            nn.ReLU(),
+            nn.Dropout(0.5),
+            nn.Linear(4096, 4096),
+            nn.ReLU(),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        x = x.reshape([x.shape[0], -1])
+        return self.classifier(x)
+
+
+def alexnet(pretrained: bool = False, **kwargs) -> AlexNet:
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return AlexNet(**kwargs)
