@@ -258,3 +258,12 @@ def _kl_bernoulli_bernoulli(p: Bernoulli, q: Bernoulli):
     a, b = p.probs_, q.probs_
     return Tensor(a * (jnp.log(a) - jnp.log(b))
                   + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+from .transform import (  # noqa: E402,F401
+    AffineTransform, ChainTransform, ExpTransform, SigmoidTransform,
+    Transform, TransformedDistribution)
+
+__all__ += ["Transform", "AffineTransform", "ExpTransform",
+            "SigmoidTransform", "ChainTransform",
+            "TransformedDistribution"]

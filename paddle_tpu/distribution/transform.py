@@ -1,0 +1,137 @@
+"""Distribution transforms (reference: python/paddle/distribution/
+transform.py — Transform base with forward/inverse/log_det_jacobian,
+Affine/Exp/Sigmoid/Chain; transformed_distribution.py
+TransformedDistribution)."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import Distribution, _arr
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "ChainTransform",
+           "TransformedDistribution"]
+
+
+class Transform:
+    """Invertible map with tractable log|det J| (transform.py:Transform)."""
+
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._fldj(self._inverse(_arr(y))))
+
+    # raw-array hooks
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (transform.py:AffineTransform)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        # two-sided broadcast: scale may be wider than x (matches
+        # forward()'s output shape)
+        return jnp.log(jnp.abs(self.scale)) + jnp.zeros_like(
+            self._forward(x))
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (transform.py:ExpTransform)."""
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (transform.py:SigmoidTransform)."""
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class ChainTransform(Transform):
+    """Composition, applied first-to-last (transform.py:ChainTransform)."""
+
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = jnp.zeros(jnp.shape(x))
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Pushforward of a base distribution through transforms
+    (reference: transformed_distribution.py)."""
+
+    def __init__(self, base: Distribution,
+                 transforms: List[Transform]):
+        self.base = base
+        self.transform = ChainTransform(list(transforms)) \
+            if not isinstance(transforms, Transform) else transforms
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        y = _arr(value)
+        x = self.transform._inverse(y)
+        base_lp = self.base.log_prob(Tensor(x))._data
+        return Tensor(base_lp - self.transform._fldj(x))

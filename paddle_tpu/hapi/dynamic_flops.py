@@ -27,14 +27,17 @@ def _prod(shape):
 
 def _count_layer(layer: Layer, x: Tensor, y) -> Optional[int]:
     from ..nn.layers.common import Linear
-    from ..nn.layers.conv import Conv2D
+    from ..nn.layers.conv import _ConvNd
     from ..nn.layers.norm import _BatchNormBase, LayerNorm
 
     out = y[0] if isinstance(y, (tuple, list)) else y
-    if isinstance(layer, Conv2D):
-        kernel_ops = _prod(layer._kernel_size) * (
-            layer._in_channels // layer._groups)
-        bias_ops = 1 if layer.bias is not None else 0
+    if isinstance(layer, _ConvNd):  # every rank incl. transpose
+        out_channels = out.shape[1]
+        # MACs per output element = weight elems per output channel
+        # (= kernel_elems * in_channels/groups for plain convs; the
+        # weight-derived form also covers transpose layouts)
+        kernel_ops = _prod(layer.weight.shape) // max(out_channels, 1)
+        bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
         return _prod(out.shape) * (kernel_ops + bias_ops)
     if isinstance(layer, Linear):
         return _prod(out.shape[:-1]) * layer._in_features \
@@ -74,7 +77,9 @@ def flops(net: Layer, input_size, custom_ops: Optional[Dict] = None,
         if not list(sub.children()):  # leaves only
             handles.append(sub.register_forward_post_hook(
                 make_hook(sub)))
-    was_training = net.training
+    # remember per-sublayer training flags: a blanket net.train() on
+    # restore would un-freeze individually eval()'d sublayers
+    modes = [(sub, sub.training) for sub in net.sublayers(include_self=True)]
     net.eval()
     try:
         x = Tensor(jnp.zeros(tuple(int(s) for s in input_size),
@@ -84,8 +89,8 @@ def flops(net: Layer, input_size, custom_ops: Optional[Dict] = None,
     finally:
         for h in handles:
             h.remove()
-        if was_training:
-            net.train()
+        for sub, mode in modes:
+            sub.training = mode
     total = sum(c for _, c in records)
     if print_detail:
         for name, c in records:
@@ -119,7 +124,8 @@ def summary(net: Layer, input_size=None, dtypes=None) -> Dict:
             if not list(sub.children()):
                 handles.append(sub.register_forward_post_hook(
                     make_hook(name)))
-        was_training = net.training
+        modes = [(sub, sub.training)
+                 for sub in net.sublayers(include_self=True)]
         net.eval()
         try:
             np_dtype = jnp.float32 if not dtypes else \
@@ -132,8 +138,8 @@ def summary(net: Layer, input_size=None, dtypes=None) -> Dict:
         finally:
             for h in handles:
                 h.remove()
-            if was_training:
-                net.train()
+            for sub, mode in modes:
+                sub.training = mode
 
     rows = []
     total = 0

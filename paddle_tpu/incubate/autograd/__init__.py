@@ -90,8 +90,8 @@ def jacobian(func: Callable, xs) -> Union[Tensor, List]:
     outputs (nested [output][input] when both are multiple)."""
     xs = _listify(xs)
     raw = _functionalize(func, xs)
-    # probe output arity without differentiating
-    probe = raw(*[x._data for x in xs])
+    # probe output arity via an abstract trace (no FLOPs)
+    probe = jax.eval_shape(raw, *[x._data for x in xs])
     multi_out = isinstance(probe, tuple)
     jac = jax.jacrev(raw, argnums=tuple(range(len(xs))))(
         *[x._data for x in xs])
@@ -133,7 +133,12 @@ class Jacobian:
             raise NotImplementedError(
                 "the lazy-matrix API supports a single input; use "
                 "jacobian() for the multi-input list form")
-        self._val = jacobian(func, xs)
+        val = jacobian(func, xs)
+        if isinstance(val, list):
+            raise NotImplementedError(
+                "the lazy-matrix API supports a single output; use "
+                "jacobian() for the multi-output form")
+        self._val = val
 
     def __getitem__(self, idx):
         return Tensor(self._val._data[idx])
@@ -158,7 +163,8 @@ def grad_fn(func: Callable):
     def g(*xs):
         xs_t = [_tensorize(x) for x in xs]
         raw = _functionalize(func, xs_t)
-        if isinstance(raw(*[x._data for x in xs_t]), tuple):
+        if isinstance(jax.eval_shape(raw, *[x._data for x in xs_t]),
+                      tuple):  # abstract trace: no extra forward
             raise NotImplementedError(
                 "grad_fn supports single-output functions; sum or "
                 "select one output, or use vjp() for multi-output")

@@ -199,3 +199,29 @@ class TestDPTrainStepOverMesh:
                                   [dist.Shard(0)])
             loss = step([x], [y])
         assert float(loss.numpy()) < 0.1
+
+
+class TestCrossMeshReshard:
+    """reshard across DIFFERENT meshes (reference: cross-mesh reshard
+    functions, reshard_function_registry.h + same_status reshard) —
+    device_put retiles between the meshes' shardings."""
+
+    def test_1d_to_2d_mesh(self):
+        import numpy as np
+
+        from paddle_tpu.distributed import (ProcessMesh, Replicate,
+                                            Shard, reshard, shard_tensor)
+
+        m1 = ProcessMesh(np.arange(8), dim_names=["dp"])
+        m2 = ProcessMesh(np.arange(8).reshape(2, 4),
+                         dim_names=["dp", "mp"])
+        x = paddle.to_tensor(
+            np.arange(64, dtype=np.float32).reshape(8, 8))
+        dx = shard_tensor(x, m1, [Shard(0)])
+        dy = reshard(dx, m2, [Shard(0), Shard(1)])
+        assert dy._dist_attr[0].dim_names == ["dp", "mp"]
+        np.testing.assert_allclose(dy.numpy(), x.numpy())
+        assert dy._data.addressable_shards[0].data.shape == (4, 2)
+        # and back to replicated on the original mesh
+        dz = reshard(dy, m1, [Replicate()])
+        np.testing.assert_allclose(dz.numpy(), x.numpy())

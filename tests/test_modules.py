@@ -279,3 +279,51 @@ class TestQuantization:
         assert abs(obs.scale() - 5.0 / 127) < 1e-6
         qd = quant_dequant(jnp.asarray([1.0]), obs.scale())
         assert abs(float(qd[0]) - 1.0) < obs.scale()
+
+
+class TestDistributionTransforms:
+    def test_affine_roundtrip_and_lognormal(self):
+        from scipy import stats as sps
+
+        from paddle_tpu.distribution import (AffineTransform,
+                                             ExpTransform, Normal,
+                                             TransformedDistribution)
+
+        t = AffineTransform(2.0, 3.0)
+        x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(y.numpy(), [5.0, -1.0])
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(x).numpy(),
+            np.log(3.0) * np.ones(2), rtol=1e-6)
+
+        # LogNormal = exp(Normal): log_prob matches scipy
+        d = TransformedDistribution(Normal(0.0, 1.0), [ExpTransform()])
+        v = np.array([0.5, 1.0, 2.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(v)).numpy(),
+            sps.lognorm(s=1.0).logpdf(v), rtol=1e-5)
+        paddle.seed(5)
+        s = d.sample((20000,)).numpy()
+        np.testing.assert_allclose(np.log(s).mean(), 0.0, atol=0.03)
+
+    def test_sigmoid_and_chain(self):
+        from paddle_tpu.distribution import (AffineTransform,
+                                             ChainTransform,
+                                             SigmoidTransform)
+
+        chain = ChainTransform([AffineTransform(0.0, 2.0),
+                                SigmoidTransform()])
+        x = paddle.to_tensor(np.array([0.3], np.float32))
+        y = chain.forward(x)
+        np.testing.assert_allclose(
+            y.numpy(), 1 / (1 + np.exp(-0.6)), rtol=1e-6)
+        np.testing.assert_allclose(chain.inverse(y).numpy(), [0.3],
+                                   rtol=1e-5)
+        # chain fldj = sum of parts at the propagated points
+        fl = chain.forward_log_det_jacobian(x).numpy()
+        s = 1 / (1 + np.exp(-0.6))
+        np.testing.assert_allclose(
+            fl, np.log(2.0) + np.log(s * (1 - s)), rtol=1e-5)
