@@ -69,6 +69,9 @@ from . import hapi  # noqa: E402
 from .hapi.dynamic_flops import flops, summary  # noqa: E402
 from . import distribution  # noqa: E402
 from . import quantization  # noqa: E402
+from . import linalg  # noqa: E402
+from . import fft  # noqa: E402
+from . import onnx  # noqa: E402
 from . import inference  # noqa: E402
 
 # `paddle.disable_static()/enable_static()` parity: we are always dynamic

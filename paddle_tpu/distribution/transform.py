@@ -57,10 +57,11 @@ class AffineTransform(Transform):
         return (y - self.loc) / self.scale
 
     def _fldj(self, x):
-        # two-sided broadcast: scale may be wider than x (matches
-        # forward()'s output shape)
-        return jnp.log(jnp.abs(self.scale)) + jnp.zeros_like(
-            self._forward(x))
+        # two-sided broadcast: scale/loc may be wider than x (matches
+        # forward()'s output shape) — shape-only, no forward compute
+        shape = jnp.broadcast_shapes(jnp.shape(self.scale),
+                                     jnp.shape(self.loc), jnp.shape(x))
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), shape)
 
 
 class ExpTransform(Transform):

@@ -1,0 +1,86 @@
+"""paddle.fft — discrete Fourier transforms.
+
+TPU-native equivalent of the reference's fft module (reference:
+python/paddle/fft.py over phi fft kernels/cuFFT). Lowered via jnp.fft —
+XLA's FFT HLO; norm conventions match the reference ("backward" default,
+"ortho", "forward").
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.dispatch import as_tensor_args, eager_apply
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+    "fftn", "ifftn", "rfft2", "irfft2", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _op(name, raw, x):
+    return eager_apply(name, raw, as_tensor_args(x))
+
+
+def _mk1d(jfn, opname):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return _op(opname, lambda a: jfn(a, n=n, axis=axis, norm=norm), x)
+
+    op.__name__ = opname
+    return op
+
+
+fft = _mk1d(jnp.fft.fft, "fft")
+ifft = _mk1d(jnp.fft.ifft, "ifft")
+rfft = _mk1d(jnp.fft.rfft, "rfft")
+irfft = _mk1d(jnp.fft.irfft, "irfft")
+hfft = _mk1d(jnp.fft.hfft, "hfft")
+ihfft = _mk1d(jnp.fft.ihfft, "ihfft")
+
+
+def _mk2d(jfn, opname):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return _op(opname, lambda a: jfn(a, s=s, axes=axes, norm=norm), x)
+
+    op.__name__ = opname
+    return op
+
+
+fft2 = _mk2d(jnp.fft.fft2, "fft2")
+ifft2 = _mk2d(jnp.fft.ifft2, "ifft2")
+rfft2 = _mk2d(jnp.fft.rfft2, "rfft2")
+irfft2 = _mk2d(jnp.fft.irfft2, "irfft2")
+
+
+def _mkn(jfn, opname):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return _op(opname, lambda a: jfn(a, s=s, axes=axes, norm=norm), x)
+
+    op.__name__ = opname
+    return op
+
+
+fftn = _mkn(jnp.fft.fftn, "fftn")
+ifftn = _mkn(jnp.fft.ifftn, "ifftn")
+rfftn = _mkn(jnp.fft.rfftn, "rfftn")
+irfftn = _mkn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+def fftshift(x, axes=None, name=None):
+    return _op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return _op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)

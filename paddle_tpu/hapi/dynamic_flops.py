@@ -32,7 +32,9 @@ def _count_layer(layer: Layer, x: Tensor, y) -> Optional[int]:
 
     out = y[0] if isinstance(y, (tuple, list)) else y
     if isinstance(layer, _ConvNd):  # every rank incl. transpose
-        out_channels = out.shape[1]
+        # the layer's own attr, not out.shape[1] — NHWC data_format puts
+        # a spatial dim there
+        out_channels = layer._out_channels
         # MACs per output element = weight elems per output channel
         # (= kernel_elems * in_channels/groups for plain convs; the
         # weight-derived form also covers transpose layouts)

@@ -1,0 +1,16 @@
+"""paddle.onnx — export surface (reference: python/paddle/onnx/export.py
+delegates to the external paddle2onnx package)."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """The reference shells out to paddle2onnx (not available here, and
+    ONNX is a GPU/CPU-deployment interchange). The TPU deployment
+    artifact is portable StableHLO — use ``paddle.jit.save`` and load
+    with ``paddle.inference.Config``/``create_predictor``."""
+    raise NotImplementedError(
+        "ONNX export is not part of the TPU build; use paddle.jit.save "
+        "(StableHLO artifact) + paddle.inference.create_predictor for "
+        "deployment")

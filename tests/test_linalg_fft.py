@@ -1,0 +1,133 @@
+"""paddle.linalg + paddle.fft namespace tests (reference:
+test/legacy_test/test_linalg_*.py, test/fft)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _spd(n=4, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+class TestLinalg:
+    def test_svd_reconstruction_and_grad(self):
+        spd = _spd()
+        a = paddle.to_tensor(spd, stop_gradient=False)
+        u, s, vh = paddle.linalg.svd(a)
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vh.numpy(), spd, rtol=1e-3,
+            atol=1e-3)
+        s.sum().backward()
+        assert a.grad is not None  # svd differentiable through the tape
+
+    def test_inv_solve_cholesky(self):
+        spd = _spd()
+        a = paddle.to_tensor(spd)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(a).numpy() @ spd, np.eye(4), atol=1e-4)
+        b = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 2).astype(np.float32))
+        x = paddle.linalg.solve(a, b)
+        np.testing.assert_allclose(spd @ x.numpy(), b.numpy(), atol=1e-4)
+        L = paddle.linalg.cholesky(a)
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd,
+                                   rtol=1e-4, atol=1e-4)
+        U = paddle.linalg.cholesky(a, upper=True)
+        np.testing.assert_allclose(U.numpy().T @ U.numpy(), spd,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_eigh_qr_det(self):
+        spd = _spd()
+        w, v = paddle.linalg.eigh(paddle.to_tensor(spd))
+        np.testing.assert_allclose(
+            v.numpy() @ np.diag(w.numpy()) @ v.numpy().T, spd,
+            rtol=1e-3, atol=1e-3)
+        a_np = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        q, r = paddle.linalg.qr(paddle.to_tensor(a_np))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a_np,
+                                   atol=1e-4)
+        sign, logdet = paddle.linalg.slogdet(paddle.to_tensor(spd))
+        np.testing.assert_allclose(
+            float(sign.numpy()) * np.exp(float(logdet.numpy())),
+            np.linalg.det(spd), rtol=1e-3)
+
+    def test_pinv_matrix_power_multi_dot(self):
+        a_np = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        p = paddle.linalg.pinv(paddle.to_tensor(a_np))
+        np.testing.assert_allclose(a_np @ p.numpy() @ a_np, a_np,
+                                   atol=1e-3)
+        spd = _spd(3)
+        mp = paddle.linalg.matrix_power(paddle.to_tensor(spd), 3)
+        np.testing.assert_allclose(mp.numpy(), spd @ spd @ spd,
+                                   rtol=1e-3)
+        xs = [paddle.to_tensor(
+            np.random.RandomState(i).randn(3, 3).astype(np.float32))
+            for i in range(3)]
+        md = paddle.linalg.multi_dot(xs)
+        np.testing.assert_allclose(
+            md.numpy(), xs[0].numpy() @ xs[1].numpy() @ xs[2].numpy(),
+            rtol=1e-4)
+
+    def test_triangular_solve(self):
+        spd = _spd()
+        L = np.linalg.cholesky(spd).astype(np.float32)
+        b = np.random.RandomState(2).randn(4, 1).astype(np.float32)
+        x = paddle.linalg.triangular_solve(
+            paddle.to_tensor(L), paddle.to_tensor(b), upper=False)
+        np.testing.assert_allclose(L @ x.numpy(), b, atol=1e-4)
+
+
+class TestFFT:
+    def test_fft_matches_numpy(self):
+        x = np.random.RandomState(0).randn(16).astype(np.float32)
+        f = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(f.numpy()), np.fft.fft(x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_rfft_roundtrip(self):
+        x = np.random.RandomState(1).randn(16).astype(np.float32)
+        r = paddle.fft.irfft(paddle.fft.rfft(paddle.to_tensor(x)))
+        np.testing.assert_allclose(r.numpy(), x, atol=1e-5)
+
+    def test_fft2_and_shift(self):
+        x = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+        f = paddle.fft.fft2(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(f.numpy()),
+                                   np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        sh = paddle.fft.fftshift(paddle.to_tensor(x))
+        np.testing.assert_allclose(sh.numpy(), np.fft.fftshift(x))
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5))
+
+    def test_onnx_stub(self):
+        with pytest.raises(NotImplementedError, match="jit.save"):
+            paddle.onnx.export(None, "x")
+
+
+class TestLinalgSemantics:
+    def test_eigh_uplo_ignores_other_triangle(self):
+        spd = _spd()
+        garbage = spd.copy()
+        garbage[np.tril_indices(4, -1)] = 99.0  # junk lower triangle
+        w_u, _ = paddle.linalg.eigh(paddle.to_tensor(garbage), UPLO="U")
+        w_ref, _ = paddle.linalg.eigh(paddle.to_tensor(spd))
+        np.testing.assert_allclose(np.sort(w_u.numpy()),
+                                   np.sort(w_ref.numpy()), rtol=1e-4)
+
+    def test_matrix_rank_absolute_tol(self):
+        a = np.diag([1e-4, 1e-6]).astype(np.float32)
+        r = paddle.linalg.matrix_rank(paddle.to_tensor(a), tol=1e-5)
+        assert int(r.numpy()) == 1  # absolute threshold, not relative
+
+    def test_cross_first_dim3_axis(self):
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        y = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        out = paddle.linalg.cross(paddle.to_tensor(x),
+                                  paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(),
+                                   np.cross(x, y, axis=0), rtol=1e-5)
