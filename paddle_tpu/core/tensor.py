@@ -48,7 +48,16 @@ class Tensor:
         if isinstance(data, Tensor):
             data = data._data
         elif not isinstance(data, jax.Array):
-            data = jnp.asarray(data)
+            import numpy as _np
+
+            host = _np.asarray(data)
+            if _np.issubdtype(host.dtype, _np.complexfloating):
+                # the TPU backend has no complex support — complex
+                # tensors live on the host CPU device from creation
+                # (a TPU-resident complex buffer can't even be read back)
+                data = jax.device_put(host, jax.devices("cpu")[0])
+            else:
+                data = jnp.asarray(data)
         self._data: jax.Array = data
         self.stop_gradient = stop_gradient
         self.grad: Optional[Tensor] = None
@@ -245,7 +254,11 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
             from .dtype import get_default_dtype
 
             arr = arr.astype(get_default_dtype().np_dtype)
-        arr = jnp.asarray(arr)
+        if np.issubdtype(arr.dtype, np.complexfloating):
+            # TPU has no complex support — keep complex on the host CPU
+            arr = jax.device_put(arr, jax.devices("cpu")[0])
+        else:
+            arr = jnp.asarray(arr)
     if dtype is not None:
         arr = arr.astype(convert_dtype(dtype).np_dtype)
     if place is not None and isinstance(place, Place):

@@ -139,10 +139,18 @@ def launch(argv: List[str] = None) -> int:
         if rc == 0:
             return 0
         restarts += 1
-        if args.elastic_level <= 0 or restarts > args.max_restarts:
+        # exit codes 101/102 are the elastic-restart REQUEST contract
+        # (fleet.elastic ELASTIC_EXIT_CODE / auto-parallel variant,
+        # reference manager.py:32) — honor them even without
+        # --elastic_level; other failures relaunch only when elastic
+        elastic_requested = rc in (101, 102)
+        if not elastic_requested and args.elastic_level <= 0:
             return rc
-        print(f"launch: worker failed (rc={rc}); elastic relaunch "
-              f"{restarts}/{args.max_restarts}", file=sys.stderr)
+        if restarts > args.max_restarts:
+            return rc
+        print(f"launch: worker exited rc={rc} "
+              f"({'elastic restart requested' if elastic_requested else 'failure'}); "
+              f"relaunch {restarts}/{args.max_restarts}", file=sys.stderr)
 
 
 def main():

@@ -19,7 +19,26 @@ __all__ = [
 
 
 def _op(name, raw, x):
-    return eager_apply(name, raw, as_tensor_args(x))
+    (t,) = as_tensor_args(x)
+    # FFT results are complex and the TPU backend has no complex support
+    # — run the op on the host CPU device (jax dispatches eager ops to
+    # the input's device). The moved tensor keeps the tape link, so
+    # gradients still flow (the transfer's vjp is identity).
+    import jax
+
+    cpu = jax.devices("cpu")[0]
+    if t._data.device.platform != "cpu":
+        from .core.tensor import Tensor
+
+        moved = Tensor(jax.device_put(t._data, cpu),
+                       stop_gradient=t.stop_gradient)
+        moved._grad_node = t._grad_node
+        moved._out_idx = t._out_idx
+        t = moved
+    # default_device: jnp.fft internals create norm scalars on the
+    # DEFAULT device — those must land on CPU too
+    with jax.default_device(cpu):
+        return eager_apply(name, raw, [t])
 
 
 def _mk1d(jfn, opname):
@@ -67,15 +86,23 @@ irfftn = _mkn(jnp.fft.irfftn, "irfftn")
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.dtype import convert_dtype
     from .core.tensor import Tensor
 
-    return Tensor(jnp.fft.fftfreq(n, d=d))
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        out = out.astype(convert_dtype(dtype).np_dtype)
+    return Tensor(out)
 
 
 def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.dtype import convert_dtype
     from .core.tensor import Tensor
 
-    return Tensor(jnp.fft.rfftfreq(n, d=d))
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        out = out.astype(convert_dtype(dtype).np_dtype)
+    return Tensor(out)
 
 
 def fftshift(x, axes=None, name=None):

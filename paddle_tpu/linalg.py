@@ -1,172 +1,24 @@
 """paddle.linalg — decomposition/solver namespace.
 
-TPU-native equivalent of the reference's linalg surface (reference:
-python/paddle/linalg.py re-exporting tensor/linalg.py — svd, qr, eig,
-eigh, inv, det, slogdet, cholesky, solve, lstsq, pinv, matrix_power,
-triangular_solve, matrix_rank, cond, multi_dot, norm; PHI kernels
-paddle/phi/kernels/*_kernel.h per op). Lowered via jnp.linalg — on TPU
-the decompositions run XLA's blocked algorithms; grads come from
-jax.vjp like every other dispatched op.
+TPU-native equivalent of the reference's linalg namespace (reference:
+python/paddle/linalg.py, which re-exports tensor/linalg.py ops). One
+implementation lives in ``ops/linalg.py`` (registered ops with tape
+gradients); this module is the namespaced view, exactly like the
+reference — no second copies to diverge.
 """
 from __future__ import annotations
 
-import jax.numpy as jnp
-
-from .ops.dispatch import as_tensor_args, eager_apply
+from .ops.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, cross, det, dot, eig,
+    eigh, eigvals, eigvalsh, lstsq, lu, matmul, matrix_power, matrix_rank,
+    multi_dot, norm, pinv, qr, slogdet, solve, svd, triangular_solve,
+)
+from .ops.linalg import inverse  # noqa: F401
+from .ops.linalg import inverse as inv  # noqa: F401  (paddle.linalg.inv)
 
 __all__ = [
-    "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh", "inv", "det",
-    "slogdet", "cholesky", "solve", "lstsq", "pinv", "matrix_power",
-    "triangular_solve", "matrix_rank", "cond", "multi_dot", "norm",
-    "matmul", "cross", "dot",
+    "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "cross",
+    "det", "dot", "eig", "eigh", "eigvals", "eigvalsh", "inv", "inverse",
+    "lstsq", "lu", "matmul", "matrix_power", "matrix_rank", "multi_dot",
+    "norm", "pinv", "qr", "slogdet", "solve", "svd", "triangular_solve",
 ]
-
-
-def _op(name, raw, tensors, n_outputs=1):
-    return eager_apply(name, raw, as_tensor_args(*tensors),
-                       n_outputs=n_outputs)
-
-
-def svd(x, full_matrices=False, name=None):
-    return _op("svd",
-               lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
-               [x], n_outputs=3)
-
-
-def qr(x, mode="reduced", name=None):
-    return _op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), [x],
-               n_outputs=2)
-
-
-def eig(x, name=None):
-    return _op("eig", lambda a: tuple(jnp.linalg.eig(a)), [x], n_outputs=2)
-
-
-def eigh(x, UPLO="L", name=None):
-    def raw(a):
-        herm = _from_triangle(a, UPLO)
-        return tuple(jnp.linalg.eigh(herm, symmetrize_input=False))
-
-    return _op("eigh", raw, [x], n_outputs=2)
-
-
-def _from_triangle(a, UPLO):
-    """Build the Hermitian matrix from ONE triangle (Paddle/LAPACK UPLO
-    semantics — the other triangle's contents are ignored)."""
-    if UPLO == "U":
-        u = jnp.triu(a)
-        return u + jnp.swapaxes(u, -1, -2) \
-            - jnp.triu(jnp.tril(a))  # subtract diag counted twice
-    low = jnp.tril(a)
-    return low + jnp.swapaxes(low, -1, -2) - jnp.triu(jnp.tril(a))
-
-
-def eigvals(x, name=None):
-    return _op("eigvals", lambda a: jnp.linalg.eigvals(a), [x])
-
-
-def eigvalsh(x, UPLO="L", name=None):
-    return _op("eigvalsh",
-               lambda a: jnp.linalg.eigvalsh(_from_triangle(a, UPLO)),
-               [x])
-
-
-def inv(x, name=None):
-    return _op("inv", lambda a: jnp.linalg.inv(a), [x])
-
-
-def det(x, name=None):
-    return _op("det", lambda a: jnp.linalg.det(a), [x])
-
-
-def slogdet(x, name=None):
-    return _op("slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), [x],
-               n_outputs=2)
-
-
-def cholesky(x, upper=False, name=None):
-    def raw(a):
-        L = jnp.linalg.cholesky(a)
-        return jnp.swapaxes(L, -1, -2) if upper else L
-
-    return _op("cholesky", raw, [x])
-
-
-def solve(x, y, name=None):
-    return _op("solve", lambda a, b: jnp.linalg.solve(a, b), [x, y])
-
-
-def lstsq(x, y, rcond=None, driver=None, name=None):
-    def raw(a, b):
-        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
-        return sol, res, rank, sv
-
-    return _op("lstsq", raw, [x, y], n_outputs=4)
-
-
-def pinv(x, rcond=1e-15, hermitian=False, name=None):
-    return _op("pinv", lambda a: jnp.linalg.pinv(
-        a, rtol=rcond, hermitian=hermitian), [x])
-
-
-def matrix_power(x, n, name=None):
-    return _op("matrix_power",
-               lambda a: jnp.linalg.matrix_power(a, n), [x])
-
-
-def triangular_solve(x, y, upper=True, transpose=False,
-                     unitriangular=False, name=None):
-    from jax.scipy.linalg import solve_triangular
-
-    def raw(a, b):
-        return solve_triangular(a, b, lower=not upper,
-                                trans=1 if transpose else 0,
-                                unit_diagonal=unitriangular)
-
-    return _op("triangular_solve", raw, [x, y])
-
-
-def matrix_rank(x, tol=None, hermitian=False, name=None):
-    def raw(a):
-        if tol is None:
-            return jnp.linalg.matrix_rank(a)
-        # Paddle's tol is an ABSOLUTE singular-value threshold
-        s = jnp.linalg.eigvalsh(a) if hermitian else \
-            jnp.linalg.svd(a, compute_uv=False)
-        return jnp.sum(jnp.abs(s) > tol, axis=-1)
-
-    return _op("matrix_rank", raw, [x])
-
-
-def cond(x, p=None, name=None):
-    return _op("cond", lambda a: jnp.linalg.cond(a, p=p), [x])
-
-
-def multi_dot(xs, name=None):
-    return _op("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs),
-               list(xs))
-
-
-def norm(x, p=None, axis=None, keepdim=False, name=None):
-    from .ops import linalg as _ops_linalg
-
-    return _ops_linalg.norm(x, p=p if p is not None else "fro",
-                            axis=axis, keepdim=keepdim)
-
-
-def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
-    from .ops import linalg as _ops_linalg
-
-    return _ops_linalg.matmul(x, y, transpose_x, transpose_y)
-
-
-def cross(x, y, axis=9, name=None):
-    from .ops import linalg as _ops_linalg
-
-    return _ops_linalg.cross(x, y, axis=axis)
-
-
-def dot(x, y, name=None):
-    from .ops import linalg as _ops_linalg
-
-    return _ops_linalg.dot(x, y)

@@ -160,22 +160,49 @@ def svd(x, full_matrices=False, name=None):
 def eig(x, name=None):
     a = np.asarray(_as_tensor(x)._data)
     w, v = np.linalg.eig(a)
-    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+    # Tensor() places complex results on the CPU device (no TPU support)
+    return Tensor(w), Tensor(v)
+
+
+def _from_triangle(a, UPLO):
+    """Hermitian matrix from ONE triangle (LAPACK UPLO semantics: the
+    other triangle's contents are ignored; off-diagonal mirror is
+    CONJUGATED for complex inputs)."""
+    diag = jnp.triu(jnp.tril(a))
+    tri = jnp.triu(a) if UPLO == "U" else jnp.tril(a)
+    return tri + jnp.conj(jnp.swapaxes(tri, -1, -2)) - diag
 
 
 def eigh(x, UPLO="L", name=None):
-    return eager_apply("eigh",
-                       lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)),
-                       [x], {}, n_outputs=2)
+    t = _as_tensor(x)
+    if jnp.issubdtype(t._data.dtype, jnp.complexfloating):
+        # complex is unsupported on the TPU backend: host path (same
+        # treatment as eig)
+        a = np.asarray(t._data)
+        tri = np.triu(a) if UPLO == "U" else np.tril(a)
+        herm = tri + np.conj(tri.swapaxes(-1, -2)) - np.triu(np.tril(a))
+        w, v = np.linalg.eigh(herm)
+        return Tensor(w), Tensor(v)
+    return eager_apply(
+        "eigh",
+        lambda a: tuple(jnp.linalg.eigh(_from_triangle(a, UPLO),
+                                        symmetrize_input=False)),
+        [x], {}, n_outputs=2)
 
 
 def eigvals(x, name=None):
     a = np.asarray(_as_tensor(x)._data)
-    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+    return Tensor(np.linalg.eigvals(a))
 
 
 def eigvalsh(x, UPLO="L", name=None):
-    return eager_apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a), [x], {})
+    t = _as_tensor(x)
+    if jnp.issubdtype(t._data.dtype, jnp.complexfloating):
+        w, _ = eigh(t, UPLO=UPLO)
+        return w
+    return eager_apply(
+        "eigvalsh",
+        lambda a: jnp.linalg.eigvalsh(_from_triangle(a, UPLO)), [x], {})
 
 
 def inverse(x, name=None):
@@ -233,8 +260,13 @@ def matrix_power(x, n, name=None):
 
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):
-    return Tensor(jnp.linalg.matrix_rank(_as_tensor(x)._data,
-                                         rtol=tol).astype(jnp.int64))
+    a = _as_tensor(x)._data
+    if tol is None:
+        return Tensor(jnp.linalg.matrix_rank(a).astype(jnp.int64))
+    # Paddle's tol is an ABSOLUTE singular-value threshold
+    s = jnp.abs(jnp.linalg.eigvalsh(a)) if hermitian else \
+        jnp.linalg.svd(a, compute_uv=False)
+    return Tensor(jnp.sum(s > tol, axis=-1).astype(jnp.int64))
 
 
 def cond(x, p=None, name=None):

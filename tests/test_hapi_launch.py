@@ -164,3 +164,30 @@ class TestLaunchCLI:
             cwd="/root/repo", env=env, capture_output=True, text=True,
             timeout=120)
         assert proc.returncode == 3
+
+
+class TestElasticExitCode:
+    def test_exit_101_triggers_relaunch_without_elastic_level(self, tmp_path):
+        """Exit code 101 is the elastic-restart REQUEST (manager.py:32):
+        the launcher relaunches even without --elastic_level."""
+        script = tmp_path / "flaky.py"
+        marker = tmp_path / "ran_once"
+        script.write_text(
+            "import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').write('1')\n"
+            "    sys.exit(101)\n"  # first run requests elastic restart
+            "print('SECOND_RUN_OK')\n")
+        import subprocess
+        import sys as _sys
+
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "1", "--max_restarts", "2", str(script)],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        assert "elastic restart requested" in proc.stderr

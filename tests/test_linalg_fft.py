@@ -49,10 +49,11 @@ class TestLinalg:
         q, r = paddle.linalg.qr(paddle.to_tensor(a_np))
         np.testing.assert_allclose(q.numpy() @ r.numpy(), a_np,
                                    atol=1e-4)
-        sign, logdet = paddle.linalg.slogdet(paddle.to_tensor(spd))
-        np.testing.assert_allclose(
-            float(sign.numpy()) * np.exp(float(logdet.numpy())),
-            np.linalg.det(spd), rtol=1e-3)
+        res = paddle.linalg.slogdet(paddle.to_tensor(spd))
+        # Paddle returns one stacked tensor [2, ...]: [sign, logdet]
+        sign, logdet = float(res.numpy()[0]), float(res.numpy()[1])
+        np.testing.assert_allclose(sign * np.exp(logdet),
+                                   np.linalg.det(spd), rtol=1e-3)
 
     def test_pinv_matrix_power_multi_dot(self):
         a_np = np.random.RandomState(0).randn(3, 5).astype(np.float32)
