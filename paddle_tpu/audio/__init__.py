@@ -1,0 +1,13 @@
+"""paddle_tpu.audio — audio feature extraction.
+
+TPU-native equivalent of the reference's audio package (reference:
+python/paddle/audio — features/layers.py Spectrogram/MelSpectrogram/
+LogMelSpectrogram/MFCC over functional/window.py + functional/
+functional.py hz_to_mel/mel_frequencies/compute_fbank_matrix). The STFT
+rides the framework's fft ops; feature layers are nn.Layers so they
+compose into models.
+"""
+from . import features  # noqa: F401
+from . import functional  # noqa: F401
+
+__all__ = ["features", "functional"]

@@ -1,0 +1,143 @@
+"""Audio feature layers (reference: python/paddle/audio/features/
+layers.py — Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length: int, hop_length: int):
+    """[..., T] -> [..., n_frames, frame_length] (strided framing)."""
+    n_frames = 1 + (x.shape[-1] - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(n_frames)[:, None])
+    return x[..., idx]
+
+
+class Spectrogram(Layer):
+    """STFT power spectrogram [..., n_fft//2+1, n_frames] (reference:
+    features/layers.py Spectrogram). Center-padding (reflect) like the
+    reference's default."""
+
+    def __init__(self, n_fft: int = 512, hop_length=None, win_length=None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 dtype=None):
+        super().__init__()
+        self.n_fft = n_fft
+        self.win_length = win_length or n_fft
+        self.hop_length = hop_length or self.win_length // 2
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = F.get_window(window, self.win_length)._data
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self.register_buffer("window", Tensor(w), persistable=False)
+
+    def forward(self, x):
+        from ..ops.dispatch import as_tensor_args, eager_apply
+
+        (t,) = as_tensor_args(x)
+        win = self.window._data
+        n_fft, hop = self.n_fft, self.hop_length
+        power, center, pad_mode = self.power, self.center, self.pad_mode
+
+        def raw(sig):
+            if center:
+                pad = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2,
+                                                    n_fft // 2)]
+                sig = jnp.pad(sig, pad, mode=pad_mode)
+            frames = _frame(sig, n_fft, hop) * win  # [..., F, n_fft]
+            spec = jnp.fft.rfft(frames, axis=-1)
+            mag = jnp.abs(spec) ** power
+            return jnp.swapaxes(mag, -1, -2)  # [..., bins, frames]
+
+        import jax
+
+        from ..fft import to_cpu_op
+
+        # rfft: complex intermediates stay off the TPU (see fft.py)
+        t = to_cpu_op(t)
+        with jax.default_device(jax.devices("cpu")[0]):
+            return eager_apply("spectrogram", raw, [t])
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram × mel filterbank (reference: MelSpectrogram)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann",
+                 power: float = 2.0, n_mels: int = 64, f_min: float = 50.0,
+                 f_max=None, htk: bool = False, norm: str = "slaney",
+                 dtype=None):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power)
+        fbank = F.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                       htk, norm)
+        self.register_buffer("fbank", fbank, persistable=False)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)  # [..., bins, frames]
+        from ..ops.dispatch import as_tensor_args, eager_apply
+
+        fb = self.fbank._data
+
+        def raw(s):
+            return jnp.einsum("mb,...bf->...mf", fb, s)
+
+        (t,) = as_tensor_args(spec)
+        return eager_apply("mel_fbank", raw, [t])
+
+
+class LogMelSpectrogram(Layer):
+    """power_to_db(MelSpectrogram) (reference: LogMelSpectrogram)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann",
+                 power: float = 2.0, n_mels: int = 64, f_min: float = 50.0,
+                 f_max=None, ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db=None, dtype=None):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, n_mels, f_min, f_max)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self.mel(x), self.ref_value, self.amin,
+                             self.top_db)
+
+
+class MFCC(Layer):
+    """DCT-II over log-mel (reference: MFCC)."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40,
+                 n_fft: int = 512, hop_length=None, n_mels: int = 64,
+                 f_min: float = 50.0, f_max=None, top_db=None, dtype=None):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length,
+                                        n_mels=n_mels, f_min=f_min,
+                                        f_max=f_max, top_db=top_db)
+        self.register_buffer("dct", F.create_dct(n_mfcc, n_mels),
+                             persistable=False)
+
+    def forward(self, x):
+        lm = self.logmel(x)  # [..., n_mels, frames]
+        from ..ops.dispatch import as_tensor_args, eager_apply
+
+        dct = self.dct._data
+
+        def raw(s):
+            return jnp.einsum("mc,...mf->...cf", dct, s)
+
+        (t,) = as_tensor_args(lm)
+        return eager_apply("mfcc_dct", raw, [t])

@@ -1,0 +1,131 @@
+"""Audio DSP functional primitives (reference: python/paddle/audio/
+functional/functional.py — hz_to_mel:*, mel_to_hz, mel_frequencies,
+compute_fbank_matrix, power_to_db; window.py get_window)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "compute_fbank_matrix", "power_to_db", "get_window",
+           "create_dct"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        # Slaney formula (librosa/reference default)
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        if np.ndim(f):
+            log_t = f >= min_log_hz
+            out = np.where(log_t, min_log_mel
+                           + np.log(np.maximum(f, min_log_hz)
+                                    / min_log_hz) / logstep, out)
+        elif f >= min_log_hz:
+            out = min_log_mel + np.log(f / min_log_hz) / logstep
+    return out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    out = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = np.log(6.4) / 27.0
+    if np.ndim(m):
+        log_t = m >= min_log_mel
+        out = np.where(log_t,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    elif m >= min_log_mel:
+        out = min_log_hz * np.exp(logstep * (m - min_log_mel))
+    return out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                       n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None,
+                         htk: bool = False, norm: str = "slaney"):
+    """[n_mels, n_fft//2+1] triangular mel filterbank (reference:
+    functional.py compute_fbank_matrix)."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fft_freqs = np.linspace(0, sr / 2.0, n_fft // 2 + 1)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights.astype(np.float32)))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    """10*log10(power) with clipping (reference: power_to_db)."""
+    from ..ops.dispatch import as_tensor_args, eager_apply
+
+    (t,) = as_tensor_args(spect)
+
+    def raw(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+        log_spec = log_spec - 10.0 * jnp.log10(
+            jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return eager_apply("power_to_db", raw, [t])
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann/hamming/blackman/... (reference: window.py get_window)."""
+    n = win_length if not fftbins else win_length + 1
+    k = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / (n - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / (n - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / (n - 1))
+             + 0.08 * np.cos(4 * np.pi * k / (n - 1)))
+    elif window in ("boxcar", "rect", "ones"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(jnp.asarray(w.astype(np.float32)))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: str = "ortho"):
+    """[n_mels, n_mfcc] DCT-II basis (reference: create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / np.sqrt(2.0)
+        dct *= np.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.astype(np.float32)))

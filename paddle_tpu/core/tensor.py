@@ -57,7 +57,7 @@ class Tensor:
                 # (a TPU-resident complex buffer can't even be read back)
                 data = jax.device_put(host, jax.devices("cpu")[0])
             else:
-                data = jnp.asarray(data)
+                data = jnp.asarray(host)  # single conversion
         self._data: jax.Array = data
         self.stop_gradient = stop_gradient
         self.grad: Optional[Tensor] = None
@@ -260,7 +260,15 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
         else:
             arr = jnp.asarray(arr)
     if dtype is not None:
-        arr = arr.astype(convert_dtype(dtype).np_dtype)
+        np_dtype = convert_dtype(dtype).np_dtype
+        if np.issubdtype(np_dtype, np.complexfloating) and \
+                getattr(arr, "device", None) is not None and \
+                getattr(arr.device, "platform", "cpu") != "cpu":
+            # casting TO complex must also leave the TPU device
+            arr = jax.device_put(np.asarray(arr).astype(np_dtype),
+                                 jax.devices("cpu")[0])
+        else:
+            arr = arr.astype(np_dtype)
     if place is not None and isinstance(place, Place):
         arr = jax.device_put(arr, place.jax_device())
     return Tensor(arr, stop_gradient=stop_gradient)

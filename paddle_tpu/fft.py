@@ -18,26 +18,28 @@ __all__ = [
 ]
 
 
-def _op(name, raw, x):
-    (t,) = as_tensor_args(x)
-    # FFT results are complex and the TPU backend has no complex support
-    # — run the op on the host CPU device (jax dispatches eager ops to
-    # the input's device). The moved tensor keeps the tape link, so
-    # gradients still flow (the transfer's vjp is identity).
+def to_cpu_op(t):
+    """Move a tensor to the host CPU device AS A DISPATCHED OP, so the
+    transfer is on the tape and its vjp (jax transposes device_put as a
+    transfer back) returns cotangents to the producer's device. Used by
+    every op whose result is complex (no TPU support): fft family,
+    audio.Spectrogram."""
     import jax
 
+    if t._data.device.platform == "cpu":
+        return t
     cpu = jax.devices("cpu")[0]
-    if t._data.device.platform != "cpu":
-        from .core.tensor import Tensor
+    return eager_apply("to_cpu", lambda a: jax.device_put(a, cpu), [t])
 
-        moved = Tensor(jax.device_put(t._data, cpu),
-                       stop_gradient=t.stop_gradient)
-        moved._grad_node = t._grad_node
-        moved._out_idx = t._out_idx
-        t = moved
+
+def _op(name, raw, x):
+    import jax
+
+    (t,) = as_tensor_args(x)
+    t = to_cpu_op(t)
     # default_device: jnp.fft internals create norm scalars on the
     # DEFAULT device — those must land on CPU too
-    with jax.default_device(cpu):
+    with jax.default_device(jax.devices("cpu")[0]):
         return eager_apply(name, raw, [t])
 
 

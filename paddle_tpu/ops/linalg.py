@@ -235,11 +235,11 @@ def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
 
 
 def lstsq(x, y, rcond=None, driver=None, name=None):
-    a = np.asarray(_as_tensor(x)._data)
-    b = np.asarray(_as_tensor(y)._data)
-    sol, res, rank, sv = np.linalg.lstsq(a, b, rcond=rcond)
-    return (Tensor(jnp.asarray(sol)), Tensor(jnp.asarray(res)),
-            Tensor(jnp.asarray(rank)), Tensor(jnp.asarray(sv)))
+    # jnp path: the solution is differentiable through the tape
+    return eager_apply(
+        "lstsq",
+        lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+        [x, y], {}, n_outputs=4)
 
 
 def det(x, name=None):

@@ -1,0 +1,64 @@
+"""paddle.audio feature tests (reference: test/legacy_test audio feature
+tests — librosa-convention checks)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.audio.features import (MFCC, LogMelSpectrogram,
+                                       MelSpectrogram, Spectrogram)
+
+
+def _sine(sr=8000, f=440.0, dur=0.5):
+    t = np.arange(int(sr * dur)) / sr
+    return np.sin(2 * np.pi * f * t).astype(np.float32)
+
+
+class TestFunctional:
+    def test_mel_hz_roundtrip(self):
+        freqs = np.array([100.0, 440.0, 1000.0, 4000.0])
+        np.testing.assert_allclose(
+            AF.mel_to_hz(AF.hz_to_mel(freqs)), freqs, rtol=1e-6)
+        np.testing.assert_allclose(
+            AF.mel_to_hz(AF.hz_to_mel(freqs, htk=True), htk=True),
+            freqs, rtol=1e-6)
+
+    def test_fbank_shape_and_partition(self):
+        fb = AF.compute_fbank_matrix(sr=8000, n_fft=256, n_mels=20)
+        assert fb.shape == [20, 129]
+        assert float(fb.numpy().min()) >= 0.0
+
+    def test_window_shapes(self):
+        for w in ("hann", "hamming", "blackman"):
+            assert AF.get_window(w, 64).shape == [64]
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = AF.power_to_db(x, top_db=None)
+        np.testing.assert_allclose(db.numpy(), [0.0, 10.0, 20.0],
+                                   atol=1e-4)
+
+
+class TestFeatures:
+    def test_spectrogram_peak_at_tone(self):
+        sr, f = 8000, 1000.0
+        spec = Spectrogram(n_fft=256, hop_length=128)(
+            paddle.to_tensor(_sine(sr, f)))
+        assert spec.shape[0] == 129
+        mean_spec = spec.numpy().mean(axis=-1)
+        peak_bin = int(mean_spec.argmax())
+        expect_bin = round(f / (sr / 256))
+        assert abs(peak_bin - expect_bin) <= 1
+
+    def test_mel_logmel_mfcc_shapes(self):
+        x = paddle.to_tensor(_sine())
+        mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert mel.shape[0] == 32
+        logmel = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert logmel.shape == mel.shape
+        mfcc = MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+        assert mfcc.shape[0] == 13
+
+    def test_batched_input(self):
+        x = paddle.to_tensor(np.stack([_sine(), _sine(f=880.0)]))
+        spec = Spectrogram(n_fft=256)(x)
+        assert spec.shape[0] == 2 and spec.shape[1] == 129
