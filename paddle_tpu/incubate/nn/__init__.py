@@ -1,4 +1,5 @@
 """incubate.nn — fused layers (reference: python/paddle/incubate/nn)."""
+from . import functional  # noqa: F401
 from .fused_transformer import (  # noqa: F401
     FusedMultiTransformer, PagedKV, qkv_split_rope_fused, rope_table)
 

@@ -1,0 +1,159 @@
+"""incubate.nn.functional — fused-op functional APIs.
+
+TPU-native equivalent of the reference's fused functional surface
+(reference: python/paddle/incubate/nn/functional — fused_rotary_
+position_embedding, fused_layer_norm, fused_linear,
+fused_multi_head_attention; plus the fork's qkv_split_rope_fused op,
+ops.yaml:8-25). "Fused" here means expressed as one dispatched op so XLA
+compiles a single fusion; the hand-scheduling the CUDA kernels do is
+XLA's job on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import as_tensor_args, eager_apply
+from .fused_transformer import _apply_rope, qkv_split_rope_fused  # noqa: F401
+
+__all__ = [
+    "fused_rotary_position_embedding", "fused_layer_norm",
+    "fused_linear", "fused_multi_head_attention",
+    "qkv_split_rope_fused",
+]
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """Rotary embedding over q/k (reference: incubate/nn/functional/
+    fused_rotary_position_embedding.py; fork kernel qkv_split_rope_
+    fused_op). Layout [batch, seq, heads, head_dim]; sin/cos
+    [seq, head_dim/2] or [1, seq, 1, head_dim/2]; position_ids [b, s]."""
+    if sin is None or cos is None:
+        raise ValueError("pass precomputed sin/cos tables (rope_table)")
+    if not use_neox_rotary_style:
+        raise NotImplementedError("interleaved (GPT-J) style rope is not "
+                                  "supported; use neox half-rotation")
+    inputs = [(name, t) for name, t in (("q", q), ("k", k), ("v", v))
+              if t is not None]
+    ts = as_tensor_args(*[t for _, t in inputs])
+    rotate = [name != "v" for name, _ in inputs]  # v passes through
+    cos_a = cos._data if hasattr(cos, "_data") else jnp.asarray(cos)
+    sin_a = sin._data if hasattr(sin, "_data") else jnp.asarray(sin)
+    pos = None if position_ids is None else jnp.asarray(
+        position_ids._data if hasattr(position_ids, "_data")
+        else position_ids)
+
+    def raw(*arrs):
+        s = arrs[0].shape[1]
+        c2 = cos_a.reshape(-1, cos_a.shape[-1])
+        s2 = sin_a.reshape(-1, sin_a.shape[-1])
+        if pos is not None:
+            c = c2[pos][:, :, None, :]
+            s_ = s2[pos][:, :, None, :]
+        else:
+            c = c2[None, :s, None, :]
+            s_ = s2[None, :s, None, :]
+        outs = [(_apply_rope(a, c, s_) if rot else a)
+                for a, rot in zip(arrs, rotate)]
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    out = eager_apply("fused_rotary_position_embedding", raw, ts,
+                      n_outputs=len(ts))
+    out = out if isinstance(out, tuple) else (out,)
+    res = []
+    it = iter(out)
+    for t in (q, k, v):
+        res.append(next(it) if t is not None else None)
+    return tuple(res)
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     residual=None, bias=None):
+    """LN with optional residual+bias pre-add, one fusion (reference:
+    incubate fused_layer_norm / phi fused_layernorm kernels). Returns
+    (out, residual_out) when residual is given, else out."""
+    tensors = [x] + [t for t in (residual, bias, norm_weight, norm_bias)
+                     if t is not None]
+    ts = as_tensor_args(*tensors)
+    has_res = residual is not None
+    has_bias = bias is not None
+    has_w = norm_weight is not None
+    has_b = norm_bias is not None
+
+    def raw(*arrs):
+        it = iter(arrs)
+        h = next(it)
+        res = next(it) if has_res else None
+        bs = next(it) if has_bias else None
+        w = next(it) if has_w else None
+        b = next(it) if has_b else None
+        if bs is not None:
+            h = h + bs
+        if res is not None:
+            h = h + res
+        residual_out = h
+        mu = jnp.mean(h, -1, keepdims=True)
+        var = jnp.var(h, -1, keepdims=True)
+        out = (h - mu) * jax.lax.rsqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return (out, residual_out) if has_res else out
+
+    return eager_apply("fused_layer_norm", raw, ts,
+                       n_outputs=2 if has_res else 1)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    """matmul+bias in one fusion (reference: incubate fused_linear)."""
+    tensors = [x, weight] + ([bias] if bias is not None else [])
+    ts = as_tensor_args(*tensors)
+    has_bias = bias is not None
+
+    def raw(a, w, *mb):
+        if transpose_weight:
+            w = jnp.swapaxes(w, -1, -2)
+        out = a @ w
+        if has_bias:
+            out = out + mb[0]
+        return out
+
+    return eager_apply("fused_linear", raw, ts)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               qkv_bias=None, linear_bias=None,
+                               num_heads=None, attn_mask=None,
+                               dropout_rate=0.0, causal=False,
+                               pre_layer_norm=False, ln_scale=None,
+                               ln_bias=None, epsilon=1e-5, training=True):
+    """Whole MHA block as one fusion: [pre-LN] → qkv → SDPA (flash path
+    on TPU) → out-proj → residual (reference: incubate
+    fused_multi_head_attention / fused_attention_op.cu)."""
+    import paddle_tpu.nn.functional as F
+
+    (xt,) = as_tensor_args(x)
+    b, s, d = xt.shape
+    if num_heads is None:
+        raise ValueError("num_heads is required")
+    h = xt
+    if pre_layer_norm:
+        h = fused_layer_norm(h, ln_scale, ln_bias, epsilon)
+    qkv = fused_linear(h, qkv_weight, qkv_bias)
+    qkv = qkv.reshape([b, s, 3, num_heads, d // num_heads])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    att = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=dropout_rate,
+        is_causal=causal, training=training)
+    att = att.reshape([b, s, d])
+    out = fused_linear(att, linear_weight, linear_bias)
+    res = xt + out  # residual (reference adds the input back)
+    if not pre_layer_norm and (ln_scale is not None
+                               or ln_bias is not None):
+        # post-LN mode: LN applies to the residual sum (reference
+        # fused_attention post_layer_norm path)
+        return fused_layer_norm(res, ln_scale, ln_bias, epsilon)
+    return res

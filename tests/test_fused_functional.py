@@ -1,0 +1,105 @@
+"""incubate.nn.functional fused-op tests (reference:
+test/legacy_test/test_fused_* suites)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as FF
+from paddle_tpu.incubate.nn import rope_table
+
+
+def test_fused_rotary_position_embedding():
+    rng = np.random.RandomState(0)
+    b, s, h, d = 2, 4, 2, 8
+    q = paddle.to_tensor(rng.randn(b, s, h, d).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(b, s, h, d).astype(np.float32))
+    cos, sin = rope_table(16, d)
+    qq, kk, vv = FF.fused_rotary_position_embedding(
+        q, k, None, sin=paddle.Tensor(sin), cos=paddle.Tensor(cos))
+    assert vv is None
+    # position 0 is identity; norms are preserved (rotation)
+    np.testing.assert_allclose(qq.numpy()[:, 0], q.numpy()[:, 0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        np.linalg.norm(qq.numpy(), axis=-1),
+        np.linalg.norm(q.numpy(), axis=-1), rtol=1e-4)
+    # position_ids override the implicit arange
+    pos = paddle.to_tensor(np.zeros((b, s), np.int32))
+    q0, k0, _ = FF.fused_rotary_position_embedding(
+        q, k, None, sin=paddle.Tensor(sin), cos=paddle.Tensor(cos),
+        position_ids=pos)
+    np.testing.assert_allclose(q0.numpy(), q.numpy(), rtol=1e-5)
+
+
+def test_fused_layer_norm_residual():
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+    res = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+    w = paddle.to_tensor(np.ones(8, np.float32))
+    b = paddle.to_tensor(np.zeros(8, np.float32))
+    out, res_out = FF.fused_layer_norm(x, w, b, residual=res)
+    np.testing.assert_allclose(res_out.numpy(),
+                               x.numpy() + res.numpy(), rtol=1e-5)
+    h = res_out.numpy()
+    ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+        h.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_linear_grad():
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(8, 4).astype(np.float32),
+                         stop_gradient=False)
+    bias = paddle.to_tensor(np.ones(4, np.float32))
+    out = FF.fused_linear(x, w, bias)
+    np.testing.assert_allclose(out.numpy(),
+                               x.numpy() @ w.numpy() + 1.0, rtol=1e-4)
+    out.sum().backward()
+    assert w.grad is not None
+
+
+def test_fused_multi_head_attention():
+    rng = np.random.RandomState(3)
+    dm, nh = 16, 4
+    x = paddle.to_tensor(rng.randn(2, 6, dm).astype(np.float32))
+    qkvw = paddle.to_tensor(rng.randn(dm, 3 * dm).astype(np.float32)
+                            * 0.1)
+    lw = paddle.to_tensor(rng.randn(dm, dm).astype(np.float32) * 0.1)
+    out = FF.fused_multi_head_attention(x, qkvw, lw, num_heads=nh,
+                                        causal=True)
+    assert out.shape == [2, 6, dm]
+    # residual identity: zero projection weight -> output == input
+    zero_lw = paddle.to_tensor(np.zeros((dm, dm), np.float32))
+    out0 = FF.fused_multi_head_attention(x, qkvw, zero_lw, num_heads=nh)
+    np.testing.assert_allclose(out0.numpy(), x.numpy(), rtol=1e-5)
+
+
+def test_rope_v_passthrough_without_k():
+    rng = np.random.RandomState(4)
+    b, s, h, d = 1, 3, 2, 8
+    q = paddle.to_tensor(rng.randn(b, s, h, d).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(b, s, h, d).astype(np.float32))
+    cos, sin = rope_table(16, d)
+    qq, kk, vv = FF.fused_rotary_position_embedding(
+        q, None, v, sin=paddle.Tensor(sin), cos=paddle.Tensor(cos))
+    assert kk is None
+    np.testing.assert_allclose(vv.numpy(), v.numpy())  # v NOT rotated
+
+
+def test_mha_post_layer_norm():
+    rng = np.random.RandomState(5)
+    dm, nh = 16, 4
+    x = paddle.to_tensor(rng.randn(2, 4, dm).astype(np.float32))
+    qkvw = paddle.to_tensor(rng.randn(dm, 3 * dm).astype(np.float32)
+                            * 0.1)
+    zero_lw = paddle.to_tensor(np.zeros((dm, dm), np.float32))
+    w = paddle.to_tensor(np.ones(dm, np.float32))
+    b = paddle.to_tensor(np.zeros(dm, np.float32))
+    out = FF.fused_multi_head_attention(
+        x, qkvw, zero_lw, num_heads=nh, pre_layer_norm=False,
+        ln_scale=w, ln_bias=b)
+    # zero projection -> residual == x; post-LN applies to it
+    h = x.numpy()
+    ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+        h.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
