@@ -1,0 +1,161 @@
+"""incubate.asp — automatic structured (2:4) sparsity.
+
+TPU-native equivalent of the reference's ASP package (reference:
+python/paddle/incubate/asp — prune_model, decorate, ASPHelper,
+calculate_density, check_mask_1d/2d; utils.py mask algorithms). The
+reference targets Ampere sparse tensor cores; on TPU 2:4 sparsity is a
+model-compression technique (the MXU has no sparse mode), so masks are
+applied as weight multiplications that XLA folds into the matmul.
+Mask semantics match the reference's ``mask_1d``: best-magnitude
+n-of-m groups along the LAST axis, per row.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+
+__all__ = ["calculate_density", "check_mask_1d", "check_mask_2d",
+           "create_mask", "prune_model", "decorate", "ASPHelper"]
+
+_MASK_BUFFER = "_asp_mask"
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (reference: asp/utils.py calculate_density)."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _row_groups(arr: np.ndarray, m: int):
+    """[rows, ceil(cols/m), m] zero-padded groups along the last axis —
+    groups never straddle rows (reference mask_1d grouping)."""
+    rows = arr.reshape(-1, arr.shape[-1])
+    pad = (-rows.shape[1]) % m
+    padded = np.pad(rows, ((0, 0), (0, pad)))
+    return padded.reshape(rows.shape[0], -1, m), pad
+
+
+def create_mask(weight, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d") -> np.ndarray:
+    """Best-magnitude n-of-m mask per last-axis group (reference:
+    asp/utils.py create_mask with mask_1d)."""
+    if mask_algo != "mask_1d":
+        raise NotImplementedError(
+            f"mask_algo {mask_algo!r}: only 'mask_1d' is implemented "
+            "(the reference's 2-D block algorithms target sparse tensor "
+            "cores the TPU doesn't have)")
+    arr = np.asarray(weight._data if isinstance(weight, Tensor)
+                     else weight)
+    groups, pad = _row_groups(np.abs(arr), m)
+    idx = np.argsort(groups, axis=-1)[..., m - n:]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, idx, 1.0, axis=-1)
+    rows = mask.reshape(mask.shape[0], -1)
+    if pad:
+        rows = rows[:, :-pad]
+    return rows.reshape(arr.shape).astype(arr.dtype)
+
+
+def check_mask_1d(mat, n: int = 2, m: int = 4) -> bool:
+    """Every last-axis m-group (per row) has ≤ n nonzeros (reference:
+    asp/utils.py check_mask_1d)."""
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    groups, _ = _row_groups(np.abs(arr), m)
+    return bool((np.count_nonzero(groups, axis=-1) <= n).all())
+
+
+def check_mask_2d(mat, n: int = 2, m: int = 4) -> bool:
+    """Every m×m block has ≤ n nonzeros per row AND per column
+    (reference: asp/utils.py check_mask_2d)."""
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    a = arr.reshape(-1, arr.shape[-1])
+    pad_r = (-a.shape[0]) % m
+    pad_c = (-a.shape[1]) % m
+    a = np.pad(np.abs(a), ((0, pad_r), (0, pad_c)))
+    blocks = a.reshape(a.shape[0] // m, m, a.shape[1] // m, m)
+    blocks = blocks.transpose(0, 2, 1, 3)  # [br, bc, m, m]
+    row_ok = (np.count_nonzero(blocks, axis=-1) <= n).all()
+    col_ok = (np.count_nonzero(blocks, axis=-2) <= n).all()
+    return bool(row_ok and col_ok)
+
+
+class ASPHelper:
+    """Pruning driver (reference: asp/asp.py ASPHelper). Masks are
+    stored as non-persistable DEVICE buffers on the pruned layer — no
+    global registry (no id-reuse hazard, no per-step host transfer,
+    lifetime tied to the layer)."""
+
+    @classmethod
+    def supported(cls, layer: Layer) -> bool:
+        from ...nn.layers.common import Linear
+
+        return isinstance(layer, Linear)
+
+    @classmethod
+    def prune_model(cls, model: Layer, n: int = 2, m: int = 4,
+                    mask_algo: str = "mask_1d") -> Dict[str, float]:
+        """Apply n:m masks to every supported layer's weight in place;
+        returns per-param density (reference: asp.py prune_model)."""
+        report = {}
+        for name, sub in model.named_sublayers(include_self=True):
+            if not cls.supported(sub):
+                continue
+            w = sub.weight
+            mask = jnp.asarray(create_mask(w, n=n, m=m,
+                                           mask_algo=mask_algo))
+            w._rebind(w._data * mask)
+            sub.register_buffer(_MASK_BUFFER, Tensor(mask),
+                                persistable=False)
+            report[f"{name}.weight" if name else "weight"] = \
+                calculate_density(w)
+        return report
+
+    @classmethod
+    def reapply_masks(cls, model: Layer) -> None:
+        """Re-zero pruned positions (wrapped around optimizer updates
+        by ``decorate``)."""
+        for _, sub in model.named_sublayers(include_self=True):
+            mask = sub._buffers.get(_MASK_BUFFER) \
+                if cls.supported(sub) else None
+            if mask is not None:
+                sub.weight._rebind(sub.weight._data * mask._data)
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d"):
+    return ASPHelper.prune_model(model, n=n, m=m, mask_algo=mask_algo)
+
+
+class _ASPOptimizerWrapper:
+    """Optimizer wrapper re-applying masks after each update (reference:
+    asp.py decorate → OptimizerWithSparsityGuarantee, which intercepts
+    BOTH step and minimize)."""
+
+    def __init__(self, optimizer, model: Layer):
+        self._inner = optimizer
+        self._model = model
+
+    def step(self):
+        out = self._inner.step()
+        ASPHelper.reapply_masks(self._model)
+        return out
+
+    def minimize(self, loss, *args, **kwargs):
+        out = self._inner.minimize(loss, *args, **kwargs)
+        ASPHelper.reapply_masks(self._model)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def decorate(model: Layer, optimizer):
+    """Wrap (model, optimizer) so sparsity survives training updates
+    (reference: asp.py decorate)."""
+    return model, _ASPOptimizerWrapper(optimizer, model)
