@@ -5,3 +5,4 @@ from . import nn  # noqa: F401
 from .moe import MoELayer  # noqa: F401
 from . import autograd  # noqa: F401
 from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401

@@ -1,0 +1,156 @@
+"""incubate.optimizer — LookAhead, ModelAverage.
+
+TPU-native equivalent of the reference's incubate optimizers (reference:
+python/paddle/incubate/optimizer/lookahead.py LookAhead — slow/fast
+weights with k-step interpolation; modelaverage.py ModelAverage —
+running parameter average applied at eval via apply()/restore()).
+DistributedFusedLamb is GPU-fused-kernel specific; the plain Lamb in
+paddle_tpu.optimizer covers its math (single fused XLA program).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k-step lookahead wrapper (reference: lookahead.py LookAhead:66).
+
+    Every k inner steps: slow += alpha * (fast - slow); fast = slow.
+    """
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        # slow weights seeded at the CURRENT (pre-training) values —
+        # the reference's first sync interpolates back toward these
+        # (lookahead.py: slow initialized from the param at decoration)
+        self._slow: Dict[int, jnp.ndarray] = {
+            id(p): jnp.copy(p._data)
+            for p in inner_optimizer._parameter_list}
+
+    @property
+    def _params(self) -> List[Tensor]:
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k:
+            return
+        for p in self._params:
+            slow = self._slow[id(p)]
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            # the param gets its OWN buffer: the fused update donates
+            # (deletes) param buffers, and _slow must survive that
+            p._rebind(jnp.copy(slow))
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+
+class ModelAverage:
+    """Windowed parameter average (reference: modelaverage.py
+    ModelAverage:44): accumulate after each step; ``apply()`` swaps the
+    averaged weights in for evaluation, ``restore()`` swaps back.
+
+    Window semantics follow the reference's accumulator rotation: the
+    live window is rate-scaled and clamped to
+    [min_average_window, max_average_window]; on overflow it rolls into
+    an old-window accumulator, so the average spans at most two recent
+    windows and stale early-training weights age out."""
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 parameters=None, min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        if parameters is None:
+            raise ValueError("pass parameters=model.parameters()")
+        self._parameters = list(parameters)
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._count = 0
+        # previous window (the reference's old-sum accumulator): when
+        # the live window hits max_average_window it rolls over here,
+        # so the average spans at most two windows of recent history
+        self._old_sum: Dict[int, jnp.ndarray] = {}
+        self._old_count = 0
+        self._num_updates = 0
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._applied = False
+        self._need_restore = True
+
+    def _window(self) -> int:
+        """Effective window length (reference modelaverage semantics:
+        rate-scaled, clamped to [min, max]_average_window)."""
+        target = int(self._num_updates * self.average_window_rate)
+        return max(self.min_average_window,
+                   min(self.max_average_window, max(target, 1)))
+
+    def step(self):
+        """Accumulate the current parameter values (call after the
+        inner optimizer's step)."""
+        self._num_updates += 1
+        if self._count >= self._window():
+            # roll the live window into the old accumulator (reference:
+            # sum_1/sum_2 rotation) so stale history ages out
+            self._old_sum = self._sum
+            self._old_count = self._count
+            self._sum = {}
+            self._count = 0
+        for p in self._parameters:
+            cur = self._sum.get(id(p))
+            # copy on first capture: donated buffers die on next step
+            self._sum[id(p)] = jnp.copy(p._data) if cur is None \
+                else cur + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap averaged weights in (reference: apply:228)."""
+        if self._count == 0:
+            raise RuntimeError("ModelAverage.apply before any step()")
+        if self._applied:
+            raise RuntimeError("apply() without restore()")
+        total = self._count + self._old_count
+        for p in self._parameters:
+            self._backup[id(p)] = jnp.copy(p._data)
+            s = self._sum[id(p)]
+            if self._old_count:
+                s = s + self._old_sum[id(p)]
+            p._rebind((s / total).astype(p._data.dtype))
+        self._applied = True
+        self._need_restore = need_restore
+
+    def restore(self, executor=None):
+        """Swap the live training weights back (reference: restore:283).
+        No-op after apply(need_restore=False) — those weights are
+        permanent."""
+        if not self._applied or not self._need_restore:
+            return
+        for p in self._parameters:
+            p._rebind(self._backup[id(p)])
+        self._backup.clear()
+        self._applied = False

@@ -1,0 +1,172 @@
+"""paddle.signal — framing + STFT/ISTFT.
+
+TPU-native equivalent of the reference's signal module (reference:
+python/paddle/signal.py — frame:30, overlap_add:145, stft:246,
+istft:423 over phi frame/overlap_add kernels + fft). Complex spectra
+ride the CPU-offload path shared with paddle.fft (the TPU backend has
+no complex dtypes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops.dispatch import as_tensor_args, eager_apply
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1,
+          name=None):
+    """Slide overlapping frames of ``frame_length`` every ``hop_length``
+    (reference: signal.py frame:30). axis=-1: [..., T] → [..., F, L];
+    axis=0: [T, ...] → [L, F, ...] matching the reference layout."""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    (t,) = as_tensor_args(x)
+
+    def raw(a):
+        if axis not in (-1, a.ndim - 1, 0):
+            raise ValueError("axis must be 0 or -1")
+        move = axis == 0 and a.ndim > 1
+        if move:
+            a = jnp.moveaxis(a, 0, -1)
+        n = a.shape[-1]
+        if frame_length > n:
+            raise ValueError(f"frame_length {frame_length} > signal "
+                             f"length {n}")
+        n_frames = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(n_frames)[:, None])
+        out = a[..., idx]                      # [..., F, L]
+        if axis == 0:
+            out = jnp.moveaxis(out, (-2, -1), (1, 0)) if a.ndim > 1 \
+                else jnp.swapaxes(out, -1, -2)
+        return out
+
+    return eager_apply("frame", raw, [t])
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of frame: sum overlapping frames (reference:
+    signal.py overlap_add:145). axis=-1: [..., F, L] → [..., T]."""
+    (t,) = as_tensor_args(x)
+
+    def raw(a):
+        if axis not in (-1, a.ndim - 1, 0):
+            raise ValueError("axis must be 0 or -1")
+        if axis == 0:
+            a = jnp.moveaxis(a, (0, 1), (-1, -2)) if a.ndim > 2 \
+                else jnp.swapaxes(a, 0, 1)
+        n_frames, frame_length = a.shape[-2], a.shape[-1]
+        total = frame_length + hop_length * (n_frames - 1)
+        lead = a.shape[:-2]
+        flat = a.reshape((-1, n_frames, frame_length))
+        out = jnp.zeros((flat.shape[0], total), flat.dtype)
+        pos = (hop_length * jnp.arange(n_frames)[:, None]
+               + jnp.arange(frame_length)[None, :])
+        out = out.at[:, pos].add(flat)
+        out = out.reshape(lead + (total,))
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return eager_apply("overlap_add", raw, [t])
+
+
+def _prepare_window(window, win_length: int, n_fft: int):
+    """Build/center-pad the analysis window ON THE CPU DEVICE (the
+    frames it multiplies are CPU-committed; a TPU-committed window
+    would be a committed-device mismatch)."""
+    cpu = jax.devices("cpu")[0]
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = window._data if hasattr(window, "_data") \
+            else jnp.asarray(window)
+    if win_length > n_fft:
+        raise ValueError(f"win_length {win_length} > n_fft {n_fft}")
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    return jax.device_put(win, cpu)
+
+
+def stft(x, n_fft: int, hop_length=None, win_length=None, window=None,
+         center: bool = True, pad_mode: str = "reflect",
+         normalized: bool = False, onesided: bool = True, name=None):
+    """Short-time Fourier transform (reference: signal.py stft:246).
+    x: [..., T] real → [..., n_fft//2+1 (onesided), n_frames] complex."""
+    from .fft import to_cpu_op
+
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = _prepare_window(window, win_length, n_fft)
+
+    (t,) = as_tensor_args(x)
+    t = to_cpu_op(t)
+
+    def raw(sig):
+        if center:
+            pad = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            sig = jnp.pad(sig, pad, mode=pad_mode)
+        n = sig.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(n_frames)[:, None])
+        frames = sig[..., idx] * win
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., bins, frames]
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        return eager_apply("stft", raw, [t])
+
+
+def istft(x, n_fft: int, hop_length=None, win_length=None, window=None,
+          center: bool = True, normalized: bool = False,
+          onesided: bool = True, length=None, return_complex: bool = False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (reference:
+    signal.py istft:423)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = _prepare_window(window, win_length, n_fft)
+
+    (t,) = as_tensor_args(x)
+
+    def raw(spec):
+        spec = jnp.swapaxes(spec, -1, -2)  # [..., frames, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(n_fft)
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win
+        n_frames = frames.shape[-2]
+        total = n_fft + hop_length * (n_frames - 1)
+        lead = frames.shape[:-2]
+        flat = frames.reshape((-1, n_frames, n_fft))
+        pos = (hop_length * jnp.arange(n_frames)[:, None]
+               + jnp.arange(n_fft)[None, :])
+        out = jnp.zeros((flat.shape[0], total), flat.dtype)
+        out = out.at[:, pos].add(flat)
+        # window-envelope normalization (COLA correction)
+        env = jnp.zeros((total,), win.dtype)
+        env = env.at[pos.reshape(-1)].add(
+            jnp.tile(win * win, n_frames))
+        out = out / jnp.maximum(env, 1e-10)
+        out = out.reshape(lead + (total,))
+        if center:
+            out = out[..., n_fft // 2: total - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        return eager_apply("istft", raw, [t])

@@ -1,0 +1,145 @@
+"""paddle.signal + incubate optimizers tests (reference:
+test/legacy_test/test_stft_op.py, test_lookahead.py,
+test_modelaverage.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.audio.functional import get_window
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 1000).astype(np.float32)
+        fr = paddle.signal.frame(paddle.to_tensor(x), 100, 100)
+        assert fr.shape == [2, 10, 100]
+        back = paddle.signal.overlap_add(fr, 100)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-6)
+
+    def test_overlapping_frames(self):
+        x = paddle.to_tensor(np.arange(10, dtype=np.float32))
+        fr = paddle.signal.frame(x, 4, 2)
+        assert fr.shape == [4, 4]
+        np.testing.assert_allclose(fr.numpy()[1], [2, 3, 4, 5])
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 1024).astype(np.float32)
+        win = get_window("hann", 256)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=256,
+                                  window=win)
+        assert spec.shape == [2, 129, 17]  # onesided bins, frames
+        rec = paddle.signal.istft(spec, n_fft=256, window=win,
+                                  length=1024)
+        np.testing.assert_allclose(rec.numpy(), x, atol=1e-4)
+
+    def test_stft_tone_peak(self):
+        sr, f, n_fft = 8000, 1000.0, 256
+        t = np.arange(2048) / sr
+        x = np.sin(2 * np.pi * f * t).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=n_fft,
+                                  window=get_window("hann", n_fft))
+        mag = np.abs(np.asarray(spec.numpy())).mean(axis=-1)
+        assert abs(int(mag.argmax()) - round(f / (sr / n_fft))) <= 1
+
+
+class TestIncubateOptimizers:
+    def _problem(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(64, 4).astype(np.float32)
+        w = rng.randn(4, 1).astype(np.float32)
+        ys = xs @ w
+        model = nn.Linear(4, 1)
+        return model, paddle.to_tensor(xs), paddle.to_tensor(ys)
+
+    def test_lookahead_converges(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate.optimizer import LookAhead
+
+        model, x, y = self._problem()
+        inner = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=5)
+        losses = []
+        for _ in range(60):
+            loss = F.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.05
+
+    def test_model_average_apply_restore(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate.optimizer import ModelAverage
+
+        model, x, y = self._problem()
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        avg = ModelAverage(parameters=model.parameters())
+        snapshots = []
+        for _ in range(10):
+            loss = F.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            avg.step()
+            snapshots.append(model.weight.numpy().copy())
+        live = model.weight.numpy().copy()
+        avg.apply()
+        np.testing.assert_allclose(model.weight.numpy(),
+                                   np.mean(snapshots, axis=0), rtol=1e-5)
+        avg.restore()
+        np.testing.assert_allclose(model.weight.numpy(), live)
+
+    def test_model_average_window_rollover(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate.optimizer import ModelAverage
+
+        paddle.seed(1)
+        p = nn.Linear(2, 1, bias_attr=False)
+        avg = ModelAverage(parameters=p.parameters(),
+                           min_average_window=3, max_average_window=3)
+        vals = []
+        for i in range(9):
+            p.weight.set_value(paddle.to_tensor(
+                np.full((2, 1), float(i), np.float32)))
+            avg.step()
+            vals.append(float(i))
+        avg.apply()
+        # windows of 3: average spans at most the last two windows
+        # (values 3..8), NOT the stale 0..2
+        got = float(p.weight.numpy()[0, 0])
+        np.testing.assert_allclose(got, np.mean(vals[3:]), rtol=1e-5)
+        avg.restore()
+
+    def test_model_average_need_restore_false(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate.optimizer import ModelAverage
+
+        paddle.seed(2)
+        p = nn.Linear(2, 1, bias_attr=False)
+        avg = ModelAverage(parameters=p.parameters())
+        avg.step()
+        applied = None
+        avg.apply(need_restore=False)
+        applied = p.weight.numpy().copy()
+        avg.restore()  # must be a no-op
+        np.testing.assert_allclose(p.weight.numpy(), applied)
+
+    def test_lookahead_first_sync_moves_toward_init(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate.optimizer import LookAhead
+
+        paddle.seed(3)
+        p = nn.Linear(2, 1, bias_attr=False)
+        init = p.weight.numpy().copy()
+        inner = paddle.optimizer.SGD(0.0, parameters=p.parameters())
+        la = LookAhead(inner, alpha=0.5, k=1)
+        # manually move fast weights, then one sync
+        p.weight.set_value(paddle.to_tensor(init + 2.0))
+        la.step()
+        # slow = init + 0.5*(fast - init) = init + 1
+        np.testing.assert_allclose(p.weight.numpy(), init + 1.0,
+                                   rtol=1e-5)
