@@ -12,11 +12,11 @@ __all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
 
 
 def _frame(x, frame_length: int, hop_length: int):
-    """[..., T] -> [..., n_frames, frame_length] (strided framing)."""
-    n_frames = 1 + (x.shape[-1] - frame_length) // hop_length
-    idx = (jnp.arange(frame_length)[None, :]
-           + hop_length * jnp.arange(n_frames)[:, None])
-    return x[..., idx]
+    """[..., T] -> [..., n_frames, frame_length] (delegates to the
+    shared strided-framing helper in paddle_tpu.signal)."""
+    from ..signal import _frame_raw
+
+    return _frame_raw(x, frame_length, hop_length)
 
 
 class Spectrogram(Layer):

@@ -68,6 +68,8 @@ class LookAhead:
         self.inner_optimizer.clear_grad()
 
     def __getattr__(self, item):
+        if item == "inner_optimizer":  # pickle/copy before __init__
+            raise AttributeError(item)
         return getattr(self.inner_optimizer, item)
 
 
@@ -146,9 +148,13 @@ class ModelAverage:
 
     def restore(self, executor=None):
         """Swap the live training weights back (reference: restore:283).
-        No-op after apply(need_restore=False) — those weights are
-        permanent."""
-        if not self._applied or not self._need_restore:
+        After apply(need_restore=False) the averaged weights are
+        permanent: restore() only clears the applied state."""
+        if not self._applied:
+            return
+        if not self._need_restore:
+            self._backup.clear()
+            self._applied = False
             return
         for p in self._parameters:
             p._rebind(self._backup[id(p)])

@@ -16,6 +16,33 @@ from .ops.dispatch import as_tensor_args, eager_apply
 __all__ = ["frame", "overlap_add", "stft", "istft"]
 
 
+def _frame_raw(a, frame_length: int, hop_length: int):
+    """[..., T] -> [..., n_frames, frame_length] strided frames (shared
+    by frame/stft and audio.features)."""
+    n = a.shape[-1]
+    if frame_length > n:
+        raise ValueError(f"frame_length {frame_length} > signal "
+                         f"length {n}")
+    n_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(n_frames)[:, None])
+    return a[..., idx]
+
+
+def _overlap_add_raw(frames, hop_length: int):
+    """[..., n_frames, L] -> [..., L + hop*(n_frames-1)] scatter-add
+    (shared by overlap_add and istft)."""
+    n_frames, frame_length = frames.shape[-2], frames.shape[-1]
+    total = frame_length + hop_length * (n_frames - 1)
+    lead = frames.shape[:-2]
+    flat = frames.reshape((-1, n_frames, frame_length))
+    pos = (hop_length * jnp.arange(n_frames)[:, None]
+           + jnp.arange(frame_length)[None, :])
+    out = jnp.zeros((flat.shape[0], total), flat.dtype)
+    out = out.at[:, pos].add(flat)
+    return out.reshape(lead + (total,)), pos
+
+
 def frame(x, frame_length: int, hop_length: int, axis: int = -1,
           name=None):
     """Slide overlapping frames of ``frame_length`` every ``hop_length``
@@ -31,14 +58,7 @@ def frame(x, frame_length: int, hop_length: int, axis: int = -1,
         move = axis == 0 and a.ndim > 1
         if move:
             a = jnp.moveaxis(a, 0, -1)
-        n = a.shape[-1]
-        if frame_length > n:
-            raise ValueError(f"frame_length {frame_length} > signal "
-                             f"length {n}")
-        n_frames = 1 + (n - frame_length) // hop_length
-        idx = (jnp.arange(frame_length)[None, :]
-               + hop_length * jnp.arange(n_frames)[:, None])
-        out = a[..., idx]                      # [..., F, L]
+        out = _frame_raw(a, frame_length, hop_length)  # [..., F, L]
         if axis == 0:
             out = jnp.moveaxis(out, (-2, -1), (1, 0)) if a.ndim > 1 \
                 else jnp.swapaxes(out, -1, -2)
@@ -50,6 +70,8 @@ def frame(x, frame_length: int, hop_length: int, axis: int = -1,
 def overlap_add(x, hop_length: int, axis: int = -1, name=None):
     """Inverse of frame: sum overlapping frames (reference:
     signal.py overlap_add:145). axis=-1: [..., F, L] → [..., T]."""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
     (t,) = as_tensor_args(x)
 
     def raw(a):
@@ -58,15 +80,7 @@ def overlap_add(x, hop_length: int, axis: int = -1, name=None):
         if axis == 0:
             a = jnp.moveaxis(a, (0, 1), (-1, -2)) if a.ndim > 2 \
                 else jnp.swapaxes(a, 0, 1)
-        n_frames, frame_length = a.shape[-2], a.shape[-1]
-        total = frame_length + hop_length * (n_frames - 1)
-        lead = a.shape[:-2]
-        flat = a.reshape((-1, n_frames, frame_length))
-        out = jnp.zeros((flat.shape[0], total), flat.dtype)
-        pos = (hop_length * jnp.arange(n_frames)[:, None]
-               + jnp.arange(frame_length)[None, :])
-        out = out.at[:, pos].add(flat)
-        out = out.reshape(lead + (total,))
+        out, _ = _overlap_add_raw(a, hop_length)
         if axis == 0:
             out = jnp.moveaxis(out, -1, 0)
         return out
@@ -110,11 +124,7 @@ def stft(x, n_fft: int, hop_length=None, win_length=None, window=None,
         if center:
             pad = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
             sig = jnp.pad(sig, pad, mode=pad_mode)
-        n = sig.shape[-1]
-        n_frames = 1 + (n - n_fft) // hop_length
-        idx = (jnp.arange(n_fft)[None, :]
-               + hop_length * jnp.arange(n_frames)[:, None])
-        frames = sig[..., idx] * win
+        frames = _frame_raw(sig, n_fft, hop_length) * win
         spec = jnp.fft.rfft(frames, axis=-1) if onesided \
             else jnp.fft.fft(frames, axis=-1)
         if normalized:
@@ -133,6 +143,10 @@ def istft(x, n_fft: int, hop_length=None, win_length=None, window=None,
     signal.py istft:423)."""
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    if onesided and return_complex:
+        raise ValueError("return_complex=True requires onesided=False "
+                         "(a onesided spectrum reconstructs a real "
+                         "signal; reference istft errors likewise)")
     win = _prepare_window(window, win_length, n_fft)
 
     (t,) = as_tensor_args(x)
@@ -149,19 +163,13 @@ def istft(x, n_fft: int, hop_length=None, win_length=None, window=None,
                 frames = frames.real
         frames = frames * win
         n_frames = frames.shape[-2]
-        total = n_fft + hop_length * (n_frames - 1)
-        lead = frames.shape[:-2]
-        flat = frames.reshape((-1, n_frames, n_fft))
-        pos = (hop_length * jnp.arange(n_frames)[:, None]
-               + jnp.arange(n_fft)[None, :])
-        out = jnp.zeros((flat.shape[0], total), flat.dtype)
-        out = out.at[:, pos].add(flat)
+        out, pos = _overlap_add_raw(frames, hop_length)
+        total = out.shape[-1]
         # window-envelope normalization (COLA correction)
         env = jnp.zeros((total,), win.dtype)
         env = env.at[pos.reshape(-1)].add(
             jnp.tile(win * win, n_frames))
         out = out / jnp.maximum(env, 1e-10)
-        out = out.reshape(lead + (total,))
         if center:
             out = out[..., n_fft // 2: total - n_fft // 2]
         if length is not None:
