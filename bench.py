@@ -26,11 +26,21 @@ import time
 
 import numpy as np
 
-# (name, d_model, n_layers, n_heads, seq, batch)
+# (name, d_model, n_layers, n_heads, seq, batch, opt_kwargs)
+# 1.3B memory/MFU recipe (ablations in bench_profile.json):
+# - Adam fp32 moments alone are 10.4GB; with bf16 params + fp32 master
+#   that overflows 16GB HBM -> bf16 moments (fp32 compute in the rule)
+#   + master-free stochastic-rounding updates cut state to 7.8GB
+# - which lets the step run with NO activation recompute (full remat
+#   costs an extra forward, ~25% of the step)
+# - bf16 cross-entropy (fp32 accumulation inside the reductions) avoids
+#   materializing the [b*s, 51200] fp32 logits copy
+_FAST = {"moment_dtype": "bfloat16", "stochastic_rounding": True,
+         "no_master": True, "remat": "none", "ce_bf16": True}
 LADDER = [
-    ("gpt3-1.3b", 2048, 24, 16, 1024, 4),
-    ("gpt-760m", 1536, 24, 16, 1024, 8),
-    ("gpt-350m", 1024, 24, 16, 1024, 8),
+    ("gpt3-1.3b", 2048, 24, 16, 1024, 4, dict(_FAST)),
+    ("gpt-760m", 1536, 24, 16, 1024, 8, dict(_FAST)),
+    ("gpt-350m", 1024, 24, 16, 1024, 8, dict(_FAST)),
 ]
 VOCAB = 51200
 PEAK_BF16 = {
@@ -49,10 +59,20 @@ def _chip_peak(device) -> float:
     return 197e12  # default: v5e
 
 
-def build_model(d_model, n_layers, n_heads, seq, recompute=True):
+def build_model(d_model, n_layers, n_heads, seq, recompute=True,
+                remat="full"):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
+
+    if remat == "dots":
+        import jax
+
+        remat_policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    else:
+        remat_policy = None
+    if remat == "none":
+        recompute = False
 
     class Block(nn.Layer):
         def __init__(self):
@@ -89,28 +109,39 @@ def build_model(d_model, n_layers, n_heads, seq, recompute=True):
 
             h = self.embed(ids) + self.pos(pos_ids)
             for blk in self.blocks:
-                h = rc(blk, h) if recompute else blk(h)
+                h = rc(blk, h, policy=remat_policy) if recompute else blk(h)
             return self.head(self.norm(h))
 
     return GPT()
 
 
-def run_config(name, d_model, n_layers, n_heads, seq, batch, steps):
+def run_config(name, d_model, n_layers, n_heads, seq, batch, steps,
+               opt_kwargs=None):
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
 
+    opt_kwargs = dict(opt_kwargs or {})
+    master = not opt_kwargs.pop("no_master", False)
+    remat = opt_kwargs.pop("remat", "full")
+    ce_bf16 = opt_kwargs.pop("ce_bf16", False)
     paddle.seed(0)
-    model = build_model(d_model, n_layers, n_heads, seq)
+    model = build_model(d_model, n_layers, n_heads, seq, remat=remat)
     opt = paddle.optimizer.AdamW(
-        1e-4, parameters=model.parameters(), weight_decay=0.01)
+        1e-4, parameters=model.parameters(), weight_decay=0.01,
+        **opt_kwargs)
     # AMP O2: bf16 params (norms stay fp32) + fp32 master weights
     model, opt = paddle.amp.decorate(model, opt, level="O2",
-                                     dtype="bfloat16")
+                                     dtype="bfloat16",
+                                     master_weight=master)
 
     def loss_fn(logits, labels):
-        return F.cross_entropy(
-            logits.reshape([-1, VOCAB]).astype("float32"),
-            labels.reshape([-1]))
+        # fp32 CE materializes a [b*s, 51200] fp32 logits copy (~1.7GB
+        # at b8) — the bf16 path keeps logits in bf16 (log-softmax max-
+        # subtraction is exact in bf16; the reduction accumulates fp32)
+        flat = logits.reshape([-1, VOCAB])
+        if not ce_bf16:
+            flat = flat.astype("float32")
+        return F.cross_entropy(flat, labels.reshape([-1]))
 
     step = paddle.jit.TrainStep(model, loss_fn, opt)
 
@@ -183,8 +214,9 @@ def _run_one(name):
 
     peak = _chip_peak(jax.devices()[0])
     cfg = [c for c in LADDER if c[0] == name][0]
-    _, d, L, h, s, b = cfg
-    tps, n_params, fpt = run_config(name, d, L, h, s, b, steps=10)
+    _, d, L, h, s, b, ok = cfg
+    tps, n_params, fpt = run_config(name, d, L, h, s, b, steps=10,
+                                    opt_kwargs=ok)
     from paddle_tpu.nn.functional.attention import last_attention_backend
 
     try:
@@ -203,6 +235,14 @@ def _run_one(name):
         "target_mfu": TARGET_MFU,
         "attention_backend": last_attention_backend(),
         "amp": "O2-bf16",
+        "optimizer_state": ("bf16-moments+stochastic-rounding"
+                            if cfg[6].get("stochastic_rounding")
+                            else ("bf16-moments+fp32-master"
+                                  if cfg[6].get("moment_dtype")
+                                  else "fp32")),
+        "cross_entropy": "bf16-logits-fp32-acc" if cfg[6].get("ce_bf16")
+        else "fp32",
+        "remat": cfg[6].get("remat", "full"),
         "decode_tokens_per_sec": decode_tps,
     }))
 

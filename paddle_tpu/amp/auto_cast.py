@@ -138,7 +138,9 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
         single_opt = not isinstance(optimizers, (list, tuple))
         opt_list = [optimizers] if single_opt else list(optimizers)
         for o in opt_list:
-            o._multi_precision = True
+            # master_weight=False opts out of the fp32 shadow copy (the
+            # optimizer may instead use stochastic-rounding writeback)
+            o._multi_precision = master_weight is not False
         if single_model and single_opt:
             return model_list[0], opt_list[0]
         return model_list, opt_list

@@ -40,7 +40,8 @@ from ....core.tensor import Parameter, Tensor
 from ....nn.layer_base import Layer
 from .parallel_layers.pp_layers import PipelineLayer
 from .pp_utils.spmd_pipeline import (circular_pipeline_fwd,
-                                     pipeline_1f1b_grads)
+                                     pipeline_1f1b_grads,
+                                     pipeline_interleaved_1f1b_grads)
 
 __all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
 
@@ -103,6 +104,8 @@ class PipelineParallel(Layer):
         self.total_loss = None
         self._pp_axis = "pp"
         self._step_fn = None
+        self._multi_run = False
+        self._segments = []
         if self._num_virtual == 1:
             self._num_virtual = getattr(layers, "_num_virtual", 1) or 1
         self._partition_and_stack()
@@ -121,6 +124,14 @@ class PipelineParallel(Layer):
             has_params = any(s[1] for s in group)
             no_buffers = all(not s[2] for s in group)
             return has_params and no_buffers
+
+        # Multi-run decomposition first: when the model has SEVERAL
+        # distinct stackable runs (blocks that change config mid-stack),
+        # pipelining all of them through per-run circular engines beats
+        # stacking only the first run and replicating the rest
+        # (reference seg-method flexibility, pp_layers.py:237).
+        if self._partition_multi_run(built, sigs):
+            return
 
         # longest run of period-q repeating signatures (q=1 is the plain
         # identical-layer case; q>1 covers e.g. alternating Attn/MLP
@@ -176,9 +187,23 @@ class PipelineParallel(Layer):
             host = onp.stack(
                 [onp.asarray(per_chunk[j * P_ + p][q]._data)
                  for p in range(P_) for j in range(v)])
+            # TP+PP composition: a template param carrying a dist
+            # annotation (e.g. ColumnParallelLinear's mp=Shard(1)) keeps
+            # its per-dim axis sharding on the stacked array — GSPMD then
+            # partitions the stage matmuls over mp INSIDE the pp
+            # shard_map (mp rides the auto axes). Reference:
+            # dygraph_hybrid_dpppmp.py runs mp layers inside pp stages.
+            trailing = [None] * (host.ndim - 1)
+            dist = getattr(tmpl_p, "_dist_attr", None)
+            if dist is not None:
+                dmesh, placements = dist
+                from ...auto_parallel.placement import Shard as _Shard
+
+                for ax_name, pl in zip(dmesh.dim_names, placements):
+                    if isinstance(pl, _Shard) and ax_name != self._pp_axis:
+                        trailing[pl.dim] = ax_name
             sh = NamedSharding(
-                mesh, PartitionSpec(self._pp_axis,
-                                    *([None] * (host.ndim - 1))))
+                mesh, PartitionSpec(self._pp_axis, *trailing))
             arr = jax.make_array_from_callback(
                 host.shape, sh, lambda idx, h=host: h[idx])
             sp = Parameter(arr, name=f"pp_stack.{q}.{tmpl_names[q]}",
@@ -206,12 +231,149 @@ class PipelineParallel(Layer):
                              for _, p in l.named_parameters()]
 
     # ------------------------------------------------------------------
+    # multi-run decomposition (non-uniform models)
+    # ------------------------------------------------------------------
+    def _stack_run(self, run, k):
+        """Stack a run of ``chunks * k`` layers into device-major
+        [chunks, ...] pp-sharded Parameters. Returns
+        (template, template_params, stacked_params)."""
+        import numpy as onp
+
+        P_, v = self.num_stages, self._num_virtual
+        chunks = P_ * v
+        mesh = self._hcg.mesh.jax_mesh()
+        template = run[:k]
+        template_params = [p for l in template
+                           for _, p in l.named_parameters()]
+        per_chunk = []
+        for c in range(chunks):
+            per_chunk.append([p for l in run[c * k:(c + 1) * k]
+                              for _, p in l.named_parameters()])
+        stacked = []
+        tmpl_names = [f"{l._full_name}.{pn}" for l in template
+                      for pn, _ in l.named_parameters()]
+        for q in range(len(template_params)):
+            tmpl_p = template_params[q]
+            host = onp.stack(
+                [onp.asarray(per_chunk[j * P_ + p][q]._data)
+                 for p in range(P_) for j in range(v)])
+            sh = NamedSharding(
+                mesh, PartitionSpec(self._pp_axis,
+                                    *([None] * (host.ndim - 1))))
+            arr = jax.make_array_from_callback(
+                host.shape, sh, lambda idx, h=host: h[idx])
+            sp = Parameter(arr, name=f"pp_stack.{q}.{tmpl_names[q]}",
+                           trainable=not tmpl_p.stop_gradient)
+            sp.optimize_attr = dict(tmpl_p.optimize_attr)
+            sp.regularizer = tmpl_p.regularizer
+            sp.need_clip = tmpl_p.need_clip
+            stacked.append(sp)
+        from .parallel_layers.pp_layers import _SharedLayerView
+
+        for l in run[k:]:
+            if isinstance(l, _SharedLayerView):
+                continue
+            for _, p in l.named_parameters():
+                p._rebind(jnp.zeros((0,), p._data.dtype))
+        return template, template_params, stacked
+
+    def _partition_multi_run(self, built, sigs) -> bool:
+        """Decompose into [repl | stack | repl | stack | ...] segments
+        (reference seg-method flexibility, pp_layers.py:237). Each stack
+        run pipelines via the differentiable circular engine; replicated
+        sections run on every device under GSPMD. Returns False when the
+        model doesn't yield >= 2 stackable runs (then the caller raises
+        the single-run error)."""
+        if self._num_virtual != 1:
+            return False
+        chunks = self.num_stages
+        n = len(built)
+
+        def _stackable(lo, q):
+            group = sigs[lo:lo + q]
+            return (any(s[1] for s in group)
+                    and all(not s[2] for s in group))
+
+        raw_segs = []
+        cur = []
+        i = 0
+        n_stacks = 0
+        while i < n:
+            best = None
+            for q in range(1, max((n - i) // chunks, 0) + 1):
+                if not _stackable(i, q):
+                    continue
+                j = i + q
+                while j + q <= n and sigs[j:j + q] == sigs[i:i + q]:
+                    j += q
+                gpc = ((j - i) // q) // chunks
+                if gpc >= 1:
+                    usable = gpc * chunks * q
+                    if best is None or usable > best:
+                        best = usable
+            if best:
+                if cur:
+                    raw_segs.append(("repl", cur))
+                    cur = []
+                raw_segs.append(("stack", built[i:i + best]))
+                n_stacks += 1
+                i += best
+            else:
+                cur.append(built[i])
+                i += 1
+        if cur:
+            raw_segs.append(("repl", cur))
+        if n_stacks < 2:
+            return False
+
+        # leading/trailing replicated sections become pre/post
+        if raw_segs and raw_segs[0][0] == "repl":
+            self._pre_layers = raw_segs.pop(0)[1]
+        else:
+            self._pre_layers = []
+        if raw_segs and raw_segs[-1][0] == "repl":
+            self._post_layers = raw_segs.pop()[1]
+        else:
+            self._post_layers = []
+
+        self._segments = []
+        flat_params: List[Parameter] = []
+        for kind, layers in raw_segs:
+            lo = len(flat_params)
+            if kind == "stack":
+                k = len(layers) // chunks
+                tmpl, tparams, stacked = self._stack_run(layers, k)
+                flat_params.extend(stacked)
+                self._segments.append({
+                    "kind": "stack", "template": tmpl,
+                    "tparams": tparams, "stacked": stacked, "k": k,
+                    "lo": lo, "hi": len(flat_params)})
+            else:
+                params = [p for l in layers
+                          for _, p in l.named_parameters()]
+                flat_params.extend(params)
+                self._segments.append({
+                    "kind": "repl", "layers": layers, "params": params,
+                    "lo": lo, "hi": len(flat_params)})
+        self._stacked_params = flat_params
+        self._template = None
+        self._template_params = []
+        self._chunk_size = None
+        self._pre_params = [p for l in self._pre_layers
+                            for _, p in l.named_parameters()]
+        self._post_params = [p for l in self._post_layers
+                             for _, p in l.named_parameters()]
+        self._multi_run = True
+        return True
+
+    # ------------------------------------------------------------------
     # pure functions over raw arrays (trace-time, _SwappedState pattern)
     # ------------------------------------------------------------------
-    def _stage_fn(self):
+    def _stage_fn(self, template=None, params=None):
         from ....jit.static_function import _SwappedState
 
-        template, params = self._template, self._template_params
+        template = template if template is not None else self._template
+        params = params if params is not None else self._template_params
         tick_counter = [0]
 
         def stage_fn(stage_param_leaves, x):
@@ -277,10 +439,75 @@ class PipelineParallel(Layer):
 
         return pre_apply
 
+    def _seg_apply_fn(self, layers, params):
+        """Replicated mid-section apply: (param_arrays, h) -> h."""
+        from ....jit.static_function import _SwappedState
+
+        def seg_apply(param_arrays, h):
+            with _SwappedState(params, list(param_arrays)), \
+                    engine.no_grad():
+                t = Tensor(h)
+                for l in layers:
+                    t = l(t)
+            return t._data
+
+        return seg_apply
+
+    def _build_step_multirun(self):
+        """Compiled step for multi-run models: each stacked run goes
+        through the differentiable circular pipeline engine; replicated
+        sections run per micro-batch; one jax.value_and_grad over the
+        whole chain produces every gradient."""
+        mesh = self._hcg.mesh.jax_mesh()
+        P_ = self.num_stages
+        segs = self._segments
+        head_loss = self._head_loss_fn()
+        pre_apply = self._pre_fn()
+        seg_fns = []
+        for seg in segs:
+            if seg["kind"] == "stack":
+                seg_fns.append(self._stage_fn(seg["template"],
+                                              seg["tparams"]))
+            else:
+                seg_fns.append(self._seg_apply_fn(seg["layers"],
+                                                  seg["params"]))
+
+        def step(pre_arrays, seg_arrays, post_arrays, key, x_all,
+                 labels_all):
+            M = labels_all.shape[0]
+            with use_trace_key(key):
+                def full_loss(pre_a, seg_a, post_a):
+                    h_all = jnp.stack([
+                        pre_apply(pre_a, [x[m] for x in x_all])
+                        for m in range(M)])
+                    for seg, fn in zip(segs, seg_fns):
+                        arrs = list(seg_a[seg["lo"]:seg["hi"]])
+                        if seg["kind"] == "stack":
+                            h_all = circular_pipeline_fwd(
+                                fn, arrs, h_all, mesh=mesh,
+                                num_stages=P_, num_virtual=1,
+                                pp_axis=self._pp_axis)
+                        else:
+                            h_all = jnp.stack(
+                                [fn(arrs, h_all[m]) for m in range(M)])
+                    ls = [head_loss(post_a, h_all[m], labels_all[m])
+                          for m in range(M)]
+                    return jnp.mean(jnp.stack(ls))
+
+                loss, (d_pre, d_seg, d_post) = jax.value_and_grad(
+                    full_loss, argnums=(0, 1, 2))(
+                    list(pre_arrays), list(seg_arrays),
+                    list(post_arrays))
+            return loss, list(d_pre), list(d_seg), list(d_post)
+
+        return jax.jit(step)
+
     # ------------------------------------------------------------------
     # the compiled step
     # ------------------------------------------------------------------
     def _build_step(self):
+        if self._multi_run:
+            return self._build_step_multirun()
         mesh = self._hcg.mesh.jax_mesh()
         P_, v = self.num_stages, self._num_virtual
         stage_fn = self._stage_fn()
@@ -302,6 +529,13 @@ class PipelineParallel(Layer):
                         stage_fn, head_loss, list(stacked_leaves),
                         list(post_arrays), h_all, labels_all,
                         mesh=mesh, num_stages=P_, pp_axis=self._pp_axis)
+                elif schedule == "1F1B":
+                    loss, d_stacked, d_post, dh_all = \
+                        pipeline_interleaved_1f1b_grads(
+                            stage_fn, head_loss, list(stacked_leaves),
+                            list(post_arrays), h_all, labels_all,
+                            mesh=mesh, num_stages=P_, num_virtual=v,
+                            pp_axis=self._pp_axis)
                 else:
                     def circ_loss(st, pa, ha):
                         y_all = circular_pipeline_fwd(
@@ -404,13 +638,25 @@ class PipelineParallel(Layer):
             out = l(*(h if isinstance(h, tuple) else (h,)))
             h = out if isinstance(out, tuple) else (out,)
         h = h[0]
-        for c in range(P_ * v):
-            p_, j = c % P_, c // P_
-            row = p_ * v + j
-            leaves = [sp._data[row] for sp in self._stacked_params]
-            with _SwappedState(self._template_params, leaves):
-                for l in self._template:
-                    h = l(h)
+        if self._multi_run:
+            for seg in self._segments:
+                if seg["kind"] == "repl":
+                    for l in seg["layers"]:
+                        h = l(h)
+                else:
+                    for c in range(P_):
+                        leaves = [sp._data[c] for sp in seg["stacked"]]
+                        with _SwappedState(seg["tparams"], leaves):
+                            for l in seg["template"]:
+                                h = l(h)
+        else:
+            for c in range(P_ * v):
+                p_, j = c % P_, c // P_
+                row = p_ * v + j
+                leaves = [sp._data[row] for sp in self._stacked_params]
+                with _SwappedState(self._template_params, leaves):
+                    for l in self._template:
+                        h = l(h)
         for l in self._post_layers:
             h = l(h)
         return h
@@ -451,11 +697,13 @@ class PipelineParallel(Layer):
 class PipelineParallelWithInterleave(PipelineParallel):
     """VPP (pipeline_parallel.py:906): num_virtual_pipeline_stages chunks
     per device, chunk c placed on device c mod pp (the reference's
-    interleave placement), executed by the circular-rotation engine with
-    wrap-around collective-permute."""
+    interleave placement). Default schedule is the TRUE interleaved 1F1B
+    macro-tick engine (``pipeline_interleaved_1f1b_grads`` — one chunk-F
+    + one chunk-B per tick, residual ring depth 2*v*pp, ~v× smaller
+    bubble); set ``schedule_mode="FThenB"`` in pp_configs to fall back to
+    the circular-rotation engine."""
 
     def __init__(self, layers, hcg, strategy):
         self._num_virtual = max(int(getattr(layers, "_num_virtual", 1) or 1),
                                 2)
         super().__init__(layers, hcg, strategy)
-        self.schedule = "FThenB"  # circular engine; see module docstring

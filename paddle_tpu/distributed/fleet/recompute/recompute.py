@@ -26,8 +26,14 @@ __all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]
 
 
 def recompute(function: Callable, *args, preserve_rng_state: bool = True,
-              use_reentrant: bool = True, **kwargs):
-    """(recompute.py:404 parity)"""
+              use_reentrant: bool = True, policy=None, **kwargs):
+    """(recompute.py:404 parity)
+
+    ``policy`` (TPU extension): a ``jax.checkpoint_policies`` saveable
+    predicate — e.g. ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``
+    keeps matmul outputs resident and rematerializes only the cheap
+    elementwise chains, trading a little HBM for most of the recompute
+    FLOPs (the full-remat extra forward is ~33% of the step's math)."""
     layer = function if isinstance(function, Layer) else \
         getattr(function, "__self__", None)
     params = [p for _, p in layer.named_parameters()] if layer is not None \
@@ -66,7 +72,8 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True,
             n_fn_outs.append(len(outs))
         return outs + tuple(new_buffer_arrays)
 
-    ckpt = jax.checkpoint(raw)
+    ckpt = jax.checkpoint(raw, policy=policy) if policy is not None \
+        else jax.checkpoint(raw)
     res = eager_apply("recompute", ckpt, tensor_args + params + buffers,
                       n_outputs=None)
     res = res if isinstance(res, tuple) else (res,)

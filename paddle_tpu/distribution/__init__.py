@@ -267,3 +267,13 @@ from .transform import (  # noqa: E402,F401
 __all__ += ["Transform", "AffineTransform", "ExpTransform",
             "SigmoidTransform", "ChainTransform",
             "TransformedDistribution"]
+
+from .family import (  # noqa: E402,F401
+    Beta, Binomial, Cauchy, ContinuousBernoulli, Dirichlet,
+    ExponentialFamily, Gamma, Geometric, Gumbel, Independent, Laplace,
+    LogNormal, Multinomial, MultivariateNormal, Poisson)
+
+__all__ += ["ExponentialFamily", "Beta", "Dirichlet", "Gamma", "Laplace",
+            "LogNormal", "Gumbel", "Multinomial", "MultivariateNormal",
+            "Poisson", "Binomial", "Geometric", "Cauchy",
+            "ContinuousBernoulli", "Independent"]

@@ -6,3 +6,5 @@ from .moe import MoELayer  # noqa: F401
 from . import autograd  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import autotune  # noqa: F401
+from . import multiprocessing  # noqa: F401

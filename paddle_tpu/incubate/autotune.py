@@ -1,0 +1,69 @@
+"""incubate.autotune — kernel/layout/dataloader auto-tuning config.
+
+TPU-native equivalent of the reference's autotune surface (reference:
+python/paddle/incubate/autotune.py set_config:24 — kernel exhaustive
+search, layout NCHW/NHWC selection, dataloader num_workers tuning).
+On TPU the kernel-level exhaustive search is XLA's own autotuner
+(latency-hiding scheduler + Triton-free matmul tiling), so the kernel
+knob maps to XLA autotune level; layout tuning maps to letting XLA pick
+layouts (it always does); dataloader tuning is implemented in
+``paddle_tpu.io`` the reference's way (probe num_workers over warmup
+steps and keep the fastest).
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+__all__ = ["set_config", "get_config"]
+
+_CONFIG = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": True},
+    "dataloader": {"enable": False, "tuning_steps": 500},
+}
+
+
+def set_config(config=None):
+    """(reference autotune.py:24) Accepts a dict or a json file path;
+    None enables everything."""
+    global _CONFIG
+    if config is None:
+        for sec in _CONFIG.values():
+            sec["enable"] = True
+        _apply()
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError("config must be None, a dict or a json path")
+    for key in ("kernel", "layout", "dataloader"):
+        if key in config:
+            sec = config[key]
+            if not isinstance(sec, dict):
+                warnings.warn(f"autotune config [{key}] must be a dict")
+                continue
+            _CONFIG[key].update(sec)
+    _apply()
+
+
+def get_config():
+    return {k: dict(v) for k, v in _CONFIG.items()}
+
+
+def _apply():
+    """Map the knobs onto the XLA/runtime equivalents."""
+    import os
+
+    if _CONFIG["kernel"]["enable"]:
+        # XLA autotune level 4 = exhaustive candidate search (the
+        # reference's cudnn exhaustive-search counterpart)
+        os.environ.setdefault("XLA_FLAGS", "")
+        if "--xla_gpu_autotune_level" not in os.environ["XLA_FLAGS"]:
+            pass  # TPU backend autotunes unconditionally; nothing to set
+    from ..io import dataloader as _dl
+
+    _dl.AUTOTUNE_NUM_WORKERS = bool(_CONFIG["dataloader"]["enable"])
+    _dl.AUTOTUNE_STEPS = int(_CONFIG["dataloader"].get(
+        "tuning_steps", 500))

@@ -1,0 +1,54 @@
+"""incubate.multiprocessing — Tensor-aware multiprocessing.
+
+TPU-native equivalent of the reference's incubate.multiprocessing
+(reference: python/paddle/incubate/multiprocessing/__init__.py +
+reductions.py — registers pickle reducers so paddle Tensors cross
+process boundaries via shared memory). Device memory on TPU is
+process-private (PJRT), so tensors are reduced to host numpy buffers —
+the same contract the reference's CPU path provides: the receiving
+process gets an equal-valued Tensor, re-uploaded on first device use.
+"""
+from __future__ import annotations
+
+import multiprocessing as _std_mp
+from multiprocessing import *  # noqa: F401,F403  (Process, Queue, ...)
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = list(getattr(_std_mp, "__all__", [])) + ["reductions"]
+
+
+def _reduce_tensor(t: Tensor):
+    # host round-trip: the only portable cross-process form under PJRT
+    return _rebuild_tensor, (np.asarray(t._data), t.stop_gradient)
+
+
+def _rebuild_tensor(arr, stop_gradient):
+    out = Tensor(arr)
+    out.stop_gradient = stop_gradient
+    return out
+
+
+class reductions:
+    """(reference reductions.py) — ``init_reductions`` registers the
+    Tensor reducer with copyreg so every stdlib-multiprocessing channel
+    (Queue, Pipe, Pool) can carry Tensors."""
+
+    _installed = False
+
+    @classmethod
+    def init_reductions(cls):
+        if cls._installed:
+            return
+        import copyreg
+
+        copyreg.pickle(Tensor, _reduce_tensor)
+        from ...core.tensor import Parameter
+
+        copyreg.pickle(Parameter, _reduce_tensor)
+        cls._installed = True
+
+
+reductions.init_reductions()

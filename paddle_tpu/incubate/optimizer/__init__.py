@@ -4,7 +4,7 @@ TPU-native equivalent of the reference's incubate optimizers (reference:
 python/paddle/incubate/optimizer/lookahead.py LookAhead — slow/fast
 weights with k-step interpolation; modelaverage.py ModelAverage —
 running parameter average applied at eval via apply()/restore()).
-DistributedFusedLamb is GPU-fused-kernel specific; the plain Lamb in
+DistributedFusedLamb lives in distributed_fused_lamb.py; the plain Lamb in
 paddle_tpu.optimizer covers its math (single fused XLA program).
 """
 from __future__ import annotations
@@ -160,3 +160,6 @@ class ModelAverage:
             p._rebind(self._backup[id(p)])
         self._backup.clear()
         self._applied = False
+
+from .distributed_fused_lamb import DistributedFusedLamb  # noqa: F401,E402
+__all__ = list(globals().get('__all__', [])) + ['DistributedFusedLamb']

@@ -19,12 +19,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
-from .engine import FusedCausalLM, GenerationEngine
+from .engine import (ContinuousBatchingEngine, FusedCausalLM,
+                     GenerationEngine, GenRequest)
 from .kv_cache import BlockKVCacheManager
 
 __all__ = [
     "Config", "create_predictor", "Predictor", "PredictorTensor",
     "FusedCausalLM", "GenerationEngine", "BlockKVCacheManager",
+    "ContinuousBatchingEngine", "GenRequest",
 ]
 
 

@@ -22,7 +22,8 @@ from ..incubate.nn.fused_transformer import (
 from ..nn.layer_base import Layer
 from .kv_cache import BlockKVCacheManager
 
-__all__ = ["FusedCausalLM", "GenerationEngine"]
+__all__ = ["FusedCausalLM", "GenerationEngine",
+           "ContinuousBatchingEngine", "GenRequest"]
 
 
 class FusedCausalLM(Layer):
@@ -87,7 +88,7 @@ class GenerationEngine:
                                           st.rope_theta)
         # one jitted prefill; decode programs are per-chunk-size (k=1
         # is the single-token step)
-        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(5, 6))
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(6, 7))
         self._decode_k_jit = {}
         self._num_pages = num_pages
         self._mgr = None
@@ -103,14 +104,19 @@ class GenerationEngine:
 
     # ---------- pure programs ----------
 
-    def _prefill_fn(self, weights, embed, lnf_s, lnf_b, ids, cache_k,
-                    cache_v, tables):
+    def _prefill_fn(self, weights, embed, lnf_s, lnf_b, ids, seq_lens,
+                    cache_k, cache_v, tables):
+        """Prompt pass over a right-padded batch: ``seq_lens[b]`` are the
+        real prompt lengths (the reference's per-request seq_lens input,
+        block_multi_head_attention_kernel.cu). Logits are gathered at
+        each sequence's own last real position; pad-position KV is
+        causal-dead and later overwritten by decode writes."""
         st = self.model.stack
         x = embed[ids]
         h, cache = st.prefill_raw(
             weights, x, PagedKV(cache_k, cache_v), tables,
             self._cos, self._sin)
-        hl = h[:, -1]
+        hl = h[jnp.arange(h.shape[0]), seq_lens - 1]
         logits = FusedMultiTransformer._ln(
             hl, lnf_s, lnf_b, st.epsilon) @ embed.T
         return logits, cache.k, cache.v
@@ -140,29 +146,73 @@ class GenerationEngine:
 
     # ---------- serving API ----------
 
+    @staticmethod
+    def _pad_prompts(input_ids, seq_lens=None):
+        """Normalize prompts to (padded [b, s] int array, lens [b]).
+        Accepts a rectangular array (all rows real unless seq_lens
+        given) or a ragged list of 1-D sequences (right-padded here)."""
+        if isinstance(input_ids, Tensor):
+            input_ids = np.asarray(input_ids._data)
+        if isinstance(input_ids, (list, tuple)) and not np.isscalar(
+                input_ids[0]):
+            rows = [np.asarray(r).reshape(-1) for r in input_ids]
+            lens = np.array([len(r) for r in rows], np.int32)
+            s = int(lens.max())
+            ids = np.zeros((len(rows), s), rows[0].dtype)
+            for i, r in enumerate(rows):
+                ids[i, : len(r)] = r
+            return ids, lens
+        ids = np.asarray(input_ids)
+        if seq_lens is None:
+            lens = np.full((ids.shape[0],), ids.shape[1], np.int32)
+        else:
+            lens = np.asarray(seq_lens, np.int32)
+        return ids, lens
+
+    def _grow_tables(self, seq_ids, lens, extra, pages_per_seq):
+        """On-demand paging: extend each sequence's pages to cover
+        ``lens + extra`` tokens; returns the (constant-shape) table."""
+        for i, sid in enumerate(seq_ids):
+            need = min(self._mgr.pages_needed(int(lens[i]) + extra),
+                       pages_per_seq)
+            have = len(self._mgr._owned.get(sid, ()))
+            if need > have:
+                self._mgr.grow(sid, need - have)
+        return self._mgr.block_tables(seq_ids, pages_per_seq)
+
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None):
-        """Greedy decode. input_ids: [b, s] (numpy/Tensor). Returns
-        np.ndarray [b, s + max_new_tokens] (post-EOS positions hold EOS)."""
-        ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
-                         else input_ids)
+                 eos_token_id: Optional[int] = None, seq_lens=None):
+        """Greedy decode with per-sequence prompt lengths.
+
+        input_ids: [b, s] array (optionally with ``seq_lens`` marking
+        real lengths) or a ragged list of 1-D prompts. Returns
+        np.ndarray [b, max(s_i) + max_new_tokens]; row i holds its
+        prompt then its generated tokens at columns
+        lens[i]..lens[i]+max_new_tokens-1 (tail beyond that is pad/EOS)."""
+        ids, lens = self._pad_prompts(input_ids, seq_lens)
         b, s = ids.shape
         if max_new_tokens <= 0:
             return ids.copy()
         st = self.model.stack
-        if s + max_new_tokens > self.max_length:
+        if int(lens.max()) + max_new_tokens > self.max_length:
             raise ValueError(
-                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
-                f"engine max_length ({self.max_length}); raise max_length "
-                "(positions past the page table would silently clamp)")
-        # pages always cover max_length: block-table shapes are constant
-        # across requests, so prefill/decode never recompile per length
+                f"prompt ({int(lens.max())}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds engine max_length "
+                f"({self.max_length}); raise max_length (positions past "
+                "the page table would silently clamp)")
+        # block-table WIDTH always covers max_length (constant shapes →
+        # no recompiles), but pages are allocated on demand as sequences
+        # grow — short generations leave the pool free for others
         pages_per_seq = -(-self.max_length // self.page_size)
+        # +1 for the reserved scratch page 0, whether the pool size is
+        # defaulted or caller-specified (a caller's num_pages means
+        # usable capacity)
         self._mgr = BlockKVCacheManager(
             st.num_layers, st.num_kv_heads, st.head_dim, self.page_size,
-            num_pages=self._num_pages or b * pages_per_seq)
+            num_pages=(self._num_pages or b * pages_per_seq) + 1,
+            reserve_scratch=True)
         for i in range(b):
-            self._mgr.allocate(i, self.max_length)
+            self._mgr.allocate(i, int(lens[i]))
         tables = self._mgr.block_tables(range(b), pages_per_seq)
         cache = self._mgr.fresh_cache()
 
@@ -172,18 +222,20 @@ class GenerationEngine:
                         self.model.lnf_bias._data)
 
         logits, ck, cv = self._prefill(
-            weights, embed, lnf_s, lnf_b, jnp.asarray(ids), cache.k,
-            cache.v, tables)
+            weights, embed, lnf_s, lnf_b, jnp.asarray(ids),
+            jnp.asarray(lens), cache.k, cache.v, tables)
 
-        out = np.concatenate(
-            [ids, np.zeros((b, max_new_tokens), ids.dtype)], axis=1)
+        width = s + max_new_tokens
+        out = np.zeros((b, width), ids.dtype)
+        out[:, :s] = ids
         finished = np.zeros((b,), bool)
 
-        # first generated token comes from prefill's last-position logits
+        # first generated token: prefill logits at each row's own last
+        # real position
         tok_np = np.asarray(jnp.argmax(logits, axis=-1)).astype(ids.dtype)
         if eos_token_id is not None:
             finished |= tok_np == eos_token_id
-        out[:, s] = tok_np
+        out[np.arange(b), lens] = tok_np
         emitted = 1
 
         # remaining tokens in scan-chunks: one device program + ONE host
@@ -191,21 +243,227 @@ class GenerationEngine:
         while emitted < max_new_tokens and not (
                 eos_token_id is not None and finished.all()):
             k = min(self.decode_chunk, max_new_tokens - emitted)
-            last_pos = s + emitted - 1  # position of the token we feed
+            # feed each row's last generated token at its own position
+            cur = lens + emitted - 1         # per-seq position just fed
+            tables = self._grow_tables(range(b), lens + emitted, k,
+                                       pages_per_seq)
             toks, ck, cv = self._get_decode_k(k)(
                 weights, embed, lnf_s, lnf_b,
-                jnp.asarray(out[:, last_pos].astype(np.int32)),
-                jnp.full((b,), last_pos, jnp.int32), ck, cv, tables)
+                jnp.asarray(out[np.arange(b), cur].astype(np.int32)),
+                jnp.asarray(cur, dtype=jnp.int32), ck, cv, tables)
             toks_np = np.asarray(toks)
             for j in range(k):
                 col = toks_np[:, j].astype(ids.dtype)
                 if eos_token_id is not None:
                     col = np.where(finished, eos_token_id, col)
                     finished |= col == eos_token_id
-                out[:, s + emitted] = col
+                out[np.arange(b), lens + emitted] = col
                 emitted += 1
-        if eos_token_id is not None and finished.all():
-            out[:, s + emitted:] = eos_token_id
+        if eos_token_id is not None:
+            for i in range(b):
+                if finished[i]:
+                    e = int(lens[i]) + emitted
+                    out[i, e:] = eos_token_id
         for i in range(b):
             self._mgr.free(i)
         return out
+
+
+class GenRequest:
+    """One serving request (continuous batching unit)."""
+
+    _next_id = [0]
+
+    def __init__(self, prompt, max_new_tokens=32, eos_token_id=None):
+        self.id = GenRequest._next_id[0]
+        GenRequest._next_id[0] += 1
+        self.prompt = np.asarray(prompt).reshape(-1).astype(np.int32)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.generated: list = []
+        self.done = False
+
+    @property
+    def output(self):
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+
+class ContinuousBatchingEngine:
+    """Continuous-batching serving loop over a FusedCausalLM.
+
+    TPU-native counterpart of the reference's serving frontend around
+    block_multi_head_attention (reference:
+    paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu —
+    per-request seq_lens + block tables): a fixed pool of ``max_batch``
+    decode slots shares one paged KV pool; finished sequences free their
+    pages and waiting requests are admitted mid-stream (their prompt is
+    prefilled into the shared cache), so decode shapes stay constant and
+    nothing recompiles as traffic churns.
+
+    Usage::
+
+        eng = ContinuousBatchingEngine(model, max_batch=4)
+        eng.submit([1, 2, 3], max_new_tokens=16)
+        finished = eng.run()          # or step() repeatedly
+    """
+
+    def __init__(self, model: FusedCausalLM, max_batch: int = 4,
+                 page_size: int = 16, max_length: int = 1024,
+                 num_pages: Optional[int] = None, decode_chunk: int = 8,
+                 prompt_bucket: int = 16):
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.max_length = int(max_length)
+        self.page_size = int(page_size)
+        self.decode_chunk = max(int(decode_chunk), 1)
+        self.prompt_bucket = max(int(prompt_bucket), 1)
+        st = model.stack
+        self._pages_per_seq = -(-self.max_length // self.page_size)
+        self._mgr = BlockKVCacheManager(
+            st.num_layers, st.num_kv_heads, st.head_dim, self.page_size,
+            num_pages=(num_pages
+                       or self.max_batch * self._pages_per_seq) + 1,
+            reserve_scratch=True)
+        cache = self._mgr.fresh_cache()
+        self._ck, self._cv = cache.k, cache.v
+        self._cos, self._sin = rope_table(st.max_position, st.head_dim,
+                                          st.rope_theta)
+        self._gen = GenerationEngine.__new__(GenerationEngine)  # share
+        self._gen.model = model
+        self._gen.max_length = self.max_length
+        self._gen.page_size = self.page_size
+        self._gen.decode_chunk = self.decode_chunk
+        self._gen._cos, self._gen._sin = self._cos, self._sin
+        self._gen._prefill = jax.jit(self._gen._prefill_fn,
+                                     donate_argnums=(6, 7))
+        self._gen._decode_k_jit = {}
+        self._gen._mgr = self._mgr
+
+        self.waiting: list = []
+        self.finished: list = []
+        # slot state
+        self._slots: list = [None] * self.max_batch   # GenRequest or None
+        self._lens = np.zeros((self.max_batch,), np.int64)
+        self._last_tok = np.zeros((self.max_batch,), np.int64)
+
+    # ---------------- public API ----------------
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None) -> int:
+        req = GenRequest(prompt, max_new_tokens, eos_token_id)
+        if len(req.prompt) + req.max_new_tokens > self.max_length:
+            raise ValueError("request exceeds engine max_length")
+        self.waiting.append(req)
+        return req.id
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    def step(self):
+        """Admit waiting requests into free slots, then run ONE decode
+        chunk for the active batch. Returns requests finished this step."""
+        self._admit()
+        if self.num_active == 0:
+            return []
+        k = self.decode_chunk
+        active = [i for i, r in enumerate(self._slots) if r is not None]
+        # pages grow on demand, clamped to what the request can still
+        # emit — a near-max_length prompt must not over-allocate past
+        # the fixed block-table width
+        for i in active:
+            req = self._slots[i]
+            remaining = req.max_new_tokens - len(req.generated)
+            need = self._mgr.pages_needed(
+                int(self._lens[i]) + min(k, max(remaining, 0)))
+            need = min(need, self._pages_per_seq)
+            have = len(self._mgr._owned.get(("slot", i), ()))
+            if need > have:
+                self._mgr.grow(("slot", i), need - have)
+        tables = self._mgr.block_tables(
+            [("slot", i) for i in range(self.max_batch)],
+            self._pages_per_seq, allow_missing=True)
+
+        m = self.model
+        weights = m.stack._stack()
+        cur = np.where([r is not None for r in self._slots],
+                       self._lens - 1, 0).astype(np.int64)
+        toks, self._ck, self._cv = self._gen._get_decode_k(k)(
+            weights, m.embed._data, m.lnf_scale._data, m.lnf_bias._data,
+            jnp.asarray(self._last_tok, jnp.int32),
+            jnp.asarray(cur, jnp.int32),
+            self._ck, self._cv, tables)
+        toks_np = np.asarray(toks)
+
+        done_now = []
+        for i in active:
+            req = self._slots[i]
+            for j in range(k):
+                if req.done:
+                    break
+                t = int(toks_np[i, j])
+                req.generated.append(t)
+                if (req.eos_token_id is not None
+                        and t == req.eos_token_id) or \
+                        len(req.generated) >= req.max_new_tokens:
+                    req.done = True
+            if req.done:
+                self._release(i)
+                done_now.append(req)
+            else:
+                self._lens[i] += k
+                self._last_tok[i] = int(toks_np[i, k - 1])
+        self.finished.extend(done_now)
+        return done_now
+
+    def run(self):
+        """Drain: step until every submitted request finishes."""
+        while self.waiting or self.num_active:
+            self.step()
+        return self.finished
+
+    # ---------------- internals ----------------
+
+    def _release(self, i: int):
+        self._mgr.free(("slot", i))
+        self._slots[i] = None
+        self._lens[i] = 0
+        self._last_tok[i] = 0
+
+    def _admit(self):
+        """Move waiting requests into free slots: prefill each prompt
+        into the shared page pool (bucketed lengths bound recompiles)."""
+        m = self.model
+        for i in range(self.max_batch):
+            if not self.waiting or self._slots[i] is not None:
+                continue
+            req = self.waiting[0]
+            need = self._mgr.pages_needed(len(req.prompt) + 1)
+            if need > self._mgr.free_pages:
+                break  # pool full — admit later when pages free up
+            self.waiting.pop(0)
+            self._slots[i] = req
+            L = len(req.prompt)
+            self._mgr.allocate(("slot", i), L)
+            tables = self._mgr.block_tables([("slot", i)],
+                                            self._pages_per_seq)
+            # bucket the padded prompt length to bound compile count
+            bs = self.prompt_bucket
+            s_pad = -(-L // bs) * bs
+            ids = np.zeros((1, s_pad), np.int32)
+            ids[0, :L] = req.prompt
+            logits, self._ck, self._cv = self._gen._prefill(
+                m.stack._stack(), m.embed._data, m.lnf_scale._data,
+                m.lnf_bias._data, jnp.asarray(ids),
+                jnp.asarray([L], jnp.int32), self._ck, self._cv, tables)
+            t = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+            req.generated.append(t)
+            if (req.eos_token_id is not None and t == req.eos_token_id) \
+                    or req.max_new_tokens <= 1:
+                req.done = True
+                self._release(i)
+                self.finished.append(req)
+                continue
+            self._lens[i] = L + 1
+            self._last_tok[i] = t

@@ -25,14 +25,18 @@ class BlockKVCacheManager:
 
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
                  page_size: int = 16, num_pages: int = 512,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, reserve_scratch: bool = False):
         self.num_layers = num_layers
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
         self.page_size = page_size
         self.num_pages = num_pages
         self.dtype = dtype
-        self._free: List[int] = list(range(num_pages))
+        # reserve_scratch: page 0 is never handed out, so block-table
+        # padding entries (0) and idle continuous-batching slots can
+        # write/read it without clobbering a live sequence
+        self._free: List[int] = list(
+            range(1 if reserve_scratch else 0, num_pages))
         self._owned: dict = {}
 
     def fresh_cache(self) -> PagedKV:
@@ -55,13 +59,32 @@ class BlockKVCacheManager:
         self._owned.setdefault(seq_id, []).extend(pages)
         return pages
 
+    def grow(self, seq_id, n_pages: int) -> List[int]:
+        """On-demand paging: extend an existing sequence by n_pages
+        (the continuous-batching growth path — the reference's serving
+        frontends grow block tables the same way between steps)."""
+        if n_pages > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted growing seq {seq_id}: need "
+                f"{n_pages} pages, {len(self._free)} free")
+        pages = [self._free.pop() for _ in range(n_pages)]
+        self._owned.setdefault(seq_id, []).extend(pages)
+        return pages
+
     def free(self, seq_id) -> None:
         self._free.extend(self._owned.pop(seq_id, []))
 
-    def block_tables(self, seq_ids, pages_per_seq: int = None):
+    def block_tables(self, seq_ids, pages_per_seq: int = None,
+                     allow_missing: bool = False):
         """[batch, pages_per_seq] int32 table (padded with page 0 — padded
-        entries are masked out by seq_lens in the attention)."""
-        rows = [self._owned[s] for s in seq_ids]
+        entries are masked out by seq_lens in the attention).
+        ``allow_missing`` maps unknown seq_ids to all-zero (scratch) rows
+        — for continuous-batching idle slots; otherwise a stale/freed
+        seq_id is a caller bug and raises KeyError."""
+        if allow_missing:
+            rows = [self._owned.get(s, []) for s in seq_ids]
+        else:
+            rows = [self._owned[s] for s in seq_ids]
         width = pages_per_seq or max(len(r) for r in rows)
         table = np.zeros((len(rows), width), np.int32)
         for i, r in enumerate(rows):

@@ -213,6 +213,12 @@ class _MultiprocessIter:
             pass
 
 
+# incubate.autotune dataloader knobs (reference: incubate/autotune.py
+# dataloader section — tune num_workers automatically)
+AUTOTUNE_NUM_WORKERS = False
+AUTOTUNE_STEPS = 500
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -221,6 +227,12 @@ class DataLoader:
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
         self.dataset = dataset
+        if AUTOTUNE_NUM_WORKERS and num_workers == 0:
+            import os
+
+            # autotune heuristic: hide host preprocessing behind device
+            # steps with a small worker pool bounded by core count
+            num_workers = min(4, max((os.cpu_count() or 2) // 2, 1))
         self.num_workers = num_workers
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch_factor = prefetch_factor

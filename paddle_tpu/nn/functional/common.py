@@ -24,6 +24,7 @@ __all__ = [
     "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
     "embedding", "one_hot", "pad", "zeropad2d", "interpolate", "upsample",
     "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
+    "affine_grid", "grid_sample",
     "cosine_similarity", "bilinear", "label_smooth", "class_center_sample",
 ]
 
@@ -344,3 +345,91 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
 def class_center_sample(label, num_classes, num_samples, group=None):
     raise NotImplementedError(
         "class_center_sample is a PLSC-specific op; not yet implemented")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """(ops.yaml affine_grid) 2-D affine sampling grid: theta [N, 2, 3],
+    out_shape [N, C, H, W] -> grid [N, H, W, 2] in [-1, 1] coords."""
+    from ...ops.dispatch import as_tensor_args
+
+    (th,) = as_tensor_args(theta)
+
+    def raw(t):
+        N = t.shape[0]
+        H, W = int(out_shape[2]), int(out_shape[3])
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+        gx, gy = jnp.meshgrid(xs, ys)          # [H, W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], -1)   # [H, W, 3]
+        return jnp.einsum("hwk,njk->nhwj", base, t)
+
+    return eager_apply("affine_grid", raw, [th])
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """(ops.yaml grid_sample) Sample NCHW ``x`` at ``grid`` [N, H, W, 2]
+    normalized coords. bilinear/nearest; zeros/border/reflection padding."""
+    from ...ops.dispatch import as_tensor_args
+
+    ts = as_tensor_args(x, grid)
+
+    def _unnormalize(c, size):
+        if align_corners:
+            return (c + 1) / 2 * (size - 1)
+        return ((c + 1) * size - 1) / 2
+
+    def _pad_index(idx, size):
+        if padding_mode == "border":
+            return jnp.clip(idx, 0, size - 1), None
+        if padding_mode == "reflection":
+            if align_corners:
+                span = 2 * (size - 1)
+                m = jnp.mod(jnp.abs(idx), span) if size > 1 else idx * 0
+                return jnp.where(m > size - 1, span - m, m), None
+            span = 2 * size
+            m = jnp.mod(jnp.abs(idx + 0.5), span)
+            m = jnp.where(m > size, span - m, m) - 0.5
+            return jnp.clip(m, 0, size - 1), None
+        valid = (idx >= 0) & (idx <= size - 1)
+        return jnp.clip(idx, 0, size - 1), valid
+
+    def raw(img, g):
+        N, C, H, W = img.shape
+        gx = _unnormalize(g[..., 0], W)
+        gy = _unnormalize(g[..., 1], H)
+
+        def gather(iy, ix, valid):
+            b = jnp.arange(N)[:, None, None]
+            v = img[b, :, iy.astype(jnp.int32), ix.astype(jnp.int32)]
+            # -> [N, Hg, Wg, C]
+            if valid is not None:
+                v = jnp.where(valid[..., None], v, 0.0)
+            return v
+
+        if mode == "nearest":
+            ix, vx = _pad_index(jnp.round(gx), W)
+            iy, vy = _pad_index(jnp.round(gy), H)
+            valid = None if vx is None else (vx & vy)
+            out = gather(iy, ix, valid)
+            return jnp.moveaxis(out, -1, 1)
+
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = gx - x0
+        wy = gy - y0
+        out = 0.0
+        for dy, wyy in ((0, 1 - wy), (1, wy)):
+            for dx, wxx in ((0, 1 - wx), (1, wx)):
+                ix, vx = _pad_index(x0 + dx, W)
+                iy, vy = _pad_index(y0 + dy, H)
+                valid = None if vx is None else (vx & vy)
+                out = out + gather(iy, ix, valid) * (wxx * wyy)[..., None]
+        return jnp.moveaxis(out, -1, 1)
+
+    return eager_apply("grid_sample", raw, ts)

@@ -22,6 +22,7 @@ __all__ = [
     "cosine_embedding_loss", "triplet_margin_loss",
     "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
     "soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
+    "ctc_loss", "margin_cross_entropy", "huber_loss",
 ]
 
 
@@ -41,6 +42,32 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                                else (input, label)))
 
     def raw(logits, lab, *maybe_w):
+        # Fast path for plain index-label CE over a big vocab: gather-form
+        # with fp32 accumulation inside the reductions. Never materializes
+        # a full fp32 logits/log-probs array — for bf16 logits at GPT
+        # vocab sizes (51200) the fp32 copies are ~GBs of HBM traffic
+        # (reference fuses the same way: phi softmax_with_cross_entropy).
+        if (use_softmax and not soft_label and label_smoothing == 0.0
+                and not has_w):
+            ids = lab.astype(jnp.int32)
+            if ids.ndim == logits.ndim:
+                ids = jnp.squeeze(ids, axis=axis)
+            safe_ids = jnp.where(ids == ignore_index, 0, ids)
+            m = jnp.max(logits, axis=axis)
+            shifted = logits - jnp.expand_dims(m, axis)
+            sumexp = jnp.sum(jnp.exp(shifted.astype(jnp.float32)),
+                             axis=axis)
+            picked = jnp.take_along_axis(
+                logits, jnp.expand_dims(safe_ids, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis)
+            per = (jnp.log(sumexp) + m.astype(jnp.float32)
+                   - picked.astype(jnp.float32))
+            valid = ids != ignore_index
+            per = jnp.where(valid, per, 0.0)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+                return jnp.sum(per) / denom
+            return _reduce(per, reduction)
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
             else jnp.log(jnp.clip(logits, 1e-10))
         nclass = logits.shape[axis]
@@ -363,3 +390,124 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
 
     return eager_apply("gaussian_nll_loss", raw,
                        as_tensor_args(input, label, variance))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss (reference: nn/functional/loss.py ctc_loss over the
+    warpctc kernel, ops.yaml warpctc). TPU-native: the standard
+    log-domain alpha recursion as a ``lax.scan`` over time, vectorized
+    across the batch — one compiled program, no host loop.
+
+    log_probs: [max_time, batch, num_classes] (log-softmax applied here
+    if the rows don't sum to 1 is NOT checked — pass raw logits and they
+    are log-softmaxed, matching the reference's warpctc contract).
+    labels: [batch, max_label_len] int padded; lengths as usual.
+    """
+    def raw(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        L = lab.shape[1]
+        S = 2 * L + 1
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        lab_len = lab_len.astype(jnp.int32)
+        in_len = in_len.astype(jnp.int32)
+        s_len = 2 * lab_len + 1
+
+        # can we skip from s-2 to s? (ext[s] != blank and != ext[s-2])
+        skip_ok = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], 1)
+
+        probs_ext = jnp.take_along_axis(
+            jnp.swapaxes(lp, 0, 1), ext[:, None, :].repeat(T, 1),
+            axis=2)  # [B, T, S]
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(probs_ext[:, 0, 0])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, probs_ext[:, 0, 1], neg_inf))
+
+        def step(alpha, t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            a_shift2 = jnp.where(skip_ok, a_shift2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1),
+                                   a_shift2)
+            new_alpha = merged + probs_ext[:, t, :]
+            # frozen past each sequence's input length
+            live = (t < in_len)[:, None]
+            return jnp.where(live, new_alpha, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        # final: logaddexp of positions s_len-1 and s_len-2
+        idx_last = jnp.clip(s_len - 1, 0, S - 1)
+        idx_prev = jnp.clip(s_len - 2, 0, S - 1)
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], 1)[:, 0]
+        a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], 1)[:, 0]
+        # zero-length labels have only the all-blank path (s_len == 1):
+        # no second terminal state, so don't double-count alpha[:, 0]
+        a_prev = jnp.where(s_len >= 2, a_prev, neg_inf)
+        ll = jnp.logaddexp(a_last, a_prev)
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1.0)
+        return _reduce(loss, reduction)
+
+    return eager_apply("ctc_loss", raw,
+                       as_tensor_args(log_probs, labels, input_lengths,
+                                      label_lengths))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """ArcFace-family margin softmax (reference: nn/functional/loss.py
+    margin_cross_entropy over the margin_cross_entropy kernel): the
+    target class's cos(theta) becomes cos(m1*theta + m2) - m3, all
+    logits scaled by ``scale``. Under tensor parallelism the sharded
+    logits path compiles to the same per-shard max/sum + psum as
+    ParallelCrossEntropy."""
+    def raw(lg, lb):
+        ids = lb.astype(jnp.int32).reshape(-1)
+        n, c = lg.shape
+        onehot = jax.nn.one_hot(ids, c, dtype=lg.dtype)
+        # clamp strictly inside (-1, 1): arccos' is infinite at the
+        # boundary, so an exactly-saturated target cosine would emit NaN
+        # gradients (the reference kernel clamps the same way)
+        eps = 1e-6
+        cos = jnp.clip(lg, -1.0 + eps, 1.0 - eps)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = jnp.where(onehot > 0, target, cos) * scale
+        m = jnp.max(adj, -1, keepdims=True)
+        lse = jnp.log(jnp.sum(jnp.exp(adj - m), -1)) + m[:, 0]
+        picked = jnp.sum(adj * onehot, -1)
+        loss = lse - picked
+        if return_softmax:
+            soft = jax.nn.softmax(adj, -1)
+            return _reduce(loss, reduction), soft
+        return _reduce(loss, reduction)
+
+    n_out = 2 if return_softmax else None
+    return eager_apply("margin_cross_entropy", raw,
+                       as_tensor_args(logits, label), n_outputs=n_out)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """(ops.yaml huber_loss)"""
+    def raw(x, y):
+        d = x - y
+        ad = jnp.abs(d)
+        per = jnp.where(ad <= delta, 0.5 * d * d,
+                        delta * (ad - 0.5 * delta))
+        return _reduce(per, reduction)
+
+    return eager_apply("huber_loss", raw, as_tensor_args(input, label))

@@ -20,6 +20,7 @@ __all__ = [
     "max_pool1d", "max_pool2d", "max_pool3d",
     "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
     "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
 ]
 
 
@@ -195,3 +196,54 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(3, "max", x, output_size, "NCDHW")
+
+
+def _max_unpool(n, x, indices, kernel_size, stride, padding, output_size):
+    """Inverse of max_pool with return_mask (ops.yaml unpool/unpool3d):
+    scatters each pooled value back to its winning position. ``indices``
+    are this framework's within-window offsets (what return_mask
+    produces), so pool -> unpool roundtrips exactly."""
+    kernel = _tuplize(kernel_size, n)
+    stride_t = _tuplize(stride if stride is not None else kernel_size, n)
+    if _padding(padding, n) != [(0, 0)] * n:
+        raise NotImplementedError("max_unpool requires padding=0")
+
+    def raw(a, idx):
+        sp_in = a.shape[2:]
+        if output_size is not None:
+            sp_out = tuple(output_size)[-n:]
+        else:
+            sp_out = tuple((sp_in[i] - 1) * stride_t[i] + kernel[i]
+                           for i in range(n))
+        acc = jnp.full(a.shape[:2] + sp_out, -jnp.inf, a.dtype)
+        for k, pos in enumerate(np.ndindex(*kernel)):
+            contrib = jnp.where(idx == k, a, -jnp.inf)
+            slices = [slice(None), slice(None)]
+            for i in range(n):
+                start = pos[i]
+                end = start + (sp_in[i] - 1) * stride_t[i] + 1
+                slices.append(slice(start, end, stride_t[i]))
+            acc = acc.at[tuple(slices)].max(contrib)
+        return jnp.where(jnp.isneginf(acc), 0.0, acc)
+
+    return eager_apply("max_unpool", raw, as_tensor_args(x, indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(1, x, indices, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """(ops.yaml unpool)"""
+    return _max_unpool(2, x, indices, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """(ops.yaml unpool3d)"""
+    return _max_unpool(3, x, indices, kernel_size, stride, padding,
+                       output_size)

@@ -9,11 +9,13 @@ from .manipulation import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from . import indexing  # noqa: F401
 
-from . import creation, linalg, logic, manipulation, math, random  # noqa: F401
+from . import creation, extras, linalg, logic, manipulation, math, random  # noqa: F401
 
 __all__ = (
     list(creation.__all__) + list(math.__all__) + list(manipulation.__all__)
     + list(logic.__all__) + list(linalg.__all__) + list(random.__all__)
+    + list(extras.__all__)
 )

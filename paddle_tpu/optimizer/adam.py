@@ -17,42 +17,55 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, amsgrad=False, name=None):
+                 use_multi_tensor=False, amsgrad=False, name=None,
+                 moment_dtype=None, stochastic_rounding=False):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
-                         name, multi_precision)
+                         name, multi_precision, stochastic_rounding)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._amsgrad = amsgrad
+        if moment_dtype in ("bfloat16", "bf16"):
+            self._moment_dtype = jnp.bfloat16
+        elif moment_dtype not in (None, "float32", "fp32"):
+            raise ValueError(f"unsupported moment_dtype {moment_dtype!r}")
+
+    def _lowprec_state_keys(self):
+        if self._moment_dtype is None:
+            return frozenset()
+        return frozenset({"moment1", "moment2", "moment2_max"})
 
     def _init_state(self, p):
+        md = self._moment_dtype or p._data.dtype
         st = {
-            "moment1": jnp.zeros_like(p._data),
-            "moment2": jnp.zeros_like(p._data),
+            "moment1": jnp.zeros(p._data.shape, md),
+            "moment2": jnp.zeros(p._data.shape, md),
             "beta1_pow": jnp.ones((), jnp.float32),
             "beta2_pow": jnp.ones((), jnp.float32),
         }
         if self._amsgrad:
-            st["moment2_max"] = jnp.zeros_like(p._data)
+            st["moment2_max"] = jnp.zeros(p._data.shape, md)
         return st
 
     def _rule(self, p, g, state, hyper):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
-        m1 = b1 * state["moment1"] + (1 - b1) * g
-        m2 = b2 * state["moment2"] + (1 - b2) * g * g
+        cd = p.dtype  # compute dtype (fp32 master / upcast param)
+        m1 = b1 * state["moment1"].astype(cd) + (1 - b1) * g
+        m2 = b2 * state["moment2"].astype(cd) + (1 - b2) * g * g
         b1p = state["beta1_pow"] * b1
         b2p = state["beta2_pow"] * b2
-        m1_hat = m1 / (1 - b1p)
+        m1_hat = m1 / (1 - b1p).astype(cd)
         if self._amsgrad:
-            m2_max = jnp.maximum(state["moment2_max"], m2)
-            m2_hat = m2_max / (1 - b2p)
+            m2_max = jnp.maximum(state["moment2_max"].astype(cd), m2)
+            m2_hat = m2_max / (1 - b2p).astype(cd)
         else:
-            m2_hat = m2 / (1 - b2p)
+            m2_hat = m2 / (1 - b2p).astype(cd)
         new_p = p - hyper["lr"] * m1_hat / (jnp.sqrt(m2_hat) + eps)
-        st = {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
-              "beta2_pow": b2p}
+        st = {"moment1": self._moment_store(m1),
+              "moment2": self._moment_store(m2),
+              "beta1_pow": b1p, "beta2_pow": b2p}
         if self._amsgrad:
-            st["moment2_max"] = m2_max
+            st["moment2_max"] = self._moment_store(m2_max)
         return new_p, st
 
 
@@ -64,12 +77,14 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, amsgrad=False,
-                 name=None):
+                 name=None, moment_dtype=None, stochastic_rounding=False):
         coeff = weight_decay if isinstance(weight_decay, float) else (
             weight_decay.coeff if isinstance(weight_decay, L2Decay) else 0.01)
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
-                         amsgrad=amsgrad, name=name)
+                         amsgrad=amsgrad, name=name,
+                         moment_dtype=moment_dtype,
+                         stochastic_rounding=stochastic_rounding)
         self._coeff = float(coeff)
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio

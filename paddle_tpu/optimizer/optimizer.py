@@ -27,6 +27,22 @@ from .lr import LRScheduler
 __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp"]
 
 
+def _stochastic_round_bf16(x: jnp.ndarray, key) -> jnp.ndarray:
+    """fp32 -> bf16 with stochastic rounding (unbiased downcast).
+
+    Adds uniform random bits below the bf16 mantissa cut, then truncates.
+    IEEE-754 bit ordering makes the integer add carry correctly through
+    mantissa/exponent within a sign class, so E[round(x)] == x. Used for
+    master-free low-memory training (bf16 params updated directly); the
+    reference's counterpart is the fp32 master-weight path of the fused
+    adam kernel (phi/kernels/gpu/adam_kernel.cu multi_precision)."""
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    noise = jax.random.bits(key, x.shape, dtype=jnp.uint16).astype(jnp.uint32)
+    rounded = (bits + noise) >> 16
+    return jax.lax.bitcast_convert_type(
+        rounded.astype(jnp.uint16), jnp.bfloat16)
+
+
 class Optimizer:
     """Base optimizer.
 
@@ -36,7 +52,7 @@ class Optimizer:
 
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None,
-                 multi_precision=False):
+                 multi_precision=False, stochastic_rounding=False):
         if parameters is None:
             raise ValueError(
                 "parameters must be provided (dygraph-style optimizer)")
@@ -65,6 +81,17 @@ class Optimizer:
         self._jit_update = None
         self._multi_precision = multi_precision
         self._master_weights: Dict[int, jnp.ndarray] = {}
+        # Master-free low-memory mode: bf16 params are upcast to fp32 for
+        # the update rule and written back with stochastic rounding — an
+        # unbiased downcast, so no fp32 shadow copy is needed. Halves the
+        # optimizer footprint vs multi_precision (no 4-byte master).
+        self._stochastic_rounding = stochastic_rounding
+        # Storage dtype for the heavy per-param moment accumulators
+        # (moment1/moment2/velocity...). None = fp32 (reference adam
+        # semantics); "bfloat16" stores them in bf16 and upcasts to fp32
+        # inside the rule, halving moment memory (the knob that lets
+        # GPT-3 1.3B + AdamW fit one 16GB chip).
+        self._moment_dtype = None
 
     # ---------------- lr ----------------
     def get_lr(self) -> float:
@@ -92,8 +119,9 @@ class Optimizer:
             # first step and force a full recompile of the train step.
             if self._multi_precision and p._data.dtype in (jnp.float16,
                                                            jnp.bfloat16):
+                exempt = self._lowprec_state_keys()
                 st = {k: (v.astype(jnp.float32)
-                          if hasattr(v, "dtype") and
+                          if k not in exempt and hasattr(v, "dtype") and
                           jnp.issubdtype(v.dtype, jnp.floating) else v)
                       for k, v in st.items()}
                 st["_master"] = p._data.astype(jnp.float32)
@@ -103,9 +131,23 @@ class Optimizer:
     def _init_state(self, p: Parameter) -> Dict[str, Any]:
         return {}
 
+    def _lowprec_state_keys(self) -> frozenset:
+        """State keys deliberately stored below fp32 (see _moment_dtype);
+        exempt from the multi_precision fp32 upcast in _state_for."""
+        return frozenset()
+
+    def _moment_store(self, arr: jnp.ndarray) -> jnp.ndarray:
+        """Downcast a moment accumulator to its storage dtype."""
+        if self._moment_dtype is not None:
+            return arr.astype(self._moment_dtype)
+        return arr
+
     def _hyper(self) -> Dict[str, Any]:
         """Scalar hyperparams fed to the compiled rule each step."""
-        return {"lr": self.get_lr()}
+        h = {"lr": self.get_lr()}
+        if self._stochastic_rounding:
+            h["_sr_key"] = jax.random.PRNGKey(self._global_step)
+        return h
 
     def _rule(self, p, g, state, hyper):
         raise NotImplementedError
@@ -180,19 +222,28 @@ class Optimizer:
         """Pure pytree update over raw arrays — usable both from the eager
         jitted path and traced inside a whole-step compiled program."""
         new_ps, new_sts = [], []
-        for p, g, st, pp in zip(ps, gs, sts, pps):
-            h = dict(hyp)
+        sr_key = hyp.get("_sr_key") if isinstance(hyp, dict) else None
+        for i, (p, g, st, pp) in enumerate(zip(ps, gs, sts, pps)):
+            h = {k: v for k, v in hyp.items() if k != "_sr_key"}
             h.update(pp)
             h["lr"] = h["lr"] * h.pop("lr_mult", 1.0)
             st = dict(st)
             master = st.pop("_master", None)
             p_eff = master if master is not None else p
+            sr = (sr_key is not None and master is None
+                  and p.dtype == jnp.bfloat16)
+            if sr:  # master-free: fp32 compute, unbiased bf16 writeback
+                p_eff = p.astype(jnp.float32)
             g_eff = g.astype(p_eff.dtype) if g.dtype != p_eff.dtype else g
             np_, nst = self._rule(p_eff, g_eff, st, h)
             if master is not None:
                 nst = dict(nst)
                 nst["_master"] = np_
-            new_ps.append(np_.astype(p.dtype))
+            if sr:
+                new_ps.append(_stochastic_round_bf16(
+                    np_, jax.random.fold_in(sr_key, i)))
+            else:
+                new_ps.append(np_.astype(p.dtype))
             new_sts.append(nst)
         return new_ps, new_sts
 

@@ -19,13 +19,40 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 
+from .factory import (ClassWithArguments, ObserverFactory, QuanterFactory,
+                      instantiate, observer, quanter)
+
 __all__ = [
-    "QuantConfig", "PTQ", "QAT", "AbsmaxObserver", "MovingAverageObserver",
-    "QuantedLinear", "FakeQuant", "quant_dequant",
+    "QuantConfig", "SingleLayerConfig", "PTQ", "QAT", "AbsmaxObserver",
+    "MovingAverageObserver", "QuantedLinear", "FakeQuant", "quant_dequant",
+    "BaseObserver", "BaseQuanter", "QuanterFactory", "ObserverFactory",
+    "quanter", "observer", "FakeQuanterWithAbsMaxObserver",
 ]
 
 
-class AbsmaxObserver:
+class BaseObserver:
+    """Observer contract (reference: quantization/base_observer.py —
+    collect statistics during calibration, expose the deployed scale)."""
+
+    def observe(self, arr) -> None:
+        raise NotImplementedError
+
+    def scale(self) -> float:
+        raise NotImplementedError
+
+    def cal_thresholds(self) -> None:
+        """Finalize statistics (no-op for running-stat observers)."""
+
+
+class BaseQuanter(BaseObserver):
+    """Quanter contract (reference: quantization/base_quanter.py): an
+    observer that also simulates quantization in the forward pass."""
+
+    def __call__(self, x):
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
     """Per-tensor absmax range observer (reference:
     quantization/observers/abs_max.py)."""
 
@@ -67,32 +94,132 @@ def quant_dequant(arr, scale: float, bits: int = 8):
     return q * scale
 
 
+class SingleLayerConfig:
+    """Per-layer activation/weight quanter pair (reference:
+    quantization/config.py:36 SingleLayerConfig)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._activation = activation
+        self._weight = weight
+
+    @property
+    def activation(self):
+        return self._activation
+
+    @property
+    def weight(self):
+        return self._weight
+
+    def __str__(self):
+        return f"activation: {self._activation}\nweight: {self._weight}"
+
+
 class QuantConfig:
-    """Which layers get quantized and with what observers (reference:
-    quantization/config.py QuantConfig.add_type_config)."""
+    """Which layers get quantized and with what observers/quanters
+    (reference: quantization/config.py QuantConfig — resolution priority
+    layer-instance > qualified-name > type > global default; plus
+    QAT layer mappings and customized leaves)."""
 
     def __init__(self, activation=None, weight=None):
         self._default_act = activation or (lambda: MovingAverageObserver())
         self._default_wt = weight or (lambda: AbsmaxObserver())
-        self._type_configs: Dict[Type, dict] = {}
+        self._has_explicit_default = (activation is not None
+                                      or weight is not None)
+        self._layer_configs: Dict[int, SingleLayerConfig] = {}
+        self._name_configs: Dict[str, SingleLayerConfig] = {}
+        self._type_configs: Dict[Type, SingleLayerConfig] = {}
+        self._qat_layer_mappings: Dict[Type, Type] = {}
+        self._customized_leaves: list = []
 
-    def add_type_config(self, layer_type: Type, activation=None,
-                        weight=None):
-        self._type_configs[layer_type] = {
-            "activation": activation or self._default_act,
-            "weight": weight or self._default_wt,
-        }
+    # ---- reference API (config.py) ----
+    def add_layer_config(self, layer, activation=None, weight=None):
+        """Pin a config to specific layer INSTANCES (config.py
+        add_layer_config — highest priority)."""
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs[id(l)] = SingleLayerConfig(
+                activation or self._default_act,
+                weight or self._default_wt)
 
-    def _config_for(self, layer: Layer) -> Optional[dict]:
+    def add_name_config(self, name, activation=None, weight=None):
+        """Pin a config to qualified sublayer names (config.py
+        add_name_config)."""
+        names = name if isinstance(name, (list, tuple)) else [name]
+        for n in names:
+            self._name_configs[n] = SingleLayerConfig(
+                activation or self._default_act,
+                weight or self._default_wt)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_configs[t] = SingleLayerConfig(
+                activation or self._default_act,
+                weight or self._default_wt)
+
+    def add_qat_layer_mapping(self, source: Type, target: Type):
+        """Register source layer type -> QAT-wrapped type (config.py
+        add_qat_layer_mapping; default mapping covers Linear)."""
+        self._qat_layer_mappings[source] = target
+
+    def add_customized_leaves(self, layer_type):
+        """Types treated as leaves during traversal (config.py
+        add_customized_leaves)."""
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        self._customized_leaves.extend(types)
+
+    @property
+    def qat_layer_mappings(self):
         from ..nn.layers.common import Linear
 
-        if type(layer) in self._type_configs:
-            return self._type_configs[type(layer)]
-        if isinstance(layer, Linear) and not self._type_configs:
-            # default policy: quantize Linears
-            return {"activation": self._default_act,
-                    "weight": self._default_wt}
+        out = {Linear: _QATLinear}
+        out.update(self._qat_layer_mappings)
+        return out
+
+    def _is_leaf(self, layer: Layer) -> bool:
+        return type(layer) in tuple(self._customized_leaves)
+
+    def _get_config_by_layer(self, qualname: str,
+                             layer: Layer) -> Optional[SingleLayerConfig]:
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        if qualname in self._name_configs:
+            return self._name_configs[qualname]
+        for t, cfg in self._type_configs.items():
+            if type(layer) is t:
+                return cfg
+        # global default: applies to mappable types (Linear + registered
+        # mappings) when nothing narrower was configured
+        explicit = (self._layer_configs or self._name_configs
+                    or self._type_configs)
+        if type(layer) in self.qat_layer_mappings and (
+                self._has_explicit_default or not explicit):
+            return SingleLayerConfig(self._default_act, self._default_wt)
         return None
+
+    # back-compat shim (round-2 internal API)
+    def _config_for(self, layer: Layer) -> Optional[dict]:
+        cfg = self._get_config_by_layer("", layer)
+        if cfg is None:
+            return None
+        return {"activation": cfg.activation, "weight": cfg.weight}
+
+    def __str__(self):
+        lines = ["Global config:",
+                 str(SingleLayerConfig(self._default_act,
+                                       self._default_wt))]
+        for n, c in self._name_configs.items():
+            lines.append(f"{n}:\n{c}")
+        return "\n".join(lines)
+
+
+def _walk_quantizable(model: Layer, prefix=""):
+    """Yield (parent, local_name, qualified_name, child) pre-order."""
+    for name, child in list(model.named_children()):
+        qual = f"{prefix}.{name}" if prefix else name
+        yield model, name, qual, child
 
 
 class _ObservedLinear(Layer):
@@ -144,21 +271,22 @@ class PTQ:
     def __init__(self, config: Optional[QuantConfig] = None):
         self.config = config or QuantConfig()
 
-    def quantize(self, model: Layer) -> Layer:
+    def quantize(self, model: Layer, prefix="") -> Layer:
         from ..nn.layers.common import Linear
 
-        for name, child in list(model.named_children()):
-            cfg = self.config._config_for(child)
+        for parent, name, qual, child in _walk_quantizable(model, prefix):
+            cfg = self.config._get_config_by_layer(qual, child)
             if cfg is not None:
                 # deployment (QuantedLinear) assumes x @ weight semantics
                 if not isinstance(child, Linear):
                     raise NotImplementedError(
                         f"PTQ supports Linear layers; got "
-                        f"{type(child).__name__} for {name!r}")
-                model.add_sublayer(name, _ObservedLinear(
-                    child, cfg["activation"](), cfg["weight"]()))
-            else:
-                self.quantize(child)
+                        f"{type(child).__name__} for {qual!r}")
+                parent.add_sublayer(name, _ObservedLinear(
+                    child, instantiate(cfg.activation),
+                    instantiate(cfg.weight)))
+            elif not self.config._is_leaf(child):
+                self.quantize(child, qual)
         return model
 
     def convert(self, model: Layer) -> Layer:
@@ -197,6 +325,26 @@ class FakeQuant(Layer):
         return eager_apply("fake_quant", raw, [t])
 
 
+@quanter("FakeQuanterWithAbsMaxObserver")
+class FakeQuanterWithAbsMaxObserverLayer(MovingAverageObserver,
+                                         BaseQuanter):
+    """EMA-absmax fake quanter (reference: quanters/abs_max.py —
+    FakeQuanterWithAbsMaxObserverLayer; the module-level
+    ``FakeQuanterWithAbsMaxObserver`` symbol is the registered factory).
+    Usable directly as an observer inside FakeQuant or standalone as a
+    quant-dequant callable."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 quant_bits: int = None, **kwargs):
+        bits = quant_bits if quant_bits is not None else bit_length
+        super().__init__(quant_bits=bits, momentum=moving_rate)
+
+    def __call__(self, x):
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        self.observe(arr)
+        return Tensor(quant_dequant(arr, self.scale(), self.quant_bits))
+
+
 class QAT:
     """Quantization-aware training driver (reference: quantization/qat.py):
     wraps eligible layers' inputs+weights with FakeQuant nodes."""
@@ -204,20 +352,22 @@ class QAT:
     def __init__(self, config: Optional[QuantConfig] = None):
         self.config = config or QuantConfig()
 
-    def quantize(self, model: Layer) -> Layer:
-        from ..nn.layers.common import Linear
-
-        for name, child in list(model.named_children()):
-            cfg = self.config._config_for(child)
+    def quantize(self, model: Layer, prefix="") -> Layer:
+        mappings = self.config.qat_layer_mappings
+        for parent, name, qual, child in _walk_quantizable(model, prefix):
+            cfg = self.config._get_config_by_layer(qual, child)
             if cfg is not None:
-                if not isinstance(child, Linear):
+                target = mappings.get(type(child))
+                if target is None:
                     raise NotImplementedError(
-                        f"QAT supports Linear layers; got "
-                        f"{type(child).__name__} for {name!r}")
-                model.add_sublayer(name, _QATLinear(
-                    child, cfg["activation"](), cfg["weight"]()))
-            else:
-                self.quantize(child)
+                        f"no QAT layer mapping for "
+                        f"{type(child).__name__} ({qual!r}); register "
+                        f"one via QuantConfig.add_qat_layer_mapping")
+                parent.add_sublayer(name, target(
+                    child, instantiate(cfg.activation),
+                    instantiate(cfg.weight)))
+            elif not self.config._is_leaf(child):
+                self.quantize(child, qual)
         return model
 
     def convert(self, model: Layer) -> Layer:

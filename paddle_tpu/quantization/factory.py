@@ -1,0 +1,111 @@
+"""Quanter/observer factories + registry.
+
+TPU-native equivalent of the reference's factory layer (reference:
+python/paddle/quantization/factory.py — ClassWithArguments,
+QuanterFactory, ObserverFactory, the ``quanter()`` class decorator that
+registers a quanter under a public name).
+"""
+from __future__ import annotations
+
+from typing import Dict, Type
+
+__all__ = ["ClassWithArguments", "ObserverFactory", "QuanterFactory",
+           "quanter", "observer", "QUANTER_REGISTRY", "OBSERVER_REGISTRY"]
+
+QUANTER_REGISTRY: Dict[str, Type] = {}
+OBSERVER_REGISTRY: Dict[str, Type] = {}
+
+
+class ClassWithArguments:
+    """Delayed construction: holds (cls, args, kwargs); ``_instance()``
+    builds a fresh object per wrapped layer (factory.py:23)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+
+    @property
+    def cls(self):
+        return self._cls
+
+    @property
+    def args(self):
+        return self._args
+
+    @property
+    def kwargs(self):
+        return self._kwargs
+
+    def _instance(self):
+        return self._cls(*self._args, **self._kwargs)
+
+    def __str__(self):
+        kv = ",".join(f"{k}={v}" for k, v in self._kwargs.items())
+        return f"{self._cls.__name__}({kv})"
+
+    __repr__ = __str__
+
+
+class ObserverFactory(ClassWithArguments):
+    """(factory.py ObserverFactory)"""
+
+
+class QuanterFactory(ClassWithArguments):
+    """(factory.py QuanterFactory)"""
+
+
+def _make_factory_class(name, cls, base):
+    def __init__(self, *args, **kwargs):
+        base.__init__(self, cls, *args, **kwargs)
+
+    return type(name, (base,), {"__init__": __init__})
+
+
+def quanter(name: str):
+    """Class decorator: register a quanter implementation and expose a
+    same-named QuanterFactory (reference factory.py ``quanter``)::
+
+        @quanter("MyQuanter")
+        class MyQuanterLayer(BaseQuanter): ...
+
+        cfg = QuantConfig(activation=MyQuanter(bits=8), weight=None)
+    """
+
+    def deco(cls):
+        factory = _make_factory_class(name, cls, QuanterFactory)
+        QUANTER_REGISTRY[name] = factory
+        import sys
+
+        mod = sys.modules[cls.__module__]
+        setattr(mod, name, factory)
+        return cls
+
+    return deco
+
+
+def observer(name: str):
+    """Observer counterpart of ``quanter``."""
+
+    def deco(cls):
+        factory = _make_factory_class(name, cls, ObserverFactory)
+        OBSERVER_REGISTRY[name] = factory
+        import sys
+
+        mod = sys.modules[cls.__module__]
+        setattr(mod, name, factory)
+        return cls
+
+    return deco
+
+
+def instantiate(f):
+    """Accept a factory (``._instance()``), a class/zero-arg callable, or
+    an already-built observer/quanter object."""
+    if f is None:
+        return None
+    if hasattr(f, "_instance"):
+        return f._instance()
+    if callable(f):
+        return f()
+    return f

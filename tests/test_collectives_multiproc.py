@@ -94,6 +94,46 @@ WORKER = textwrap.dedent("""
     np.testing.assert_allclose(t.numpy(), np.full(2, 3.0))
     paddle.set_flags({"check_collective": False})
 
+    # uneven all_to_all_single (reference: communication/all_to_all.py
+    # alltoall_single with in/out_split_sizes): rank0 sends [1,3] rows,
+    # rank1 sends [2,2] rows -> rank0 receives [1,2], rank1 [3,2]
+    from paddle_tpu.distributed.communication.collectives import (
+        all_to_all_single, gather)
+    in_sp = [[1, 3], [2, 2]][rank]
+    out_sp = [[1, 2], [3, 2]][rank]
+    data = np.arange(sum(in_sp) * 2, dtype=np.float32).reshape(-1, 2) \
+        + 100 * rank
+    out = paddle.to_tensor(np.zeros((sum(out_sp), 2), np.float32))
+    all_to_all_single(out, paddle.to_tensor(data),
+                      out_split_sizes=out_sp, in_split_sizes=in_sp)
+    # expected: my inbox = [rank0's piece for me; rank1's piece for me]
+    r0 = np.arange(8, dtype=np.float32).reshape(4, 2)
+    r1 = np.arange(8, dtype=np.float32).reshape(4, 2) + 100
+    if rank == 0:
+        want = np.concatenate([r0[:1], r1[:2]])
+    else:
+        want = np.concatenate([r0[1:], r1[2:]])
+    np.testing.assert_allclose(out.numpy(), want)
+
+    # bad split sizes must raise, not silently even-split
+    try:
+        all_to_all_single(out, paddle.to_tensor(data),
+                          in_split_sizes=[1, 1, 1])
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("expected ValueError for bad split count")
+
+    # gather honors dst: only rank 1 receives
+    gl = []
+    gather(paddle.to_tensor(np.full(2, rank + 5.0, np.float32)),
+           gl, dst=1)
+    if rank == 1:
+        got = np.stack([t.numpy() for t in gl])
+        np.testing.assert_allclose(got, [[5, 5], [6, 6]])
+    else:
+        assert gl == [], "gather filled gather_list on a non-dst rank"
+
     # cross-process send/recv through the coordination-service store
     if rank == 0:
         send(paddle.to_tensor(np.arange(6, dtype=np.float32)), dst=1)

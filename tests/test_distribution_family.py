@@ -1,0 +1,287 @@
+"""Distribution family long tail: sample/log_prob/entropy/kl vs scipy.
+
+Reference parity targets: python/paddle/distribution/{beta,dirichlet,
+laplace,lognormal,gumbel,multinomial,multivariate_normal,poisson,
+binomial,geometric,cauchy,continuous_bernoulli,independent}.py.
+"""
+import numpy as np
+import pytest
+import scipy.stats as ss
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+
+def _np(t):
+    return np.asarray(t.numpy(), dtype=np.float64)
+
+
+class TestLogProbVsScipy:
+    """log_prob must match scipy's logpdf/logpmf."""
+
+    def test_beta(self):
+        d = D.Beta(2.0, 3.0)
+        x = np.array([0.1, 0.5, 0.9])
+        np.testing.assert_allclose(
+            _np(d.log_prob(x)), ss.beta.logpdf(x, 2, 3), rtol=1e-4, atol=1e-6)
+
+    def test_dirichlet(self):
+        a = np.array([1.5, 2.0, 3.0])
+        d = D.Dirichlet(a.astype(np.float32))
+        x = np.array([0.2, 0.3, 0.5])
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(x.astype(np.float32)))),
+            ss.dirichlet.logpdf(x, a), rtol=1e-4, atol=1e-6)
+
+    def test_gamma(self):
+        d = D.Gamma(3.0, 2.0)
+        x = np.array([0.5, 1.0, 4.0])
+        np.testing.assert_allclose(
+            _np(d.log_prob(x)), ss.gamma.logpdf(x, 3, scale=0.5),
+            rtol=1e-4, atol=1e-6)
+
+    def test_laplace(self):
+        d = D.Laplace(1.0, 2.0)
+        x = np.array([-1.0, 1.0, 3.0])
+        np.testing.assert_allclose(
+            _np(d.log_prob(x)), ss.laplace.logpdf(x, 1, 2), rtol=1e-4, atol=1e-6)
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.5, 0.8)
+        x = np.array([0.5, 1.0, 3.0])
+        np.testing.assert_allclose(
+            _np(d.log_prob(x)),
+            ss.lognorm.logpdf(x, 0.8, scale=np.exp(0.5)), rtol=1e-4, atol=1e-6)
+
+    def test_gumbel(self):
+        d = D.Gumbel(1.0, 2.0)
+        x = np.array([-1.0, 1.0, 4.0])
+        np.testing.assert_allclose(
+            _np(d.log_prob(x)), ss.gumbel_r.logpdf(x, 1, 2), rtol=1e-4, atol=1e-6)
+
+    def test_poisson(self):
+        d = D.Poisson(3.5)
+        k = np.array([0.0, 2.0, 7.0])
+        np.testing.assert_allclose(
+            _np(d.log_prob(k)), ss.poisson.logpmf(k, 3.5), rtol=1e-4, atol=1e-6)
+
+    def test_binomial(self):
+        d = D.Binomial(10, 0.3)
+        k = np.array([0.0, 3.0, 10.0])
+        np.testing.assert_allclose(
+            _np(d.log_prob(k)), ss.binom.logpmf(k, 10, 0.3),
+            rtol=1e-4, atol=1e-5)
+
+    def test_geometric(self):
+        d = D.Geometric(0.25)
+        k = np.array([0.0, 1.0, 5.0])
+        # scipy geom counts trials (support {1,..}); ours counts failures
+        np.testing.assert_allclose(
+            _np(d.log_prob(k)), ss.geom.logpmf(k + 1, 0.25), rtol=1e-4, atol=1e-6)
+
+    def test_cauchy(self):
+        d = D.Cauchy(1.0, 2.0)
+        x = np.array([-2.0, 1.0, 5.0])
+        np.testing.assert_allclose(
+            _np(d.log_prob(x)), ss.cauchy.logpdf(x, 1, 2), rtol=1e-4, atol=1e-6)
+
+    def test_multinomial(self):
+        d = D.Multinomial(6, np.array([0.2, 0.3, 0.5], np.float32))
+        x = np.array([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(x.astype(np.float32)))),
+            ss.multinomial.logpmf(x, 6, [0.2, 0.3, 0.5]), rtol=1e-4, atol=1e-6)
+
+    def test_mvn(self):
+        mu = np.array([1.0, -1.0])
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]])
+        d = D.MultivariateNormal(mu.astype(np.float32),
+                                 cov.astype(np.float32))
+        x = np.array([0.5, 0.5])
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(x.astype(np.float32)))),
+            ss.multivariate_normal.logpdf(x, mu, cov), rtol=1e-4, atol=1e-6)
+
+
+class TestEntropyVsScipy:
+    def test_entropies(self):
+        cases = [
+            (D.Beta(2.0, 3.0), ss.beta(2, 3).entropy()),
+            (D.Gamma(3.0, 2.0), ss.gamma(3, scale=0.5).entropy()),
+            (D.Laplace(1.0, 2.0), ss.laplace(1, 2).entropy()),
+            (D.LogNormal(0.5, 0.8),
+             ss.lognorm(0.8, scale=np.exp(0.5)).entropy()),
+            (D.Gumbel(1.0, 2.0), ss.gumbel_r(1, 2).entropy()),
+            (D.Poisson(3.5), ss.poisson(3.5).entropy()),
+            (D.Binomial(10, 0.3), ss.binom(10, 0.3).entropy()),
+            (D.Cauchy(1.0, 2.0), ss.cauchy(1, 2).entropy()),
+        ]
+        for d, ref in cases:
+            np.testing.assert_allclose(
+                float(_np(d.entropy())), float(ref), rtol=1e-4,
+                err_msg=type(d).__name__)
+
+    def test_dirichlet_entropy(self):
+        a = np.array([1.5, 2.0, 3.0])
+        d = D.Dirichlet(a.astype(np.float32))
+        np.testing.assert_allclose(
+            float(_np(d.entropy())), ss.dirichlet(a).entropy(), rtol=1e-4)
+
+    def test_mvn_entropy(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]])
+        d = D.MultivariateNormal(np.zeros(2, np.float32),
+                                 cov.astype(np.float32))
+        np.testing.assert_allclose(
+            float(_np(d.entropy())),
+            ss.multivariate_normal(np.zeros(2), cov).entropy(), rtol=1e-4)
+
+    def test_geometric_entropy(self):
+        d = D.Geometric(0.25)
+        np.testing.assert_allclose(
+            float(_np(d.entropy())), ss.geom(0.25).entropy(), rtol=1e-4)
+
+
+class TestSampling:
+    """Sample moments approach analytic mean/variance; paddle.seed governs."""
+
+    @pytest.mark.parametrize("dist,mean,var", [
+        (lambda: D.Beta(2.0, 3.0), 0.4, 0.04),
+        (lambda: D.Gamma(3.0, 2.0), 1.5, 0.75),
+        (lambda: D.Laplace(1.0, 2.0), 1.0, 8.0),
+        (lambda: D.Gumbel(1.0, 2.0), 1.0 + 2 * 0.57721566, np.pi**2 / 6 * 4),
+        (lambda: D.Poisson(3.5), 3.5, 3.5),
+        (lambda: D.Binomial(10, 0.3), 3.0, 2.1),
+        (lambda: D.Geometric(0.25), 3.0, 12.0),
+    ])
+    def test_moments(self, dist, mean, var):
+        paddle.seed(7)
+        s = _np(dist().sample((20000,)))
+        np.testing.assert_allclose(s.mean(), mean, rtol=0.1, atol=0.05)
+        np.testing.assert_allclose(s.var(), var, rtol=0.2, atol=0.1)
+
+    def test_dirichlet_sample(self):
+        paddle.seed(7)
+        d = D.Dirichlet(np.array([1.5, 2.0, 3.0], np.float32))
+        s = _np(d.sample((5000,)))
+        assert s.shape == (5000, 3)
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [1.5 / 6.5, 2 / 6.5, 3 / 6.5],
+                                   atol=0.02)
+
+    def test_mvn_sample(self):
+        paddle.seed(7)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        d = D.MultivariateNormal(np.array([1.0, -1.0], np.float32), cov)
+        s = _np(d.sample((20000,)))
+        np.testing.assert_allclose(s.mean(0), [1.0, -1.0], atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.1)
+
+    def test_multinomial_sample(self):
+        paddle.seed(7)
+        d = D.Multinomial(6, np.array([0.2, 0.3, 0.5], np.float32))
+        s = _np(d.sample((2000,)))
+        np.testing.assert_allclose(s.sum(-1), 6.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [1.2, 1.8, 3.0], atol=0.15)
+
+    def test_seed_reproducible(self):
+        paddle.seed(123)
+        a = _np(D.Beta(2.0, 3.0).sample((8,)))
+        paddle.seed(123)
+        b = _np(D.Beta(2.0, 3.0).sample((8,)))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestKL:
+    """Closed-form KL vs numeric integral / MC estimate."""
+
+    def _mc_kl(self, p, q, n=200000, seed=0):
+        paddle.seed(seed)
+        x = p.sample((n,))
+        v = _np(p.log_prob(x)) - _np(q.log_prob(x))
+        return float(np.mean(v))
+
+    @pytest.mark.parametrize("make", [
+        lambda: (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)),
+        lambda: (D.Gamma(3.0, 2.0), D.Gamma(2.5, 1.0)),
+        lambda: (D.Laplace(1.0, 2.0), D.Laplace(0.0, 1.0)),
+        lambda: (D.LogNormal(0.5, 0.8), D.LogNormal(0.0, 1.0)),
+        lambda: (D.Gumbel(1.0, 2.0), D.Gumbel(0.0, 1.5)),
+        lambda: (D.Poisson(3.5), D.Poisson(2.0)),
+        lambda: (D.Geometric(0.25), D.Geometric(0.5)),
+        lambda: (D.Cauchy(1.0, 2.0), D.Cauchy(0.0, 1.0)),
+        lambda: (D.Binomial(10, 0.3), D.Binomial(10, 0.6)),
+    ])
+    def test_kl_vs_mc(self, make):
+        p, q = make()
+        kl = float(_np(D.kl_divergence(p, q)))
+        mc = self._mc_kl(p, q)
+        assert kl >= -1e-6, f"negative KL {kl} for {type(p).__name__}"
+        np.testing.assert_allclose(kl, mc, rtol=0.1, atol=0.02,
+                                   err_msg=type(p).__name__)
+
+    def test_kl_dirichlet(self):
+        p = D.Dirichlet(np.array([1.5, 2.0, 3.0], np.float32))
+        q = D.Dirichlet(np.array([2.0, 2.0, 2.0], np.float32))
+        kl = float(_np(D.kl_divergence(p, q)))
+        mc = self._mc_kl(p, q, n=100000)
+        np.testing.assert_allclose(kl, mc, rtol=0.1, atol=0.02)
+
+    def test_kl_mvn(self):
+        p = D.MultivariateNormal(
+            np.array([1.0, -1.0], np.float32),
+            np.array([[2.0, 0.5], [0.5, 1.0]], np.float32))
+        q = D.MultivariateNormal(
+            np.zeros(2, np.float32), np.eye(2, dtype=np.float32))
+        kl = float(_np(D.kl_divergence(p, q)))
+        mc = self._mc_kl(p, q, n=100000)
+        np.testing.assert_allclose(kl, mc, rtol=0.05, atol=0.02)
+
+    def test_kl_independent(self):
+        base_p = D.Normal(np.zeros(3, np.float32),
+                          np.ones(3, np.float32))
+        base_q = D.Normal(np.ones(3, np.float32),
+                          np.full(3, 2.0, np.float32))
+        p = D.Independent(base_p, 1)
+        q = D.Independent(base_q, 1)
+        kl = float(_np(D.kl_divergence(p, q)))
+        direct = float(np.sum(_np(D.kl_divergence(base_p, base_q))))
+        np.testing.assert_allclose(kl, direct, rtol=1e-6)
+
+    def test_kl_same_is_zero(self):
+        for d in (D.Beta(2.0, 3.0), D.Gamma(3.0, 2.0),
+                  D.Laplace(1.0, 2.0), D.Poisson(3.0),
+                  D.Cauchy(0.0, 1.0)):
+            kl = float(_np(D.kl_divergence(d, d)))
+            np.testing.assert_allclose(kl, 0.0, atol=1e-5)
+
+
+class TestStructure:
+    def test_independent_shapes(self):
+        base = D.Normal(np.zeros((4, 3), np.float32),
+                        np.ones((4, 3), np.float32))
+        d = D.Independent(base, 1)
+        assert d.batch_shape == (4,)
+        assert d.event_shape == (3,)
+        assert _np(d.log_prob(np.zeros((4, 3), np.float32))).shape == (4,)
+
+    def test_cauchy_no_moments(self):
+        d = D.Cauchy(0.0, 1.0)
+        with pytest.raises(ValueError):
+            _ = d.mean
+        with pytest.raises(ValueError):
+            _ = d.variance
+
+    def test_continuous_bernoulli(self):
+        d = D.ContinuousBernoulli(0.3)
+        paddle.seed(5)
+        s = _np(d.sample((20000,)))
+        assert ((s >= 0) & (s <= 1)).all()
+        np.testing.assert_allclose(s.mean(), float(_np(d.mean)), atol=0.01)
+        # log_prob integrates to ~1 over [0,1]
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001).astype(np.float32)
+        dens = np.exp(_np(d.log_prob(xs)))
+        np.testing.assert_allclose(np.trapz(dens, xs), 1.0, atol=1e-3)
+
+    def test_mvn_requires_one_param(self):
+        with pytest.raises(ValueError):
+            D.MultivariateNormal(np.zeros(2, np.float32))

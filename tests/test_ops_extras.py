@@ -1,0 +1,309 @@
+"""Long-tail ops from the ops.yaml audit (tools/op_audit.py):
+extras batch + ctc_loss/margin_cross_entropy/huber_loss +
+grid_sample/affine_grid.
+
+Numeric references: numpy/scipy/torch-free closed forms.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestExtrasOps:
+    def test_add_n(self):
+        xs = [_t(np.full((2, 2), float(i))) for i in range(3)]
+        np.testing.assert_allclose(paddle.add_n(xs).numpy(),
+                                   np.full((2, 2), 3.0))
+
+    def test_bincount_weights(self):
+        x = _t(np.array([0, 1, 1, 3]))
+        w = _t(np.array([0.5, 1.0, 2.0, 4.0], np.float32))
+        np.testing.assert_allclose(
+            paddle.bincount(x, weights=w, minlength=6).numpy(),
+            [0.5, 3.0, 0, 4.0, 0, 0])
+
+    def test_diagonal_and_diag_embed(self):
+        a = np.arange(12).reshape(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.diagonal(_t(a), offset=1).numpy(),
+            np.diagonal(a, offset=1))
+        d = np.array([1.0, 2.0, 3.0], np.float32)
+        out = paddle.diag_embed(_t(d)).numpy()
+        np.testing.assert_allclose(out, np.diag(d))
+        out2 = paddle.diag_embed(_t(d), offset=1).numpy()
+        np.testing.assert_allclose(out2, np.diag(d, k=1))
+
+    def test_kron_complex_nextafter(self):
+        a = np.array([[1.0, 2.0]], np.float32)
+        b = np.eye(2, dtype=np.float32)
+        np.testing.assert_allclose(paddle.kron(_t(a), _t(b)).numpy(),
+                                   np.kron(a, b))
+        c = paddle.complex(_t(np.array([1.0], np.float32)),
+                           _t(np.array([2.0], np.float32))).numpy()
+        assert c.dtype == np.complex64 and c[0] == 1 + 2j
+        na = paddle.nextafter(_t(np.array([1.0], np.float32)),
+                              _t(np.array([2.0], np.float32))).numpy()
+        np.testing.assert_array_equal(na, np.nextafter(
+            np.float32(1.0), np.float32(2.0)))
+
+    def test_clip_by_norm_renorm_squared_l2(self):
+        x = np.array([3.0, 4.0], np.float32)
+        np.testing.assert_allclose(
+            paddle.clip_by_norm(_t(x), 1.0).numpy(), x / 5.0, rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.squared_l2_norm(_t(x)).numpy(), [25.0])
+        m = np.array([[3.0, 4.0], [0.3, 0.4]], np.float32)
+        out = paddle.renorm(_t(m), p=2.0, axis=0, max_norm=1.0).numpy()
+        np.testing.assert_allclose(np.linalg.norm(out[0]), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(out[1], m[1], rtol=1e-5)  # untouched
+
+    def test_logit_logcumsumexp(self):
+        p = np.array([0.2, 0.8], np.float32)
+        np.testing.assert_allclose(paddle.logit(_t(p)).numpy(),
+                                   np.log(p / (1 - p)), rtol=1e-5)
+        x = np.array([0.1, 0.5, 2.0], np.float32)
+        ref = np.log(np.cumsum(np.exp(x)))
+        np.testing.assert_allclose(
+            paddle.logcumsumexp(_t(x), axis=0).numpy(), ref, rtol=1e-5)
+
+    def test_special_functions(self):
+        import scipy.special as sp
+
+        x = np.array([0.5, 1.5], np.float32)
+        np.testing.assert_allclose(paddle.i0e(_t(x)).numpy(),
+                                   sp.i0e(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.i1e(_t(x)).numpy(),
+                                   sp.i1e(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.polygamma(_t(x), 1).numpy(),
+            sp.polygamma(1, x).astype(np.float32), rtol=1e-4)
+
+    def test_nanmedian_mode(self):
+        x = np.array([[1.0, np.nan, 3.0]], np.float32)
+        np.testing.assert_allclose(
+            paddle.nanmedian(_t(x), axis=1).numpy(), [2.0])
+        v, i = paddle.mode(_t(np.array([[2.0, 1.0, 2.0, 3.0]])))
+        assert float(v.numpy()[0]) == 2.0
+        assert int(i.numpy()[0]) == 2  # last occurrence
+
+    def test_shard_index(self):
+        x = _t(np.array([1, 5, 9, 14]))
+        out = paddle.shard_index(x, index_num=16, nshards=2,
+                                 shard_id=0).numpy()
+        np.testing.assert_array_equal(out, [1, 5, -1, -1])
+        out1 = paddle.shard_index(x, index_num=16, nshards=2,
+                                  shard_id=1).numpy()
+        np.testing.assert_array_equal(out1, [-1, -1, 1, 6])
+
+    def test_temporal_shift(self):
+        x = np.arange(2 * 4 * 1 * 1, dtype=np.float32).reshape(2, 4, 1, 1)
+        out = paddle.temporal_shift(_t(x), seg_num=2,
+                                    shift_ratio=0.25).numpy()
+        # fold=1: channel 0 shifts back (t+1), channel 1 shifts fwd (t-1)
+        assert out[0, 0, 0, 0] == x[1, 0, 0, 0]  # from next frame
+        assert out[1, 0, 0, 0] == 0               # nothing after last
+        assert out[0, 1, 0, 0] == 0               # nothing before first
+        assert out[1, 1, 0, 0] == x[0, 1, 0, 0]
+        np.testing.assert_allclose(out[:, 2:], x[:, 2:])  # untouched
+
+    def test_fill_diagonal_gather_tree(self):
+        a = np.zeros((3, 3), np.float32)
+        out = paddle.fill_diagonal(_t(a), 5.0).numpy()
+        np.testing.assert_allclose(out, np.eye(3) * 5.0)
+        ids = np.array([[[2, 2]], [[6, 1]]], np.int64)  # [T=2, B=1, beam=2]
+        parents = np.array([[[0, 0]], [[1, 0]]], np.int64)
+        out = paddle.gather_tree(_t(ids), _t(parents)).numpy()
+        # beam 0 at t=1 came from parent 1: path = ids[0][1], ids[1][0]
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 6])
+        np.testing.assert_array_equal(out[:, 0, 1], [2, 1])
+
+    def test_edit_distance(self):
+        hyp = np.array([[1, 2, 3, 0]], np.int64)
+        ref = np.array([[1, 3, 3, 0]], np.int64)
+        d, n = paddle.edit_distance(_t(hyp), _t(ref), normalized=False,
+                                    input_length=_t([3]),
+                                    label_length=_t([3]))
+        assert float(d.numpy()[0, 0]) == 1.0
+        assert int(n.numpy()[0]) == 1
+
+    def test_truncated_normal(self):
+        paddle.seed(0)
+        x = paddle.truncated_normal([20000], mean=1.0, std=2.0).numpy()
+        assert ((x > 1.0 - 4.0 - 1e-5) & (x < 1.0 + 4.0 + 1e-5)).all()
+        assert abs(x.mean() - 1.0) < 0.05
+
+
+class TestCTCLoss:
+    def test_matches_bruteforce(self):
+        """Sum over all alignments for a tiny case."""
+        rng = np.random.RandomState(0)
+        T, B, C = 4, 1, 3
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = np.array([[1, 2]], np.int64)
+        loss = F.ctc_loss(_t(logits), _t(labels), _t([T]), _t([2]),
+                          blank=0, reduction="none").numpy()
+
+        # brute force: enumerate all T-length paths collapsing to [1, 2]
+        import itertools
+
+        logp = logits[:, 0] - np.log(
+            np.exp(logits[:, 0]).sum(-1, keepdims=True))
+
+        def collapse(path):
+            out = []
+            prev = None
+            for p in path:
+                if p != prev and p != 0:
+                    out.append(p)
+                prev = p
+            return out
+
+        total = -np.inf
+        for path in itertools.product(range(C), repeat=T):
+            if collapse(path) == [1, 2]:
+                s = sum(logp[t, p] for t, p in enumerate(path))
+                total = np.logaddexp(total, s)
+        np.testing.assert_allclose(loss[0], -total, rtol=1e-4)
+
+    def test_batch_with_lengths(self):
+        rng = np.random.RandomState(1)
+        T, B, C = 6, 3, 5
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = np.array([[1, 2, 3], [4, 1, 0], [2, 0, 0]], np.int64)
+        lab_len = np.array([3, 2, 1])
+        in_len = np.array([6, 5, 4])
+        loss = F.ctc_loss(_t(logits), _t(labels), _t(in_len),
+                          _t(lab_len), reduction="none").numpy()
+        assert loss.shape == (3,)
+        assert np.isfinite(loss).all() and (loss > 0).all()
+        # row independence: row 0 alone gives the same loss
+        solo = F.ctc_loss(_t(logits[:, :1]), _t(labels[:1]),
+                          _t(in_len[:1]), _t(lab_len[:1]),
+                          reduction="none").numpy()
+        np.testing.assert_allclose(solo[0], loss[0], rtol=1e-5)
+
+
+class TestMarginCE:
+    def test_reduces_to_scaled_ce_at_zero_margin(self):
+        rng = np.random.RandomState(2)
+        cos = np.clip(rng.randn(4, 6).astype(np.float32) * 0.3, -1, 1)
+        y = rng.randint(0, 6, (4,))
+        got = F.margin_cross_entropy(_t(cos), _t(y), margin1=1.0,
+                                     margin2=0.0, margin3=0.0,
+                                     scale=10.0,
+                                     reduction="none").numpy()
+        import scipy.special as sp
+
+        z = cos * 10.0
+        ref = sp.logsumexp(z, -1) - z[np.arange(4), y]
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_margin_increases_loss(self):
+        rng = np.random.RandomState(3)
+        cos = np.clip(rng.randn(4, 6).astype(np.float32) * 0.3, -1, 1)
+        y = rng.randint(0, 6, (4,))
+        plain = float(F.margin_cross_entropy(
+            _t(cos), _t(y), margin2=0.0).numpy())
+        arc = float(F.margin_cross_entropy(
+            _t(cos), _t(y), margin2=0.5).numpy())
+        assert arc > plain
+
+
+class TestGridSample:
+    def test_identity_grid(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(1, 2, 4, 4).astype(np.float32)
+        theta = np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+        grid = F.affine_grid(_t(theta), [1, 2, 4, 4], align_corners=True)
+        out = F.grid_sample(_t(x), grid, align_corners=True).numpy()
+        np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-5)
+
+    def test_translation_nearest(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        # shift right by one pixel (align_corners grid step = 2/3)
+        theta = np.array([[[1.0, 0, -2.0 / 3], [0, 1.0, 0]]], np.float32)
+        grid = F.affine_grid(_t(theta), [1, 1, 4, 4], align_corners=True)
+        out = F.grid_sample(_t(x), grid, mode="nearest",
+                            padding_mode="zeros",
+                            align_corners=True).numpy()
+        np.testing.assert_allclose(out[0, 0, :, 1:], x[0, 0, :, :3])
+        np.testing.assert_allclose(out[0, 0, :, 0], 0.0)  # zeros pad
+
+    def test_huber_loss(self):
+        x = np.array([0.0, 2.0], np.float32)
+        y = np.array([0.5, 0.0], np.float32)
+        got = F.huber_loss(_t(x), _t(y), delta=1.0,
+                           reduction="none").numpy()
+        np.testing.assert_allclose(got, [0.125, 1.5], rtol=1e-6)
+
+
+class TestReviewFixes:
+    """Round-3 inline-review findings regression tests."""
+
+    def test_ctc_empty_label(self):
+        """Zero-length label: loss = -log P(all blank), no ln(2) bias."""
+        rng = np.random.RandomState(5)
+        T, C = 4, 3
+        logits = rng.randn(T, 1, C).astype(np.float32)
+        labels = np.zeros((1, 2), np.int64)
+        loss = F.ctc_loss(_t(logits), _t(labels), _t([T]), _t([0]),
+                          reduction="none").numpy()
+        logp = logits[:, 0] - np.log(
+            np.exp(logits[:, 0]).sum(-1, keepdims=True))
+        ref = -logp[:, 0].sum()  # all-blank path
+        np.testing.assert_allclose(loss[0], ref, rtol=1e-5)
+
+    def test_margin_ce_saturated_cos_finite_grad(self):
+        cos = np.zeros((1, 3), np.float32)
+        cos[0, 1] = 1.0  # exactly saturated target
+        t = _t(cos)
+        t.stop_gradient = False
+        loss = F.margin_cross_entropy(t, _t(np.array([1])), margin2=0.5)
+        loss.backward()
+        assert np.isfinite(t.grad.numpy()).all()
+
+    def test_fill_diagonal_nonsquare(self):
+        a = np.zeros((3, 5), np.float32)
+        out = paddle.fill_diagonal(_t(a), 1.0, offset=2).numpy()
+        want = np.zeros((3, 5), np.float32)
+        for i in range(3):
+            want[i, i + 2] = 1.0
+        np.testing.assert_allclose(out, want)
+        with pytest.raises(NotImplementedError):
+            paddle.fill_diagonal(_t(a), 1.0, wrap=True)
+
+    def test_block_tables_strict_on_stale_id(self):
+        from paddle_tpu.inference.kv_cache import BlockKVCacheManager
+
+        mgr = BlockKVCacheManager(1, 1, 4, page_size=4, num_pages=8)
+        mgr.allocate("a", 8)
+        mgr.free("a")
+        with pytest.raises(KeyError):
+            mgr.block_tables(["a"], 2)
+        # continuous-batching idle slots opt in explicitly
+        t = mgr.block_tables(["a"], 2, allow_missing=True)
+        assert (np.asarray(t) == 0).all()
+
+    def test_continuous_batching_near_max_length(self):
+        """Prompt near max_length with small max_new must not overflow
+        the block table (clamped page growth)."""
+        from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                          FusedCausalLM)
+
+        paddle.seed(7)
+        model = FusedCausalLM(vocab_size=32, embed_dim=16, num_heads=2,
+                              dim_feedforward=32, num_layers=1,
+                              max_position=128)
+        eng = ContinuousBatchingEngine(model, max_batch=1, page_size=4,
+                                       max_length=64, decode_chunk=8)
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(0, 32, (58,))
+        eng.submit(prompt, max_new_tokens=6)  # 58+6=64 == max_length
+        done = eng.run()
+        assert len(done) == 1 and len(done[0].generated) == 6

@@ -275,6 +275,85 @@ class TestAlternatingLayers:
         assert _layer_sig(a) != _layer_sig(b)
 
 
+class BlockWide(nn.Layer):
+    """Different config from Block (3x hidden) — NOT stackable with it."""
+
+    def __init__(self):
+        super().__init__()
+        self.ln = nn.LayerNorm(D)
+        self.fc1 = nn.Linear(D, 3 * D)
+        self.fc2 = nn.Linear(3 * D, D)
+
+    def forward(self, x):
+        return x + self.fc2(F.gelu(self.fc1(self.ln(x))))
+
+
+class TestMultiRunPipeline:
+    """Models whose blocks change config mid-stack still pipeline:
+    multi-run decomposition (reference seg-method flexibility,
+    parallel_layers/pp_layers.py:237)."""
+
+    def _descs(self, with_mid=False):
+        from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc
+
+        descs = [LayerDesc(nn.Embedding, VOCAB, D)]
+        descs += [LayerDesc(Block) for _ in range(PP)]
+        if with_mid:
+            descs += [LayerDesc(nn.LayerNorm, D, epsilon=1e-3)]
+        descs += [LayerDesc(BlockWide) for _ in range(PP)]
+        descs += [LayerDesc(nn.Linear, D, VOCAB)]
+        return descs
+
+    def _build_pl(self, seed, with_mid=False):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineLayer)
+
+        paddle.seed(seed)
+        return PipelineLayer(layers=self._descs(with_mid), num_stages=PP,
+                             loss_fn=_loss_fn)
+
+    @pytest.mark.parametrize("with_mid", [False, True])
+    def test_two_configs_train_to_parity(self, with_mid):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineParallel)
+
+        data = _data(M=4)
+        pl_ref = self._build_pl(51, with_mid)
+        opt_ref = paddle.optimizer.SGD(0.1, parameters=pl_ref.parameters())
+        ref_losses = []
+        for _ in range(2):
+            loss = _loss_fn(pl_ref(data[0]), data[1])
+            loss.backward()
+            opt_ref.step()
+            opt_ref.clear_grad()
+            ref_losses.append(float(loss.numpy()))
+
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs["pp_configs"].accumulate_steps = 4
+        hcg = fleet.get_hybrid_communicate_group()
+        pp = PipelineParallel(self._build_pl(51, with_mid), hcg, s)
+        assert pp._multi_run
+        n_stacks = sum(1 for sg in pp._segments if sg["kind"] == "stack")
+        assert n_stacks == 2
+        if with_mid:
+            assert any(sg["kind"] == "repl" for sg in pp._segments)
+        opt = paddle.optimizer.SGD(0.1, parameters=pp.parameters())
+        losses = [float(pp.train_batch(list(data), opt).numpy())
+                  for _ in range(2)]
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-4,
+                                   atol=1e-5)
+
+    def test_single_config_still_uses_1f1b(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineParallel)
+
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs["pp_configs"].accumulate_steps = 4
+        hcg = fleet.get_hybrid_communicate_group()
+        pp = PipelineParallel(_build(9), hcg, s)
+        assert not pp._multi_run
+
+
 class TestPipelineInterleave:
     def test_interleave_matches_single_program(self):
         from paddle_tpu.distributed.fleet.meta_parallel import (
@@ -295,6 +374,93 @@ class TestPipelineInterleave:
                   for _ in range(2)]
         np.testing.assert_allclose(losses, ref_losses, rtol=2e-4,
                                    atol=1e-5)
+
+    def test_interleaved_1f1b_is_default_schedule(self):
+        """PipelineParallelWithInterleave must run the TRUE interleaved
+        1F1B engine (reference pipeline_parallel.py:906), not fall back
+        to circular FThenB."""
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineParallelWithInterleave)
+
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs["pp_configs"].accumulate_steps = 8
+        hcg = fleet.get_hybrid_communicate_group()
+        pp = PipelineParallelWithInterleave(
+            _build(31, n_blocks=8, num_virtual=2), hcg, s)
+        assert pp.schedule == "1F1B"
+
+    def test_interleaved_residual_live_set_bounded(self):
+        """The VPP engine keeps residuals in a ring of depth 2*v*pp —
+        not per-microbatch stashes."""
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import (
+            spmd_pipeline)
+
+        Pn, v, M, mb, Dd = 2, 2, 8, 2, 6
+        mesh = fleet.get_hybrid_communicate_group().mesh.jax_mesh()
+        # engine needs a pp axis of size 2 — reuse dp axis slot by
+        # building a dedicated mesh
+        import jax as _jax
+        from jax.sharding import Mesh
+
+        devs = np.array(_jax.devices()[:Pn])
+        mesh = Mesh(devs, ("pp",))
+
+        def stage_fn(sp, x):
+            return jnp.tanh(x @ sp["w"])
+
+        def head_loss(hp, y, lbl):
+            return jnp.mean((y @ hp["wo"] - lbl) ** 2)
+
+        stacked = {"w": jnp.ones((v * Pn, Dd, Dd)) * 0.1}
+        head = {"wo": jnp.ones((Dd, 3))}
+        h_all = jnp.ones((M, mb, Dd))
+        lbl = jnp.ones((M, mb, 3))
+        jaxpr = jax.make_jaxpr(
+            lambda st, hp, ha, lb:
+            spmd_pipeline.pipeline_interleaved_1f1b_grads(
+                stage_fn, head_loss, st, hp, ha, lb, mesh=mesh,
+                num_stages=Pn, num_virtual=v))(stacked, head, h_all, lbl)
+        text = str(jaxpr).replace(" ", "")
+        ring_dim = 2 * v * Pn
+        assert f"({ring_dim},{mb},{Dd})" in text, \
+            "residual ring buffers of depth 2*v*pp expected"
+        import re
+
+        m_stash = re.findall(rf"\({M},{mb},{Dd}\)", text)
+        assert len(m_stash) < 40, (
+            f"too many [M,...] buffers ({len(m_stash)}) — VPP residuals "
+            f"should live in the 2*v*pp ring")
+
+    def test_vpp_bubble_smaller_than_plain_1f1b(self):
+        """The defining property of VPP (reference
+        pipeline_parallel.py:906): the interleaved schedule's total
+        compute-units must be strictly fewer than plain 1F1B over
+        v-chunk stages, for every v > 1."""
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils.spmd_pipeline \
+            import interleaved_tick_count
+
+        for Pn in (2, 4, 8):
+            for v in (2, 3, 4):
+                for M in (8, 16, 64):
+                    vpp_units = interleaved_tick_count(M, Pn, v)  # 1 chunk/tick
+                    plain_units = (M + 2 * Pn - 1) * v  # v chunks/tick
+                    assert vpp_units < plain_units, (
+                        f"P={Pn} v={v} M={M}: VPP {vpp_units} !< "
+                        f"plain {plain_units}")
+        # bubble (extra units beyond the ideal M*v) shrinks toward
+        # plain/vpp ≈ v(2P-1)/(vP+P-1) ≈ 2v/(v+1) at scale
+        vpp_bubble = interleaved_tick_count(64, 8, 4) - 64 * 4
+        plain_bubble = (64 + 2 * 8 - 1) * 4 - 64 * 4
+        assert vpp_bubble <= plain_bubble / 1.5, (
+            f"bubble {vpp_bubble} vs plain {plain_bubble}")
+
+    def test_vpp_formulas_reduce_to_plain_at_v1(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_utils.spmd_pipeline \
+            import interleaved_tick_count
+
+        for Pn in (2, 4):
+            for M in (4, 8, 16):
+                assert interleaved_tick_count(M, Pn, 1) == M + 2 * Pn - 1
 
     def test_distributed_model_picks_interleave(self):
         from paddle_tpu.distributed.fleet.meta_parallel import (

@@ -1,0 +1,197 @@
+"""Quantization framework parity: QuantConfig resolution, factories,
+quanter registry, PTQ/QAT of LeNet -> int8 inference predictor.
+
+Reference parity targets: python/paddle/quantization/{config.py,
+factory.py, ptq.py, qat.py, quanters/abs_max.py}.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    QAT, PTQ, AbsmaxObserver, FakeQuanterWithAbsMaxObserver,
+    MovingAverageObserver, ObserverFactory, QuantConfig, QuantedLinear,
+    QuanterFactory, SingleLayerConfig, quanter)
+
+
+def _lenet():
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(3)
+    return LeNet(num_classes=10)
+
+
+def _calib_data(n=4):
+    rng = np.random.RandomState(0)
+    return paddle.to_tensor(rng.randn(n, 1, 28, 28).astype(np.float32))
+
+
+class TestFactories:
+    def test_quanter_factory_delays_construction(self):
+        f = FakeQuanterWithAbsMaxObserver(moving_rate=0.8)
+        assert isinstance(f, QuanterFactory)
+        a, b = f._instance(), f._instance()
+        assert a is not b
+        assert a.momentum == 0.8
+
+    def test_quanter_decorator_registers(self):
+        from paddle_tpu.quantization.factory import QUANTER_REGISTRY
+
+        assert "FakeQuanterWithAbsMaxObserver" in QUANTER_REGISTRY
+
+        @quanter("MyTestQuanter")
+        class MyTestQuanterLayer(AbsmaxObserver):
+            pass
+
+        assert "MyTestQuanter" in QUANTER_REGISTRY
+        import paddle_tpu.quantization.factory  # registry module
+        f = QUANTER_REGISTRY["MyTestQuanter"](quant_bits=4)
+        assert f._instance().quant_bits == 4
+
+
+class TestQuantConfigResolution:
+    def test_type_config(self):
+        cfg = QuantConfig()
+        cfg.add_type_config(nn.Linear,
+                            activation=lambda: MovingAverageObserver(),
+                            weight=lambda: AbsmaxObserver())
+        lin, conv = nn.Linear(4, 4), nn.Conv2D(1, 1, 3)
+        assert cfg._get_config_by_layer("x", lin) is not None
+        assert cfg._get_config_by_layer("y", conv) is None
+
+    def test_name_config_beats_type(self):
+        cfg = QuantConfig()
+        marker = lambda: AbsmaxObserver(quant_bits=4)  # noqa: E731
+        cfg.add_type_config(nn.Linear, weight=lambda: AbsmaxObserver())
+        cfg.add_name_config("fc2", weight=marker)
+        lin = nn.Linear(4, 4)
+        got = cfg._get_config_by_layer("fc2", lin)
+        from paddle_tpu.quantization.factory import instantiate
+
+        assert instantiate(got.weight).quant_bits == 4
+
+    def test_layer_config_beats_all(self):
+        cfg = QuantConfig()
+        lin = nn.Linear(4, 4)
+        cfg.add_name_config("fc", weight=lambda: AbsmaxObserver(8))
+        cfg.add_layer_config(lin, weight=lambda: AbsmaxObserver(4))
+        from paddle_tpu.quantization.factory import instantiate
+
+        got = cfg._get_config_by_layer("fc", lin)
+        assert instantiate(got.weight).quant_bits == 4
+
+    def test_qat_layer_mapping_registry(self):
+        cfg = QuantConfig()
+        assert nn.Linear in cfg.qat_layer_mappings
+
+        class Custom(nn.Layer):
+            pass
+
+        class CustomQAT(nn.Layer):
+            pass
+
+        cfg.add_qat_layer_mapping(Custom, CustomQAT)
+        assert cfg.qat_layer_mappings[Custom] is CustomQAT
+
+    def test_customized_leaves_stop_descent(self):
+        cfg = QuantConfig()
+
+        class Blockish(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+        cfg.add_customized_leaves(Blockish)
+        m = nn.Sequential(Blockish())
+        PTQ(cfg).quantize(m)
+        # the inner Linear must NOT have been wrapped
+        from paddle_tpu.quantization import _ObservedLinear
+
+        inner = list(m.named_sublayers())
+        assert not any(isinstance(l, _ObservedLinear) for _, l in inner)
+
+
+class TestLeNetPTQ:
+    def test_ptq_lenet_to_predictor(self, tmp_path):
+        """PTQ LeNet -> quantized predictor matches fp32 within
+        tolerance (the reference's PTQ->save_inference_model flow)."""
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.static.input_spec import InputSpec
+
+        net = _lenet()
+        x = _calib_data()
+        fp32_out = net(x).numpy()
+
+        ptq = PTQ()
+        net = ptq.quantize(net)
+        for _ in range(3):   # calibration passes
+            net(x)
+        net = ptq.convert(net)
+        assert any(isinstance(l, QuantedLinear)
+                   for _, l in net.named_sublayers())
+        q_out = net(x).numpy()
+        # int8 weight-only: logits close to fp32
+        assert np.mean(np.abs(q_out - fp32_out)) < 0.1 * (
+            np.mean(np.abs(fp32_out)) + 1e-6)
+        np.testing.assert_array_equal(q_out.argmax(-1),
+                                      fp32_out.argmax(-1))
+
+        path = str(tmp_path / "lenet_int8")
+        paddle.jit.save(net, path,
+                        input_spec=[InputSpec([4, 1, 28, 28], "float32")])
+        pred = create_predictor(Config(path))
+        name = pred.get_input_names()[0]
+        pred.get_input_handle(name).copy_from_cpu(np.asarray(x.numpy()))
+        assert pred.run()
+        got = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(got, q_out, rtol=1e-5, atol=1e-5)
+
+
+class TestLeNetQAT:
+    def test_qat_lenet_trains_and_converts(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.static.input_spec import InputSpec
+        import paddle_tpu.nn.functional as F
+
+        net = _lenet()
+        cfg = QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver(moving_rate=0.9),
+            weight=FakeQuanterWithAbsMaxObserver(moving_rate=0.9))
+        qat = QAT(cfg)
+        net = qat.quantize(net)
+
+        opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(8, 1, 28, 28).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 10, (8,)))
+        losses = []
+        for _ in range(5):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses  # STE lets QAT train
+
+        net.eval()
+        fake_out = net(x).numpy()
+        net = qat.convert(net)
+        assert any(isinstance(l, QuantedLinear)
+                   for _, l in net.named_sublayers())
+        q_out = net(x).numpy()
+        # converted int8 model tracks the fake-quant model
+        np.testing.assert_allclose(
+            q_out.argmax(-1), fake_out.argmax(-1))
+
+        path = str(tmp_path / "lenet_qat_int8")
+        paddle.jit.save(net, path,
+                        input_spec=[InputSpec([8, 1, 28, 28], "float32")])
+        pred = create_predictor(Config(path))
+        name = pred.get_input_names()[0]
+        pred.get_input_handle(name).copy_from_cpu(np.asarray(x.numpy()))
+        assert pred.run()
+        got = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(got, q_out, rtol=1e-4, atol=1e-4)

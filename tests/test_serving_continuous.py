@@ -1,0 +1,168 @@
+"""Serving generality: per-sequence prompt lengths + continuous batching.
+
+Reference parity target: the per-request seq_lens/block-table interface
+of paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
+plus the admit/evict loop of its serving frontends.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import (
+    ContinuousBatchingEngine, FusedCausalLM, GenerationEngine)
+
+
+def _model(seed=7):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=64, embed_dim=32, num_heads=4,
+                         dim_feedforward=64, num_layers=2,
+                         max_position=128)
+
+
+def _dense_greedy(model, prompt, n):
+    """Reference: full re-forward each step, argmax of the last real
+    position."""
+    seq = np.asarray(prompt, np.int64).reshape(1, -1)
+    for _ in range(n):
+        logits = model(paddle.to_tensor(seq)).numpy()
+        nxt = logits[:, -1].argmax(-1)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    return seq[0]
+
+
+class TestRaggedPrompts:
+    def test_unequal_prompt_lengths_per_seq_parity(self):
+        """A batch with different prompt lengths must decode each row to
+        the same tokens as that row generated alone (per-sequence greedy
+        parity) — the reference's per-request seq_lens contract."""
+        model = _model()
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 64, (L,)) for L in (3, 6, 9)]
+        n_new = 6
+
+        engine = GenerationEngine(model, page_size=4, max_length=64,
+                                  decode_chunk=2)
+        out = engine.generate(prompts, max_new_tokens=n_new)
+        assert out.shape == (3, 9 + n_new)
+
+        for i, p in enumerate(prompts):
+            ref = _dense_greedy(model, p, n_new)
+            got = np.concatenate(
+                [out[i, : len(p)], out[i, len(p): len(p) + n_new]])
+            np.testing.assert_array_equal(
+                got, ref, err_msg=f"row {i} (len {len(p)})")
+
+    def test_rect_batch_with_seq_lens(self):
+        model = _model()
+        rng = np.random.RandomState(5)
+        ids = rng.randint(0, 64, (2, 8))
+        lens = np.array([5, 8])
+        engine = GenerationEngine(model, page_size=4, max_length=64)
+        out = engine.generate(ids, max_new_tokens=4, seq_lens=lens)
+        for i in range(2):
+            ref = _dense_greedy(model, ids[i, : lens[i]], 4)
+            np.testing.assert_array_equal(
+                out[i, lens[i]: lens[i] + 4], ref[lens[i]:])
+
+    def test_on_demand_paging(self):
+        """Pages must be allocated as sequences grow, not all upfront."""
+        model = _model()
+        engine = GenerationEngine(model, page_size=4, max_length=64,
+                                  decode_chunk=2)
+        ids = np.array([[1, 2, 3]])
+        # instrument: capture free-page count right after prefill alloc
+        from paddle_tpu.inference.kv_cache import BlockKVCacheManager
+
+        orig_alloc = BlockKVCacheManager.allocate
+        snapshots = []
+
+        def spy(self, seq_id, max_length):
+            r = orig_alloc(self, seq_id, max_length)
+            snapshots.append(self.free_pages)
+            return r
+
+        BlockKVCacheManager.allocate = spy
+        try:
+            engine.generate(ids, max_new_tokens=12)
+        finally:
+            BlockKVCacheManager.allocate = orig_alloc
+        # prompt len 3 -> 1 page allocated initially; 64-token coverage
+        # would be 16 pages. Paging actually pages now.
+        total = engine._mgr.num_pages
+        assert snapshots[0] >= total - 2, (
+            f"upfront allocation detected: {total - snapshots[0]} pages "
+            "taken at prefill for a 3-token prompt")
+
+
+class TestContinuousBatching:
+    def test_batch_parity_with_solo_runs(self):
+        model = _model()
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, 64, (L,)) for L in (4, 7, 5)]
+        eng = ContinuousBatchingEngine(model, max_batch=3, page_size=4,
+                                       max_length=64, decode_chunk=2)
+        rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        done = eng.run()
+        assert sorted(r.id for r in done) == sorted(rids)
+        by_id = {r.id: r for r in done}
+        for rid, p in zip(rids, prompts):
+            ref = _dense_greedy(model, p, 6)
+            np.testing.assert_array_equal(by_id[rid].output, ref,
+                                          err_msg=f"req {rid}")
+
+    def test_mid_stream_admit(self):
+        """A request submitted while others are decoding must be admitted
+        into a free slot mid-stream and still match its solo output."""
+        model = _model()
+        rng = np.random.RandomState(13)
+        p1, p2 = rng.randint(0, 64, (5,)), rng.randint(0, 64, (8,))
+        p3 = rng.randint(0, 64, (6,))
+
+        eng = ContinuousBatchingEngine(model, max_batch=2, page_size=4,
+                                       max_length=64, decode_chunk=2)
+        r1 = eng.submit(p1, max_new_tokens=10)
+        r2 = eng.submit(p2, max_new_tokens=10)
+        eng.step()          # both decoding
+        assert eng.num_active == 2
+        r3 = eng.submit(p3, max_new_tokens=4)   # queued: no free slot
+        eng.step()
+        # r3 waits until a slot frees (max_batch=2)
+        assert any(r.id == r3 for r in eng.waiting) or eng.num_active == 2
+        done = eng.run()
+        by_id = {r.id: r for r in done}
+        assert set(by_id) == {r1, r2, r3}
+        for rid, p, n in ((r1, p1, 10), (r2, p2, 10), (r3, p3, 4)):
+            ref = _dense_greedy(model, p, n)
+            np.testing.assert_array_equal(by_id[rid].output, ref,
+                                          err_msg=f"req {rid}")
+
+    def test_more_requests_than_slots(self):
+        """6 requests through 2 slots: slot reuse + page recycling."""
+        model = _model()
+        rng = np.random.RandomState(17)
+        prompts = [rng.randint(0, 64, (rng.randint(3, 10),))
+                   for _ in range(6)]
+        eng = ContinuousBatchingEngine(model, max_batch=2, page_size=4,
+                                       max_length=64, decode_chunk=2)
+        rids = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        done = eng.run()
+        assert len(done) == 6
+        by_id = {r.id: r for r in done}
+        for rid, p in zip(rids, prompts):
+            ref = _dense_greedy(model, p, 5)
+            np.testing.assert_array_equal(by_id[rid].output, ref)
+        # all pages returned to the pool
+        assert eng._mgr.free_pages == eng._mgr.num_pages - 1  # scratch
+
+    def test_eos_finishes_request(self):
+        model = _model()
+        ids = np.array([1, 2, 3])
+        ref = _dense_greedy(model, ids, 1)
+        eos = int(ref[-1])  # first generated token = EOS
+        eng = ContinuousBatchingEngine(model, max_batch=2, page_size=4,
+                                       max_length=32, decode_chunk=2)
+        rid = eng.submit(ids, max_new_tokens=8, eos_token_id=eos)
+        done = eng.run()
+        assert done[0].id == rid and done[0].done
+        assert done[0].generated[-1] == eos
+        assert len(done[0].generated) <= 8

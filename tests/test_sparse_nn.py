@@ -1,0 +1,182 @@
+"""sparse.nn: Conv3D/SubmConv3D/MaxPool3D/BatchNorm/activations/attention.
+
+Reference parity targets: python/paddle/sparse/nn (layer/conv.py:239
+Conv3D, :509 SubmConv3D; functional/transformer.py:22 attention;
+kernels paddle/phi/kernels/sparse/). Numeric reference: dense conv on
+the densified input.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+from paddle_tpu.sparse import nn as snn
+
+
+def _rand_coo(rng, shape, density=0.2):
+    """Random sparse [N, D, H, W, C] with unique active sites."""
+    N, D, H, W, C = shape
+    total = N * D * H * W
+    n_active = max(int(total * density), 1)
+    flat = rng.choice(total, n_active, replace=False)
+    coords = np.stack(np.unravel_index(flat, (N, D, H, W)), axis=0)
+    vals = rng.randn(n_active, C).astype(np.float32)
+    return sparse.sparse_coo_tensor(coords, vals, shape=list(shape))
+
+
+def _dense_conv3d(x_dense, w, b, stride, padding):
+    """Dense NDHWC conv reference via numpy (small sizes)."""
+    N, D, H, W, C = x_dense.shape
+    kd, kh, kw, cin, cout = w.shape
+    sd = sh = sw = stride
+    pd = ph = pw = padding
+    od = (D + 2 * pd - kd) // sd + 1
+    oh = (H + 2 * ph - kh) // sh + 1
+    ow = (W + 2 * pw - kw) // sw + 1
+    xp = np.zeros((N, D + 2 * pd, H + 2 * ph, W + 2 * pw, C),
+                  x_dense.dtype)
+    xp[:, pd:pd + D, ph:ph + H, pw:pw + W] = x_dense
+    out = np.zeros((N, od, oh, ow, cout), np.float32)
+    for n in range(N):
+        for i in range(od):
+            for j in range(oh):
+                for k in range(ow):
+                    patch = xp[n, i * sd:i * sd + kd, j * sh:j * sh + kh,
+                               k * sw:k * sw + kw]
+                    out[n, i, j, k] = np.tensordot(
+                        patch, w, axes=([0, 1, 2, 3], [0, 1, 2, 3]))
+    if b is not None:
+        out += b
+    return out
+
+
+class TestSparseConv:
+    def test_conv3d_matches_dense(self):
+        rng = np.random.RandomState(0)
+        shape = (2, 4, 4, 4, 3)
+        x = _rand_coo(rng, shape, density=0.3)
+        w = rng.randn(3, 3, 3, 3, 5).astype(np.float32) * 0.3
+        b = rng.randn(5).astype(np.float32)
+        out = snn.conv3d(x, paddle.to_tensor(w), paddle.to_tensor(b),
+                         stride=1, padding=1)
+        got = out.to_dense().numpy()
+        ref = _dense_conv3d(x.to_dense().numpy(), w, None, 1, 1)
+        # sparse conv adds bias only at ACTIVE output sites; compare there
+        active = np.abs(got).sum(-1) > 0
+        np.testing.assert_allclose(got[active], (ref + b)[active],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_subm_conv3d_preserves_pattern(self):
+        rng = np.random.RandomState(1)
+        shape = (1, 5, 5, 5, 2)
+        x = _rand_coo(rng, shape, density=0.15)
+        w = rng.randn(3, 3, 3, 2, 4).astype(np.float32)
+        out = snn.subm_conv3d(x, paddle.to_tensor(w), None, stride=1,
+                              padding=1)
+        assert out.shape == [1, 5, 5, 5, 4]
+        in_coords = set(map(tuple, np.asarray(
+            x.indices().numpy()).T.tolist()))
+        out_coords = set(map(tuple, np.asarray(
+            out.indices().numpy()).T.tolist()))
+        assert out_coords == in_coords  # submanifold contract
+
+    def test_conv3d_layer_and_stride(self):
+        rng = np.random.RandomState(2)
+        paddle.seed(0)
+        conv = snn.Conv3D(2, 6, kernel_size=2, stride=2, padding=0)
+        x = _rand_coo(rng, (1, 4, 4, 4, 2), density=0.4)
+        out = conv(x)
+        assert out.shape == [1, 2, 2, 2, 6]
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        ref = _dense_conv3d(x.to_dense().numpy(), w, None, 2, 0)
+        got = out.to_dense().numpy()
+        active = np.abs(got).sum(-1) > 0
+        np.testing.assert_allclose(got[active], (ref + b)[active],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_max_pool3d(self):
+        rng = np.random.RandomState(3)
+        x = _rand_coo(rng, (1, 4, 4, 4, 2), density=0.4)
+        out = snn.max_pool3d(x, kernel_size=2, stride=2)
+        assert out.shape == [1, 2, 2, 2, 2]
+        dense = x.to_dense().numpy()
+        got = out.to_dense().numpy()
+        # at active output sites: max over the 2x2x2 window's ACTIVE
+        # inputs (empty sites don't contribute zeros)
+        for (n, i, j, k) in np.argwhere(np.abs(got).sum(-1) > 0):
+            win = dense[n, 2 * i:2 * i + 2, 2 * j:2 * j + 2,
+                        2 * k:2 * k + 2].reshape(-1, 2)
+            active_rows = win[np.abs(win).sum(-1) > 0]
+            np.testing.assert_allclose(got[n, i, j, k],
+                                       active_rows.max(0), rtol=1e-5)
+
+
+class TestSparseActivationsNorm:
+    def test_activations(self):
+        rng = np.random.RandomState(4)
+        x = _rand_coo(rng, (1, 3, 3, 3, 4), density=0.3)
+        vals = x.values().numpy()
+        np.testing.assert_allclose(
+            snn.ReLU()(x).values().numpy(), np.maximum(vals, 0))
+        np.testing.assert_allclose(
+            snn.ReLU6()(x).values().numpy(),
+            np.clip(vals * 1.0, 0, 6), rtol=1e-6)
+        np.testing.assert_allclose(
+            snn.LeakyReLU(0.1)(x).values().numpy(),
+            np.where(vals >= 0, vals, 0.1 * vals), rtol=1e-6)
+
+    def test_csr_softmax(self):
+        crows = np.array([0, 2, 3])
+        cols = np.array([0, 2, 1])
+        vals = np.array([1.0, 2.0, 5.0], np.float32)
+        csr = sparse.sparse_csr_tensor(crows, cols, vals, [2, 3])
+        out = snn.Softmax()(csr)
+        v = out.values().numpy()
+        e = np.exp([1.0, 2.0])
+        np.testing.assert_allclose(v[:2], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(v[2], 1.0, rtol=1e-6)
+
+    def test_batchnorm(self):
+        rng = np.random.RandomState(5)
+        paddle.seed(0)
+        x = _rand_coo(rng, (2, 3, 3, 3, 4), density=0.5)
+        bn = snn.BatchNorm(4)
+        out = bn(x)
+        v = out.values().numpy()
+        assert v.shape == x.values().numpy().shape
+        np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(v.std(0), 1.0, atol=1e-2)
+
+
+class TestSparseAttention:
+    def test_matches_dense_masked(self):
+        rng = np.random.RandomState(6)
+        b, h, s, d = 1, 2, 4, 8
+        q = rng.randn(b, h, s, d).astype(np.float32)
+        k = rng.randn(b, h, s, d).astype(np.float32)
+        v = rng.randn(b, h, s, d).astype(np.float32)
+        # causal pattern as batched CSR [b*h, s, s]
+        crows, cols = [], []
+        for _ in range(b * h):
+            cr = [0]
+            for r in range(s):
+                cols.extend(range(r + 1))
+                cr.append(cr[-1] + r + 1)
+            crows.extend(cr)
+        nnz = sum(r + 1 for r in range(s)) * b * h
+        mask = sparse.sparse_csr_tensor(
+            np.array(crows), np.array(cols),
+            np.ones(nnz, np.float32), [b * h, s, s])
+        out = snn.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                            paddle.to_tensor(v), mask).numpy()
+        # dense causal reference
+        logits = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(d)
+        causal = np.tril(np.ones((s, s), bool))
+        logits = np.where(causal, logits, -1e30)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        ref = np.einsum("bhst,bhtd->bhsd", w, v)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,206 @@
+"""paddle.text (datasets + viterbi_decode) and incubate fills
+(autotune, DistributedFusedLamb, multiprocessing).
+
+Reference parity targets: python/paddle/text/, incubate/autotune.py,
+incubate/optimizer/distributed_fused_lamb.py:115,
+incubate/multiprocessing/.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _np_viterbi(emission, trans, length, include_bos_eos):
+    """Brute-force reference over all tag paths (small cases)."""
+    import itertools
+
+    T, n = emission.shape
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(n), repeat=length):
+        s = emission[0, path[0]]
+        if include_bos_eos:
+            s += trans[n - 1, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emission[t, path[t]]
+        if include_bos_eos:
+            s += trans[path[length - 1], n - 2]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("include", [False, True])
+    def test_matches_bruteforce(self, include):
+        rng = np.random.RandomState(0)
+        b, T, n = 3, 5, 4
+        emission = rng.rand(b, T, n).astype(np.float32)
+        trans = rng.rand(n, n).astype(np.float32)
+        lengths = np.array([5, 3, 4], np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(emission), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=include)
+        scores, paths = scores.numpy(), paths.numpy()
+        assert paths.shape == (b, 5)
+        for i in range(b):
+            ref_s, ref_p = _np_viterbi(emission[i], trans,
+                                       int(lengths[i]), include)
+            np.testing.assert_allclose(scores[i], ref_s, rtol=1e-5,
+                                       err_msg=f"row {i}")
+            np.testing.assert_array_equal(
+                paths[i, : lengths[i]], ref_p, err_msg=f"row {i}")
+            assert (paths[i, lengths[i]:] == 0).all()
+
+    def test_decoder_layer(self):
+        rng = np.random.RandomState(1)
+        trans = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+        dec = paddle.text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        em = paddle.to_tensor(rng.rand(2, 4, 4).astype(np.float32))
+        lens = paddle.to_tensor(np.array([4, 2], np.int64))
+        scores, paths = dec(em, lens)
+        assert tuple(scores.shape) == (2,) and tuple(paths.shape) == (2, 4)
+
+
+class TestTextDatasets:
+    def test_all_datasets_build_and_index(self):
+        from paddle_tpu.text import (Conll05st, Imdb, Imikolov, Movielens,
+                                     UCIHousing, WMT14, WMT16)
+
+        ds = Imdb(mode="train", synthetic_size=32)
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label.shape == (1,)
+        assert len(ds) == 32
+
+        ng = Imikolov(mode="train", window_size=5, synthetic_size=16)
+        assert len(ng[0]) == 5
+
+        ml = Movielens(mode="test", synthetic_size=8)
+        rec = ml[3]
+        assert len(rec) == 8 and rec[-1].dtype == np.float32
+
+        uci = UCIHousing(mode="train", synthetic_size=16)
+        f, t = uci[0]
+        assert f.shape == (13,) and t.shape == (1,)
+
+        for cls in (WMT14, WMT16):
+            wmt = cls(mode="train", synthetic_size=8)
+            src, trg, nxt = wmt[0]
+            assert src[0] == 0 and src[-1] == 1  # BOS/EOS framing
+            assert len(trg) == len(nxt)
+
+        srl = Conll05st(synthetic_size=4)
+        sample = srl[0]
+        assert len(sample) == 9
+        assert all(len(s) == len(sample[0]) for s in sample)
+
+    def test_uci_trains_linear_regression(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.text import UCIHousing
+
+        ds = UCIHousing(mode="train", synthetic_size=64)
+        loader = DataLoader(ds, batch_size=16, shuffle=True)
+        paddle.seed(0)
+        model = nn.Linear(13, 1)
+        opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+        losses = []
+        for _ in range(5):
+            for x, y in loader:
+                loss = ((model(x) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestIncubateAutotune:
+    def test_set_config_dict_and_reset(self):
+        from paddle_tpu.incubate import autotune
+        from paddle_tpu.io import dataloader as dl
+
+        autotune.set_config({"dataloader": {"enable": True,
+                                            "tuning_steps": 100}})
+        assert dl.AUTOTUNE_NUM_WORKERS is True
+        assert dl.AUTOTUNE_STEPS == 100
+        cfg = autotune.get_config()
+        assert cfg["dataloader"]["enable"] is True
+        autotune.set_config({"dataloader": {"enable": False}})
+        assert dl.AUTOTUNE_NUM_WORKERS is False
+
+    def test_set_config_json_file(self, tmp_path):
+        import json
+
+        from paddle_tpu.incubate import autotune
+
+        p = tmp_path / "tune.json"
+        p.write_text(json.dumps({"kernel": {"enable": True}}))
+        autotune.set_config(str(p))
+        assert autotune.get_config()["kernel"]["enable"] is True
+
+
+class TestDistributedFusedLamb:
+    def test_trains_and_matches_lamb_at_acc1(self):
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(16, 2).astype(np.float32))
+
+        def run(opt_cls, **kw):
+            paddle.seed(5)
+            m = nn.Linear(8, 2)
+            opt = opt_cls(learning_rate=1e-2,
+                          parameters=m.parameters(), **kw)
+            for _ in range(5):
+                loss = ((m(x) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return float(loss.numpy()), m
+
+        l_ref, _ = run(paddle.optimizer.Lamb)
+        l_dfl, _ = run(DistributedFusedLamb)
+        np.testing.assert_allclose(l_dfl, l_ref, rtol=1e-5)
+
+    def test_gradient_accumulation(self):
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 2).astype(np.float32))
+        paddle.seed(5)
+        m = nn.Linear(4, 2)
+        w0 = m.weight.numpy().copy()
+        opt = DistributedFusedLamb(1e-2, parameters=m.parameters(),
+                                   gradient_accumulation_steps=2)
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()   # accumulates, no update
+        np.testing.assert_array_equal(m.weight.numpy(), w0)
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()   # applies
+        assert not np.allclose(m.weight.numpy(), w0)
+
+
+class TestIncubateMultiprocessing:
+    def test_tensor_through_queue(self):
+        from paddle_tpu.incubate import multiprocessing as mp
+
+        q = mp.get_context("spawn").Queue() if False else mp.Queue()
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        q.put(t)
+        got = q.get(timeout=30)
+        np.testing.assert_allclose(got.numpy(), t.numpy())
+        assert isinstance(got, type(t))
+
+    def test_pickle_roundtrip(self):
+        import pickle
+
+        t = paddle.to_tensor(np.ones((3, 2), np.float32))
+        t.stop_gradient = False
+        r = pickle.loads(pickle.dumps(t))
+        np.testing.assert_allclose(r.numpy(), t.numpy())
+        assert r.stop_gradient is False
