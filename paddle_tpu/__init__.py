@@ -75,6 +75,7 @@ from . import onnx  # noqa: E402
 from . import audio  # noqa: E402
 from . import signal  # noqa: E402
 from . import text  # noqa: E402
+from . import geometric  # noqa: E402
 from . import inference  # noqa: E402
 
 # `paddle.disable_static()/enable_static()` parity: we are always dynamic

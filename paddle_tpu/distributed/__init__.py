@@ -38,3 +38,4 @@ __all__ = [
     "get_world_size", "DataParallel", "init_parallel_env", "is_initialized",
 ]
 from . import ps  # noqa: F401  (raise-stub surface, SURVEY §7.3)
+from . import rpc  # noqa: F401

@@ -46,6 +46,24 @@ from .pp_utils.spmd_pipeline import (circular_pipeline_fwd,
 __all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
 
 
+def _trailing_spec(tmpl_p, ndim_stacked: int, pp_axis: str):
+    """Per-dim axis names for a stacked param's trailing dims: a template
+    param carrying a dist annotation (e.g. ColumnParallelLinear's
+    mp=Shard(1)) keeps its sharding on the stacked array, so GSPMD
+    partitions the stage matmuls over mp INSIDE the pp shard_map
+    (TP+PP composition — reference dygraph_hybrid_dpppmp.py)."""
+    trailing = [None] * (ndim_stacked - 1)
+    dist = getattr(tmpl_p, "_dist_attr", None)
+    if dist is not None:
+        dmesh, placements = dist
+        from ...auto_parallel.placement import Shard as _Shard
+
+        for ax_name, pl in zip(dmesh.dim_names, placements):
+            if isinstance(pl, _Shard) and ax_name != pp_axis:
+                trailing[pl.dim] = ax_name
+    return trailing
+
+
 def _scalar_config(layer: Layer):
     """Non-parameter configuration that changes compute (dropout rate,
     eps, activation name, ...) — layers whose config differs must not be
@@ -187,21 +205,7 @@ class PipelineParallel(Layer):
             host = onp.stack(
                 [onp.asarray(per_chunk[j * P_ + p][q]._data)
                  for p in range(P_) for j in range(v)])
-            # TP+PP composition: a template param carrying a dist
-            # annotation (e.g. ColumnParallelLinear's mp=Shard(1)) keeps
-            # its per-dim axis sharding on the stacked array — GSPMD then
-            # partitions the stage matmuls over mp INSIDE the pp
-            # shard_map (mp rides the auto axes). Reference:
-            # dygraph_hybrid_dpppmp.py runs mp layers inside pp stages.
-            trailing = [None] * (host.ndim - 1)
-            dist = getattr(tmpl_p, "_dist_attr", None)
-            if dist is not None:
-                dmesh, placements = dist
-                from ...auto_parallel.placement import Shard as _Shard
-
-                for ax_name, pl in zip(dmesh.dim_names, placements):
-                    if isinstance(pl, _Shard) and ax_name != self._pp_axis:
-                        trailing[pl.dim] = ax_name
+            trailing = _trailing_spec(tmpl_p, host.ndim, self._pp_axis)
             sh = NamedSharding(
                 mesh, PartitionSpec(self._pp_axis, *trailing))
             arr = jax.make_array_from_callback(
@@ -257,9 +261,9 @@ class PipelineParallel(Layer):
             host = onp.stack(
                 [onp.asarray(per_chunk[j * P_ + p][q]._data)
                  for p in range(P_) for j in range(v)])
+            trailing = _trailing_spec(tmpl_p, host.ndim, self._pp_axis)
             sh = NamedSharding(
-                mesh, PartitionSpec(self._pp_axis,
-                                    *([None] * (host.ndim - 1))))
+                mesh, PartitionSpec(self._pp_axis, *trailing))
             arr = jax.make_array_from_callback(
                 host.shape, sh, lambda idx, h=host: h[idx])
             sp = Parameter(arr, name=f"pp_stack.{q}.{tmpl_names[q]}",

@@ -1,0 +1,195 @@
+"""paddle.distributed.rpc — minimal RPC over the coordination service.
+
+TPU-native equivalent of the reference's brpc-backed RPC (reference:
+python/paddle/distributed/rpc/rpc.py — init_rpc, rpc_sync, rpc_async,
+shutdown, get_worker_info; C++ paddle/fluid/distributed/rpc). The
+transport here is the JAX coordination-service KV store (the TCPStore
+equivalent): each worker owns an ordered inbox (a KV counter hands out
+slots), a daemon thread executes incoming pickled calls, and responses
+land on per-call keys. Control-plane scale by design — the data plane
+is XLA collectives.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+_TIMEOUT_MS = 120_000
+_state: Dict[str, Any] = {"inited": False}
+
+
+class WorkerInfo:
+    """(reference rpc.py WorkerInfo) name/rank/ip/port — transport is
+    the coordinator, so ip/port are informational."""
+
+    def __init__(self, name: str, rank: int, ip: str = "", port: int = 0):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+
+
+def _client():
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "rpc needs jax.distributed initialized "
+            "(init_parallel_env / init_rpc with master_endpoint)")
+    return client
+
+
+class _Future:
+    """(reference rpc_async return) .wait() joins the response key."""
+
+    def __init__(self, key: str):
+        self._key = key
+        self._done = False
+        self._value = None
+
+    def wait(self, timeout_ms: int = _TIMEOUT_MS):
+        if self._done:
+            return self._value
+        blob = _client().blocking_key_value_get_bytes(self._key,
+                                                      timeout_ms)
+        _client().key_value_delete(self._key)
+        ok, payload = pickle.loads(blob)
+        self._done = True
+        if not ok:
+            raise RuntimeError(f"rpc remote exception: {payload}")
+        self._value = payload
+        return self._value
+
+
+def _inbox_loop(rank: int):
+    client = _client()
+    slot = 1
+    while True:
+        try:
+            blob = client.blocking_key_value_get_bytes(
+                f"paddle_tpu/rpc/req/{rank}/{slot}", 3_600_000)
+        except Exception:
+            if _state.get("stopping"):
+                return
+            continue  # retry the SAME slot — skipping would orphan it
+        client.key_value_delete(f"paddle_tpu/rpc/req/{rank}/{slot}")
+        slot += 1
+        req = pickle.loads(blob)
+        if req.get("op") == "__shutdown__":
+            return
+        fn, args, kwargs, resp_key = (req["fn"], req["args"],
+                                      req["kwargs"], req["resp"])
+        try:
+            result = (True, fn(*args, **(kwargs or {})))
+        except Exception as e:  # ship the error back, don't kill the loop
+            result = (False, repr(e))
+        client.key_value_set_bytes(resp_key,
+                                   pickle.dumps(result, protocol=4))
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """(reference rpc.py init_rpc) Join the RPC group under ``name``."""
+    import jax
+
+    from . import parallel as _par
+
+    if not _state.get("inited"):
+        try:
+            _client()
+        except RuntimeError:
+            _par.init_parallel_env()
+    my_rank = jax.process_index() if rank is None else rank
+    client = _client()
+    client.key_value_set(f"paddle_tpu/rpc/name/{my_rank}", name)
+    _state.update(inited=True, name=name, rank=my_rank,
+                  world_size=world_size or jax.process_count(),
+                  stopping=False)
+    t = threading.Thread(target=_inbox_loop, args=(my_rank,),
+                         daemon=True, name="paddle-rpc-inbox")
+    t.start()
+    _state["thread"] = t
+    # wait until every peer registered (the reference barriers too)
+    for r in range(_state["world_size"]):
+        client.blocking_key_value_get(f"paddle_tpu/rpc/name/{r}",
+                                      _TIMEOUT_MS)
+
+
+def _resolve(to) -> int:
+    if isinstance(to, int):
+        return to
+    for info in get_all_worker_infos():
+        if info.name == to:
+            return info.rank
+    raise ValueError(f"unknown rpc worker {to!r}")
+
+
+def rpc_async(to, fn, args=None, kwargs=None,
+              timeout=_TIMEOUT_MS / 1000) -> _Future:
+    """(reference rpc.py rpc_async) Returns a Future."""
+    if not _state.get("inited"):
+        raise RuntimeError("call init_rpc first")
+    client = _client()
+    dst = _resolve(to)
+    me = _state["rank"]
+    slot = client.key_value_increment(f"paddle_tpu/rpc/inbox/{dst}", 1)
+    resp_key = f"paddle_tpu/rpc/resp/{me}/{dst}/{slot}"
+    payload = pickle.dumps(
+        {"fn": fn, "args": tuple(args or ()), "kwargs": kwargs,
+         "resp": resp_key}, protocol=4)
+    client.key_value_set_bytes(f"paddle_tpu/rpc/req/{dst}/{slot}",
+                               payload)
+    return _Future(resp_key)
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_TIMEOUT_MS / 1000):
+    """(reference rpc.py rpc_sync)"""
+    return rpc_async(to, fn, args, kwargs).wait(int(timeout * 1000))
+
+
+def get_worker_info(name_or_rank) -> WorkerInfo:
+    for info in get_all_worker_infos():
+        if info.name == name_or_rank or info.rank == name_or_rank:
+            return info
+    raise ValueError(f"unknown worker {name_or_rank!r}")
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    client = _client()
+    out = []
+    for r in range(_state.get("world_size", 0)):
+        try:
+            name = client.blocking_key_value_get(
+                f"paddle_tpu/rpc/name/{r}", 1000)
+        except Exception:
+            continue
+        out.append(WorkerInfo(name, r))
+    return out
+
+
+def shutdown():
+    """(reference rpc.py shutdown) Drain own inbox thread; peers stop
+    via their own shutdown calls (graceful barrier-free teardown)."""
+    if not _state.get("inited"):
+        return
+    _state["stopping"] = True
+    client = _client()
+    me = _state["rank"]
+    slot = client.key_value_increment(f"paddle_tpu/rpc/inbox/{me}", 1)
+    client.key_value_set_bytes(
+        f"paddle_tpu/rpc/req/{me}/{slot}",
+        pickle.dumps({"op": "__shutdown__"}, protocol=4))
+    t = _state.get("thread")
+    if t is not None:
+        t.join(timeout=10)
+    _state["inited"] = False

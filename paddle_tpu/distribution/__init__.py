@@ -277,3 +277,8 @@ __all__ += ["ExponentialFamily", "Beta", "Dirichlet", "Gamma", "Laplace",
             "LogNormal", "Gumbel", "Multinomial", "MultivariateNormal",
             "Poisson", "Binomial", "Geometric", "Cauchy",
             "ContinuousBernoulli", "Independent"]
+
+from . import constraint  # noqa: E402,F401
+from . import variable  # noqa: E402,F401
+
+__all__ += ["constraint", "variable"]

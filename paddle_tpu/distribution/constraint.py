@@ -1,0 +1,56 @@
+"""Distribution support constraints (reference:
+python/paddle/distribution/constraint.py — Constraint/Real/Range/
+Positive/Simplex used by transforms to validate domains/codomains)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Constraint", "Real", "Range", "Positive", "Simplex",
+           "real", "positive"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Constraint:
+    """(constraint.py:17) callable support check -> bool Tensor."""
+
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        v = _arr(value)
+        return Tensor(v == v)  # finite-domain reals: NaN excluded
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+
+    def __call__(self, value):
+        v = _arr(value)
+        return Tensor((jnp.asarray(self._lower) <= v)
+                      & (v <= jnp.asarray(self._upper)))
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return Tensor(_arr(value) > 0)
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        v = _arr(value)
+        ok = jnp.all(v >= 0, -1) & (
+            jnp.abs(jnp.sum(v, -1) - 1) < 1e-6)
+        return Tensor(ok)
+
+
+real = Real()
+positive = Positive()

@@ -1,0 +1,69 @@
+"""Random-variable descriptors (reference:
+python/paddle/distribution/variable.py — Variable/Real/Positive/
+Independent/Stack: event metadata + support constraint per variable)."""
+from __future__ import annotations
+
+from . import constraint as _c
+
+__all__ = ["Variable", "Real", "Positive", "Independent", "Stack",
+           "real", "positive"]
+
+
+class Variable:
+    """(variable.py:19) is_discrete + event_rank + support check."""
+
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+        self._constraint = constraint
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, value):
+        return self._constraint(value)
+
+
+class Real(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, _c.real)
+
+
+class Positive(Variable):
+    def __init__(self, event_rank=0):
+        super().__init__(False, event_rank, _c.positive)
+
+
+class Independent(Variable):
+    """(variable.py:56) reinterpret rightmost batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._reinterpreted_batch_rank = reinterpreted_batch_rank
+        super().__init__(base.is_discrete,
+                         base.event_rank + reinterpreted_batch_rank,
+                         base._constraint)
+
+
+class Stack(Variable):
+    """(variable.py:85) stack of variables along an axis."""
+
+    def __init__(self, vars_, axis=0):
+        self._vars = vars_
+        self._axis = axis
+        super().__init__(any(v.is_discrete for v in vars_),
+                         max(v.event_rank for v in vars_),
+                         vars_[0]._constraint if vars_ else None)
+
+    @property
+    def stacked_vars(self):
+        return self._vars
+
+
+real = Real()
+positive = Positive()

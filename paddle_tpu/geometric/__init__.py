@@ -1,0 +1,136 @@
+"""paddle_tpu.geometric — graph-learning primitives.
+
+TPU-native equivalent of the reference's geometric package (reference:
+python/paddle/geometric — math.py segment_sum/mean/max/min,
+message_passing/send_recv.py send_u_recv:36 / send_ue_recv / send_uv;
+CUDA kernels paddle/phi/kernels/gpu/graph_send_recv_*). The scatter
+reductions map directly onto ``jax.ops.segment_*`` — XLA lowers them to
+sorted-segment scatters that tile onto the VPU; no hash tables needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import as_tensor_args, eager_apply
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv",
+]
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    arr = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+    return int(jnp.max(arr)) + 1 if arr.size else 0
+
+
+def _segment(kind, data, ids, n):
+    f = {"sum": jax.ops.segment_sum, "mean": None,
+         "max": jax.ops.segment_max, "min": jax.ops.segment_min}[kind]
+    if kind == "mean":
+        s = jax.ops.segment_sum(data, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                  ids, num_segments=n)
+        return s / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (data.ndim - 1))
+    out = f(data, ids, num_segments=n)
+    if kind in ("max", "min"):
+        # empty segments: paddle returns 0, jax returns -inf/+inf (or
+        # int min/max); zero must keep the input dtype — a weak 0.0
+        # would silently promote integer data to float
+        cnt = jax.ops.segment_sum(
+            jnp.ones((data.shape[0],), jnp.int32), ids, num_segments=n)
+        empty = (cnt == 0).reshape((-1,) + (1,) * (data.ndim - 1))
+        out = jnp.where(empty, jnp.zeros((), out.dtype), out)
+    return out
+
+
+def segment_sum(data, segment_ids, name=None):
+    """(reference geometric/math.py segment_sum)"""
+    ts = as_tensor_args(data, segment_ids)
+    n = _num_segments(ts[1], None)
+    return eager_apply("segment_sum",
+                       lambda d, i: _segment("sum", d,
+                                             i.astype(jnp.int32), n), ts)
+
+
+def segment_mean(data, segment_ids, name=None):
+    ts = as_tensor_args(data, segment_ids)
+    n = _num_segments(ts[1], None)
+    return eager_apply("segment_mean",
+                       lambda d, i: _segment("mean", d,
+                                             i.astype(jnp.int32), n), ts)
+
+
+def segment_max(data, segment_ids, name=None):
+    ts = as_tensor_args(data, segment_ids)
+    n = _num_segments(ts[1], None)
+    return eager_apply("segment_max",
+                       lambda d, i: _segment("max", d,
+                                             i.astype(jnp.int32), n), ts)
+
+
+def segment_min(data, segment_ids, name=None):
+    ts = as_tensor_args(data, segment_ids)
+    n = _num_segments(ts[1], None)
+    return eager_apply("segment_min",
+                       lambda d, i: _segment("min", d,
+                                             i.astype(jnp.int32), n), ts)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """(reference send_recv.py:36) gather x[src] then scatter-reduce to
+    dst: one fused gather+segment reduction, no materialized messages."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    ts = as_tensor_args(x, src_index, dst_index)
+    n = _num_segments(ts[2], out_size) if out_size is not None else \
+        max(_num_segments(ts[2], None), ts[0]._data.shape[0])
+
+    def raw(xd, src, dst):
+        msgs = xd[src.astype(jnp.int32)]
+        return _segment(reduce_op, msgs, dst.astype(jnp.int32), n)
+
+    return eager_apply("send_u_recv", raw, ts)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """(reference send_recv.py send_ue_recv) node features combined with
+    edge features via message_op, then scatter-reduced."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    ts = as_tensor_args(x, y, src_index, dst_index)
+    n = _num_segments(ts[3], out_size) if out_size is not None else \
+        max(_num_segments(ts[3], None), ts[0]._data.shape[0])
+
+    def raw(xd, yd, src, dst):
+        msgs = ops[message_op](xd[src.astype(jnp.int32)], yd)
+        return _segment(reduce_op, msgs, dst.astype(jnp.int32), n)
+
+    return eager_apply("send_ue_recv", raw, ts)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """(reference send_recv.py send_uv) per-edge message from both
+    endpoints' features; no reduction."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    ts = as_tensor_args(x, y, src_index, dst_index)
+
+    def raw(xd, yd, src, dst):
+        return ops[message_op](xd[src.astype(jnp.int32)],
+                               yd[dst.astype(jnp.int32)])
+
+    return eager_apply("send_uv", raw, ts)

@@ -93,14 +93,19 @@ class GenerationEngine:
         self._num_pages = num_pages
         self._mgr = None
 
-    def _get_decode_k(self, k: int):
-        if k not in self._decode_k_jit:
+    def _get_decode_k(self, k: int, sample_cfg=None):
+        """One compiled program per (chunk size, greedy-vs-sample,
+        top_k); temperature/top_p flow in as traced scalars so
+        per-request values never recompile."""
+        key = (k, sample_cfg)
+        if key not in self._decode_k_jit:
             import functools
 
-            self._decode_k_jit[k] = jax.jit(
-                functools.partial(self._decode_k_fn, k=k),
+            self._decode_k_jit[key] = jax.jit(
+                functools.partial(self._decode_k_fn, k=k,
+                                  sample_cfg=sample_cfg),
                 donate_argnums=(6, 7))
-        return self._decode_k_jit[k]
+        return self._decode_k_jit[key]
 
     # ---------- pure programs ----------
 
@@ -121,15 +126,56 @@ class GenerationEngine:
             hl, lnf_s, lnf_b, st.epsilon) @ embed.T
         return logits, cache.k, cache.v
 
-    def _decode_k_fn(self, weights, embed, lnf_s, lnf_b, tok, seq_lens,
-                     cache_k, cache_v, tables, *, k):
-        """K greedy steps as ONE XLA program: the argmax feeds back into
-        the next step inside lax.scan, so the host syncs once per chunk
-        instead of once per token (the per-token dispatch round-trip is
-        what bounds serving latency on a remote/tunneled chip)."""
-        st = self.model.stack
+    @staticmethod
+    def _pick_token(logits, key, sample_cfg):
+        """Greedy argmax, or temperature/top-k/top-p sampling (the
+        reference's top_p_sampling serving op, ops.yaml).
 
-        def step(carry, _):
+        sample_cfg is (temperature, top_k, top_p) with temperature and
+        top_p as TRACED scalars — per-request values don't recompile the
+        decode program; only top_k (a shape-determining slice) and the
+        sampling on/off switch are static."""
+        if sample_cfg is None:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        temperature, top_k, top_p = sample_cfg
+        logits = logits / jnp.maximum(jnp.asarray(temperature,
+                                                  logits.dtype), 1e-6)
+        neg = jnp.asarray(-1e30, logits.dtype)
+        if top_k and top_k > 0 and top_k < logits.shape[-1]:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, neg, logits)
+        # top_p traced: the mask arithmetic below is a no-op at
+        # top_p >= 1.0, so one compiled program serves every value
+        sorted_l = jnp.flip(jnp.sort(logits, axis=-1), -1)
+        probs = jax.nn.softmax(sorted_l, -1)
+        cum = jnp.cumsum(probs, -1)
+        keep_sorted = (cum - probs) < jnp.asarray(top_p, probs.dtype)
+        thresh = jnp.min(jnp.where(keep_sorted, sorted_l, jnp.inf),
+                         -1, keepdims=True)
+        logits = jnp.where(logits >= thresh, logits, neg)
+        return jax.random.categorical(key, logits, axis=-1) \
+            .astype(jnp.int32)
+
+    def _decode_k_fn(self, weights, embed, lnf_s, lnf_b, tok, seq_lens,
+                     cache_k, cache_v, tables, key=None,
+                     sample_params=None, *, k, sample_cfg=None):
+        """K decode steps as ONE XLA program: the picked token feeds back
+        into the next step inside lax.scan, so the host syncs once per
+        chunk instead of once per token (the per-token dispatch
+        round-trip is what bounds serving latency on a remote/tunneled
+        chip). Greedy by default; sample_cfg=(static top_k,) +
+        sample_params=(temperature, top_p) traced arrays switch to
+        ancestral sampling with a per-step folded key."""
+        st = self.model.stack
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        cfg = None
+        if sample_cfg is not None:
+            (top_k,) = sample_cfg
+            temperature, top_p = sample_params
+            cfg = (temperature, top_k, top_p)
+
+        def step(carry, i):
             tok, lens, ck, cv = carry
             x = embed[tok]
             h, cache = st.decode_raw(
@@ -137,11 +183,12 @@ class GenerationEngine:
                 self._cos, self._sin)
             logits = FusedMultiTransformer._ln(
                 h, lnf_s, lnf_b, st.epsilon) @ embed.T
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = self._pick_token(logits, jax.random.fold_in(key, i),
+                                   cfg)
             return (nxt, lens + 1, cache.k, cache.v), nxt
 
         (tok, seq_lens, ck, cv), toks = jax.lax.scan(
-            step, (tok, seq_lens, cache_k, cache_v), None, length=k)
+            step, (tok, seq_lens, cache_k, cache_v), jnp.arange(k))
         return jnp.swapaxes(toks, 0, 1), ck, cv  # [b, k]
 
     # ---------- serving API ----------
@@ -181,7 +228,9 @@ class GenerationEngine:
         return self._mgr.block_tables(seq_ids, pages_per_seq)
 
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None, seq_lens=None):
+                 eos_token_id: Optional[int] = None, seq_lens=None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0):
         """Greedy decode with per-sequence prompt lengths.
 
         input_ids: [b, s] array (optionally with ``seq_lens`` marking
@@ -225,6 +274,17 @@ class GenerationEngine:
             weights, embed, lnf_s, lnf_b, jnp.asarray(ids),
             jnp.asarray(lens), cache.k, cache.v, tables)
 
+        from ..core.generator import next_rng_key
+
+        # static part: (top_k,) — temperature/top_p stay traced; greedy
+        # decoding must not consume the global RNG stream at all
+        static_cfg = (int(top_k),) if do_sample else None
+        params = (jnp.asarray(float(temperature), jnp.float32),
+                  jnp.asarray(float(top_p), jnp.float32)) \
+            if do_sample else None
+        pick_cfg = (params[0], int(top_k), params[1]) if do_sample \
+            else None
+
         width = s + max_new_tokens
         out = np.zeros((b, width), ids.dtype)
         out[:, :s] = ids
@@ -232,7 +292,9 @@ class GenerationEngine:
 
         # first generated token: prefill logits at each row's own last
         # real position
-        tok_np = np.asarray(jnp.argmax(logits, axis=-1)).astype(ids.dtype)
+        tok_np = np.asarray(self._pick_token(
+            logits, next_rng_key() if do_sample else None,
+            pick_cfg)).astype(ids.dtype)
         if eos_token_id is not None:
             finished |= tok_np == eos_token_id
         out[np.arange(b), lens] = tok_np
@@ -247,10 +309,11 @@ class GenerationEngine:
             cur = lens + emitted - 1         # per-seq position just fed
             tables = self._grow_tables(range(b), lens + emitted, k,
                                        pages_per_seq)
-            toks, ck, cv = self._get_decode_k(k)(
+            toks, ck, cv = self._get_decode_k(k, static_cfg)(
                 weights, embed, lnf_s, lnf_b,
                 jnp.asarray(out[np.arange(b), cur].astype(np.int32)),
-                jnp.asarray(cur, dtype=jnp.int32), ck, cv, tables)
+                jnp.asarray(cur, dtype=jnp.int32), ck, cv, tables,
+                next_rng_key() if do_sample else None, params)
             toks_np = np.asarray(toks)
             for j in range(k):
                 col = toks_np[:, j].astype(ids.dtype)

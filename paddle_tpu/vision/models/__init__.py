@@ -6,3 +6,19 @@ from .resnet import (  # noqa: F401
 from .alexnet import AlexNet, alexnet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+    densenet264,
+)
+from .shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
+)
+from .googlenet import GoogLeNet, googlenet  # noqa: F401
+from .mobilenetv1 import (  # noqa: F401
+    MobileNetV1, mobilenet_v1, MobileNetV3Small, MobileNetV3Large,
+    mobilenet_v3_small, mobilenet_v3_large,
+)
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401

@@ -1,0 +1,97 @@
+"""GoogLeNet / Inception v1 (reference:
+python/paddle/vision/models/googlenet.py — inception modules with four
+parallel branches, two auxiliary classifiers)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, inp, out, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(inp, out, k, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class Inception(nn.Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvBN(inp, c1, 1)
+        self.b2 = nn.Sequential(_ConvBN(inp, c3r, 1),
+                                _ConvBN(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_ConvBN(inp, c5r, 1),
+                                _ConvBN(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _ConvBN(inp, proj, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _ConvBN(64, 64, 1), _ConvBN(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+            # two aux heads (train-time deep supervision)
+            self.aux1 = self._aux(512, num_classes)
+            self.aux2 = self._aux(528, num_classes)
+
+    @staticmethod
+    def _aux(inp, num_classes):
+        return nn.Sequential(
+            nn.AdaptiveAvgPool2D(4), _ConvBN(inp, 128, 1),
+            nn.Flatten(), nn.Linear(128 * 16, 1024), nn.ReLU(),
+            nn.Dropout(0.7), nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 and self.training \
+            else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 and self.training \
+            else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(paddle.flatten(x, 1)))
+            return x, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,150 @@
+"""Inception v3 (reference: python/paddle/vision/models/inceptionv3.py —
+factorized convolutions: InceptionA-E blocks, 299x299 input)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, inp, out, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(inp, out, k, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _IncA(nn.Layer):
+    def __init__(self, inp, pool_feat):
+        super().__init__()
+        self.b1 = _ConvBN(inp, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(inp, 48, 1),
+                                _ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBN(inp, 64, 1),
+                                _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(inp, pool_feat, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b5(x), self.b3(x),
+                              self.bp(x)], axis=1)
+
+
+class _IncB(nn.Layer):  # grid reduction 35->17
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = _ConvBN(inp, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_ConvBN(inp, 64, 1),
+                                 _ConvBN(64, 96, 3, padding=1),
+                                 _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b3d(x), self.pool(x)],
+                             axis=1)
+
+
+class _IncC(nn.Layer):  # 7x1/1x7 factorized
+    def __init__(self, inp, ch7):
+        super().__init__()
+        self.b1 = _ConvBN(inp, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(inp, ch7, 1),
+            _ConvBN(ch7, ch7, (1, 7), padding=(0, 3)),
+            _ConvBN(ch7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _ConvBN(inp, ch7, 1),
+            _ConvBN(ch7, ch7, (7, 1), padding=(3, 0)),
+            _ConvBN(ch7, ch7, (1, 7), padding=(0, 3)),
+            _ConvBN(ch7, ch7, (7, 1), padding=(3, 0)),
+            _ConvBN(ch7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(inp, 192, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b7(x), self.b7d(x),
+                              self.bp(x)], axis=1)
+
+
+class _IncD(nn.Layer):  # grid reduction 17->8
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(inp, 192, 1),
+                                _ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _ConvBN(inp, 192, 1),
+            _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)],
+                             axis=1)
+
+
+class _IncE(nn.Layer):  # expanded filter bank
+    def __init__(self, inp):
+        super().__init__()
+        self.b1 = _ConvBN(inp, 320, 1)
+        self.b3_1 = _ConvBN(inp, 384, 1)
+        self.b3_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bd_1 = nn.Sequential(_ConvBN(inp, 448, 1),
+                                  _ConvBN(448, 384, 3, padding=1))
+        self.bd_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.bd_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(inp, 192, 1))
+
+    def forward(self, x):
+        a = self.b3_1(x)
+        b = self.bd_1(x)
+        return paddle.concat(
+            [self.b1(x),
+             paddle.concat([self.b3_2a(a), self.b3_2b(a)], axis=1),
+             paddle.concat([self.bd_2a(b), self.bd_2b(b)], axis=1),
+             self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160),
+            _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(paddle.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,186 @@
+"""MobileNetV1 + V3 (reference: python/paddle/vision/models/
+mobilenetv1.py depthwise-separable stacks; mobilenetv3.py inverted
+residuals with squeeze-excitation + hardswish)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+__all__ = ["MobileNetV1", "mobilenet_v1", "MobileNetV3Small",
+           "MobileNetV3Large", "mobilenet_v3_small", "mobilenet_v3_large"]
+
+
+class _DWSep(nn.Layer):
+    def __init__(self, inp, out, stride):
+        super().__init__()
+        self.dw = nn.Sequential(
+            nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                      bias_attr=False),
+            nn.BatchNorm2D(inp), nn.ReLU())
+        self.pw = nn.Sequential(
+            nn.Conv2D(inp, out, 1, bias_attr=False),
+            nn.BatchNorm2D(out), nn.ReLU())
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [(c(32), c(64), 1), (c(64), c(128), 2),
+               (c(128), c(128), 1), (c(128), c(256), 2),
+               (c(256), c(256), 1), (c(256), c(512), 2)] + \
+            [(c(512), c(512), 1)] * 5 + \
+            [(c(512), c(1024), 2), (c(1024), c(1024), 1)]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, c(32), 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c(32)), nn.ReLU())
+        self.blocks = nn.Sequential(
+            *[_DWSep(i, o, s) for i, o, s in cfg])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+class _SE(nn.Layer):
+    def __init__(self, ch, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, ch // r, 1)
+        self.fc2 = nn.Conv2D(ch // r, ch, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    def __init__(self, inp, hidden, out, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        act_l = nn.Hardswish if act == "hs" else nn.ReLU
+        layers = []
+        if hidden != inp:
+            layers += [nn.Conv2D(inp, hidden, 1, bias_attr=False),
+                       nn.BatchNorm2D(hidden), act_l()]
+        layers += [nn.Conv2D(hidden, hidden, k, stride=stride,
+                             padding=k // 2, groups=hidden,
+                             bias_attr=False),
+                   nn.BatchNorm2D(hidden), act_l()]
+        if use_se:
+            layers.append(_SE(hidden))
+        layers += [nn.Conv2D(hidden, out, 1, bias_attr=False),
+                   nn.BatchNorm2D(out)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+_V3_SMALL = [
+    # k, hidden, out, se, act, stride
+    (3, 16, 16, True, "re", 2), (3, 72, 24, False, "re", 2),
+    (3, 88, 24, False, "re", 1), (5, 96, 40, True, "hs", 2),
+    (5, 240, 40, True, "hs", 1), (5, 240, 40, True, "hs", 1),
+    (5, 120, 48, True, "hs", 1), (5, 144, 48, True, "hs", 1),
+    (5, 288, 96, True, "hs", 2), (5, 576, 96, True, "hs", 1),
+    (5, 576, 96, True, "hs", 1),
+]
+_V3_LARGE = [
+    (3, 16, 16, False, "re", 1), (3, 64, 24, False, "re", 2),
+    (3, 72, 24, False, "re", 1), (5, 72, 40, True, "re", 2),
+    (5, 120, 40, True, "re", 1), (5, 120, 40, True, "re", 1),
+    (3, 240, 80, False, "hs", 2), (3, 200, 80, False, "hs", 1),
+    (3, 184, 80, False, "hs", 1), (3, 184, 80, False, "hs", 1),
+    (3, 480, 112, True, "hs", 1), (3, 672, 112, True, "hs", 1),
+    (5, 672, 160, True, "hs", 2), (5, 960, 160, True, "hs", 1),
+    (5, 960, 160, True, "hs", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_ch, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 16, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(16), nn.Hardswish())
+        blocks = []
+        inp = 16
+        for k, hidden, out, se, act, s in cfg:
+            blocks.append(_V3Block(inp, hidden, out, k, s, se, act))
+            inp = out
+        self.blocks = nn.Sequential(*blocks)
+        mid = cfg[-1][1]
+        self.head_conv = nn.Sequential(
+            nn.Conv2D(inp, mid, 1, bias_attr=False),
+            nn.BatchNorm2D(mid), nn.Hardswish())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(mid, last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.head_conv(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(paddle.flatten(x, 1))
+        return x
+
+
+def _check_v3_scale(scale):
+    # width multipliers below 1.0 need per-stage _make_divisible channel
+    # plumbing; fail loudly instead of silently building the full net
+    if scale != 1.0:
+        raise NotImplementedError(
+            f"MobileNetV3 scale={scale} is not supported (only 1.0); "
+            "width multipliers would silently change every channel "
+            "count")
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, num_classes=1000, with_pool=True, scale=1.0):
+        _check_v3_scale(scale)
+        super().__init__(_V3_SMALL, 1024, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, num_classes=1000, with_pool=True, scale=1.0):
+        _check_v3_scale(scale)
+        super().__init__(_V3_LARGE, 1280, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, **kwargs):
+    return MobileNetV3Small(**kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, **kwargs):
+    return MobileNetV3Large(**kwargs)

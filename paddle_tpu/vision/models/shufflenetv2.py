@@ -1,0 +1,108 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py —
+channel-split units + channel shuffle)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def _shuffle(x, groups=2):
+    return F.channel_shuffle(x, groups=groups)
+
+
+class _Unit(nn.Layer):
+    def __init__(self, inp, out, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out // 2
+        if stride == 1:
+            main_in = inp // 2
+        else:
+            main_in = inp
+            self.short = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=2, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU())
+        self.main = nn.Sequential(
+            nn.Conv2D(main_in, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.main(x2)], axis=1)
+        else:
+            out = paddle.concat([self.short(x), self.main(x)], axis=1)
+        return _shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale must be one of {list(_STAGE_OUT)}")
+        c0, c1, c2, c3, c_last = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c0), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        inp = c0
+        for out, repeat in ((c1, 4), (c2, 8), (c3, 4)):
+            stages.append(_Unit(inp, out, stride=2))
+            for _ in range(repeat - 1):
+                stages.append(_Unit(out, out, stride=1))
+            inp = out
+        self.stages = nn.Sequential(*stages)
+        self.final = nn.Sequential(
+            nn.Conv2D(inp, c_last, 1, bias_attr=False),
+            nn.BatchNorm2D(c_last), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c_last, num_classes)
+
+    def forward(self, x):
+        x = self.final(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def _make(scale):
+    def f(pretrained=False, **kwargs):
+        return ShuffleNetV2(scale=scale, **kwargs)
+
+    return f
+
+
+shufflenet_v2_x0_25 = _make(0.25)
+shufflenet_v2_x0_33 = _make(0.33)
+shufflenet_v2_x0_5 = _make(0.5)
+shufflenet_v2_x1_0 = _make(1.0)
+shufflenet_v2_x1_5 = _make(1.5)
+shufflenet_v2_x2_0 = _make(2.0)

@@ -1,0 +1,86 @@
+"""paddle.geometric: segment reductions + message passing.
+
+Reference parity targets: python/paddle/geometric/math.py,
+message_passing/send_recv.py:36.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.geometric as G
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestSegment:
+    def test_segment_reductions(self):
+        data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]],
+                        np.float32)
+        ids = np.array([0, 0, 1, 1])
+        np.testing.assert_allclose(
+            G.segment_sum(_t(data), _t(ids)).numpy(),
+            [[4, 6], [12, 14]])
+        np.testing.assert_allclose(
+            G.segment_mean(_t(data), _t(ids)).numpy(),
+            [[2, 3], [6, 7]])
+        np.testing.assert_allclose(
+            G.segment_max(_t(data), _t(ids)).numpy(),
+            [[3, 4], [7, 8]])
+        np.testing.assert_allclose(
+            G.segment_min(_t(data), _t(ids)).numpy(),
+            [[1, 2], [5, 6]])
+
+    def test_empty_segment_is_zero(self):
+        data = np.array([[1.0]], np.float32)
+        ids = np.array([2])
+        out = G.segment_max(_t(data), _t(ids)).numpy()
+        np.testing.assert_allclose(out, [[0.0], [0.0], [1.0]])
+
+
+class TestMessagePassing:
+    def test_send_u_recv_sum_mean(self):
+        x = np.array([[0., 2., 3.], [1., 4., 5.], [2., 6., 7.]],
+                     np.float32)
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 1, 0])
+        out = G.send_u_recv(_t(x), _t(src), _t(dst),
+                            reduce_op="sum").numpy()
+        want = np.zeros_like(x)
+        for s, d in zip(src, dst):
+            want[d] += x[s]
+        np.testing.assert_allclose(out, want)
+        outm = G.send_u_recv(_t(x), _t(src), _t(dst),
+                             reduce_op="mean").numpy()
+        np.testing.assert_allclose(outm[1], (x[0] + x[2]) / 2)
+
+    def test_send_u_recv_out_size(self):
+        x = np.array([[1.0], [2.0]], np.float32)
+        out = G.send_u_recv(_t(x), _t([0, 1]), _t([0, 0]),
+                            reduce_op="max", out_size=4).numpy()
+        assert out.shape == (4, 1)
+        np.testing.assert_allclose(out[:, 0], [2, 0, 0, 0])
+
+    def test_send_ue_recv(self):
+        x = np.array([[1.0], [2.0]], np.float32)
+        e = np.array([[10.0], [20.0], [30.0]], np.float32)
+        src = np.array([0, 1, 1])
+        dst = np.array([1, 0, 1])
+        out = G.send_ue_recv(_t(x), _t(e), _t(src), _t(dst),
+                             message_op="add", reduce_op="sum").numpy()
+        np.testing.assert_allclose(out, [[22.0], [11.0 + 32.0]])
+
+    def test_send_uv(self):
+        x = np.array([[1.0], [2.0], [3.0]], np.float32)
+        y = np.array([[10.0], [20.0], [30.0]], np.float32)
+        out = G.send_uv(_t(x), _t(y), _t([0, 2]), _t([1, 0]),
+                        message_op="mul").numpy()
+        np.testing.assert_allclose(out, [[20.0], [30.0]])
+
+
+class TestDtypes:
+    def test_int_segment_max_keeps_dtype(self):
+        data = np.array([[3], [1]], np.int32)
+        out = G.segment_max(_t(data), _t([1, 1]))
+        assert out.numpy().dtype == np.int32
+        np.testing.assert_array_equal(out.numpy(), [[0], [3]])

@@ -166,3 +166,96 @@ class TestContinuousBatching:
         assert done[0].id == rid and done[0].done
         assert done[0].generated[-1] == eos
         assert len(done[0].generated) <= 8
+
+
+class TestSampling:
+    """Sampling decode (the reference's top_p_sampling serving surface):
+    temperature / top-k / top-p with paddle.seed-governed keys."""
+
+    def test_topk1_equals_greedy(self):
+        model = _model()
+        rng = np.random.RandomState(21)
+        ids = rng.randint(0, 64, (2, 5))
+        eng = GenerationEngine(model, page_size=4, max_length=32,
+                               decode_chunk=2)
+        greedy = eng.generate(ids, max_new_tokens=5)
+        paddle.seed(0)
+        topk1 = eng.generate(ids, max_new_tokens=5, do_sample=True,
+                             top_k=1)
+        np.testing.assert_array_equal(topk1, greedy)
+
+    def test_tiny_temperature_equals_greedy(self):
+        model = _model()
+        rng = np.random.RandomState(22)
+        ids = rng.randint(0, 64, (1, 6))
+        eng = GenerationEngine(model, page_size=4, max_length=32,
+                               decode_chunk=2)
+        greedy = eng.generate(ids, max_new_tokens=4)
+        paddle.seed(1)
+        cold = eng.generate(ids, max_new_tokens=4, do_sample=True,
+                            temperature=1e-5)
+        np.testing.assert_array_equal(cold, greedy)
+
+    def test_seed_reproducible_and_varies(self):
+        model = _model()
+        rng = np.random.RandomState(23)
+        ids = rng.randint(0, 64, (1, 4))
+        eng = GenerationEngine(model, page_size=4, max_length=64,
+                               decode_chunk=4)
+        kw = dict(max_new_tokens=12, do_sample=True, temperature=1.5,
+                  top_p=0.95)
+        paddle.seed(7)
+        a = eng.generate(ids, **kw)
+        paddle.seed(7)
+        b = eng.generate(ids, **kw)
+        np.testing.assert_array_equal(a, b)
+        paddle.seed(8)
+        c = eng.generate(ids, **kw)
+        assert not np.array_equal(a, c), "different seeds gave same draw"
+
+    def test_top_p_restricts_support(self):
+        """Every sampled first token must lie in the minimal nucleus."""
+        model = _model()
+        rng = np.random.RandomState(24)
+        ids = rng.randint(0, 64, (1, 5))
+        logits = model(paddle.to_tensor(ids)).numpy()[0, -1]
+        probs = np.exp(logits - logits.max())
+        probs /= probs.sum()
+        order = np.argsort(probs)[::-1]
+        cum = np.cumsum(probs[order])
+        nucleus = set(order[: int(np.searchsorted(cum, 0.5)) + 1].tolist())
+        eng = GenerationEngine(model, page_size=4, max_length=32)
+        for seed in range(8):
+            paddle.seed(seed)
+            out = eng.generate(ids, max_new_tokens=1, do_sample=True,
+                               top_p=0.5)
+            assert int(out[0, 5]) in nucleus, (int(out[0, 5]), nucleus)
+
+    def test_greedy_does_not_consume_rng(self):
+        """Greedy decode must leave the global RNG stream untouched."""
+        model = _model()
+        ids = np.random.RandomState(25).randint(0, 64, (1, 4))
+        eng = GenerationEngine(model, page_size=4, max_length=32,
+                               decode_chunk=2)
+        paddle.seed(42)
+        ref_draw = paddle.randn([4]).numpy()
+        paddle.seed(42)
+        eng.generate(ids, max_new_tokens=4)  # greedy
+        post_draw = paddle.randn([4]).numpy()
+        np.testing.assert_array_equal(ref_draw, post_draw)
+
+    def test_temperature_change_reuses_compiled_program(self):
+        """temperature/top_p are traced: different values must hit the
+        same (k, top_k) program cache entry."""
+        model = _model()
+        ids = np.random.RandomState(26).randint(0, 64, (1, 4))
+        eng = GenerationEngine(model, page_size=4, max_length=32,
+                               decode_chunk=2)
+        paddle.seed(0)
+        eng.generate(ids, max_new_tokens=4, do_sample=True,
+                     temperature=0.7, top_p=0.9)
+        n_programs = len(eng._decode_k_jit)
+        paddle.seed(0)
+        eng.generate(ids, max_new_tokens=4, do_sample=True,
+                     temperature=1.3, top_p=0.8)
+        assert len(eng._decode_k_jit) == n_programs

@@ -62,3 +62,70 @@ def test_flops_and_summary():
     s = paddle.summary(net)
     assert s["total_params"] == 61_610
     assert s["trainable_params"] == 61_610
+
+
+class TestModelZooExpansion:
+    """Round-3 zoo fills (reference: python/paddle/vision/models/
+    {squeezenet,densenet,shufflenetv2,googlenet,mobilenetv1,
+    inceptionv3}.py): forward shapes + a train step."""
+
+    @pytest.mark.parametrize("ctor,size", [
+        (lambda: paddle.vision.models.squeezenet1_1(num_classes=10), 64),
+        (lambda: paddle.vision.models.densenet121(num_classes=10), 64),
+        (lambda: paddle.vision.models.shufflenet_v2_x0_25(
+            num_classes=10), 64),
+        (lambda: paddle.vision.models.mobilenet_v1(
+            scale=0.25, num_classes=10), 64),
+        (lambda: paddle.vision.models.mobilenet_v3_small(
+            num_classes=10), 64),
+    ])
+    def test_forward_shape(self, ctor, size):
+        paddle.seed(0)
+        m = ctor()
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, size, size)
+            .astype(np.float32))
+        out = m(x)
+        assert tuple(out.shape) == (2, 10)
+
+    def test_googlenet_aux_heads(self):
+        paddle.seed(0)
+        m = paddle.vision.models.googlenet(num_classes=10)
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(1, 3, 96, 96).astype(np.float32))
+        m.train()
+        out, aux1, aux2 = m(x)
+        assert tuple(out.shape) == (1, 10)
+        assert tuple(aux1.shape) == (1, 10)
+        assert tuple(aux2.shape) == (1, 10)
+        m.eval()
+        out, aux1, aux2 = m(x)
+        assert aux1 is None and aux2 is None
+
+    def test_inception_v3_forward(self):
+        paddle.seed(0)
+        m = paddle.vision.models.inception_v3(num_classes=10)
+        m.eval()
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(1, 3, 299, 299).astype(np.float32))
+        assert tuple(m(x).shape) == (1, 10)
+
+    def test_small_model_trains(self):
+        paddle.seed(3)
+        m = paddle.vision.models.shufflenet_v2_x0_25(num_classes=4)
+        opt = paddle.optimizer.Adam(1e-3, parameters=m.parameters())
+        rng = np.random.RandomState(3)
+        x = paddle.to_tensor(rng.randn(4, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (4,)))
+        m.train()
+        import paddle_tpu.nn.functional as F
+
+        losses = []
+        for _ in range(4):
+            loss = F.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]

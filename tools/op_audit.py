@@ -200,7 +200,11 @@ def collect_implemented():
     import paddle_tpu.nn.functional as F
     from paddle_tpu.core.tensor import Tensor
 
-    for mod in (paddle, F, paddle.linalg, paddle.fft, paddle.signal):
+    import paddle_tpu.geometric as geo
+    import paddle_tpu.vision.ops as vops
+
+    for mod in (paddle, F, paddle.linalg, paddle.fft, paddle.signal,
+                paddle.text, geo, vops):
         names.update(n for n in dir(mod) if not n.startswith("_"))
     names.update(n for n in dir(Tensor) if not n.startswith("_"))
     return names
