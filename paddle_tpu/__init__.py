@@ -76,7 +76,28 @@ from . import audio  # noqa: E402
 from . import signal  # noqa: E402
 from . import text  # noqa: E402
 from . import geometric  # noqa: E402
+from . import utils  # noqa: E402
+from .hapi import hub  # noqa: E402
 from . import inference  # noqa: E402
+
+def is_compiled_with_cuda():
+    """False by design: this build's accelerator backend is TPU/XLA
+    (reference framework.py is_compiled_with_cuda)."""
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = None):
+    """TPU is the (PJRT) device backend here."""
+    return device_type in (None, "tpu")
+
 
 # `paddle.disable_static()/enable_static()` parity: we are always dynamic
 # with jit-compiled regions, so these are state toggles kept for API compat.

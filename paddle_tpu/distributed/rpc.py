@@ -51,28 +51,34 @@ def _client():
 class _Future:
     """(reference rpc_async return) .wait() joins the response key."""
 
-    def __init__(self, key: str):
+    def __init__(self, key: str, timeout_ms: int = _TIMEOUT_MS):
         self._key = key
+        self._timeout_ms = timeout_ms
         self._done = False
         self._value = None
+        self._error = None
 
-    def wait(self, timeout_ms: int = _TIMEOUT_MS):
+    def wait(self, timeout_ms: Optional[int] = None):
         if self._done:
+            if self._error is not None:  # re-raise on every wait
+                raise RuntimeError(self._error)
             return self._value
-        blob = _client().blocking_key_value_get_bytes(self._key,
-                                                      timeout_ms)
+        blob = _client().blocking_key_value_get_bytes(
+            self._key,
+            timeout_ms if timeout_ms is not None else self._timeout_ms)
         _client().key_value_delete(self._key)
         ok, payload = pickle.loads(blob)
         self._done = True
         if not ok:
-            raise RuntimeError(f"rpc remote exception: {payload}")
+            self._error = f"rpc remote exception: {payload}"
+            raise RuntimeError(self._error)
         self._value = payload
         return self._value
 
 
-def _inbox_loop(rank: int):
+def _inbox_loop(rank: int, start_slot: int):
     client = _client()
-    slot = 1
+    slot = start_slot
     while True:
         try:
             blob = client.blocking_key_value_get_bytes(
@@ -86,6 +92,8 @@ def _inbox_loop(rank: int):
         req = pickle.loads(blob)
         if req.get("op") == "__shutdown__":
             return
+        if req.get("op") == "__noop__":  # init start marker
+            continue
         fn, args, kwargs, resp_key = (req["fn"], req["args"],
                                       req["kwargs"], req["resp"])
         try:
@@ -104,39 +112,60 @@ def init_rpc(name: str, rank: Optional[int] = None,
 
     from . import parallel as _par
 
-    if not _state.get("inited"):
-        try:
-            _client()
-        except RuntimeError:
-            _par.init_parallel_env()
+    if _state.get("inited"):
+        raise RuntimeError(
+            "rpc already initialized in this process; call shutdown() "
+            "first (a second inbox thread would double-execute requests)")
+    try:
+        _client()
+    except RuntimeError:
+        _par.init_parallel_env()
     my_rank = jax.process_index() if rank is None else rank
     client = _client()
+    try:  # re-init: the name key persists in the coordinator
+        client.key_value_delete(f"paddle_tpu/rpc/name/{my_rank}")
+    except Exception:
+        pass
     client.key_value_set(f"paddle_tpu/rpc/name/{my_rank}", name)
+    # claim one inbox slot as a start marker: the counter persists in the
+    # coordinator across shutdown/re-init, so the fresh inbox thread must
+    # resume where the counter is, not at slot 1
+    start = client.key_value_increment(f"paddle_tpu/rpc/inbox/{my_rank}",
+                                       1)
+    client.key_value_set_bytes(
+        f"paddle_tpu/rpc/req/{my_rank}/{start}",
+        pickle.dumps({"op": "__noop__"}, protocol=4))
     _state.update(inited=True, name=name, rank=my_rank,
                   world_size=world_size or jax.process_count(),
                   stopping=False)
-    t = threading.Thread(target=_inbox_loop, args=(my_rank,),
+    t = threading.Thread(target=_inbox_loop, args=(my_rank, start),
                          daemon=True, name="paddle-rpc-inbox")
     t.start()
     _state["thread"] = t
-    # wait until every peer registered (the reference barriers too)
+    # wait until every peer registered (the reference barriers too),
+    # caching the immutable name->rank registry for _resolve
+    names = {}
     for r in range(_state["world_size"]):
-        client.blocking_key_value_get(f"paddle_tpu/rpc/name/{r}",
-                                      _TIMEOUT_MS)
+        names[client.blocking_key_value_get(
+            f"paddle_tpu/rpc/name/{r}", _TIMEOUT_MS)] = r
+    _state["names"] = names
 
 
 def _resolve(to) -> int:
     if isinstance(to, int):
         return to
-    for info in get_all_worker_infos():
-        if info.name == to:
-            return info.rank
+    # names are immutable after the init barrier — resolved from the
+    # cached registry, no KV round-trips per call
+    names = _state.get("names", {})
+    if to in names:
+        return names[to]
     raise ValueError(f"unknown rpc worker {to!r}")
 
 
 def rpc_async(to, fn, args=None, kwargs=None,
               timeout=_TIMEOUT_MS / 1000) -> _Future:
-    """(reference rpc.py rpc_async) Returns a Future."""
+    """(reference rpc.py rpc_async) Returns a Future honoring
+    ``timeout`` (seconds) in its wait()."""
     if not _state.get("inited"):
         raise RuntimeError("call init_rpc first")
     client = _client()
@@ -149,7 +178,7 @@ def rpc_async(to, fn, args=None, kwargs=None,
          "resp": resp_key}, protocol=4)
     client.key_value_set_bytes(f"paddle_tpu/rpc/req/{dst}/{slot}",
                                payload)
-    return _Future(resp_key)
+    return _Future(resp_key, timeout_ms=int(timeout * 1000))
 
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=_TIMEOUT_MS / 1000):
@@ -165,6 +194,10 @@ def get_worker_info(name_or_rank) -> WorkerInfo:
 
 
 def get_all_worker_infos() -> List[WorkerInfo]:
+    names = _state.get("names")
+    if names:  # immutable post-init registry
+        return [WorkerInfo(n, r) for n, r in sorted(names.items(),
+                                                    key=lambda kv: kv[1])]
     client = _client()
     out = []
     for r in range(_state.get("world_size", 0)):

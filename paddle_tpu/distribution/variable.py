@@ -54,11 +54,13 @@ class Stack(Variable):
     """(variable.py:85) stack of variables along an axis."""
 
     def __init__(self, vars_, axis=0):
+        if not vars_:
+            raise ValueError("Stack requires a non-empty variable list")
         self._vars = vars_
         self._axis = axis
         super().__init__(any(v.is_discrete for v in vars_),
                          max(v.event_rank for v in vars_),
-                         vars_[0]._constraint if vars_ else None)
+                         vars_[0]._constraint)
 
     @property
     def stacked_vars(self):

@@ -1,0 +1,57 @@
+"""paddle.hub — load models from a local repo directory (reference:
+python/paddle/hapi/hub.py — hub.list/help/load over a hubconf.py;
+github/gitee sources need egress, so the local-dir source is the
+supported path here and remote sources raise with guidance)."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.pop("hubconf", None)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local",):
+        raise ValueError(
+            "zero-egress environment: only source='local' is supported "
+            "(clone the hub repo and pass its directory)")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """(hub.py list) Entrypoint names exported by the repo's hubconf."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """(hub.py help) The entrypoint's docstring."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"model {model!r} not found in {repo_dir}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """(hub.py load) Call the entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"model {model!r} not found in {repo_dir}")
+    return getattr(mod, model)(**kwargs)

@@ -21,8 +21,16 @@ def _shuffle(x, groups=2):
     return F.channel_shuffle(x, groups=groups)
 
 
+def _act_layer(act):
+    if act == "relu":
+        return nn.ReLU()
+    if act in ("swish", "silu"):
+        return nn.Silu()
+    raise ValueError(f"unsupported activation {act!r} (relu|swish)")
+
+
 class _Unit(nn.Layer):
-    def __init__(self, inp, out, stride):
+    def __init__(self, inp, out, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch = out // 2
@@ -35,15 +43,15 @@ class _Unit(nn.Layer):
                           bias_attr=False),
                 nn.BatchNorm2D(inp),
                 nn.Conv2D(inp, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU())
+                nn.BatchNorm2D(branch), _act_layer(act))
         self.main = nn.Sequential(
             nn.Conv2D(main_in, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.BatchNorm2D(branch), _act_layer(act),
             nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
                       groups=branch, bias_attr=False),
             nn.BatchNorm2D(branch),
             nn.Conv2D(branch, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU())
+            nn.BatchNorm2D(branch), _act_layer(act))
 
     def forward(self, x):
         if self.stride == 1:
@@ -66,19 +74,19 @@ class ShuffleNetV2(nn.Layer):
         self.with_pool = with_pool
         self.stem = nn.Sequential(
             nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(c0), nn.ReLU(),
+            nn.BatchNorm2D(c0), _act_layer(act),
             nn.MaxPool2D(3, stride=2, padding=1))
         stages = []
         inp = c0
         for out, repeat in ((c1, 4), (c2, 8), (c3, 4)):
-            stages.append(_Unit(inp, out, stride=2))
+            stages.append(_Unit(inp, out, stride=2, act=act))
             for _ in range(repeat - 1):
-                stages.append(_Unit(out, out, stride=1))
+                stages.append(_Unit(out, out, stride=1, act=act))
             inp = out
         self.stages = nn.Sequential(*stages)
         self.final = nn.Sequential(
             nn.Conv2D(inp, c_last, 1, bias_attr=False),
-            nn.BatchNorm2D(c_last), nn.ReLU())
+            nn.BatchNorm2D(c_last), _act_layer(act))
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
         if num_classes > 0:

@@ -75,7 +75,7 @@ def _run_cluster(script, port, repo):
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env, cwd=repo,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    results = [p.communicate(timeout=300) for p in procs]
+    results = [p.communicate(timeout=600) for p in procs]
     return procs, results
 
 
@@ -92,3 +92,31 @@ def test_two_process_rpc(tmp_path):
             return
         last_err = "\n".join(err[-1500:] for _, err in results)
     raise AssertionError(f"rpc cluster failed twice:\n{last_err}")
+
+
+REINIT_WORKER = WORKER.replace(
+    'print(f"RPC_RANK{rank}_OK")',
+    '''# re-init after shutdown: the persisted inbox counter must not
+# strand the fresh inbox thread (round-3 review fix)
+rpc.init_rpc(f"worker{rank}", rank=rank, world_size=2)
+assert rpc.rpc_sync(peer, add, args=(10, 20)) == 30
+# rpc_async timeout is honored on the Future
+fut = rpc.rpc_async(peer, add, args=(1, 1), timeout=30)
+assert fut.wait() == 2
+rpc.shutdown()
+print(f"RPC_RANK{rank}_OK")''')
+
+
+def test_rpc_reinit_after_shutdown(tmp_path):
+    script = tmp_path / "rpc_reinit_worker.py"
+    script.write_text(REINIT_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    last_err = ""
+    for attempt in range(2):
+        procs, results = _run_cluster(script, _free_port(), repo)
+        if all(p.returncode == 0 for p in procs) and all(
+                f"RPC_RANK{r}_OK" in out
+                for r, (out, _) in enumerate(results)):
+            return
+        last_err = "\n".join(err[-1500:] for _, err in results)
+    raise AssertionError(f"rpc reinit cluster failed twice:\n{last_err}")

@@ -1,0 +1,80 @@
+"""paddle.utils / paddle.hub / is_compiled_with_* parity.
+
+Reference targets: python/paddle/utils/{unique_name,deprecated,
+dlpack}.py, install_check.py, python/paddle/hapi/hub.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestUtils:
+    def test_unique_name_generate_and_guard(self):
+        un = paddle.utils.unique_name
+        a, b = un.generate("fc"), un.generate("fc")
+        assert a != b and a.startswith("fc_")
+        with un.guard():
+            c = un.generate("fc")
+            assert c == "fc_0"  # fresh counter inside the guard
+        d = un.generate("fc")
+        assert d not in (a, b, c)
+
+    def test_deprecated_warns_and_calls(self):
+        @paddle.utils.deprecated(update_to="new_api", since="2.0")
+        def old(x):
+            return x + 1
+
+        with pytest.warns(DeprecationWarning, match="new_api"):
+            assert old(1) == 2
+
+    def test_require_version(self):
+        assert paddle.utils.require_version("0.0.0")
+        with pytest.raises(RuntimeError):
+            paddle.utils.require_version("999.0.0")
+
+    def test_try_import(self):
+        assert paddle.utils.try_import("json") is not None
+        with pytest.raises(ImportError):
+            paddle.utils.try_import("definitely_not_a_module_xyz")
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "successfully" in capsys.readouterr().out
+
+    def test_dlpack_roundtrip(self):
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        cap = paddle.utils.dlpack.to_dlpack(t)
+        r = paddle.utils.dlpack.from_dlpack(cap)
+        np.testing.assert_array_equal(r.numpy(), t.numpy())
+
+    def test_is_compiled_flags(self):
+        assert paddle.is_compiled_with_cuda() is False
+        assert paddle.is_compiled_with_rocm() is False
+        assert paddle.is_compiled_with_custom_device("tpu") is True
+
+
+class TestHub:
+    def _repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def toy_model(width=4):\n"
+            "    \"\"\"A toy entrypoint.\"\"\"\n"
+            "    import paddle_tpu.nn as nn\n"
+            "    return nn.Linear(width, width)\n")
+        return str(tmp_path)
+
+    def test_list_help_load(self, tmp_path):
+        repo = self._repo(tmp_path)
+        assert paddle.hub.list(repo) == ["toy_model"]
+        assert "toy entrypoint" in paddle.hub.help(repo, "toy_model")
+        m = paddle.hub.load(repo, "toy_model", width=3)
+        assert tuple(m.weight.shape) == (3, 3)
+
+    def test_remote_source_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="local"):
+            paddle.hub.list("some/repo", source="github")
+
+    def test_unknown_model_raises(self, tmp_path):
+        repo = self._repo(tmp_path)
+        with pytest.raises(ValueError):
+            paddle.hub.load(repo, "nope")
