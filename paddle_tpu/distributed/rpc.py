@@ -88,12 +88,19 @@ def _inbox_loop(rank: int, start_slot: int):
                 return
             continue  # retry the SAME slot — skipping would orphan it
         client.key_value_delete(f"paddle_tpu/rpc/req/{rank}/{slot}")
+        # persist consumption progress so a re-init resumes exactly
+        # after the last handled slot (requests sent while the worker
+        # was down still get served — no orphaned slots)
+        try:
+            client.key_value_delete(f"paddle_tpu/rpc/consumed/{rank}")
+        except Exception:
+            pass
+        client.key_value_set(f"paddle_tpu/rpc/consumed/{rank}",
+                             str(slot))
         slot += 1
         req = pickle.loads(blob)
         if req.get("op") == "__shutdown__":
             return
-        if req.get("op") == "__noop__":  # init start marker
-            continue
         fn, args, kwargs, resp_key = (req["fn"], req["args"],
                                       req["kwargs"], req["resp"])
         try:
@@ -127,14 +134,15 @@ def init_rpc(name: str, rank: Optional[int] = None,
     except Exception:
         pass
     client.key_value_set(f"paddle_tpu/rpc/name/{my_rank}", name)
-    # claim one inbox slot as a start marker: the counter persists in the
-    # coordinator across shutdown/re-init, so the fresh inbox thread must
-    # resume where the counter is, not at slot 1
-    start = client.key_value_increment(f"paddle_tpu/rpc/inbox/{my_rank}",
-                                       1)
-    client.key_value_set_bytes(
-        f"paddle_tpu/rpc/req/{my_rank}/{start}",
-        pickle.dumps({"op": "__noop__"}, protocol=4))
+    # resume after the last slot the previous inbox consumed (persisted
+    # by the loop): slots written while the worker was down are still
+    # pending and get served; nothing is orphaned across re-init
+    try:
+        consumed = int(client.blocking_key_value_get(
+            f"paddle_tpu/rpc/consumed/{my_rank}", 1000))
+    except Exception:
+        consumed = 0
+    start = consumed + 1
     _state.update(inited=True, name=name, rank=my_rank,
                   world_size=world_size or jax.process_count(),
                   stopping=False)

@@ -19,7 +19,10 @@ def _load_hubconf(repo_dir: str):
         raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
     spec = importlib.util.spec_from_file_location("hubconf", path)
     mod = importlib.util.module_from_spec(spec)
-    sys.modules.pop("hubconf", None)
+    # register BEFORE exec (standard importlib recipe): objects defined
+    # in hubconf.py must resolve __module__ through sys.modules so they
+    # stay picklable (e.g. through incubate.multiprocessing)
+    sys.modules["hubconf"] = mod
     spec.loader.exec_module(mod)
     return mod
 

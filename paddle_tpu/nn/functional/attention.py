@@ -48,6 +48,10 @@ def set_flash_block_sizes(block_q=None, block_k=None):
     per-arch FA2 launch-config knob). None restores the kernel default
     (128/128); larger tiles amortize VMEM loads for long seqs."""
     global _FA_BLOCKS
+    if block_q is None and block_k is not None:
+        raise ValueError(
+            "set_flash_block_sizes: block_q is required when block_k "
+            "is given (block_q=None resets to defaults)")
     _FA_BLOCKS = None if block_q is None else (int(block_q),
                                                int(block_k or block_q))
 
@@ -66,6 +70,14 @@ def _fa_blocks(m, b, h, sq, sk, d):
     else:
         bq = min(_FA_BLOCKS[0], sq)
         bk = min(_FA_BLOCKS[1], sk)
+        # the kernel requires tiles to divide the sequence; snap down
+        # rather than fail trace-time with an opaque Pallas error
+        while bq > 128 and sq % bq:
+            bq //= 2
+        while bk > 128 and sk % bk:
+            bk //= 2
+        if sq % bq or sk % bk:
+            return m.BlockSizes.get_default(b, h, sq, sk, d)
     return m.BlockSizes(
         block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
         block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,

@@ -22,7 +22,7 @@ __all__ = [
     "cosine_embedding_loss", "triplet_margin_loss",
     "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
     "soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
-    "ctc_loss", "margin_cross_entropy", "huber_loss",
+    "ctc_loss", "margin_cross_entropy", "huber_loss", "rnnt_loss",
 ]
 
 
@@ -511,3 +511,75 @@ def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
         return _reduce(per, reduction)
 
     return eager_apply("huber_loss", raw, as_tensor_args(input, label))
+
+
+def rnnt_loss(logits, labels, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss (reference: the warprnnt op, ops.yaml; python
+    surface paddle.nn.functional.rnnt_loss). TPU-native: the standard
+    (t, u) lattice forward recursion as nested ``lax.scan``s —
+    sequential over time, sequential over the label axis inside each
+    step, vectorized over the batch.
+
+    logits: [B, T, U+1, V] joint-network outputs (T acoustic frames,
+    U max label length); labels: [B, U] int padded; lengths as usual.
+    """
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: fastemit_lambda != 0 (FastEmit regularization) "
+            "is not implemented; pass 0.0")
+
+    def raw(lg, lab, in_len, lab_len):
+        B, T, U1, V = lg.shape
+        U = U1 - 1
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        blank_lp = lp[..., blank]                       # [B, T, U+1]
+        lab_i = lab.astype(jnp.int32)
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], lab_i[:, None, :, None].repeat(T, 1),
+            axis=3)[..., 0]                             # [B, T, U]
+        in_len = in_len.astype(jnp.int32)
+        lab_len = lab_len.astype(jnp.int32)
+        u_range = jnp.arange(U1)
+
+        # t = 0 row: only emits along u
+        row0 = jnp.concatenate(
+            [jnp.zeros((B, 1), lp.dtype),
+             jnp.cumsum(emit_lp[:, 0, :], axis=1)], axis=1)
+        row0 = jnp.where(u_range[None, :] <= lab_len[:, None], row0,
+                         neg_inf)
+
+        def step_t(alpha, t):
+            from_blank = alpha + blank_lp[:, t - 1, :]   # stay at u
+
+            def step_u(carry, u):
+                v = jnp.logaddexp(
+                    from_blank[:, u],
+                    carry + emit_lp[:, t, u - 1])
+                return v, v
+
+            a0 = from_blank[:, 0]
+            _, rest = jax.lax.scan(step_u, a0, jnp.arange(1, U1))
+            new = jnp.concatenate([a0[:, None],
+                                   jnp.swapaxes(rest, 0, 1)], axis=1)
+            new = jnp.where(u_range[None, :] <= lab_len[:, None], new,
+                            neg_inf)
+            live = (t < in_len)[:, None]   # freeze rows past T_b
+            return jnp.where(live, new, alpha), None
+
+        alpha, _ = jax.lax.scan(step_t, row0, jnp.arange(1, T))
+        # final: alpha[T_b-1, U_b] + blank emission there
+        idx_u = jnp.clip(lab_len, 0, U)[:, None]
+        a_fin = jnp.take_along_axis(alpha, idx_u, axis=1)[:, 0]
+        t_fin = jnp.clip(in_len - 1, 0, T - 1)
+        b_fin = jnp.take_along_axis(
+            jnp.take_along_axis(blank_lp, t_fin[:, None, None]
+                                .repeat(U1, 2), axis=1)[:, 0, :],
+            idx_u, axis=1)[:, 0]
+        loss = -(a_fin + b_fin)
+        return _reduce(loss, reduction)
+
+    return eager_apply("rnnt_loss", raw,
+                       as_tensor_args(logits, labels, input_lengths,
+                                      label_lengths))

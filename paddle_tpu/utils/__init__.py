@@ -58,7 +58,15 @@ def require_version(min_version, max_version=None):
     from .. import __version__
 
     def _key(v):
-        return tuple(int(x) for x in str(v).split(".")[:3])
+        import re
+
+        parts = []
+        for x in str(v).split(".")[:3]:
+            m = re.match(r"\d+", x)
+            parts.append(int(m.group()) if m else 0)
+        while len(parts) < 3:  # '0.1' must equal '0.1.0'
+            parts.append(0)
+        return tuple(parts)
 
     cur = _key(__version__)
     if _key(min_version) > cur:
@@ -90,13 +98,13 @@ class download:
 
     @staticmethod
     def get_weights_path_from_url(url, md5sum=None):
+        if os.path.exists(url):  # an explicit local path always wins
+            return url
         path = os.path.expanduser(
             os.path.join("~", ".cache", "paddle_tpu", "weights",
                          os.path.basename(url)))
         if os.path.exists(path):
             return path
-        if os.path.exists(url):  # already a local path
-            return url
         raise RuntimeError(
             f"zero-egress environment: place the file at {path} "
             f"(requested {url})")

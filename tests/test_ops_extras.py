@@ -307,3 +307,62 @@ class TestReviewFixes:
         eng.submit(prompt, max_new_tokens=6)  # 58+6=64 == max_length
         done = eng.run()
         assert len(done) == 1 and len(done[0].generated) == 6
+
+
+class TestRNNTLoss:
+    """rnnt_loss (the warprnnt op) vs brute-force path enumeration."""
+
+    def _brute(self, logits, labels, T, U):
+        """Sum over all monotonic (t,u) alignment paths."""
+        import itertools
+        import scipy.special as sp
+
+        lp = logits - sp.logsumexp(logits, -1, keepdims=True)
+        # path = order of U emits among T-1 time steps... enumerate move
+        # sequences: from (0,0), moves: blank (t+1) x (T-1), emit (u+1)
+        # x U, then final blank at (T-1, U)
+        total = -np.inf
+        moves = ["b"] * (T - 1) + ["e"] * U
+        for perm in set(itertools.permutations(moves)):
+            t = u = 0
+            s = 0.0
+            for mv in perm:
+                if mv == "b":
+                    s += lp[t, u, 0]
+                    t += 1
+                else:
+                    s += lp[t, u, labels[u]]
+                    u += 1
+            s += lp[T - 1, U, 0]  # final blank
+            total = np.logaddexp(total, s)
+        return -total
+
+    def test_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        B, T, U, V = 2, 3, 2, 4
+        logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, U))
+        loss = F.rnnt_loss(_t(logits), _t(labels), _t([T, T]),
+                           _t([U, U]), blank=0,
+                           reduction="none").numpy()
+        for b in range(B):
+            ref = self._brute(logits[b], labels[b], T, U)
+            np.testing.assert_allclose(loss[b], ref, rtol=1e-4,
+                                       err_msg=f"row {b}")
+
+    def test_ragged_lengths(self):
+        rng = np.random.RandomState(1)
+        B, T, U, V = 3, 4, 3, 5
+        logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, U))
+        in_len = np.array([4, 3, 2])
+        lab_len = np.array([3, 2, 1])
+        loss = F.rnnt_loss(_t(logits), _t(labels), _t(in_len),
+                           _t(lab_len), reduction="none").numpy()
+        assert np.isfinite(loss).all() and (loss > 0).all()
+        for b in range(B):
+            ref = self._brute(
+                logits[b, : in_len[b], : lab_len[b] + 1],
+                labels[b, : lab_len[b]], in_len[b], lab_len[b])
+            np.testing.assert_allclose(loss[b], ref, rtol=1e-4,
+                                       err_msg=f"row {b}")

@@ -47,6 +47,10 @@ WORKER = textwrap.dedent("""
         assert "kaboom" in str(e)
     else:
         raise AssertionError("expected remote exception")
+    # barrier before shutdown: a fast rank must not tear down its inbox
+    # while the peer's last request is still in flight
+    from jax._src import distributed as _dist
+    _dist.global_state.client.wait_at_barrier("rpc_done_1", 60000)
     rpc.shutdown()
     print(f"RPC_RANK{rank}_OK")
 """)
@@ -103,6 +107,7 @@ assert rpc.rpc_sync(peer, add, args=(10, 20)) == 30
 # rpc_async timeout is honored on the Future
 fut = rpc.rpc_async(peer, add, args=(1, 1), timeout=30)
 assert fut.wait() == 2
+_dist.global_state.client.wait_at_barrier("rpc_done_2", 60000)
 rpc.shutdown()
 print(f"RPC_RANK{rank}_OK")''')
 

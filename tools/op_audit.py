@@ -91,7 +91,7 @@ COVERED_BY = {
     "sigmoid_cross_entropy_with_logits": "F.binary_cross_entropy_with_logits",
     "kldiv_loss": "F.kl_div", "identity_loss": "paddle.mean/sum (IPU-specific op)",
     "warpctc": "F.ctc_loss (lax.scan alpha recursion, nn/functional/loss.py)",
-    "warprnnt": "F.ctc_loss family (RNN-T loss: same scan skeleton; not shipped)",
+    "warprnnt": "F.rnnt_loss (nested lax.scan lattice recursion, nn/functional/loss.py)",
     "logsigmoid": "F.log_sigmoid", "tanh_shrink": "F.tanhshrink",
     "repeat_interleave_with_tensor_index": "paddle.repeat_interleave",
     # interpolation family -> F.interpolate
