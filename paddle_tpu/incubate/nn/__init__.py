@@ -2,6 +2,13 @@
 from . import functional  # noqa: F401
 from .fused_transformer import (  # noqa: F401
     FusedMultiTransformer, PagedKV, qkv_split_rope_fused, rope_table)
+from .layers import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedEcMoe,
+    FusedFeedForward, FusedLinear, FusedMultiHeadAttention,
+    FusedTransformerEncoderLayer)
 
 __all__ = ["FusedMultiTransformer", "PagedKV", "qkv_split_rope_fused",
-           "rope_table"]
+           "rope_table", "FusedLinear", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer",
+           "FusedEcMoe"]

@@ -19,6 +19,7 @@ from .fused_transformer import _apply_rope, qkv_split_rope_fused  # noqa: F401
 __all__ = [
     "fused_rotary_position_embedding", "fused_layer_norm",
     "fused_linear", "fused_multi_head_attention",
+    "fused_bias_dropout_residual_layer_norm",
     "qkv_split_rope_fused",
 ]
 
@@ -127,7 +128,8 @@ def fused_linear(x, weight, bias=None, transpose_weight=False):
 def fused_multi_head_attention(x, qkv_weight, linear_weight,
                                qkv_bias=None, linear_bias=None,
                                num_heads=None, attn_mask=None,
-                               dropout_rate=0.0, causal=False,
+                               dropout_rate=0.0, out_dropout_rate=0.0,
+                               causal=False,
                                pre_layer_norm=False, ln_scale=None,
                                ln_bias=None, epsilon=1e-5, training=True):
     """Whole MHA block as one fusion: [pre-LN] → qkv → SDPA (flash path
@@ -150,6 +152,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         is_causal=causal, training=training)
     att = att.reshape([b, s, d])
     out = fused_linear(att, linear_weight, linear_bias)
+    if out_dropout_rate:
+        out = F.dropout(out, p=out_dropout_rate, training=training)
     res = xt + out  # residual (reference adds the input back)
     if not pre_layer_norm and (ln_scale is not None
                                or ln_bias is not None):
@@ -157,3 +161,20 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         # fused_attention post_layer_norm path)
         return fused_layer_norm(res, ln_scale, ln_bias, epsilon)
     return res
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5,
+                                           epsilon=1e-5, training=True,
+                                           mode="upscale_in_train",
+                                           name=None):
+    """LN(residual + dropout(x + bias)) in one fused region (reference:
+    incubate/nn/functional/fused_transformer.py
+    fused_bias_dropout_residual_layer_norm over the CUDA fused op)."""
+    import paddle_tpu.nn.functional as F
+
+    (xt, rt) = as_tensor_args(x, residual)
+    h = xt if bias is None else xt + bias
+    h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    return fused_layer_norm(rt + h, ln_scale, ln_bias, epsilon)
