@@ -13,10 +13,11 @@ import importlib
 import os
 import warnings
 
+from . import cpp_extension  # noqa: F401
 from . import unique_name  # noqa: F401
 
 __all__ = ["deprecated", "try_import", "require_version", "run_check",
-           "unique_name", "download", "dlpack"]
+           "unique_name", "download", "dlpack", "cpp_extension"]
 
 
 def deprecated(update_to="", since="", reason="", level=0):

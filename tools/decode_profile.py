@@ -1,0 +1,246 @@
+"""Decode-bottleneck ablation: time isolated components of the 1.3B
+paged-KV decode step on the real chip (VERDICT r3 weak #1 diagnosis).
+
+Run one mode per fresh subprocess (HBM fragmentation):
+    python tools/decode_profile.py --mode full|noattn|headonly|xla_attn|...
+
+Each mode prints one JSON line with tokens/sec for a 64-step decode
+chunk at batch 16 on the gpt3-1.3b geometry (d2048 L24 h16 hd128).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+D, L, H, HD = 2048, 24, 16, 128
+VOCAB = 51200
+BATCH = 16
+PROMPT = 128
+CHUNK = 64
+PAGE = 16
+
+
+def build(bf16_stack=True, bf16_embed=False):
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import FusedCausalLM
+
+    paddle.seed(0)
+    model = FusedCausalLM(vocab_size=VOCAB, embed_dim=D, num_heads=H,
+                         dim_feedforward=4 * D, num_layers=L,
+                         max_position=PROMPT + CHUNK + 64)
+    if bf16_stack:
+        st = model.stack
+        for n in ("qkv_weight", "qkv_bias", "out_weight", "out_bias",
+                  "ffn1_weight", "ffn1_bias", "ffn2_weight", "ffn2_bias"):
+            p = getattr(st, n)
+            p._rebind(p._data.astype(jnp.bfloat16))
+    if bf16_embed:
+        model.embed._rebind(model.embed._data.astype(jnp.bfloat16))
+    return model
+
+
+def time_chunk(fn, args, steps=3):
+    """Compile + time a chunk program; returns sec/chunk."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # re-fetch a scalar to force through the tunnel
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / steps
+
+
+def mode_full(cache_dtype="float32", attn="pallas", bf16_embed=False):
+    """Current engine path end-to-end (greedy, chunk=64)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference import GenerationEngine
+
+    model = build(bf16_embed=bf16_embed)
+    eng = GenerationEngine(model, page_size=PAGE,
+                           max_length=PROMPT + CHUNK + 2,
+                           decode_chunk=CHUNK)
+    if attn == "xla":
+        import paddle_tpu.nn.functional.paged_attention as pa
+
+        def forced(q, kc, vc, lens, tables):
+            return pa._xla_paged(q, kc, vc, lens, tables)
+        pa.paged_attention = forced
+        import paddle_tpu.incubate.nn.fused_transformer as ft
+        ft.paged_attention = forced
+    if cache_dtype != "float32":
+        from paddle_tpu.inference import kv_cache as kvmod
+        orig_init = kvmod.BlockKVCacheManager.__init__
+
+        def patched(self, *a, **kw):
+            kw["dtype"] = jnp.bfloat16
+            orig_init(self, *a, **kw)
+        kvmod.BlockKVCacheManager.__init__ = patched
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, VOCAB, (BATCH, PROMPT))
+    new = 1 + CHUNK
+    eng.generate(ids, max_new_tokens=new)  # compile
+    t0 = time.perf_counter()
+    out = eng.generate(ids, max_new_tokens=new)
+    dt = time.perf_counter() - t0
+    assert out.shape == (BATCH, PROMPT + new)
+    return BATCH * new / dt
+
+
+def mode_weights_only():
+    """Transformer matmuls only (no attention, no cache, no logits):
+    the pure weight-streaming floor."""
+    import jax
+    import jax.numpy as jnp
+
+    model = build()
+    st = model.stack
+    w = st._stack()
+
+    def chunk(weights, x):
+        def tok_step(carry, _):
+            h = carry
+
+            def body(h, wl):
+                hn = (h - jnp.mean(h, -1, keepdims=True)) * wl["ln1_scale"][:D]
+                qkv = hn @ wl["qkv_weight"]
+                att = qkv[:, :D]
+                h = h + att @ wl["out_weight"] + wl["out_bias"]
+                ff = jax.nn.gelu(h @ wl["ffn1_weight"] + wl["ffn1_bias"])
+                h = h + ff @ wl["ffn2_weight"] + wl["ffn2_bias"]
+                return h, None
+            h, _ = jax.lax.scan(body, h, weights)
+            return h, h[:, 0]
+        h, outs = jax.lax.scan(tok_step, x, jnp.arange(CHUNK))
+        return outs
+
+    fn = jax.jit(chunk)
+    x = jnp.ones((BATCH, D), jnp.bfloat16)
+    sec = time_chunk(fn, (w, x))
+    return BATCH * CHUNK / sec
+
+
+def mode_head_only(bf16=False):
+    """Logits head (h @ embed.T) + argmax, 64 steps."""
+    import jax
+    import jax.numpy as jnp
+
+    model = build(bf16_embed=bf16)
+    embed = model.embed._data
+
+    def chunk(embed, h):
+        def tok_step(carry, _):
+            logits = carry @ embed.T
+            tok = jnp.argmax(logits, -1)
+            return carry + 1e-6 * tok[:, None].astype(carry.dtype), tok
+        _, toks = jax.lax.scan(tok_step, h, jnp.arange(CHUNK))
+        return toks
+
+    fn = jax.jit(chunk)
+    h = jnp.ones((BATCH, D), embed.dtype)
+    sec = time_chunk(fn, (embed, h))
+    return BATCH * CHUNK / sec
+
+
+def mode_cache_copy(dtype="float32"):
+    """Cost of shuttling the paged cache through scan xs->ys per token
+    (the current decode structure) with NO compute."""
+    import jax
+    import jax.numpy as jnp
+
+    pages_per_seq = -(-(PROMPT + CHUNK + 2) // PAGE)
+    npages = BATCH * pages_per_seq + 1
+    shape = (L, H, npages, PAGE, HD)
+    dt = jnp.float32 if dtype == "float32" else jnp.bfloat16
+    ck, cv = jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    def chunk(ck, cv):
+        def tok_step(carry, i):
+            ck, cv = carry
+
+            def body(_, per_layer):
+                k, v = per_layer
+                k = k.at[0, 0, 0, 0].add(1.0)
+                return 0.0, (k, v)
+            _, (ck, cv) = jax.lax.scan(body, 0.0, (ck, cv))
+            return (ck, cv), ck[0, 0, 0, 0, 0]
+        (ck, cv), outs = jax.lax.scan(tok_step, (ck, cv),
+                                      jnp.arange(CHUNK))
+        return outs
+
+    # no donation: time_chunk re-invokes with the same arrays
+    fn = jax.jit(chunk)
+    sec = time_chunk(fn, (ck, cv))
+    return BATCH * CHUNK / sec
+
+
+def mode_pallas_attn(dtype="float32"):
+    """Pallas paged-attention kernel alone, 64 steps x 24 layers."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.paged_attention import paged_attention
+
+    pages_per_seq = -(-(PROMPT + CHUNK + 2) // PAGE)
+    npages = BATCH * pages_per_seq + 1
+    dt = jnp.float32 if dtype == "float32" else jnp.bfloat16
+    ck = jnp.zeros((H, npages, PAGE, HD), dt)
+    cv = jnp.zeros((H, npages, PAGE, HD), dt)
+    tables = jnp.arange(1, 1 + BATCH * pages_per_seq, dtype=jnp.int32) \
+        .reshape(BATCH, pages_per_seq)
+    lens = jnp.full((BATCH,), PROMPT, jnp.int32)
+
+    def chunk(q, ck, cv):
+        def tok_step(q, i):
+            def body(q, _):
+                o = paged_attention(q, ck, cv, lens, tables)
+                return o.astype(q.dtype), None
+            q, _ = jax.lax.scan(body, q, jnp.arange(L))
+            return q, q[0, 0, 0]
+        q, _ = jax.lax.scan(tok_step, q, jnp.arange(CHUNK))
+        return q
+
+    q = jnp.ones((BATCH, H, HD), dt)
+    fn = jax.jit(chunk)
+    sec = time_chunk(fn, (q, ck, cv))
+    return BATCH * CHUNK / sec
+
+
+MODES = {
+    "full": lambda: mode_full(),
+    "bf16cache": lambda: mode_full(cache_dtype="bfloat16"),
+    "bf16embed": lambda: mode_full(bf16_embed=True),
+    "bf16both": lambda: mode_full(cache_dtype="bfloat16", bf16_embed=True),
+    "xla_attn": lambda: mode_full(attn="xla"),
+    "weights_only": mode_weights_only,
+    "head_only": lambda: mode_head_only(False),
+    "head_only_bf16": lambda: mode_head_only(True),
+    "cache_copy": lambda: mode_cache_copy("float32"),
+    "cache_copy_bf16": lambda: mode_cache_copy("bfloat16"),
+    "pallas_attn": lambda: mode_pallas_attn("float32"),
+    "pallas_attn_bf16": lambda: mode_pallas_attn("bfloat16"),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", required=True, choices=sorted(MODES))
+    args = ap.parse_args()
+    t0 = time.time()
+    tps = MODES[args.mode]()
+    print(json.dumps({"mode": args.mode, "tokens_per_sec": round(tps, 1),
+                      "wall": round(time.time() - t0, 1)}))
+
+
+if __name__ == "__main__":
+    main()
