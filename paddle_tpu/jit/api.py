@@ -70,9 +70,25 @@ def save(layer, path, input_spec=None, **configs):
                     for b in buffers]
         from jax import export as jexport
 
-        exp = jexport.export(jax.jit(pure_forward))(
-            p_shapes, b_shapes, *arg_shapes)
-        exported_blobs.append(exp.serialize())
+        try:
+            exp = jexport.export(jax.jit(pure_forward))(
+                p_shapes, b_shapes, *arg_shapes)
+            blob = exp.serialize()
+        except Exception as e:
+            if "callback" in str(e).lower():
+                raise RuntimeError(
+                    "jit.save cannot serialize a model that calls a "
+                    "HOST custom op (a C++ kernel bridged via "
+                    "jax.pure_callback — e.g. "
+                    "cpp_extension.CustomOpModule.elementwise_op): the "
+                    "StableHLO artifact would reference a host function "
+                    "that does not exist at load time. Re-implement the "
+                    "op as a device kernel (jnp/Pallas) via "
+                    "cpp_extension.register_custom_op, or deploy the "
+                    "model eagerly without jit.save."
+                ) from e
+            raise
+        exported_blobs.append(blob)
 
     meta = {
         "class_name": type(layer).__name__,

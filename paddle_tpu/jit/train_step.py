@@ -149,11 +149,9 @@ class TrainStep:
             return [wd(p, g) for p, g in zip(p_arrays, grads)]
         return grads
 
-    def __call__(self, inputs, labels=()):
-        if isinstance(inputs, Tensor):
-            inputs = [inputs]
-        if isinstance(labels, Tensor):
-            labels = [labels]
+    def _build_args(self, inputs, labels):
+        """Assemble the positional args of ``_pure_step`` exactly as
+        ``__call__`` passes them (single source for call + lowering)."""
         opt = self.optimizer
         trainable = [self._params[i] for i in self._trainable_idx]
         fun = getattr(opt, "_apply_decay_param_fun", None)
@@ -170,10 +168,26 @@ class TrainStep:
                      for t in inputs]
         lb_arrays = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
                      for t in labels]
+        return (p_arrays, opt_states, b_arrays, in_arrays, lb_arrays, key,
+                hyper, per_param)
+
+    def lower_hlo(self, inputs, labels=()) -> str:
+        """Lower the whole-step program for these inputs and return the
+        optimized HLO text (used by HLO-assertion tests and the
+        multichip dryrun; does NOT execute the step)."""
+        return self._compiled.lower(*self._build_args(inputs, labels)) \
+            .compile().as_text()
+
+    def __call__(self, inputs, labels=()):
+        if isinstance(inputs, Tensor):
+            inputs = [inputs]
+        if isinstance(labels, Tensor):
+            labels = [labels]
+        opt = self.optimizer
+        trainable = [self._params[i] for i in self._trainable_idx]
 
         loss, new_params, new_sts, new_bufs = self._compiled(
-            p_arrays, opt_states, b_arrays, in_arrays, lb_arrays, key,
-            hyper, per_param)
+            *self._build_args(inputs, labels))
 
         for p, a in zip(self._params, new_params):
             p._rebind(a)

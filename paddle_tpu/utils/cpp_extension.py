@@ -20,9 +20,11 @@ framework exactly like a built-in op:
     ``jax.custom_vjp`` rule, so the tape, ``to_static`` tracing, AND
     whole-step ``jit.TrainStep`` all see the custom gradient);
   - ``to_static`` / ``jit.save`` — the forward is jax-traceable, so it
-    serializes into the StableHLO artifact and reloads in the Predictor
-    (host C++ ops execute via callback and are eager/jit-executable but
-    NOT serializable — ``jit.save`` raises a clear error for them).
+    serializes into the StableHLO artifact and reloads in the Predictor.
+    Host C++ ops execute via callback and are eager/jit-executable but
+    NOT serializable; ``jit.save`` detects the host custom-call in the
+    export and raises with guidance instead of emitting a broken
+    artifact.
 """
 from __future__ import annotations
 
@@ -246,15 +248,20 @@ def load(name: str, sources: Sequence[str],
     tag = _hash_build(sources, extra_cflags, extra_ldflags)
     so_path = os.path.join(build_dir, f"{name}_{tag}.so")
     if not os.path.exists(so_path):
+        # build to a private temp path, then atomically publish: an
+        # interrupted/concurrent build must never leave a half-written
+        # .so at the cache-hit path
+        tmp_path = f"{so_path}.build.{os.getpid()}"
         cmd = (["g++", "-O3", "-fPIC", "-shared", "-std=c++17"]
                + list(extra_cflags) + list(sources)
-               + ["-o", so_path] + list(extra_ldflags))
+               + ["-o", tmp_path] + list(extra_ldflags))
         if verbose:
             print("[cpp_extension]", " ".join(cmd))
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"cpp_extension build of `{name}` failed:\n{proc.stderr}")
+        os.replace(tmp_path, so_path)
     return CustomOpModule(name, so_path)
 
 

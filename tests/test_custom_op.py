@@ -256,6 +256,27 @@ class TestCppExtensionLoad:
             build_directory=os.path.dirname(ext.lib_path))
         assert again.lib_path == ext.lib_path
 
+    def test_jit_save_host_op_raises_clear_error(self, ext, tmp_path):
+        """A model using a host C++ callback op must fail jit.save with
+        guidance, not a raw serialization error or a broken artifact."""
+        op = ext.elementwise_op("my_csquare", op_name="my_csquare_save")
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return op(self.fc(x))
+
+        model = Net()
+        model.eval()
+        from paddle_tpu.static.input_spec import InputSpec
+
+        with pytest.raises(RuntimeError, match="HOST custom op"):
+            paddle.jit.save(model, str(tmp_path / "hostnet"),
+                            input_spec=[InputSpec([2, 4], "float32")])
+
     def test_cuda_extension_raises(self):
         with pytest.raises(RuntimeError, match="Pallas"):
             cpp_extension.CUDAExtension(sources=["x.cu"])

@@ -33,21 +33,8 @@ def _loss(logits, labels):
 
 
 def _lower_train_step(step, inputs, labels):
-    """Build _pure_step args exactly as TrainStep.__call__ does, lower."""
-    opt = step.optimizer
-    trainable = [step._params[i] for i in step._trainable_idx]
-    opt_states = [opt._state_for(p) for p in trainable]
-    hyper = opt._hyper()
-    per_param = [opt._per_param_hyper(p) for p in trainable]
-    from paddle_tpu.core.generator import default_generator
-
-    key = default_generator().next_key()
-    lowered = step._compiled.lower(
-        [p._data for p in step._params], opt_states,
-        [b._data for b in step._buffers],
-        [t._data for t in inputs], [t._data for t in labels], key,
-        hyper, per_param)
-    return lowered.compile().as_text()
+    """One source of truth for the arg build: TrainStep.lower_hlo."""
+    return step.lower_hlo(inputs, labels)
 
 
 class TestZeroStage2:

@@ -112,12 +112,15 @@ def mode_weights_only():
             h = carry
 
             def body(h, wl):
-                hn = (h - jnp.mean(h, -1, keepdims=True)) * wl["ln1_scale"][:D]
+                hn = ((h - jnp.mean(h, -1, keepdims=True))
+                      * wl["ln1_scale"][:D]).astype(h.dtype)
                 qkv = hn @ wl["qkv_weight"]
                 att = qkv[:, :D]
-                h = h + att @ wl["out_weight"] + wl["out_bias"]
+                h = (h + att @ wl["out_weight"] + wl["out_bias"]) \
+                    .astype(h.dtype)
                 ff = jax.nn.gelu(h @ wl["ffn1_weight"] + wl["ffn1_bias"])
-                h = h + ff @ wl["ffn2_weight"] + wl["ffn2_bias"]
+                h = (h + ff @ wl["ffn2_weight"] + wl["ffn2_bias"]) \
+                    .astype(h.dtype)
                 return h, None
             h, _ = jax.lax.scan(body, h, weights)
             return h, h[:, 0]
@@ -216,6 +219,144 @@ def mode_pallas_attn(dtype="float32"):
     return BATCH * CHUNK / sec
 
 
+def mode_carry_cache(dtype="float32"):
+    """In-place alternative to the scan xs->ys shuttle: cache pool as
+    fori_loop carry, one scatter per layer (layers folded into the page
+    dim). If XLA aliases the carry, cost ~= true bytes written (tiny)."""
+    import jax
+    import jax.numpy as jnp
+
+    pages_per_seq = -(-(PROMPT + CHUNK + 2) // PAGE)
+    npages = BATCH * pages_per_seq + 1
+    dt = jnp.float32 if dtype == "float32" else jnp.bfloat16
+    shape = (H, L * npages, PAGE, HD)
+    ck, cv = jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+    tables = jnp.arange(1, 1 + BATCH * pages_per_seq, dtype=jnp.int32) \
+        .reshape(BATCH, pages_per_seq)
+
+    def chunk(ck, cv):
+        def tok_step(carry, i):
+            ck, cv = carry
+            pos = jnp.full((BATCH,), PROMPT, jnp.int32) + i
+            page_ids = tables[jnp.arange(BATCH), pos // PAGE]
+            slots = pos % PAGE
+            newk = jnp.ones((H, BATCH, HD), dt)
+
+            def body(l, c):
+                ck, cv = c
+                pid = page_ids + l * npages
+                ck = ck.at[:, pid, slots].set(newk)
+                cv = cv.at[:, pid, slots].set(newk)
+                return (ck, cv)
+            ck, cv = jax.lax.fori_loop(0, L, body, (ck, cv))
+            return (ck, cv), ck[0, 0, 0, 0]
+        (ck, cv), outs = jax.lax.scan(tok_step, (ck, cv),
+                                      jnp.arange(CHUNK))
+        return outs
+
+    fn = jax.jit(chunk)
+    sec = time_chunk(fn, (ck, cv))
+    return BATCH * CHUNK / sec
+
+
+def mode_head_variant(kind):
+    """Logits-head alternatives (head_only fp32 = 7.3ms/step is 17x off
+    the 420MB/819GB/s roofline; bf16 untransposed is pathological)."""
+    import jax
+    import jax.numpy as jnp
+
+    model = build()
+    embed = model.embed._data  # [V, D] fp32
+    # derive the variant from the kind string: transpose iff t_,
+    # bf16-cast iff bf16, preferred fp32 accumulate iff prefer
+    w = jnp.array(embed.T) if kind.startswith("t_") else embed
+    if "bf16" in kind:
+        w = w.astype(jnp.bfloat16)
+    prefer = "prefer" in kind
+    argmax = "noargmax" not in kind
+
+    cdim = 0 if kind.startswith("t_") else 1
+
+    def chunk(w, h):
+        def tok_step(carry, _):
+            logits = jax.lax.dot_general(
+                carry, w, (((1,), (cdim,)), ((), ())),
+                preferred_element_type=jnp.float32 if prefer else None)
+            tok = (jnp.argmax(logits, -1) if argmax
+                   else jnp.max(logits, -1).astype(jnp.int32))
+            return carry + (1e-6 * tok[:, None]).astype(carry.dtype), tok
+        _, toks = jax.lax.scan(tok_step, h, jnp.arange(CHUNK))
+        return toks
+
+    fn = jax.jit(chunk)
+    h = jnp.ones((BATCH, D), jnp.bfloat16 if "bf16" in kind
+                 else jnp.float32)
+    sec = time_chunk(fn, (w, h))
+    return BATCH * CHUNK / sec
+
+
+def mode_argmax_only():
+    """Isolate argmax over [b, V] inside a scan (head matmul excluded)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.ones((BATCH, VOCAB), jnp.float32)
+
+    def chunk(logits, h):
+        def tok_step(carry, _):
+            tok = jnp.argmax(logits + carry[:, :1], -1)
+            return carry + (1e-6 * tok[:, None]).astype(carry.dtype), tok
+        _, toks = jax.lax.scan(tok_step, h, jnp.arange(CHUNK))
+        return toks
+
+    fn = jax.jit(chunk)
+    h = jnp.ones((BATCH, VOCAB), jnp.float32)
+    sec = time_chunk(fn, (logits, h))
+    return BATCH * CHUNK / sec
+
+
+def mode_weights_unrolled():
+    """Weight streaming with UNSTACKED per-layer weights and a Python-
+    unrolled layer loop (no scan slice-copies of the stacked arrays)."""
+    import jax
+    import jax.numpy as jnp
+
+    model = build()
+    w = model.stack._stack()
+    layers = [{k: v[l] for k, v in w.items()} for l in range(L)]
+
+    def chunk(layers, x):
+        def tok_step(h, _):
+            for wl in layers:
+                hn = ((h - jnp.mean(h, -1, keepdims=True))
+                      * wl["ln1_scale"]).astype(h.dtype)
+                qkv = hn @ wl["qkv_weight"]
+                att = qkv[:, :D]
+                h = (h + att @ wl["out_weight"] + wl["out_bias"]) \
+                    .astype(h.dtype)
+                ff = jax.nn.gelu(h @ wl["ffn1_weight"] + wl["ffn1_bias"])
+                h = (h + ff @ wl["ffn2_weight"] + wl["ffn2_bias"]) \
+                    .astype(h.dtype)
+            return h, h[:, 0]
+        h, outs = jax.lax.scan(tok_step, x, jnp.arange(CHUNK))
+        return outs
+
+    fn = jax.jit(chunk)
+    x = jnp.ones((BATCH, D), jnp.bfloat16)
+    sec = time_chunk(fn, (layers, x))
+    return BATCH * CHUNK / sec
+
+
+def mode_pallas_page(page, dtype="bfloat16"):
+    """Pallas paged attention with a different page size (DMA width)."""
+    global PAGE
+    old, PAGE = PAGE, page
+    try:
+        return mode_pallas_attn(dtype)
+    finally:
+        PAGE = old
+
+
 MODES = {
     "full": lambda: mode_full(),
     "bf16cache": lambda: mode_full(cache_dtype="bfloat16"),
@@ -229,6 +370,20 @@ MODES = {
     "cache_copy_bf16": lambda: mode_cache_copy("bfloat16"),
     "pallas_attn": lambda: mode_pallas_attn("float32"),
     "pallas_attn_bf16": lambda: mode_pallas_attn("bfloat16"),
+    "carry_cache": lambda: mode_carry_cache("float32"),
+    "carry_cache_bf16": lambda: mode_carry_cache("bfloat16"),
+    "head_t_bf16": lambda: mode_head_variant("t_bf16"),
+    "head_t_bf16_prefer": lambda: mode_head_variant("t_bf16_prefer"),
+    "head_bf16_prefer": lambda: mode_head_variant("bf16_prefer"),
+    "head_t_f32": lambda: mode_head_variant("t_f32"),
+    "pallas_page32": lambda: mode_pallas_page(32),
+    "pallas_page64": lambda: mode_pallas_page(64),
+    "pallas_page8": lambda: mode_pallas_page(8),
+    "head_t_bf16_noargmax": lambda: mode_head_variant("t_bf16_noargmax"),
+    "head_bf16_prefer_noargmax":
+        lambda: mode_head_variant("bf16_prefer_noargmax"),
+    "argmax_only": mode_argmax_only,
+    "weights_unrolled": mode_weights_unrolled,
 }
 
 
