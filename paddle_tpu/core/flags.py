@@ -70,6 +70,10 @@ define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: report stats onl
 define_flag("record_double_grad", True,
             "record primal recipes on the tape for paddle.grad(create_graph=True); disable to save memory in first-order-only runs")
 define_flag("benchmark", False, "synchronize after each op for timing")
+define_flag("paged_attention_backend", "auto",
+            "decode paged-attention backend: auto (XLA gather path — "
+            "avoids Pallas/scatter layout-copy conflict, see "
+            "nn/functional/paged_attention.py) | xla | pallas")
 define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
 define_flag("eager_jit_ops", True, "dispatch eager ops through cached jit computations")
 define_flag("stop_check_timeout", 900, "bound (seconds) on distributed store waits")

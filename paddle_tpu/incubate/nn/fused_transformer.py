@@ -47,6 +47,19 @@ def _apply_rope(x, cos, sin):
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
+def _split_rope(proj, positions, num_heads, num_kv_heads, head_dim,
+                cos_table, sin_table):
+    """Head split + rotary embedding over a computed QKV projection."""
+    lead = proj.shape[:-1]
+    nq, nkv = num_heads, num_kv_heads
+    q, k, v = jnp.split(
+        proj.reshape(*lead, (nq + 2 * nkv), head_dim), [nq, nq + nkv],
+        axis=-2)
+    cos = cos_table[positions][..., None, :]   # [.., 1, hd/2]
+    sin = sin_table[positions][..., None, :]
+    return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin), v
+
+
 def qkv_split_rope_fused(x, qkv_w, qkv_b, positions, num_heads,
                          num_kv_heads, head_dim, cos_table, sin_table):
     """Fused QKV projection + head split + rotary embedding.
@@ -60,19 +73,23 @@ def qkv_split_rope_fused(x, qkv_w, qkv_b, positions, num_heads,
     proj = x @ qkv_w
     if qkv_b is not None:
         proj = proj + qkv_b
-    lead = x.shape[:-1]
-    nq, nkv = num_heads, num_kv_heads
-    q, k, v = jnp.split(
-        proj.reshape(*lead, (nq + 2 * nkv), head_dim), [nq, nq + nkv],
-        axis=-2)
-    cos = cos_table[positions][..., None, :]   # [.., 1, hd/2]
-    sin = sin_table[positions][..., None, :]
-    return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin), v
+    return _split_rope(proj, positions, num_heads, num_kv_heads,
+                       head_dim, cos_table, sin_table)
 
 
 class PagedKV(NamedTuple):
-    """Stacked per-layer paged KV cache (the carry of the decode scan)."""
-    k: jax.Array   # [L, n_kv, num_pages, page_size, head_dim]
+    """Layer-folded paged KV pool (the carry of the decode loop).
+
+    Layers are FOLDED into the page dimension — layer ``l``'s logical
+    page ``p`` lives at physical page ``l * num_pages + p`` — so one
+    decode step updates the pool **in place** (XLA aliases loop-carry
+    buffers; the scatter writes only the new token's rows). The round-3
+    layout ([L, n_kv, pages, ...] shuttled through scan xs→ys) copied
+    the whole pool every token: measured 10.8ms/step of pure copy on
+    the 1.3B config vs 0.7ms for this carry design (tools/decode_profile
+    cache_copy vs carry_cache).
+    """
+    k: jax.Array   # [n_kv, num_layers * num_pages, page_size, head_dim]
     v: jax.Array
 
 
@@ -136,7 +153,33 @@ class FusedMultiTransformer(Layer):
         names = ["ln1_scale", "ln1_bias", "qkv_weight", "qkv_bias",
                  "out_weight", "out_bias", "ln2_scale", "ln2_bias",
                  "ffn1_weight", "ffn1_bias", "ffn2_weight", "ffn2_bias"]
-        return {n: getattr(self, n)._data for n in names}
+        out = {n: getattr(self, n)._data for n in names}
+        for n in ("qkv", "out", "ffn1", "ffn2"):
+            s = getattr(self, f"{n}_scale_woq", None)
+            if s is not None:
+                out[f"{n}_scale"] = s._data
+        return out
+
+    def quantize_weight_only_int8(self):
+        """In-place weight-only int8 quantization of the four matmul
+        stacks (serving counterpart of the reference's
+        weight_only_linear / weight_quantize ops, ops.yaml): symmetric
+        per-output-channel scales; biases/LN stay full precision. The
+        decode program applies scales on matmul OUTPUTS so weight HBM
+        reads halve (see ``_mm``)."""
+        from ...core.tensor import Parameter
+
+        for n in ("qkv", "out", "ffn1", "ffn2"):
+            p = getattr(self, f"{n}_weight")
+            w = p._data.astype(jnp.float32)
+            scale = jnp.max(jnp.abs(w), axis=1, keepdims=True) / 127.0
+            scale = jnp.maximum(scale, 1e-8)          # [L, 1, out]
+            q = jnp.clip(jnp.round(w / scale), -127, 127) \
+                .astype(jnp.int8)
+            p._rebind(q)
+            setattr(self, f"{n}_scale_woq",
+                    Parameter(scale[:, 0, :]))        # [L, out]
+        return self
 
     def _act(self, x):
         return (jax.nn.gelu(x) if self.activation == "gelu"
@@ -148,81 +191,135 @@ class FusedMultiTransformer(Layer):
         var = jnp.var(x, -1, keepdims=True)
         return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
 
+    @staticmethod
+    def _mm(x, w, scale):
+        """x @ w, honoring int8 weight-only quantization: with
+        per-OUTPUT-channel scales, dequant commutes with the matmul —
+        ``(x @ w_q) * scale`` — so the int8→bf16 convert fuses into the
+        dot's weight read and HBM weight traffic halves (the decode
+        path is weight-bandwidth bound; reference comparator:
+        weight_only_linear, phi/kernels/fusion/gpu/)."""
+        if w.dtype == jnp.int8:
+            return (x @ w.astype(x.dtype)) * scale.astype(x.dtype)
+        return x @ w
+
+    def _layer_body(self, w, h, positions, kv_write, attend, cos_t,
+                    sin_t):
+        """One pre-LN transformer layer over hidden ``h`` (any leading
+        dims). Compute dtype FOLLOWS h (bf16 weights + bf16 h → pure
+        bf16 MXU dots; LN statistics promote to fp32 internally and are
+        cast back)."""
+        eps = self.epsilon
+        sc = w.get
+        hn = self._ln(h, w["ln1_scale"], w["ln1_bias"], eps) \
+            .astype(h.dtype)
+        qkv_w = w["qkv_weight"]
+        if qkv_w.dtype == jnp.int8:
+            proj = self._mm(hn, qkv_w, w["qkv_scale"]) + w["qkv_bias"]
+            q, k, v = _split_rope(proj, positions, self.num_heads,
+                                  self.num_kv_heads, self.head_dim,
+                                  cos_t, sin_t)
+        else:
+            q, k, v = qkv_split_rope_fused(
+                hn, qkv_w, w["qkv_bias"], positions,
+                self.num_heads, self.num_kv_heads, self.head_dim,
+                cos_t, sin_t)
+        ck, cv = kv_write(k, v)
+        att = attend(q, k, v, ck, cv)
+        att = att.reshape(*h.shape[:-1],
+                          self.num_heads * self.head_dim).astype(h.dtype)
+        h = (h + self._mm(att, w["out_weight"], sc("out_scale"))
+             + w["out_bias"]).astype(h.dtype)
+        hn = self._ln(h, w["ln2_scale"], w["ln2_bias"], eps) \
+            .astype(h.dtype)
+        ff = self._act(self._mm(hn, w["ffn1_weight"], sc("ffn1_scale"))
+                       + w["ffn1_bias"])
+        h = (h + self._mm(ff, w["ffn2_weight"], sc("ffn2_scale"))
+             + w["ffn2_bias"]).astype(h.dtype)
+        return h, ck, cv
+
+    def _pages_per_layer(self, cache: PagedKV) -> int:
+        return cache.k.shape[1] // self.num_layers
+
     def prefill_raw(self, weights, x, cache, block_tables, cos_t, sin_t):
         """Prompt pass: x [b, s, d] → (hidden [b, s, d], filled cache).
 
-        Causal dense attention (flash-fusable by XLA/Pallas); each layer's
-        K/V written into its page slice. ``cache=None`` runs the pure
-        dense forward (training/eval parity path) with no KV writes.
-        Ragged batches are NOT masked here — pad prompts to a common
-        length (dense attention over padding is causal-safe for the
-        suffix tokens actually decoded).
+        Causal dense attention (flash-fusable by XLA/Pallas); each
+        layer's K/V written into its layer-offset pages of the folded
+        pool. ``cache=None`` runs the pure dense forward (training/eval
+        parity path) with no KV writes. Ragged batches are NOT masked
+        here — pad prompts to a common length (dense attention over
+        padding is causal-safe for the suffix tokens actually decoded).
         """
         b, s, d = x.shape
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-        eps = self.epsilon
-        with_cache = cache is not None
+        group = self.num_heads // self.num_kv_heads
 
-        def body(h, per_layer):
-            if with_cache:
-                w, ck, cv = per_layer
-            else:
-                w, ck, cv = per_layer, None, None
-            hn = self._ln(h, w["ln1_scale"], w["ln1_bias"], eps)
-            q, k, v = qkv_split_rope_fused(
-                hn, w["qkv_weight"], w["qkv_bias"], positions,
-                self.num_heads, self.num_kv_heads, self.head_dim,
-                cos_t, sin_t)
-            if with_cache:
-                ck, cv = write_prefill_kv_pages(ck, cv, k, v,
-                                                block_tables)
-            group = self.num_heads // self.num_kv_heads
+        def attend(q, k, v, ck, cv):
             kq = jnp.repeat(k, group, axis=-2)
             vq = jnp.repeat(v, group, axis=-2)
-            att = jax.nn.dot_product_attention(
-                q, kq, vq, is_causal=True,
-                scale=self.head_dim ** -0.5)
-            att = att.reshape(b, s, self.num_heads * self.head_dim)
-            h = h + att @ w["out_weight"] + w["out_bias"]
-            hn = self._ln(h, w["ln2_scale"], w["ln2_bias"], eps)
-            ff = self._act(hn @ w["ffn1_weight"] + w["ffn1_bias"])
-            h = h + ff @ w["ffn2_weight"] + w["ffn2_bias"]
-            return h, ((ck, cv) if with_cache else None)
+            return jax.nn.dot_product_attention(
+                q, kq, vq, is_causal=True, scale=self.head_dim ** -0.5)
 
-        if with_cache:
-            h, (nk, nv) = jax.lax.scan(body, x,
-                                       (weights, cache.k, cache.v))
-            return h, PagedKV(nk, nv)
-        h, _ = jax.lax.scan(body, x, weights)
-        return h, None
+        if cache is None:
+            def body(h, w):
+                h, _, _ = self._layer_body(
+                    w, h, positions, lambda k, v: (None, None), attend,
+                    cos_t, sin_t)
+                return h, None
+
+            h, _ = jax.lax.scan(body, x, weights)
+            return h, None
+
+        npages = self._pages_per_layer(cache)
+
+        def body(l, carry):
+            h, ck, cv = carry
+            w = {n: jax.lax.dynamic_index_in_dim(a, l, 0, False)
+                 for n, a in weights.items()}
+            tbl = block_tables + l * npages
+            h, ck, cv = self._layer_body(
+                w, h, positions,
+                lambda k, v: write_prefill_kv_pages(ck, cv, k, v, tbl),
+                attend, cos_t, sin_t)
+            return h, ck, cv
+
+        h, nk, nv = jax.lax.fori_loop(
+            0, self.num_layers, body, (x, cache.k, cache.v))
+        return h, PagedKV(nk, nv)
 
     def decode_raw(self, weights, x, cache: PagedKV, block_tables,
                    seq_lens, cos_t, sin_t):
         """One decode step: x [b, d] token embeddings, seq_lens [b] =
         tokens already cached (the new token's position). Returns
-        (hidden [b, d], cache')."""
-        eps = self.epsilon
+        (hidden [b, d], cache').
 
-        def body(h, per_layer):
-            w, ck, cv = per_layer
-            hn = self._ln(h, w["ln1_scale"], w["ln1_bias"], eps)
-            q, k, v = qkv_split_rope_fused(
-                hn, w["qkv_weight"], w["qkv_bias"], seq_lens,
-                self.num_heads, self.num_kv_heads, self.head_dim,
-                cos_t, sin_t)
-            ck, cv = write_kv_pages(ck, cv, k, v, seq_lens, block_tables)
-            att = paged_attention(q, ck, cv,
-                                  (seq_lens + 1).astype(jnp.int32),
-                                  block_tables)
-            att = att.reshape(h.shape[0],
-                              self.num_heads * self.head_dim)
-            h = h + att @ w["out_weight"] + w["out_bias"]
-            hn = self._ln(h, w["ln2_scale"], w["ln2_bias"], eps)
-            ff = self._act(hn @ w["ffn1_weight"] + w["ffn1_bias"])
-            h = h + ff @ w["ffn2_weight"] + w["ffn2_bias"]
-            return h, (ck, cv)
+        Layer loop = ``fori_loop`` with the pool as carry: per step the
+        pool is only scatter-written (new token rows) and gather-read
+        (the Pallas kernel's page DMAs) — never copied.
+        """
+        npages = self._pages_per_layer(cache)
 
-        h, (nk, nv) = jax.lax.scan(body, x, (weights, cache.k, cache.v))
+        def attend_paged(tbl):
+            def attend(q, k, v, ck, cv):
+                return paged_attention(q, ck, cv,
+                                       (seq_lens + 1).astype(jnp.int32),
+                                       tbl)
+            return attend
+
+        def body(l, carry):
+            h, ck, cv = carry
+            w = {n: jax.lax.dynamic_index_in_dim(a, l, 0, False)
+                 for n, a in weights.items()}
+            tbl = block_tables + l * npages
+            h, ck, cv = self._layer_body(
+                w, h, seq_lens,
+                lambda k, v: write_kv_pages(ck, cv, k, v, seq_lens, tbl),
+                attend_paged(tbl), cos_t, sin_t)
+            return h, ck, cv
+
+        h, nk, nv = jax.lax.fori_loop(
+            0, self.num_layers, body, (x, cache.k, cache.v))
         return h, PagedKV(nk, nv)
 
     # ---------- eager Layer API ----------

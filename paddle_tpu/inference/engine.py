@@ -78,7 +78,7 @@ class GenerationEngine:
 
     def __init__(self, model: FusedCausalLM, page_size: int = 16,
                  max_length: int = 1024, num_pages: Optional[int] = None,
-                 decode_chunk: int = 8):
+                 decode_chunk: int = 8, kv_dtype=None):
         self.model = model
         st = model.stack
         self.max_length = max_length
@@ -86,12 +86,28 @@ class GenerationEngine:
         self.decode_chunk = max(int(decode_chunk), 1)
         self._cos, self._sin = rope_table(st.max_position, st.head_dim,
                                           st.rope_theta)
-        # one jitted prefill; decode programs are per-chunk-size (k=1
-        # is the single-token step)
-        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(6, 7))
-        self._decode_k_jit = {}
+        self._init_serving_state(kv_dtype)
         self._num_pages = num_pages
         self._mgr = None
+
+    def _init_serving_state(self, kv_dtype):
+        """Serving dtype discipline + compiled-program holders (shared
+        with ContinuousBatchingEngine): the COMPUTE dtype follows the
+        stack weights (cast them bf16 for the bandwidth-bound serving
+        path; fp32 stacks keep exact dense parity; int8 = weight-only
+        quantized → compute bf16), the KV pool follows kv_dtype
+        (default: same as compute), and the lm head is a PRE-TRANSPOSED
+        [d, vocab] copy in compute dtype with fp32 accumulation in the
+        logits dot."""
+        wd = self.model.stack.qkv_weight._data.dtype
+        self._cdtype = jnp.bfloat16 if wd == jnp.int8 else wd
+        self._kv_dtype = kv_dtype or self._cdtype
+        self._head_t = jnp.array(self.model.embed._data.T) \
+            .astype(self._cdtype)
+        # one jitted prefill; decode programs are per-chunk-size (k=1
+        # is the single-token step); cache operands are donated
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(7, 8))
+        self._decode_k_jit = {}
 
     def _get_decode_k(self, k: int, sample_cfg=None):
         """One compiled program per (chunk size, greedy-vs-sample,
@@ -104,26 +120,35 @@ class GenerationEngine:
             self._decode_k_jit[key] = jax.jit(
                 functools.partial(self._decode_k_fn, k=k,
                                   sample_cfg=sample_cfg),
-                donate_argnums=(6, 7))
+                donate_argnums=(7, 8))
         return self._decode_k_jit[key]
 
     # ---------- pure programs ----------
 
-    def _prefill_fn(self, weights, embed, lnf_s, lnf_b, ids, seq_lens,
-                    cache_k, cache_v, tables):
+    def _logits(self, h, head_t, lnf_s, lnf_b):
+        """LM head: final LN + pre-transposed [d, vocab] matmul with
+        fp32 accumulation (argmax/sampling happen on fp32 logits)."""
+        hl = FusedMultiTransformer._ln(
+            h, lnf_s, lnf_b, self.model.stack.epsilon) \
+            .astype(head_t.dtype)
+        return jax.lax.dot_general(
+            hl, head_t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def _prefill_fn(self, weights, embed, head_t, lnf_s, lnf_b, ids,
+                    seq_lens, cache_k, cache_v, tables):
         """Prompt pass over a right-padded batch: ``seq_lens[b]`` are the
         real prompt lengths (the reference's per-request seq_lens input,
         block_multi_head_attention_kernel.cu). Logits are gathered at
         each sequence's own last real position; pad-position KV is
         causal-dead and later overwritten by decode writes."""
         st = self.model.stack
-        x = embed[ids]
+        x = embed[ids].astype(self._cdtype)
         h, cache = st.prefill_raw(
             weights, x, PagedKV(cache_k, cache_v), tables,
             self._cos, self._sin)
         hl = h[jnp.arange(h.shape[0]), seq_lens - 1]
-        logits = FusedMultiTransformer._ln(
-            hl, lnf_s, lnf_b, st.epsilon) @ embed.T
+        logits = self._logits(hl, head_t, lnf_s, lnf_b)
         return logits, cache.k, cache.v
 
     @staticmethod
@@ -156,8 +181,8 @@ class GenerationEngine:
         return jax.random.categorical(key, logits, axis=-1) \
             .astype(jnp.int32)
 
-    def _decode_k_fn(self, weights, embed, lnf_s, lnf_b, tok, seq_lens,
-                     cache_k, cache_v, tables, key=None,
+    def _decode_k_fn(self, weights, embed, head_t, lnf_s, lnf_b, tok,
+                     seq_lens, cache_k, cache_v, tables, key=None,
                      sample_params=None, *, k, sample_cfg=None):
         """K decode steps as ONE XLA program: the picked token feeds back
         into the next step inside lax.scan, so the host syncs once per
@@ -177,12 +202,11 @@ class GenerationEngine:
 
         def step(carry, i):
             tok, lens, ck, cv = carry
-            x = embed[tok]
+            x = embed[tok].astype(self._cdtype)
             h, cache = st.decode_raw(
                 weights, x, PagedKV(ck, cv), tables, lens,
                 self._cos, self._sin)
-            logits = FusedMultiTransformer._ln(
-                h, lnf_s, lnf_b, st.epsilon) @ embed.T
+            logits = self._logits(h, head_t, lnf_s, lnf_b)
             nxt = self._pick_token(logits, jax.random.fold_in(key, i),
                                    cfg)
             return (nxt, lens + 1, cache.k, cache.v), nxt
@@ -259,7 +283,7 @@ class GenerationEngine:
         self._mgr = BlockKVCacheManager(
             st.num_layers, st.num_kv_heads, st.head_dim, self.page_size,
             num_pages=(self._num_pages or b * pages_per_seq) + 1,
-            reserve_scratch=True)
+            dtype=self._kv_dtype, reserve_scratch=True)
         for i in range(b):
             self._mgr.allocate(i, int(lens[i]))
         tables = self._mgr.block_tables(range(b), pages_per_seq)
@@ -271,7 +295,7 @@ class GenerationEngine:
                         self.model.lnf_bias._data)
 
         logits, ck, cv = self._prefill(
-            weights, embed, lnf_s, lnf_b, jnp.asarray(ids),
+            weights, embed, self._head_t, lnf_s, lnf_b, jnp.asarray(ids),
             jnp.asarray(lens), cache.k, cache.v, tables)
 
         from ..core.generator import next_rng_key
@@ -310,7 +334,7 @@ class GenerationEngine:
             tables = self._grow_tables(range(b), lens + emitted, k,
                                        pages_per_seq)
             toks, ck, cv = self._get_decode_k(k, static_cfg)(
-                weights, embed, lnf_s, lnf_b,
+                weights, embed, self._head_t, lnf_s, lnf_b,
                 jnp.asarray(out[np.arange(b), cur].astype(np.int32)),
                 jnp.asarray(cur, dtype=jnp.int32), ck, cv, tables,
                 next_rng_key() if do_sample else None, params)
@@ -374,7 +398,7 @@ class ContinuousBatchingEngine:
     def __init__(self, model: FusedCausalLM, max_batch: int = 4,
                  page_size: int = 16, max_length: int = 1024,
                  num_pages: Optional[int] = None, decode_chunk: int = 8,
-                 prompt_bucket: int = 16):
+                 prompt_bucket: int = 16, kv_dtype=None):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_length = int(max_length)
@@ -383,24 +407,22 @@ class ContinuousBatchingEngine:
         self.prompt_bucket = max(int(prompt_bucket), 1)
         st = model.stack
         self._pages_per_seq = -(-self.max_length // self.page_size)
-        self._mgr = BlockKVCacheManager(
-            st.num_layers, st.num_kv_heads, st.head_dim, self.page_size,
-            num_pages=(num_pages
-                       or self.max_batch * self._pages_per_seq) + 1,
-            reserve_scratch=True)
-        cache = self._mgr.fresh_cache()
-        self._ck, self._cv = cache.k, cache.v
-        self._cos, self._sin = rope_table(st.max_position, st.head_dim,
-                                          st.rope_theta)
         self._gen = GenerationEngine.__new__(GenerationEngine)  # share
         self._gen.model = model
         self._gen.max_length = self.max_length
         self._gen.page_size = self.page_size
         self._gen.decode_chunk = self.decode_chunk
+        self._gen._init_serving_state(kv_dtype)
+        self._mgr = BlockKVCacheManager(
+            st.num_layers, st.num_kv_heads, st.head_dim, self.page_size,
+            num_pages=(num_pages
+                       or self.max_batch * self._pages_per_seq) + 1,
+            dtype=self._gen._kv_dtype, reserve_scratch=True)
+        cache = self._mgr.fresh_cache()
+        self._ck, self._cv = cache.k, cache.v
+        self._cos, self._sin = rope_table(st.max_position, st.head_dim,
+                                          st.rope_theta)
         self._gen._cos, self._gen._sin = self._cos, self._sin
-        self._gen._prefill = jax.jit(self._gen._prefill_fn,
-                                     donate_argnums=(6, 7))
-        self._gen._decode_k_jit = {}
         self._gen._mgr = self._mgr
 
         self.waiting: list = []
@@ -453,7 +475,8 @@ class ContinuousBatchingEngine:
         cur = np.where([r is not None for r in self._slots],
                        self._lens - 1, 0).astype(np.int64)
         toks, self._ck, self._cv = self._gen._get_decode_k(k)(
-            weights, m.embed._data, m.lnf_scale._data, m.lnf_bias._data,
+            weights, m.embed._data, self._gen._head_t,
+            m.lnf_scale._data, m.lnf_bias._data,
             jnp.asarray(self._last_tok, jnp.int32),
             jnp.asarray(cur, jnp.int32),
             self._ck, self._cv, tables)
@@ -517,8 +540,8 @@ class ContinuousBatchingEngine:
             ids = np.zeros((1, s_pad), np.int32)
             ids[0, :L] = req.prompt
             logits, self._ck, self._cv = self._gen._prefill(
-                m.stack._stack(), m.embed._data, m.lnf_scale._data,
-                m.lnf_bias._data, jnp.asarray(ids),
+                m.stack._stack(), m.embed._data, self._gen._head_t,
+                m.lnf_scale._data, m.lnf_bias._data, jnp.asarray(ids),
                 jnp.asarray([L], jnp.int32), self._ck, self._cv, tables)
             t = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
             req.generated.append(t)

@@ -40,7 +40,9 @@ class BlockKVCacheManager:
         self._owned: dict = {}
 
     def fresh_cache(self) -> PagedKV:
-        shape = (self.num_layers, self.num_kv_heads, self.num_pages,
+        # layer-FOLDED pool (see PagedKV): layer l's logical page p is
+        # physical page l * num_pages + p — decode updates it in place
+        shape = (self.num_kv_heads, self.num_layers * self.num_pages,
                  self.page_size, self.head_dim)
         return PagedKV(jnp.zeros(shape, self.dtype),
                        jnp.zeros(shape, self.dtype))

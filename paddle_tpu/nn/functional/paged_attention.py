@@ -88,12 +88,26 @@ def _xla_paged(q, key_cache, value_cache, seq_lens, block_tables):
 def paged_attention(q, key_cache, value_cache, seq_lens, block_tables):
     """Single-token decode attention over a paged KV cache.
 
-    Raw-array functional op (used inside compiled decode steps). The Pallas
-    kernel's mosaic lowering requires the lane dim (head_dim) to be a
-    multiple of 128 (verified on v5e); other head dims take the XLA path,
-    which on TPU still compiles to a fused gather + masked attention.
+    Raw-array functional op (used inside compiled decode steps).
+
+    Backend selection (FLAGS_paged_attention_backend: auto|xla|pallas):
+    ``auto`` uses the XLA gather+masked-attention path on TPU. Measured
+    reason (r4, 1.3B decode): the stock Pallas kernel imposes the
+    default ``{3,2,1,0}`` layout on the cache operands while the
+    in-place page scatter prefers ``{3,0,2,1}``, so mixing them makes
+    XLA insert two full-pool layout copies per layer per token —
+    catastrophically slower than the gather it avoids. All-XLA keeps
+    one layout end-to-end. The Pallas kernel stays available for
+    layouts/configs where it wins (requires head_dim % 128 == 0).
     """
-    if _on_tpu() and q.shape[-1] % 128 == 0:
+    from ...core.flags import flag
+
+    backend = flag("paged_attention_backend")
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"FLAGS_paged_attention_backend={backend!r}: valid values "
+            "are 'auto', 'xla', 'pallas'")
+    if backend == "pallas":
         return _pallas_paged(q, key_cache, value_cache, seq_lens,
                              block_tables)
     return _xla_paged(q, key_cache, value_cache, seq_lens, block_tables)

@@ -347,6 +347,145 @@ def mode_weights_unrolled():
     return BATCH * CHUNK / sec
 
 
+def mode_loop_overhead():
+    """Pure lax.scan iteration cost: 64 steps of h+1 on [b, d]."""
+    import jax
+    import jax.numpy as jnp
+
+    def chunk(h):
+        def tok_step(carry, _):
+            return carry + 1.0, carry[0, 0]
+        h, outs = jax.lax.scan(tok_step, h, jnp.arange(CHUNK))
+        return outs
+
+    fn = jax.jit(chunk)
+    h = jnp.ones((BATCH, D), jnp.bfloat16)
+    sec = time_chunk(fn, (h,))
+    return BATCH * CHUNK / sec
+
+
+def mode_head_noloop():
+    """ONE head matmul+argmax per device program (no scan): per-
+    dispatch+compute latency through the tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    model = build()
+    w = jnp.array(model.embed._data.T).astype(jnp.bfloat16)
+
+    def one(w, h):
+        logits = jax.lax.dot_general(
+            h, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return jnp.argmax(logits, -1)
+
+    fn = jax.jit(one)
+    h = jnp.ones((BATCH, D), jnp.bfloat16)
+    out = fn(w, h)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(CHUNK):
+        out = fn(w, out.sum() * jnp.zeros((BATCH, D), jnp.bfloat16)
+                 + h)
+    _ = np.asarray(out)[:1]
+    sec = time.perf_counter() - t0
+    return BATCH * CHUNK / sec
+
+
+def mode_head_indep():
+    """64-scan of the head matmul with NO loop-carried dependence on the
+    matmul input (tests cross-iteration pipelining/prefetch)."""
+    import jax
+    import jax.numpy as jnp
+
+    model = build()
+    w = jnp.array(model.embed._data.T).astype(jnp.bfloat16)
+
+    def chunk(w, h):
+        def tok_step(acc, _):
+            logits = jax.lax.dot_general(
+                h, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return acc + jnp.argmax(logits, -1).sum(), acc
+        acc, outs = jax.lax.scan(tok_step, jnp.int32(0),
+                                 jnp.arange(CHUNK))
+        return acc
+
+    fn = jax.jit(chunk)
+    h = jnp.ones((BATCH, D), jnp.bfloat16)
+    sec = time_chunk(fn, (w, h))
+    return BATCH * CHUNK / sec
+
+
+def mode_head_unroll():
+    """16 sequential head matmul+argmax steps UNROLLED in one jit (no
+    while loop): is lax.scan itself the bottleneck?"""
+    import jax
+    import jax.numpy as jnp
+
+    model = build()
+    w = jnp.array(model.embed._data.T).astype(jnp.bfloat16)
+    k = 16
+
+    def prog(w, h):
+        toks = []
+        for _ in range(k):
+            logits = jax.lax.dot_general(
+                h, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            tok = jnp.argmax(logits, -1)
+            toks.append(tok)
+            h = h + (1e-6 * tok[:, None]).astype(h.dtype)
+        return jnp.stack(toks)
+
+    fn = jax.jit(prog)
+    h = jnp.ones((BATCH, D), jnp.bfloat16)
+    sec = time_chunk(fn, (w, h))
+    return BATCH * k / sec
+
+
+def mode_weights_int8():
+    """Weight streaming with int8 weights dequantized in-body (bytes
+    halve vs bf16; if bandwidth-bound, time should halve)."""
+    import jax
+    import jax.numpy as jnp
+
+    model = build()
+    w = model.stack._stack()
+    q = {k: (jnp.round(v * 127).astype(jnp.int8) if v.ndim == 3
+             else v) for k, v in w.items()}
+
+    def chunk(weights, x):
+        def tok_step(carry, _):
+            h = carry
+
+            def body(h, wl):
+                hn = ((h - jnp.mean(h, -1, keepdims=True))
+                      * wl["ln1_scale"]).astype(h.dtype)
+                qkv = hn @ (wl["qkv_weight"].astype(jnp.bfloat16)
+                            * (1.0 / 127))
+                att = qkv[:, :D]
+                h = (h + att @ (wl["out_weight"].astype(jnp.bfloat16)
+                                * (1.0 / 127)) + wl["out_bias"]) \
+                    .astype(h.dtype)
+                ff = jax.nn.gelu(
+                    h @ (wl["ffn1_weight"].astype(jnp.bfloat16)
+                         * (1.0 / 127)) + wl["ffn1_bias"])
+                h = (h + ff @ (wl["ffn2_weight"].astype(jnp.bfloat16)
+                               * (1.0 / 127)) + wl["ffn2_bias"]) \
+                    .astype(h.dtype)
+                return h, None
+            h, _ = jax.lax.scan(body, h, weights)
+            return h, h[:, 0]
+        h, outs = jax.lax.scan(tok_step, x, jnp.arange(CHUNK))
+        return outs
+
+    fn = jax.jit(chunk)
+    x = jnp.ones((BATCH, D), jnp.bfloat16)
+    sec = time_chunk(fn, (q, x))
+    return BATCH * CHUNK / sec
+
+
 def mode_pallas_page(page, dtype="bfloat16"):
     """Pallas paged attention with a different page size (DMA width)."""
     global PAGE
@@ -384,6 +523,11 @@ MODES = {
         lambda: mode_head_variant("bf16_prefer_noargmax"),
     "argmax_only": mode_argmax_only,
     "weights_unrolled": mode_weights_unrolled,
+    "loop_overhead": mode_loop_overhead,
+    "head_noloop": mode_head_noloop,
+    "head_indep": mode_head_indep,
+    "head_unroll": mode_head_unroll,
+    "weights_int8": mode_weights_int8,
 }
 
 
