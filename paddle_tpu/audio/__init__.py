@@ -7,7 +7,11 @@ functional.py hz_to_mel/mel_frequencies/compute_fbank_matrix). The STFT
 rides the framework's fft ops; feature layers are nn.Layers so they
 compose into models.
 """
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
 from . import features  # noqa: F401
 from . import functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 
-__all__ = ["features", "functional"]
+__all__ = ["features", "functional", "backends", "datasets",
+           "info", "load", "save"]

@@ -1,0 +1,126 @@
+"""Audio I/O backends — PCM16 WAV over the stdlib ``wave`` module.
+
+TPU-native equivalent of the reference's audio backend layer (reference:
+python/paddle/audio/backends/{backend.py,init_backend.py,wave_backend.py}
+— an info/load/save trio with a pluggable backend registry whose built-in
+implementation is the stdlib wave reader). Zero-egress build: the only
+built-in backend is ``wave``; ``set_backend`` of anything else raises
+with guidance (the reference downloads paddleaudio for soundfile).
+"""
+from __future__ import annotations
+
+import wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save",
+           "list_available_backends", "get_current_backend", "set_backend"]
+
+
+class AudioInfo:
+    """Signal metadata (reference backends/backend.py:21)."""
+
+    def __init__(self, sample_rate: int, num_samples: int,
+                 num_channels: int, bits_per_sample: int, encoding: str):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample}, "
+                f"encoding='{self.encoding}')")
+
+
+def info(filepath: str) -> AudioInfo:
+    """Metadata of a PCM16 WAV file (reference wave_backend.py:37)."""
+    with wave.open(str(filepath), "rb") as f:
+        return AudioInfo(
+            sample_rate=f.getframerate(), num_samples=f.getnframes(),
+            num_channels=f.getnchannels(),
+            bits_per_sample=f.getsampwidth() * 8, encoding="PCM_S")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple["paddle_tpu.Tensor", int]:
+    """Load a PCM16 WAV file (reference wave_backend.py:89).
+
+    Returns (waveform Tensor [channels, time] — or int16 un-normalized
+    when ``normalize=False`` — and the sample rate).
+    """
+    from ..core.tensor import Tensor
+
+    with wave.open(str(filepath), "rb") as f:
+        sr, nch, width = f.getframerate(), f.getnchannels(), f.getsampwidth()
+        if width != 2:
+            raise RuntimeError(
+                "only PCM16 WAV is supported by the built-in `wave` "
+                "backend (got sample width "
+                f"{width * 8} bits); convert the file or extend via a "
+                "custom backend")
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, dtype="<i2").reshape(-1, nch)
+    if normalize:
+        data = (data / 32768.0).astype(np.float32)
+    wavef = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(wavef)), sr
+
+
+def save(filepath: str, src, sample_rate: int,
+         channels_first: bool = True, bits_per_sample: int = 16) -> None:
+    """Save a waveform as PCM16 WAV (reference wave_backend.py:168).
+
+    ``src``: Tensor/ndarray [channels, time] (or [time, channels] when
+    ``channels_first=False``); float inputs are assumed in [-1, 1].
+    """
+    from ..core.tensor import Tensor
+
+    if bits_per_sample != 16:
+        raise RuntimeError("the built-in `wave` backend writes PCM16 "
+                           f"only (got bits_per_sample={bits_per_sample})")
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    if not channels_first:
+        arr = arr.T
+    if np.issubdtype(arr.dtype, np.floating):
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype("<i2")
+    else:
+        arr = arr.astype("<i2")
+    with wave.open(str(filepath), "wb") as f:
+        f.setnchannels(arr.shape[0])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr.T).tobytes())
+
+
+_BACKEND = "wave"
+
+
+def list_available_backends():
+    """(reference init_backend.py:37) Only the stdlib backend ships in
+    the zero-egress build."""
+    return ["wave"]
+
+
+def get_current_backend() -> str:
+    return _BACKEND
+
+
+def set_backend(backend_name: str) -> None:
+    """(reference init_backend.py:139)"""
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"audio backend '{backend_name}' is not available in this "
+            "zero-egress build; available: "
+            f"{list_available_backends()} (the reference installs "
+            "paddleaudio for 'soundfile')")

@@ -13,7 +13,10 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+from .folder import DatasetFolder, ImageFolder  # noqa: F401
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder", "ImageFolder"]
 
 
 class MNIST(Dataset):

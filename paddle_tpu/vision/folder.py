@@ -1,0 +1,163 @@
+"""Directory-tree image datasets: DatasetFolder / ImageFolder.
+
+TPU-native equivalent of the reference's folder datasets (reference:
+python/paddle/vision/datasets/folder.py — DatasetFolder:66 walks
+``root/class_x/xxx.ext`` into (path, class) samples; ImageFolder:310
+walks a flat/nested tree into unlabeled samples). Loader default is PIL
+(cv2 optional in the reference; absent here), and ``.npy`` arrays load
+without PIL — the synthetic-data path used throughout the zero-egress
+test suite.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "has_valid_extension",
+           "make_dataset", "default_loader", "pil_loader", "IMG_EXTENSIONS"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp", ".npy")
+
+
+def has_valid_extension(filename: str, extensions) -> bool:
+    """(reference folder.py:26) case-insensitive suffix check."""
+    return filename.lower().endswith(tuple(extensions))
+
+
+def pil_loader(path: str):
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
+
+
+def npy_loader(path: str):
+    return np.load(path)
+
+
+def default_loader(path: str):
+    """(reference folder.py:301) .npy → numpy; images → PIL RGB."""
+    if path.lower().endswith(".npy"):
+        return npy_loader(path)
+    return pil_loader(path)
+
+
+def make_dataset(directory: str, class_to_idx, extensions,
+                 is_valid_file: Optional[Callable] = None):
+    """(reference folder.py:43) expand ``root/class_x/**/*.ext`` into
+    [(path, class_idx)] — nested subdirectories included."""
+    samples = []
+    directory = os.path.expanduser(directory)
+    if (extensions is None) == (is_valid_file is None):
+        raise ValueError(
+            "exactly one of extensions / is_valid_file must be given")
+    if is_valid_file is None:
+        def is_valid_file(p):
+            return has_valid_extension(p, extensions)
+    for target in sorted(class_to_idx):
+        d = os.path.join(directory, target)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[target]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """Labeled tree dataset: ``root/class_name/*.ext`` → (sample,
+    class_idx) (reference folder.py:66).
+
+    Attributes match the reference: ``classes`` (sorted class names),
+    ``class_to_idx``, ``samples`` [(path, idx)], ``targets``.
+    """
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=None, transform=None, target_transform=None,
+                 is_valid_file: Optional[Callable] = None):
+        super().__init__()
+        self.root = root
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes, class_to_idx = self._find_classes(root)
+        samples = make_dataset(root, class_to_idx, extensions,
+                               is_valid_file)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\n"
+                f"Supported extensions are: {extensions}")
+        self.loader = loader or default_loader
+        self.extensions = extensions
+        self.classes = classes
+        self.class_to_idx = class_to_idx
+        self.samples = samples
+        self.targets = [s[1] for s in samples]
+        self.transform = transform
+        self.target_transform = target_transform
+
+    def _find_classes(self, dir: str):
+        """(reference folder.py:241) immediate subdirs = classes."""
+        classes = sorted(e.name for e in os.scandir(dir) if e.is_dir())
+        if not classes:
+            raise RuntimeError(f"no class folders found in {dir}")
+        return classes, {c: i for i, c in enumerate(classes)}
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        if self.target_transform is not None:
+            target = self.target_transform(target)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Unlabeled tree dataset: every valid file under ``root``
+    (reference folder.py:310). ``__getitem__`` returns ``[sample]``."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=None, transform=None,
+                 is_valid_file: Optional[Callable] = None):
+        super().__init__()
+        self.root = root
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if is_valid_file is None:
+            exts = extensions
+
+            def is_valid_file(p):
+                return has_valid_extension(p, exts)
+        samples: List[str] = []
+        for r, _, fnames in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(r, fname)
+                if is_valid_file(path):
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\n"
+                f"Supported extensions are: {extensions}")
+        self.loader = loader or default_loader
+        self.samples = samples
+        self.transform = transform
+
+    def __getitem__(self, index):
+        sample = self.loader(self.samples[index])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
