@@ -1,0 +1,80 @@
+"""Graph-break diagnostics for traced programs.
+
+TPU-native counterpart of the reference's dy2static error layer
+(reference: the SOT opcode executor falls back per-opcode,
+paddle/fluid/pybind/eval_frame.c:411; dy2static/error.py rewrites trace
+errors with user-frame context). This framework traces under jax.jit
+instead of rewriting bytecode, so a data-dependent Python branch
+surfaces as a JAX concretization error mid-trace; these helpers catch
+that and re-raise a framework-level GraphBreakError that names the
+traced function, pinpoints the user frame, and prescribes the fix
+(paddle.static.nn.cond/while_loop or an eager-only op's masked
+alternative).
+"""
+from __future__ import annotations
+
+import traceback
+
+import jax
+
+__all__ = ["GraphBreakError", "reraise_graph_break"]
+
+# ops documented eager-only (data-dependent output shapes —
+# ops/manipulation.py:6); named in the diagnostic when they appear in
+# the failing trace
+_EAGER_ONLY = ("nonzero", "masked_select", "unique")
+
+_CONCRETIZATION_ERRORS = (
+    jax.errors.TracerBoolConversionError,
+    jax.errors.ConcretizationTypeError,
+    jax.errors.NonConcreteBooleanIndexError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.TracerArrayConversionError,
+)
+
+
+class GraphBreakError(RuntimeError):
+    """Data-dependent Python control flow (or an eager-only op) inside a
+    traced program."""
+
+
+def _user_frame(exc) -> str:
+    """Best-effort: the deepest traceback frame outside jax/paddle_tpu
+    internals (the user's `if tensor:` line)."""
+    frames = traceback.extract_tb(exc.__traceback__)
+    for fr in reversed(frames):
+        f = fr.filename
+        if "/jax/" not in f and "/paddle_tpu/" not in f:
+            return f"{fr.filename}:{fr.lineno} ({fr.line})"
+    return "<unknown frame>"
+
+
+def reraise_graph_break(fn_name: str, exc: BaseException):
+    """If ``exc`` is a JAX concretization error, raise the framework
+    GraphBreakError naming the offender and the fix; otherwise return
+    False so the caller re-raises the original."""
+    if not isinstance(exc, _CONCRETIZATION_ERRORS):
+        return False
+    msg = str(exc)
+    culprit = _user_frame(exc)
+    hints = [
+        f"graph break while tracing `{fn_name}`: the Python code makes "
+        f"a data-dependent decision on a traced Tensor at {culprit}.",
+        "Under @to_static / jit.TrainStep / jit.save the function is "
+        "traced ONCE with abstract values, so `if tensor:`, "
+        "`while tensor:`, `int(tensor)` or `tensor.numpy()` cannot "
+        "run (SURVEY §7.0: no data-dependent Python control flow "
+        "under jit).",
+        "Fixes: use paddle.static.nn.cond(pred, true_fn, false_fn) / "
+        "paddle.static.nn.while_loop for control flow; "
+        "paddle.where/masking for data-dependent selection; or "
+        "move the branch out of the traced function.",
+    ]
+    eager_ops = [op for op in _EAGER_ONLY if op in msg]
+    if eager_ops:
+        hints.append(
+            f"Note: `{eager_ops[0]}` has a data-dependent output shape "
+            "and is EAGER-ONLY (ops/manipulation.py); inside traced "
+            "code use where/masking with a static bound instead.")
+    hints.append(f"--- original JAX error ---\n{msg.splitlines()[0]}")
+    raise GraphBreakError("\n".join(hints)) from exc

@@ -148,7 +148,14 @@ class _Program:
         a_arrays = [t._data for t in arg_tensors]
         key = default_generator().next_key()
 
-        out_arrays, new_buffers = self._fwd(p_arrays, b_arrays, a_arrays, key)
+        try:
+            out_arrays, new_buffers = self._fwd(p_arrays, b_arrays,
+                                                a_arrays, key)
+        except Exception as e:  # graph-break diagnostics (VERDICT r3 #7)
+            from .graph_break import reraise_graph_break
+
+            if not reraise_graph_break(sf._name, e):
+                raise
         for b, nb in zip(sf._buffers, new_buffers):
             if nb is not b._data:
                 b._rebind(nb)

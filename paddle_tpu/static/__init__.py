@@ -5,6 +5,7 @@ XLA programs here (SURVEY.md §7.0); InputSpec is the shared signature type.
 Static-graph user APIs are provided for compat where they have a natural
 traced equivalent.
 """
+from . import nn  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
 from .program import (  # noqa: F401
     CompiledProgram, Executor, Program, data, default_main_program,
@@ -13,6 +14,7 @@ from .program import (  # noqa: F401
 )
 
 __all__ = [
+    "nn",
     "InputSpec", "Program", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "scope_guard",
     "save_inference_model", "load_inference_model", "CompiledProgram",

@@ -1,0 +1,183 @@
+"""Data-dependent control flow: ``paddle.static.nn.cond`` /
+``while_loop`` / ``case`` / ``switch_case``.
+
+TPU-native equivalent of the reference's static control-flow ops
+(reference: python/paddle/static/nn/control_flow.py — while_loop:629,
+cond:1126, case/switch_case below them; backed by the
+conditional_block/while C++ ops). Here the two execution modes map
+naturally:
+
+- **eager**: the predicate is a concrete array — evaluate it and run the
+  chosen branch as ordinary eager ops. The autograd tape records the
+  executed branch (and each executed loop iteration), so gradients flow
+  with no special casing — the same property the reference gets from
+  dygraph's Python `if`.
+- **traced** (inside ``to_static`` / ``TrainStep`` / ``jit.save``): the
+  predicate is a tracer — lower to ``jax.lax.cond`` /
+  ``jax.lax.while_loop``, the compiler-friendly forms XLA requires
+  (SURVEY §7.0: no data-dependent Python control flow under jit).
+  Reverse-mode through a traced ``while_loop`` is undefined in XLA;
+  differentiate a bounded loop via ``lax.scan``-style rewrites or run
+  the loop eagerly (documented limitation; the reference's while op has
+  the analogous grad-block restriction).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _is_tracing(*tensors) -> bool:
+    return any(isinstance(t._data, jax.core.Tracer) for t in tensors
+               if isinstance(t, Tensor))
+
+
+def _flatten(out):
+    """Flatten a branch output pytree into (template, [arrays])."""
+    from ..jit.static_function import _flatten_tensors
+
+    tensors: List[Tensor] = []
+    tmpl = _flatten_tensors(out, tensors)
+    return tmpl, [t._data for t in tensors]
+
+
+def _unflatten(tmpl, arrays):
+    from ..jit.static_function import _unflatten_tensors
+
+    return _unflatten_tensors(tmpl, [Tensor(a) for a in arrays])
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None,
+         return_names=None):
+    """Run ``true_fn()`` when pred else ``false_fn()`` (reference
+    control_flow.py:1126). Both branches must return the same
+    structure/shapes/dtypes (checked when traced, as the reference's
+    static cond requires)."""
+    pred = pred if isinstance(pred, Tensor) else Tensor(jnp.asarray(pred))
+    if not _is_tracing(pred):
+        return true_fn() if bool(pred._data) else false_fn()
+
+    tmpl_box = {}
+
+    def _branch(fn, key):
+        def wrapped(_):
+            out = fn()
+            tmpl, arrays = _flatten(out)
+            tmpl_box[key] = (tmpl, [(a.shape, a.dtype) for a in arrays])
+            return tuple(arrays)
+        return wrapped
+
+    true_w, false_w = _branch(true_fn, "t"), _branch(false_fn, "f")
+    # trace both eagerly first so structure mismatches raise a
+    # framework error (not a raw jax one)
+    out_t = jax.eval_shape(true_w, ())
+    out_f = jax.eval_shape(false_w, ())
+    sig_t = [(o.shape, o.dtype) for o in out_t]
+    sig_f = [(o.shape, o.dtype) for o in out_f]
+    if sig_t != sig_f or repr(tmpl_box["t"][0]) != repr(tmpl_box["f"][0]):
+        raise ValueError(
+            "paddle.static.nn.cond: true_fn and false_fn must return "
+            f"the same structure/shapes/dtypes; got {sig_t} vs {sig_f} "
+            "(reference control_flow.py:1126 check_output_structure)")
+    arrays = jax.lax.cond(pred._data.astype(bool).reshape(()),
+                          true_w, false_w, ())
+    return _unflatten(tmpl_box["t"][0], list(arrays))
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars,
+               is_test=False, name=None):
+    """``while cond_fn(*vars): vars = body_fn(*vars)`` (reference
+    control_flow.py:629). loop_vars is a list/tuple; body must return
+    matching shapes/dtypes. Eager mode supports gradients through the
+    unrolled tape; traced mode lowers to ``jax.lax.while_loop``."""
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("loop_vars must be a non-empty list/tuple")
+    loop_vars = list(loop_vars)
+    tensors = [v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+               for v in loop_vars]
+
+    def _pred(vars_now):
+        out = cond_fn(*vars_now)
+        return bool(out._data if isinstance(out, Tensor) else out)
+
+    if not _is_tracing(*tensors):
+        # eager: Python loop; the tape sees every executed op
+        vars_now = tensors
+        while _pred(vars_now):
+            out = body_fn(*vars_now)
+            out = out if isinstance(out, (list, tuple)) else (out,)
+            if len(out) != len(vars_now):
+                raise ValueError(
+                    "body_fn must return as many values as loop_vars "
+                    f"({len(vars_now)}), got {len(out)}")
+            vars_now = [v if isinstance(v, Tensor)
+                        else Tensor(jnp.asarray(v)) for v in out]
+        return vars_now
+
+    def cond_w(arrays):
+        out = cond_fn(*[Tensor(a) for a in arrays])
+        arr = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+        return arr.astype(bool).reshape(())
+
+    def body_w(arrays):
+        out = body_fn(*[Tensor(a) for a in arrays])
+        out = out if isinstance(out, (list, tuple)) else (out,)
+        new = [o._data if isinstance(o, Tensor) else jnp.asarray(o)
+               for o in out]
+        if len(new) != len(arrays):
+            raise ValueError(
+                "body_fn must return as many values as loop_vars "
+                f"({len(arrays)}), got {len(new)}")
+        return tuple(a.astype(old.dtype) if a.dtype != old.dtype else a
+                     for a, old in zip(new, arrays))
+
+    arrays = jax.lax.while_loop(cond_w, body_w,
+                                tuple(t._data for t in tensors))
+    return [Tensor(a) for a in arrays]
+
+
+def case(pred_fn_pairs: Sequence[Tuple], default: Callable = None,
+         name=None):
+    """First-match-wins branch chain (reference control_flow.py case):
+    nested ``cond`` over (pred, fn) pairs."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+
+    def build(pairs):
+        (pred, fn), rest = pairs[0], pairs[1:]
+        if not rest:
+            if default is None:
+                return fn()
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(rest))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name=None):
+    """Integer-indexed dispatch (reference control_flow.py
+    switch_case). branch_fns: dict {int: fn} or list of (int, fn)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = sorted((int(i), f) for i, f in branch_fns)
+    idx = branch_index if isinstance(branch_index, Tensor) \
+        else Tensor(jnp.asarray(branch_index))
+
+    def build(pairs):
+        (k, fn), rest = pairs[0], pairs[1:]
+        pred = Tensor((idx._data == k).reshape(()))
+        if not rest:
+            if default is None:
+                return fn()
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(rest))
+
+    return build(items)

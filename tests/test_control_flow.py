@@ -1,0 +1,209 @@
+"""static.nn control flow + graph-break diagnostics (VERDICT r3 #7).
+
+Reference: python/paddle/static/nn/control_flow.py (while_loop:629,
+cond:1126); the SOT graph-break layer (eval_frame.c:411) maps here to
+framework-level GraphBreakError diagnostics from trace failures.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static.nn import case, cond, switch_case, while_loop
+
+
+class TestCondEager:
+    def test_branch_selection(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        out = cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [6.0])
+        out = cond(x.sum() < 0, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [2.0])
+
+    def test_grad_through_taken_branch(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        out = cond(x.sum() > 0, lambda: x * 3, lambda: x * 5)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+    def test_nested_structure_output(self):
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        out = cond(x.sum() > 0,
+                   lambda: {"a": x * 2, "b": (x + 1, x - 1)},
+                   lambda: {"a": x, "b": (x, x)})
+        np.testing.assert_allclose(out["a"].numpy(), [2.0, 2.0])
+        np.testing.assert_allclose(out["b"][0].numpy(), [2.0, 2.0])
+
+
+class TestCondTraced:
+    def test_cond_in_to_static(self):
+        x = paddle.to_tensor(np.array([4.0], np.float32))
+
+        @paddle.jit.to_static
+        def f(x):
+            return cond(x.sum() > 3, lambda: x * 10, lambda: x)
+
+        np.testing.assert_allclose(f(x).numpy(), [40.0])
+        # same compiled program, other branch at runtime
+        y = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(f(y).numpy(), [1.0])
+
+    def test_cond_grad_in_train_step(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                return cond(h.sum() > 0, lambda: h * 2, lambda: h * 0.5)
+
+        model = Net()
+        opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+        step = paddle.jit.TrainStep(
+            model, lambda o, y: ((o - y) ** 2).mean(), opt)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 4).astype(np.float32))
+        y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        l0 = float(step([x], [y]).numpy())
+        for _ in range(5):
+            loss = step([x], [y])
+        assert float(loss.numpy()) < l0
+
+    def test_branch_structure_mismatch_raises(self):
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+
+        @paddle.jit.to_static
+        def f(x):
+            return cond(x.sum() > 0, lambda: (x, x), lambda: x)
+
+        with pytest.raises(ValueError, match="same structure"):
+            f(x)
+
+
+class TestWhileLoop:
+    def test_eager_loop_and_gradient(self):
+        """Gradient flows through the unrolled eager tape: y = x*2^3."""
+        x = paddle.to_tensor(np.array([1.5], np.float32),
+                             stop_gradient=False)
+        i = paddle.to_tensor(np.array(0, np.int64))
+        iv, yv = while_loop(lambda i, y: i < 3,
+                            lambda i, y: [i + 1, y * 2.0], [i, x])
+        np.testing.assert_allclose(yv.numpy(), [12.0])
+        yv.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_traced_while_loop(self):
+        @paddle.jit.to_static
+        def f(x):
+            i = paddle.to_tensor(np.array(0, np.int64))
+            _, out = while_loop(lambda i, y: i < 4,
+                                lambda i, y: [i + 1, y + y], [i, x])
+            return out
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(f(x).numpy(), [16.0])
+
+    def test_data_dependent_trip_count_traced(self):
+        """The loop bound is a runtime VALUE — one compiled program
+        serves different trip counts (the reason while_loop exists)."""
+        @paddle.jit.to_static
+        def countdown(n):
+            i = paddle.to_tensor(np.array(0, np.int64))
+            _, c = while_loop(
+                lambda i, c: i < n.astype("int64").sum(),
+                lambda i, c: [i + 1, c + 2.0],
+                [i, paddle.to_tensor(np.array(0.0, np.float32))])
+            return c
+
+        a = float(countdown(paddle.to_tensor(
+            np.array(3, np.int64))).numpy())
+        b = float(countdown(paddle.to_tensor(
+            np.array(5, np.int64))).numpy())
+        assert (a, b) == (6.0, 10.0)
+
+    def test_bad_body_arity_raises(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        i = paddle.to_tensor(np.array(0, np.int64))
+        with pytest.raises(ValueError, match="as many values"):
+            while_loop(lambda i, y: i < 2, lambda i, y: [i + 1], [i, x])
+
+
+class TestCaseSwitch:
+    def test_case_first_match(self):
+        x = paddle.to_tensor(np.array(2.0, np.float32))
+        out = case([(x > 3, lambda: x * 10), (x > 1, lambda: x * 100)],
+                   default=lambda: x)
+        np.testing.assert_allclose(out.numpy(), 200.0)
+
+    def test_switch_case(self):
+        idx = paddle.to_tensor(np.array(1, np.int64))
+        out = switch_case(idx, {0: lambda: paddle.to_tensor(0.0),
+                                1: lambda: paddle.to_tensor(10.0)},
+                          default=lambda: paddle.to_tensor(-1.0))
+        np.testing.assert_allclose(out.numpy(), 10.0)
+
+
+class TestGraphBreakDiagnostics:
+    def test_python_if_on_tensor_in_to_static(self):
+        from paddle_tpu.jit.graph_break import GraphBreakError
+
+        @paddle.jit.to_static
+        def f(x):
+            if (x.sum() > 0):  # data-dependent Python branch
+                return x * 2
+            return x
+
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with pytest.raises(GraphBreakError,
+                           match="static.nn.cond") as ei:
+            f(x)
+        assert "graph break while tracing `f`" in str(ei.value)
+
+    def test_train_step_graph_break_names_model(self):
+        from paddle_tpu.jit.graph_break import GraphBreakError
+
+        class BadNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(2, 2)
+
+            def forward(self, x):
+                h = self.fc(x)
+                while h.sum() > 100:  # Python while on a tracer
+                    h = h * 0.5
+                return h
+
+        model = BadNet()
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        step = paddle.jit.TrainStep(
+            model, lambda o, y: ((o - y) ** 2).mean(), opt)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        y = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with pytest.raises(GraphBreakError, match="BadNet"):
+            step([x], [y])
+
+    def test_eager_only_op_named_in_diagnostic(self):
+        from paddle_tpu.jit.graph_break import GraphBreakError
+
+        @paddle.jit.to_static
+        def f(x):
+            nz = paddle.nonzero(x)  # data-dependent shape: eager-only
+            return nz.sum()
+
+        x = paddle.to_tensor(np.array([1.0, 0.0, 2.0], np.float32))
+        with pytest.raises((GraphBreakError, RuntimeError)):
+            f(x)
+
+    def test_unrelated_errors_pass_through(self):
+        @paddle.jit.to_static
+        def f(x):
+            return x.reshape([7, 7])  # genuine shape error
+
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        from paddle_tpu.jit.graph_break import GraphBreakError
+
+        with pytest.raises(Exception) as ei:
+            f(x)
+        assert not isinstance(ei.value, GraphBreakError)
