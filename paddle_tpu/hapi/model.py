@@ -45,7 +45,13 @@ class Model:
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
-        """(model.py prepare)"""
+        """(model.py prepare) In a launched multi-process run this also
+        wires data parallelism automatically — the reference's
+        DynamicGraphAdapter wraps the network in paddle.DataParallel
+        when ParallelEnv().nranks > 1 (reference hapi/model.py:1054);
+        here prepare() detects an initialized parallel env, wraps the
+        network (param broadcast + bucketed grad allreduce on the tape)
+        and fit() shards batches with DistributedBatchSampler."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -55,6 +61,12 @@ class Model:
         else:
             self._metrics = list(metrics)
         self._train_step = None
+        import paddle_tpu.distributed as dist
+
+        if (dist.is_initialized() and dist.get_world_size() > 1
+                and not isinstance(self.network, dist.DataParallel)):
+            self.network = dist.DataParallel(self.network)
+            self._distributed = True
         if isinstance(amp_configs, (str, dict)):
             level = amp_configs if isinstance(amp_configs, str) \
                 else amp_configs.get("level", "O1")
@@ -69,17 +81,40 @@ class Model:
             if self._optimizer is None or self._loss is None:
                 raise RuntimeError("call Model.prepare(optimizer, loss) "
                                    "before fit()")
-            from ..jit.train_step import TrainStep
+            if getattr(self, "_distributed", False):
+                # DP runs on the eager tape: the DataParallel backward-
+                # final hook performs the bucketed grad allreduce (the
+                # reference dygraph adapter's reducer path)
+                def eager_step(inputs, labels):
+                    out = self.network(*inputs)
+                    outs = out if isinstance(out, (list, tuple)) \
+                        else (out,)
+                    loss = self._loss(*outs, *labels)
+                    loss.backward()
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
+                    return loss
 
-            self._train_step = TrainStep(self.network, self._loss,
-                                         self._optimizer)
+                self._train_step = eager_step
+            else:
+                from ..jit.train_step import TrainStep
+
+                self._train_step = TrainStep(self.network, self._loss,
+                                             self._optimizer)
         return self._train_step
 
-    @staticmethod
-    def _loader(data, batch_size, shuffle, drop_last, num_workers):
+    def _loader(self, data, batch_size, shuffle, drop_last, num_workers):
         if data is None or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
+            if getattr(self, "_distributed", False):
+                from ..io import DistributedBatchSampler
+
+                bs = DistributedBatchSampler(
+                    data, batch_size=batch_size, shuffle=shuffle,
+                    drop_last=drop_last)
+                return DataLoader(data, batch_sampler=bs,
+                                  num_workers=num_workers)
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                               drop_last=drop_last, num_workers=num_workers)
         return data  # any iterable of batches
@@ -157,6 +192,11 @@ class Model:
         for epoch in range(epochs):
             if self.stop_training:
                 break
+            sampler = getattr(loader, "batch_sampler", None)
+            if sampler is not None and hasattr(sampler, "set_epoch"):
+                # distributed sampler reshuffles per epoch (the
+                # reference's fit calls set_epoch the same way)
+                sampler.set_epoch(epoch)
             cbks.on_epoch_begin(epoch)
             losses = []
             for step_i, batch in enumerate(loader):
