@@ -21,12 +21,17 @@ __all__ = list(getattr(_std_mp, "__all__", [])) + ["reductions"]
 
 
 def _reduce_tensor(t: Tensor):
-    # host round-trip: the only portable cross-process form under PJRT
-    return _rebuild_tensor, (np.asarray(t._data), t.stop_gradient)
+    # host round-trip: the only portable cross-process form under PJRT.
+    # The CLASS rides along: copyreg dispatch is also what copy.deepcopy
+    # consults, so reducing a Parameter to a plain Tensor would demote
+    # params in deepcopied Layers (e.g. TransformerEncoder's per-layer
+    # deepcopy) and break optimizers downstream.
+    return _rebuild_tensor, (type(t), np.asarray(t._data),
+                             t.stop_gradient)
 
 
-def _rebuild_tensor(arr, stop_gradient):
-    out = Tensor(arr)
+def _rebuild_tensor(cls, arr, stop_gradient):
+    out = cls(arr)
     out.stop_gradient = stop_gradient
     return out
 
