@@ -41,7 +41,9 @@ def _parse(argv):
         prog="paddle_tpu.distributed.launch",
         description="launch distributed training (launch/main.py:20 parity)")
     p.add_argument("--nnodes", type=int, default=1)
-    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--node_rank", type=int, default=None,
+                   help="this node's rank; omit for arrival-order "
+                        "auto-assignment by the built-in master")
     p.add_argument("--nproc_per_node", type=int, default=1)
     p.add_argument("--master", default=None,
                    help="host:port of the coordinator (rank-0 host)")
@@ -58,7 +60,8 @@ def _parse(argv):
 
 
 def _spawn(args, global_rank: int, local_rank: int, world: int,
-           master: str, endpoints: str) -> subprocess.Popen:
+           master: str, endpoints: str,
+           generation: int = 0) -> subprocess.Popen:
     env = dict(os.environ)
     addr, port = master.rsplit(":", 1)
     env.update({
@@ -71,6 +74,7 @@ def _spawn(args, global_rank: int, local_rank: int, world: int,
         "PADDLE_TRAINER_ENDPOINTS": endpoints,
         "PADDLE_CURRENT_ENDPOINT":
             endpoints.split(",")[global_rank],
+        "PADDLE_RESTART_GENERATION": str(generation),
     })
     if args.devices:
         env["CUDA_VISIBLE_DEVICES"] = args.devices  # compat no-op on TPU
@@ -85,13 +89,17 @@ def _spawn(args, global_rank: int, local_rank: int, world: int,
 
 
 def launch(argv: List[str] = None) -> int:
-    """(main.py:20) spawn per-rank workers, watch, propagate failure."""
+    """(main.py:20) spawn per-rank workers, watch, propagate failure.
+    Multi-node runs rendezvous through the built-in KV master
+    (controllers/master.py parity — see launch/master.py): pass the
+    SAME --master on every node, ranks auto-assign, heartbeats detect
+    dead nodes and drive elastic re-rendezvous."""
     args = _parse(argv if argv is not None else sys.argv[1:])
-    world = args.nnodes * args.nproc_per_node
+    if args.nnodes > 1:
+        return _launch_multinode(args)
+    world = args.nproc_per_node
+    node_rank = args.node_rank or 0
     if args.master is None:
-        if args.nnodes > 1:
-            raise SystemExit("--master host:port is required for "
-                             "multi-node launches")
         args.master = f"127.0.0.1:{_free_port()}"
     addr = args.master.rsplit(":", 1)[0]
     base_port = int(args.master.rsplit(":", 1)[1])
@@ -102,36 +110,14 @@ def launch(argv: List[str] = None) -> int:
     while True:
         procs = []
         for local_rank in range(args.nproc_per_node):
-            global_rank = args.node_rank * args.nproc_per_node + local_rank
+            global_rank = node_rank * args.nproc_per_node + local_rank
             procs.append(_spawn(args, global_rank, local_rank, world,
                                 args.master, endpoints))
 
         # watcher (controllers/watcher.py parity): poll until all exit or
         # one fails
-        rc = 0
         try:
-            while procs:
-                alive = []
-                for p in procs:
-                    r = p.poll()
-                    if r is None:
-                        alive.append(p)
-                    elif r != 0:
-                        rc = r
-                if rc != 0:
-                    for p in procs:
-                        if p.poll() is None:
-                            p.send_signal(signal.SIGTERM)
-                    deadline = time.time() + 10
-                    for p in procs:
-                        try:
-                            p.wait(max(0.1, deadline - time.time()))
-                        except subprocess.TimeoutExpired:
-                            p.kill()
-                    break
-                procs = alive
-                if procs:
-                    time.sleep(0.2)
+            rc = _watch(procs)
         except KeyboardInterrupt:
             for p in procs:
                 p.send_signal(signal.SIGTERM)
@@ -151,6 +137,135 @@ def launch(argv: List[str] = None) -> int:
         print(f"launch: worker exited rc={rc} "
               f"({'elastic restart requested' if elastic_requested else 'failure'}); "
               f"relaunch {restarts}/{args.max_restarts}", file=sys.stderr)
+
+
+def _watch(procs, on_tick=None):
+    """Poll workers until all exit (rc 0) or one fails; on failure kill
+    the rest. ``on_tick()`` may return a non-None rc to force teardown
+    (the dead-peer path). Returns the first non-zero rc (or 0)."""
+    rc = 0
+    while procs:
+        alive = []
+        for p in procs:
+            r = p.poll()
+            if r is None:
+                alive.append(p)
+            elif r != 0 and rc == 0:
+                rc = r
+        if rc == 0 and on_tick is not None:
+            forced = on_tick()
+            if forced is not None:
+                rc = forced
+        if rc != 0:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            deadline = time.time() + 10
+            for p in procs:
+                try:
+                    p.wait(max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            return rc
+        procs = alive
+        if procs:
+            time.sleep(0.2)
+    return 0
+
+
+DEAD_PEER_RC = 101  # reuse the elastic-restart contract code
+
+
+def _launch_multinode(args) -> int:
+    """Rendezvous via the built-in master, heartbeat, elastic failover
+    (reference: controllers/master.py + fleet elastic manager)."""
+    from .master import LaunchMaster
+
+    if args.master is None:
+        raise SystemExit(
+            "--master host:port is required for multi-node launches "
+            "(the SAME address on every node; whichever node can bind "
+            "it hosts the built-in KV master)")
+    master = LaunchMaster(args.master, args.nnodes)
+    generation = master.current_generation()
+    requested_rank = args.node_rank if args.node_rank is not None else -1
+    world = args.nnodes * args.nproc_per_node
+    restarts = 0
+
+    while True:
+        from .master import RanksClaimedError
+
+        deadline = time.time() + 180
+        while True:
+            try:
+                node_rank, peers = master.rendezvous(
+                    requested_rank, args.nproc_per_node, generation)
+                break
+            except RanksClaimedError:
+                # late joiner (restarted node): the running epoch is
+                # full — wait for the survivors to notice the failure
+                # and bump, then join the fresh generation
+                if time.time() > deadline:
+                    raise
+                time.sleep(2.0)
+                generation = max(generation,
+                                 master.current_generation())
+        # the node-0 launcher publishes a FRESH coordinator endpoint per
+        # generation (the jax coordination service cannot be reused
+        # across failovers)
+        coord_key = f"g{generation}/coord"
+        if node_rank == 0 and not master.store.check(coord_key):
+            master.store.set(coord_key,
+                             f"{peers[0]['host']}:{_free_port()}")
+        coord = master.store.get(coord_key).decode()
+        endpoints = []
+        for nr, peer in enumerate(peers):
+            for lr in range(peer["nproc"]):
+                endpoints.append(f"{peer['host']}:0")
+        endpoints = ",".join(endpoints)
+        master.start_heartbeat(node_rank, generation)
+
+        procs = []
+        for local_rank in range(args.nproc_per_node):
+            global_rank = node_rank * args.nproc_per_node + local_rank
+            procs.append(_spawn(args, global_rank, local_rank, world,
+                                coord, endpoints, generation))
+
+        gen = generation
+
+        def dead_check(_last=[0.0]):
+            now = time.time()
+            if now - _last[0] < 1.0:
+                return None
+            _last[0] = now
+            dead = master.dead_peers(node_rank, gen)
+            if dead:
+                print(f"launch: node(s) {dead} heartbeat lost "
+                      f"(generation {gen}); tearing down for "
+                      "re-rendezvous", file=sys.stderr)
+                return DEAD_PEER_RC
+            return None
+
+        try:
+            rc = _watch(procs, on_tick=dead_check)
+        except KeyboardInterrupt:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            master.stop_heartbeat()
+            raise
+        master.stop_heartbeat()
+        if rc == 0:
+            # tell peers this node FINISHED (stopped beats != death)
+            master.mark_done(node_rank, generation)
+            return 0
+        elastic = rc in (101, 102) or args.elastic_level > 0
+        restarts += 1
+        if not elastic or restarts > args.max_restarts:
+            return rc
+        generation = master.bump_generation(generation)
+        requested_rank = node_rank  # keep my rank across failovers
+        print(f"launch: re-rendezvous at generation {generation} "
+              f"({restarts}/{args.max_restarts})", file=sys.stderr)
 
 
 def main():

@@ -1,0 +1,193 @@
+"""Built-in launch master: KV rendezvous + node heartbeats.
+
+TPU-native equivalent of the reference's launch master (reference:
+python/paddle/distributed/launch/controllers/master.py — HTTPMaster
+over utils/kv_server.py for single-shot rendezvous, ETCDMaster for
+heartbeat + peer-failure watching). Here both roles ride the framework's
+native C++ TCPStore (core/native/tcp_store.cc):
+
+  - every launcher is given the SAME ``--master host:port``; whichever
+    node can bind it hosts the KV server (no separate etcd / hand-wired
+    rank-0 bootstrapping), everyone else connects as a client;
+  - rendezvous is generation-scoped: nodes register under
+    ``g{N}/``-prefixed keys, ranks are assigned by arrival (or honored
+    when ``--node_rank`` is pinned), and the assembled peer list is
+    what ``_spawn`` turns into the PADDLE_* env contract;
+  - each launcher heartbeats ``g{N}/beat/{rank}`` and watches the
+    others; a stale peer (launcher died / node lost) triggers the
+    elastic path: kill local workers, bump the generation, re-
+    rendezvous, respawn — the reference ETCDMaster flow.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import List, Optional, Tuple
+
+__all__ = ["LaunchMaster", "RanksClaimedError"]
+
+
+class RanksClaimedError(RuntimeError):
+    """Every rank of this generation is already claimed — the caller is
+    late to a completed rendezvous (typically a restarted launcher that
+    read the generation before the survivors bumped it). Refresh the
+    generation and retry."""
+
+
+class LaunchMaster:
+    HEARTBEAT_INTERVAL = 1.0
+
+    def __init__(self, endpoint: str, nnodes: int):
+        from ...core.native import TCPStore
+
+        self.endpoint = endpoint
+        self.nnodes = nnodes
+        host, port = endpoint.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.is_server = False
+        try:
+            # whichever launcher can bind hosts the KV map
+            self.store = TCPStore(host="0.0.0.0", port=self.port,
+                                  is_master=True)
+            self.store.host = host  # clients elsewhere dial the name
+            self.is_server = True
+        except RuntimeError:
+            self.store = TCPStore(host=host, port=self.port,
+                                  is_master=False)
+        self._beat_stop: Optional[threading.Event] = None
+
+    # ---------------- rendezvous ----------------
+
+    def rendezvous(self, node_rank: int, nproc: int, generation: int,
+                   timeout: float = 120.0) -> Tuple[int, List[str]]:
+        """Register this node and block until all ``nnodes`` peers of
+        this generation are present. Returns (node_rank, node descriptor
+        list sorted by rank). node_rank < 0 → assigned by arrival order
+        (the reference's job_id-keyed sync_peers)."""
+        g = f"g{generation}"
+        if node_rank < 0:
+            # claim the first free rank (atomic add — a survivor that
+            # KEPT its rank across a failover claims it explicitly, so
+            # arrival order alone would collide)
+            for r in range(self.nnodes):
+                if self.store.add(f"{g}/claim/{r}", 1) == 1:
+                    node_rank = r
+                    break
+            else:
+                raise RanksClaimedError(
+                    f"rendezvous generation {generation}: all "
+                    f"{self.nnodes} ranks already claimed")
+        elif self.store.add(f"{g}/claim/{node_rank}", 1) != 1:
+            raise RuntimeError(
+                f"--node_rank {node_rank} is already claimed in "
+                f"generation {generation}: two launchers were started "
+                "with the same rank (omit --node_rank for arrival-order "
+                "assignment)")
+        me = json.dumps({"host": _my_host(self.host), "nproc": nproc})
+        self.store.set(f"{g}/peers/{node_rank}", me)
+        deadline = time.time() + timeout
+        while True:
+            if all(self.store.check(f"{g}/peers/{r}")
+                   for r in range(self.nnodes)):
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rendezvous generation {generation}: "
+                    f"{self.nnodes} nodes required")
+            time.sleep(0.2)
+        peers = [json.loads(self.store.get(f"{g}/peers/{r}").decode())
+                 for r in range(self.nnodes)]
+        return node_rank, peers
+
+    # ---------------- heartbeats ----------------
+
+    def start_heartbeat(self, node_rank: int, generation: int) -> None:
+        self.stop_heartbeat()
+        stop = threading.Event()
+        g = f"g{generation}"
+
+        def beat():
+            while not stop.is_set():
+                try:
+                    self.store.set(f"{g}/beat/{node_rank}",
+                                   repr(time.time()))
+                except Exception:
+                    return  # store gone — launcher is exiting anyway
+                stop.wait(self.HEARTBEAT_INTERVAL)
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        self._beat_stop = stop
+
+    def stop_heartbeat(self) -> None:
+        if self._beat_stop is not None:
+            self._beat_stop.set()
+            self._beat_stop = None
+
+    def mark_done(self, node_rank: int, generation: int) -> None:
+        """Record a clean exit so peers don't mistake a finished node
+        (whose beats stop) for a dead one."""
+        try:
+            self.store.set(f"g{generation}/done/{node_rank}", b"1")
+        except Exception:
+            pass  # store host may be the one exiting
+
+    def dead_peers(self, node_rank: int, generation: int,
+                   ttl: float = 5.0) -> List[int]:
+        """Ranks whose heartbeat VALUE stopped changing for ``ttl``
+        seconds of LOCAL time (skew-free: remote timestamps are treated
+        as opaque change tokens, never compared to our clock — the
+        ETCDMaster fetch_peer_alive diff). A peer that never beat yet
+        has grace until its first beat; a peer that marked itself done
+        is finished, not dead."""
+        g = f"g{generation}"
+        if getattr(self, "_beat_seen_gen", None) != generation:
+            self._beat_seen = {}
+            self._beat_seen_gen = generation
+        now = time.monotonic()
+        dead = []
+        for r in range(self.nnodes):
+            if r == node_rank:
+                continue
+            if not self.store.check(f"{g}/beat/{r}"):
+                continue
+            if self.store.check(f"{g}/done/{r}"):
+                continue
+            val = self.store.get(f"{g}/beat/{r}")
+            seen = self._beat_seen.get(r)
+            if seen is None or seen[0] != val:
+                self._beat_seen[r] = (val, now)
+                continue
+            if now - seen[1] > ttl:
+                dead.append(r)
+        return dead
+
+    def current_generation(self) -> int:
+        """Latest generation (0 when the job never failed over). A
+        RESTARTED launcher calls this to join the survivors' epoch."""
+        if self.store.check("generation"):
+            return int(self.store.get("generation").decode())
+        return 0
+
+    def bump_generation(self, current: int) -> int:
+        """Advance past a failover of generation ``current``: exactly
+        one detector moves the counter (the per-generation bump marker
+        makes racing survivors idempotent), everyone returns
+        ``current + 1``. Known race (documented, reference ETCDMaster
+        has the analogue): a peer restarted BEFORE any survivor
+        detected the failure re-joins the stale generation until a
+        heartbeat TTL elapses."""
+        if self.store.add(f"gen_bump/{current}", 1) == 1:
+            self.store.set("generation", str(current + 1))
+        return current + 1
+
+
+def _my_host(master_host: str) -> str:
+    if master_host in ("127.0.0.1", "localhost", "0.0.0.0"):
+        return "127.0.0.1"
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return socket.gethostname()
