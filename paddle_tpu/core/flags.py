@@ -72,8 +72,10 @@ define_flag("record_double_grad", True,
 define_flag("benchmark", False, "synchronize after each op for timing")
 define_flag("paged_attention_backend", "auto",
             "decode paged-attention backend: auto (XLA gather path — "
-            "avoids Pallas/scatter layout-copy conflict, see "
-            "nn/functional/paged_attention.py) | xla | pallas")
+            "measured fastest end-to-end, see "
+            "nn/functional/paged_attention.py) | xla | fused "
+            "(hand-written page-DMA Pallas kernel, opt-in) | pallas "
+            "(stock jax kernel via a layout transpose)")
 define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
 define_flag("eager_jit_ops", True, "dispatch eager ops through cached jit computations")
 define_flag("stop_check_timeout", 900, "bound (seconds) on distributed store waits")

@@ -78,7 +78,7 @@ def qkv_split_rope_fused(x, qkv_w, qkv_b, positions, num_heads,
 
 
 class PagedKV(NamedTuple):
-    """Layer-folded paged KV pool (the carry of the decode loop).
+    """Layer-folded PAGE-MAJOR paged KV pool (the decode-loop carry).
 
     Layers are FOLDED into the page dimension — layer ``l``'s logical
     page ``p`` lives at physical page ``l * num_pages + p`` — so one
@@ -87,9 +87,11 @@ class PagedKV(NamedTuple):
     layout ([L, n_kv, pages, ...] shuttled through scan xs→ys) copied
     the whole pool every token: measured 10.8ms/step of pure copy on
     the 1.3B config vs 0.7ms for this carry design (tools/decode_profile
-    cache_copy vs carry_cache).
+    cache_copy vs carry_cache). Page-major ([P, ps, n_kv, d]) makes
+    each page one contiguous block: the scatter's indexed dims lead and
+    the fused Pallas decode kernel DMAs pages whole.
     """
-    k: jax.Array   # [n_kv, num_layers * num_pages, page_size, head_dim]
+    k: jax.Array   # [num_layers * num_pages, page_size, n_kv, head_dim]
     v: jax.Array
 
 
@@ -239,7 +241,7 @@ class FusedMultiTransformer(Layer):
         return h, ck, cv
 
     def _pages_per_layer(self, cache: PagedKV) -> int:
-        return cache.k.shape[1] // self.num_layers
+        return cache.k.shape[0] // self.num_layers
 
     def prefill_raw(self, weights, x, cache, block_tables, cos_t, sin_t):
         """Prompt pass: x [b, s, d] → (hidden [b, s, d], filled cache).
@@ -288,34 +290,58 @@ class FusedMultiTransformer(Layer):
             0, self.num_layers, body, (x, cache.k, cache.v))
         return h, PagedKV(nk, nv)
 
+    def unstack_weights(self, weights=None):
+        """Per-layer weight dicts for the UNROLLED decode path
+        (experimental). Measured on the 1.3B b32 decode (r4): the
+        unrolled program was SLOWER end-to-end than the stacked
+        fori_loop (1859 vs 2583 tok/s) — XLA already schedules the
+        loop-indexed weight slices efficiently, and the 24-layer
+        unrolled body lost the while-loop's buffer reuse. Kept for
+        per-config experimentation via decode_raw's list form."""
+        weights = weights or self._stack()
+        return [{n: a[l] for n, a in weights.items()}
+                for l in range(self.num_layers)]
+
     def decode_raw(self, weights, x, cache: PagedKV, block_tables,
                    seq_lens, cos_t, sin_t):
         """One decode step: x [b, d] token embeddings, seq_lens [b] =
         tokens already cached (the new token's position). Returns
         (hidden [b, d], cache').
 
-        Layer loop = ``fori_loop`` with the pool as carry: per step the
-        pool is only scatter-written (new token rows) and gather-read
-        (the Pallas kernel's page DMAs) — never copied.
+        ``weights`` may be the stacked dict (fori_loop layer loop —
+        compact program) or a LIST of per-layer dicts from
+        ``unstack_weights`` (Python-unrolled — the serving-speed path:
+        no per-layer slice materialization). Either way the pool is
+        carried through the loop and only scatter-written/gather-read —
+        never copied.
         """
         npages = self._pages_per_layer(cache)
+        lens1 = (seq_lens + 1).astype(jnp.int32)
 
         def attend_paged(tbl):
             def attend(q, k, v, ck, cv):
-                return paged_attention(q, ck, cv,
-                                       (seq_lens + 1).astype(jnp.int32),
-                                       tbl)
+                return paged_attention(q, ck, cv, lens1, tbl)
             return attend
+
+        def run_layer(w, h, ck, cv, tbl):
+            return self._layer_body(
+                w, h, seq_lens,
+                lambda k, v: write_kv_pages(ck, cv, k, v, seq_lens, tbl),
+                attend_paged(tbl), cos_t, sin_t)
+
+        if isinstance(weights, (list, tuple)):
+            h, ck, cv = x, cache.k, cache.v
+            for l, w in enumerate(weights):
+                h, ck, cv = run_layer(w, h, ck, cv,
+                                      block_tables + l * npages)
+            return h, PagedKV(ck, cv)
 
         def body(l, carry):
             h, ck, cv = carry
             w = {n: jax.lax.dynamic_index_in_dim(a, l, 0, False)
                  for n, a in weights.items()}
-            tbl = block_tables + l * npages
-            h, ck, cv = self._layer_body(
-                w, h, seq_lens,
-                lambda k, v: write_kv_pages(ck, cv, k, v, seq_lens, tbl),
-                attend_paged(tbl), cos_t, sin_t)
+            h, ck, cv = run_layer(w, h, ck, cv,
+                                  block_tables + l * npages)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(

@@ -471,12 +471,11 @@ class ContinuousBatchingEngine:
             self._pages_per_seq, allow_missing=True)
 
         m = self.model
-        weights = m.stack._stack()
         cur = np.where([r is not None for r in self._slots],
                        self._lens - 1, 0).astype(np.int64)
         toks, self._ck, self._cv = self._gen._get_decode_k(k)(
-            weights, m.embed._data, self._gen._head_t,
-            m.lnf_scale._data, m.lnf_bias._data,
+            m.stack._stack(), m.embed._data,
+            self._gen._head_t, m.lnf_scale._data, m.lnf_bias._data,
             jnp.asarray(self._last_tok, jnp.int32),
             jnp.asarray(cur, jnp.int32),
             self._ck, self._cv, tables)

@@ -4,9 +4,11 @@ TPU-native equivalent of the block-table machinery behind the reference's
 block_multi_head_attention serving kernel (reference:
 paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu — its
 ``block_tables`` input; allocation policy lives in serving frontends).
-Pages are rows of a preallocated [n_kv_heads, num_pages, page_size,
-head_dim] pool per layer; the manager hands out page ids from a free
-list so sequences of different lengths share one pool with no copies.
+Pages are rows of a preallocated PAGE-MAJOR pool
+[num_layers * num_pages, page_size, n_kv_heads, head_dim] (each page one
+contiguous block — see nn/functional/paged_attention.py layout notes);
+the manager hands out LOGICAL page ids from a free list so sequences of
+different lengths share one pool with no copies.
 """
 from __future__ import annotations
 
@@ -40,10 +42,11 @@ class BlockKVCacheManager:
         self._owned: dict = {}
 
     def fresh_cache(self) -> PagedKV:
-        # layer-FOLDED pool (see PagedKV): layer l's logical page p is
-        # physical page l * num_pages + p — decode updates it in place
-        shape = (self.num_kv_heads, self.num_layers * self.num_pages,
-                 self.page_size, self.head_dim)
+        # layer-FOLDED page-major pool (see PagedKV): layer l's logical
+        # page p is physical page l * num_pages + p — decode updates it
+        # in place; each page is one contiguous DMA block
+        shape = (self.num_layers * self.num_pages, self.page_size,
+                 self.num_kv_heads, self.head_dim)
         return PagedKV(jnp.zeros(shape, self.dtype),
                        jnp.zeros(shape, self.dtype))
 

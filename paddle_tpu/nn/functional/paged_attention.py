@@ -13,12 +13,22 @@ softmax reading pages straight from HBM); elsewhere an XLA gather +
 masked dense attention computes the same thing (fake-device test
 precedent, SURVEY §4).
 
-Layouts (match the Pallas kernel):
+Layouts (PAGE-MAJOR — r4 redesign):
   q            [batch, num_q_heads, head_dim]        one decode token/seq
-  key_cache    [num_kv_heads, num_pages, page_size, head_dim]
-  value_cache  [num_kv_heads, num_pages, page_size, head_dim]
+  key_cache    [num_pages, page_size, num_kv_heads, head_dim]
+  value_cache  [num_pages, page_size, num_kv_heads, head_dim]
   seq_lens     [batch] int32   tokens already in cache (incl. current)
   block_tables [batch, pages_per_seq] int32          page ids per sequence
+
+Why page-major: one page is a CONTIGUOUS [page_size, n_kv, d] block in
+the default XLA layout, so (a) the decode scatter writes token rows
+in-place with no layout transition, (b) the fused Pallas decode kernel
+DMAs whole pages HBM→VMEM, and (c) the XLA gather fallback gathers on
+the leading dim. The stock jax paged_attention kernel wants the old
+[n_kv, P, ps, d] layout and imposes it on operands, which fought the
+scatter's preferred layout (two full-pool copies per layer per token);
+it remains available behind FLAGS_paged_attention_backend=pallas via an
+explicit transpose.
 """
 from __future__ import annotations
 
@@ -39,10 +49,15 @@ def _on_tpu() -> bool:
 
 
 def _pallas_paged(q, key_cache, value_cache, seq_lens, block_tables):
+    """Stock jax kernel path: transpose the page-major pool to the
+    [n_kv, P, ps, d] layout it expects (a full-pool copy — opt-in
+    only; the fused kernel below is the fast path)."""
     from jax.experimental.pallas.ops.tpu.paged_attention import (
         paged_attention as kernel,
     )
 
+    key_cache = jnp.transpose(key_cache, (2, 0, 1, 3))
+    value_cache = jnp.transpose(value_cache, (2, 0, 1, 3))
     page_size = key_cache.shape[2]
     pages_per_seq = block_tables.shape[1]
     # one compute block ≥ 512 tokens of K keeps the MXU fed
@@ -62,27 +77,162 @@ def _pallas_paged(q, key_cache, value_cache, seq_lens, block_tables):
 
 def _xla_paged(q, key_cache, value_cache, seq_lens, block_tables):
     b, n_q, d = q.shape
-    n_kv, _, page_size, _ = key_cache.shape
+    _, page_size, n_kv, _ = key_cache.shape
     pages_per_seq = block_tables.shape[1]
     max_len = pages_per_seq * page_size
 
-    # gather pages: [n_kv, b, pages, page, d] -> [b, n_kv, max_len, d]
-    k = key_cache[:, block_tables]
-    v = value_cache[:, block_tables]
-    k = jnp.transpose(k, (1, 0, 2, 3, 4)).reshape(b, n_kv, max_len, d)
-    v = jnp.transpose(v, (1, 0, 2, 3, 4)).reshape(b, n_kv, max_len, d)
+    # gather pages: [b, pages, page, n_kv, d] -> [b, max_len, n_kv, d]
+    k = key_cache[block_tables].reshape(b, max_len, n_kv, d)
+    v = value_cache[block_tables].reshape(b, max_len, n_kv, d)
 
     group = n_q // n_kv  # GQA: q heads per kv head
     qh = q.reshape(b, n_kv, group, d)
-    logits = jnp.einsum("bngd,bnkd->bngk", qh.astype(jnp.float32),
+    logits = jnp.einsum("bngd,bknd->bngk", qh.astype(jnp.float32),
                         k.astype(jnp.float32)) * (d ** -0.5)
     pos = jnp.arange(max_len)
     mask = pos[None, :] < seq_lens[:, None]           # [b, max_len]
     logits = jnp.where(mask[:, None, None, :], logits,
                        jnp.finfo(jnp.float32).min)
     w = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bngk,bnkd->bngd", w, v.astype(jnp.float32))
+    out = jnp.einsum("bngk,bknd->bngd", w, v.astype(jnp.float32))
     return out.reshape(b, n_q, d).astype(q.dtype)
+
+
+def _fused_paged(q, key_cache, value_cache, seq_lens, block_tables):
+    """Fused Pallas decode attention over the page-major pool.
+
+    One grid program per sequence: pages stream HBM→VMEM through a
+    double-buffered async DMA (whole [ps, n_kv, d] blocks — the layout
+    is built for this), online-softmax accumulates per page. Unlike the
+    XLA gather path this never materializes the gathered K/V (saves a
+    full write+read of every attended byte), and unlike the stock jax
+    kernel it works WITH the scatter's natural layout instead of
+    forcing a transposed pool.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, n_q, d = q.shape
+    P, ps, n_kv, _ = key_cache.shape
+    pp = block_tables.shape[1]
+    group = n_q // n_kv
+    scale = d ** -0.5
+    NEG = -1e30  # python literal: jnp scalars would be captured consts
+
+    def kernel(tables_ref, lens_ref, q_ref, k_hbm, v_hbm, o_ref,
+               k_buf, v_buf, k_sem, v_sem):
+        i = pl.program_id(0)
+        qf = q_ref[0].astype(jnp.float32) \
+            * jnp.float32(scale)            # [n_q, d]
+        q3 = qf.reshape(n_kv, group, d)
+
+        def _idx(p):
+            # explicit lax arithmetic: weak-type promotion on the
+            # pallas scalar-ref index recurses in jnp operators
+            pi = jax.lax.convert_element_type(p, jnp.int32)
+            ii = jax.lax.convert_element_type(i, jnp.int32)
+            return jax.lax.add(jax.lax.mul(ii, jnp.int32(pp)), pi)
+
+        def start_dma(p, slot):
+            pid = tables_ref[_idx(p)]
+            pltpu.make_async_copy(k_hbm.at[pid], k_buf.at[slot],
+                                  k_sem.at[slot]).start()
+            pltpu.make_async_copy(v_hbm.at[pid], v_buf.at[slot],
+                                  v_sem.at[slot]).start()
+
+        def wait_dma(p, slot):
+            pid = tables_ref[_idx(p)]
+            pltpu.make_async_copy(k_hbm.at[pid], k_buf.at[slot],
+                                  k_sem.at[slot]).wait()
+            pltpu.make_async_copy(v_hbm.at[pid], v_buf.at[slot],
+                                  v_sem.at[slot]).wait()
+
+        start_dma(jnp.int32(0), jnp.int32(0))
+        m0 = jnp.full((n_kv, group, 1), NEG, jnp.float32)
+        l0 = jnp.zeros((n_kv, group, 1), jnp.float32)
+        a0 = jnp.zeros((n_kv, group, d), jnp.float32)
+
+        lens_i = lens_ref[i]
+
+        def body(p, carry):
+            m, l, acc = carry
+            slot = jax.lax.rem(p, jnp.int32(2))
+            nxt = jax.lax.add(p, jnp.int32(1))
+
+            @pl.when(nxt < jnp.int32(pp))
+            def _():
+                start_dma(nxt, jax.lax.rem(nxt, jnp.int32(2)))
+
+            wait_dma(p, slot)
+            # lane-preserving transpose to put the batch (head) dim
+            # first: Mosaic requires equal batch dim POSITIONS
+            k = jnp.swapaxes(k_buf[slot], 0, 1).astype(jnp.float32)
+            v = jnp.swapaxes(v_buf[slot], 0, 1).astype(jnp.float32)
+            # [n_kv, group, ps] <- [n_kv, g, d] x [n_kv, ps, d]
+            logits = jax.lax.dot_general(
+                q3, k, (((2,), (2,)), ((0,), (0,))),
+                precision=jax.lax.Precision.DEFAULT,
+                preferred_element_type=jnp.float32)
+            base = jax.lax.mul(jax.lax.convert_element_type(p, jnp.int32),
+                               jnp.int32(ps))
+            pos = jax.lax.add(
+                jax.lax.broadcasted_iota(jnp.int32, (1, 1, ps), 2),
+                jax.lax.broadcast(base, (1, 1, ps)))
+            valid = jax.lax.lt(
+                pos, jax.lax.broadcast(
+                    jax.lax.convert_element_type(lens_i, jnp.int32),
+                    (1, 1, ps)))
+            logits = jnp.where(valid, logits,
+                               jnp.float32(NEG))
+            pm = jnp.maximum(m, logits.max(-1, keepdims=True))
+            alpha = jnp.exp(m - pm)
+            w = jnp.exp(logits - pm)                     # [n_kv, g, ps]
+            w = jnp.where(valid, w, jnp.float32(0.0))
+            l = l * alpha + w.sum(-1, keepdims=True)
+            # [n_kv, group, d]
+            pv = jax.lax.dot_general(
+                w, v, (((2,), (1,)), ((0,), (0,))),
+                precision=jax.lax.Precision.DEFAULT,
+                preferred_element_type=jnp.float32)
+            acc = acc * alpha + pv
+            return pm, l, acc
+
+        # int32 loop bounds: with x64 enabled (the axon env) python
+        # bounds make the index int64, and Mosaic's int64->int32
+        # convert lowering recurses forever
+        m, l, acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(pp), body,
+                                      (m0, l0, a0))
+        out = acc / jnp.maximum(l, jnp.float32(1e-30))
+        # f32 out ref: in-kernel f32->bf16 (tpu.truncf) fails to
+        # legalize on this toolchain; the caller casts outside
+        o_ref[0] = out.reshape(n_q, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, n_q, d), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, n_q, d), lambda i, *_: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, n_kv, d), key_cache.dtype),
+            pltpu.VMEM((2, ps, n_kv, d), value_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ])
+    # x64 off for the whole kernel trace: the axon env enables x64
+    # globally, and weak-typed python scalars become f64/i64 inside the
+    # kernel, which Mosaic cannot legalize
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, n_q, d), jnp.float32),
+        )(block_tables.reshape(-1).astype(jnp.int32),
+          seq_lens.astype(jnp.int32), q, key_cache, value_cache)
+    return out.astype(q.dtype)
 
 
 def paged_attention(q, key_cache, value_cache, seq_lens, block_tables):
@@ -90,7 +240,7 @@ def paged_attention(q, key_cache, value_cache, seq_lens, block_tables):
 
     Raw-array functional op (used inside compiled decode steps).
 
-    Backend selection (FLAGS_paged_attention_backend: auto|xla|pallas):
+    Backend selection (FLAGS_paged_attention_backend: auto|fused|xla|pallas):
     ``auto`` uses the XLA gather+masked-attention path on TPU. Measured
     reason (r4, 1.3B decode): the stock Pallas kernel imposes the
     default ``{3,2,1,0}`` layout on the cache operands while the
@@ -103,13 +253,21 @@ def paged_attention(q, key_cache, value_cache, seq_lens, block_tables):
     from ...core.flags import flag
 
     backend = flag("paged_attention_backend")
-    if backend not in ("auto", "xla", "pallas"):
+    if backend not in ("auto", "fused", "xla", "pallas"):
         raise ValueError(
             f"FLAGS_paged_attention_backend={backend!r}: valid values "
-            "are 'auto', 'xla', 'pallas'")
+            "are 'auto', 'fused', 'xla', 'pallas'")
     if backend == "pallas":
         return _pallas_paged(q, key_cache, value_cache, seq_lens,
                              block_tables)
+    if backend == "fused":
+        # hand-written page-DMA kernel: numerically verified, but the
+        # per-sequence grid serializes on the single TensorCore and
+        # loses to the XLA gather end-to-end on v5e (2019 vs 2531 tok/s
+        # on the 1.3B b32 rung; page 32/64 didn't close it) — explicit
+        # opt-in only until a multi-sequence-per-program variant wins
+        return _fused_paged(q, key_cache, value_cache, seq_lens,
+                            block_tables)
     return _xla_paged(q, key_cache, value_cache, seq_lens, block_tables)
 
 
@@ -118,20 +276,19 @@ def write_kv_pages(key_cache, value_cache, new_k, new_v, positions,
     """Scatter one new token's K/V per sequence into the paged cache.
 
     new_k/new_v: [batch, num_kv_heads, head_dim]; positions: [batch] slot
-    index of the new token (0-based). Returns updated caches. This is the
-    cache-write half of the reference's block_multi_head_attention (which
-    fuses append + attend); under XLA the scatter fuses into the decode
-    program so the split costs nothing.
+    index of the new token (0-based). Returns updated caches. The page-
+    major layout makes this a natural scatter: indexed dims (page, slot)
+    lead, the updated [n_kv, d] rows are contiguous — XLA keeps it in
+    place on a loop-carried pool.
     """
-    page_size = key_cache.shape[2]
+    page_size = key_cache.shape[1]
     b = positions.shape[0]
     page_ids = block_tables[jnp.arange(b), positions // page_size]  # [b]
     slots = positions % page_size                                   # [b]
-    # index pattern [h, b-page, b-slot] -> positions [n_kv, b, d]
-    k_t = jnp.transpose(new_k, (1, 0, 2)).astype(key_cache.dtype)
-    v_t = jnp.transpose(new_v, (1, 0, 2)).astype(value_cache.dtype)
-    key_cache = key_cache.at[:, page_ids, slots].set(k_t)
-    value_cache = value_cache.at[:, page_ids, slots].set(v_t)
+    key_cache = key_cache.at[page_ids, slots].set(
+        new_k.astype(key_cache.dtype))
+    value_cache = value_cache.at[page_ids, slots].set(
+        new_v.astype(value_cache.dtype))
     return key_cache, value_cache
 
 
@@ -141,13 +298,12 @@ def write_prefill_kv_pages(key_cache, value_cache, k, v, block_tables):
     Assumes the prompt starts at position 0 (fresh sequences).
     """
     b, s, n_kv, d = k.shape
-    page_size = key_cache.shape[2]
+    page_size = key_cache.shape[1]
     pos = jnp.arange(s)
     page_ids = block_tables[:, pos // page_size]      # [b, s]
-    slots = pos % page_size                           # [s]
-    bcast_slots = jnp.broadcast_to(slots, (b, s))
-    k_t = jnp.transpose(k, (2, 0, 1, 3)).astype(key_cache.dtype)
-    v_t = jnp.transpose(v, (2, 0, 1, 3)).astype(value_cache.dtype)
-    key_cache = key_cache.at[:, page_ids, bcast_slots].set(k_t)
-    value_cache = value_cache.at[:, page_ids, bcast_slots].set(v_t)
+    slots = jnp.broadcast_to(pos % page_size, (b, s))  # [b, s]
+    key_cache = key_cache.at[page_ids, slots].set(
+        k.astype(key_cache.dtype))
+    value_cache = value_cache.at[page_ids, slots].set(
+        v.astype(value_cache.dtype))
     return key_cache, value_cache

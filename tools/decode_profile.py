@@ -197,8 +197,9 @@ def mode_pallas_attn(dtype="float32"):
     pages_per_seq = -(-(PROMPT + CHUNK + 2) // PAGE)
     npages = BATCH * pages_per_seq + 1
     dt = jnp.float32 if dtype == "float32" else jnp.bfloat16
-    ck = jnp.zeros((H, npages, PAGE, HD), dt)
-    cv = jnp.zeros((H, npages, PAGE, HD), dt)
+    # PAGE-MAJOR pool (r4 layout): [P, ps, n_kv, d]
+    ck = jnp.zeros((npages, PAGE, H, HD), dt)
+    cv = jnp.zeros((npages, PAGE, H, HD), dt)
     tables = jnp.arange(1, 1 + BATCH * pages_per_seq, dtype=jnp.int32) \
         .reshape(BATCH, pages_per_seq)
     lens = jnp.full((BATCH,), PROMPT, jnp.int32)
@@ -229,7 +230,7 @@ def mode_carry_cache(dtype="float32"):
     pages_per_seq = -(-(PROMPT + CHUNK + 2) // PAGE)
     npages = BATCH * pages_per_seq + 1
     dt = jnp.float32 if dtype == "float32" else jnp.bfloat16
-    shape = (H, L * npages, PAGE, HD)
+    shape = (L * npages, PAGE, H, HD)  # page-major (r4 layout)
     ck, cv = jnp.zeros(shape, dt), jnp.zeros(shape, dt)
     tables = jnp.arange(1, 1 + BATCH * pages_per_seq, dtype=jnp.int32) \
         .reshape(BATCH, pages_per_seq)
@@ -240,13 +241,13 @@ def mode_carry_cache(dtype="float32"):
             pos = jnp.full((BATCH,), PROMPT, jnp.int32) + i
             page_ids = tables[jnp.arange(BATCH), pos // PAGE]
             slots = pos % PAGE
-            newk = jnp.ones((H, BATCH, HD), dt)
+            newk = jnp.ones((BATCH, H, HD), dt)
 
             def body(l, c):
                 ck, cv = c
                 pid = page_ids + l * npages
-                ck = ck.at[:, pid, slots].set(newk)
-                cv = cv.at[:, pid, slots].set(newk)
+                ck = ck.at[pid, slots].set(newk)
+                cv = cv.at[pid, slots].set(newk)
                 return (ck, cv)
             ck, cv = jax.lax.fori_loop(0, L, body, (ck, cv))
             return (ck, cv), ck[0, 0, 0, 0]
@@ -486,6 +487,88 @@ def mode_weights_int8():
     return BATCH * CHUNK / sec
 
 
+def mode_xla_paged_attn(batch=32, dtype="bfloat16"):
+    """Current XLA gather attention over the FOLDED pool, isolated:
+    64-step scan x 24 layers at the given batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.paged_attention import _xla_paged
+
+    pages_per_seq = -(-(PROMPT + CHUNK + 2) // PAGE)
+    npages = batch * pages_per_seq + 1
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    # PAGE-MAJOR pool (r4 layout): [P, ps, n_kv, d]
+    ck = jnp.zeros((L * npages, PAGE, H, HD), dt)
+    cv = jnp.zeros((L * npages, PAGE, H, HD), dt)
+    tables = jnp.arange(1, 1 + batch * pages_per_seq, dtype=jnp.int32) \
+        .reshape(batch, pages_per_seq)
+    lens = jnp.full((batch,), PROMPT, jnp.int32)
+
+    def chunk(q, ck, cv):
+        def tok_step(q, i):
+            def body(l, qq):
+                o = _xla_paged(qq, ck, cv, lens, tables + l * npages)
+                return o.astype(qq.dtype)
+            q = jax.lax.fori_loop(0, L, body, q)
+            return q, q[0, 0, 0]
+        q, _ = jax.lax.scan(tok_step, q, jnp.arange(CHUNK))
+        return q
+
+    q = jnp.ones((batch, H, HD), dt)
+    fn = jax.jit(chunk)
+    sec = time_chunk(fn, (q, ck, cv))
+    return batch * CHUNK / sec
+
+
+def mode_engine_full(batch=32):
+    """Current engine end-to-end at the given batch (bf16 stack; the
+    engine derives bf16 compute + bf16 KV from the weight dtype)."""
+    global BATCH
+    old, BATCH = BATCH, batch
+    try:
+        return mode_full()
+    finally:
+        BATCH = old
+
+
+def mode_engine_knockout(batch=32, knock="attn"):
+    """Engine end-to-end with ONE component knocked out in place —
+    in-context component cost = full minus knockout."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.incubate.nn.fused_transformer as ft
+    from paddle_tpu.inference import GenerationEngine
+
+    if knock == "attn":
+        def fake_attn(q, ck, cv, lens, tables):
+            return q  # [b, n_q, d] passthrough, no KV read
+        ft.paged_attention = fake_attn
+    elif knock == "head":
+        def fake_logits(self, h, head_t, lnf_s, lnf_b):
+            b = h.shape[0]
+            return jnp.broadcast_to(h[:, :1].astype(jnp.float32),
+                                    (b, VOCAB))
+        GenerationEngine._logits = fake_logits
+    elif knock == "argmax":
+        @staticmethod
+        def fake_pick(logits, key, sample_cfg):
+            return jnp.zeros((logits.shape[0],), jnp.int32)
+        GenerationEngine._pick_token = fake_pick
+    elif knock == "scatter":
+        import paddle_tpu.nn.functional.paged_attention as pa
+
+        def fake_write(ck, cv, k, v, pos, tables):
+            return ck, cv
+        ft.write_kv_pages = fake_write
+    global BATCH
+    old, BATCH = BATCH, batch
+    try:
+        return mode_full()
+    finally:
+        BATCH = old
+
+
 def mode_pallas_page(page, dtype="bfloat16"):
     """Pallas paged attention with a different page size (DMA width)."""
     global PAGE
@@ -528,6 +611,13 @@ MODES = {
     "head_indep": mode_head_indep,
     "head_unroll": mode_head_unroll,
     "weights_int8": mode_weights_int8,
+    "xla_paged_attn_b32": lambda: mode_xla_paged_attn(32),
+    "xla_paged_attn_b16": lambda: mode_xla_paged_attn(16),
+    "engine_b32": lambda: mode_engine_full(32),
+    "engine_noattn_b32": lambda: mode_engine_knockout(32, "attn"),
+    "engine_nohead_b32": lambda: mode_engine_knockout(32, "head"),
+    "engine_noargmax_b32": lambda: mode_engine_knockout(32, "argmax"),
+    "engine_noscatter_b32": lambda: mode_engine_knockout(32, "scatter"),
 }
 
 

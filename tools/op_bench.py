@@ -34,17 +34,29 @@ REPS = 30
 WARMUP = 5
 
 
-def _median_us(fn, reps=REPS, warmup=WARMUP):
+def _block(out):
+    """Block on device completion for jax arrays AND paddle Tensors —
+    jax.block_until_ready silently no-ops on non-pytree Tensor objects,
+    which would time async dispatch enqueue instead of execution."""
     import jax
 
+    if isinstance(out, (list, tuple)):
+        for o in out:
+            _block(o)
+        return
+    data = getattr(out, "_data", out)
+    jax.block_until_ready(data)
+
+
+def _median_us(fn, reps=REPS, warmup=WARMUP):
     for _ in range(warmup):
         out = fn()
-    jax.block_until_ready(out)
+    _block(out)
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
         out = fn()
-        jax.block_until_ready(out)
+        _block(out)
         ts.append(time.perf_counter() - t0)
     return float(np.median(ts) * 1e6)
 
@@ -125,8 +137,6 @@ def run_bench():
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
-    from paddle_tpu.core import engine as _engine
-
     from paddle_tpu.core.tensor import Tensor
 
     results = {}
@@ -170,11 +180,9 @@ def run_bench():
         "taped_dispatch_us": round(tape_us, 1),
         "tape_overhead_us": round(tape_us - nograd_us, 1),
     }
-    import jax as _jax
-
     return {
-        "backend": _jax.default_backend(),
-        "device": getattr(_jax.devices()[0], "device_kind", "cpu"),
+        "backend": jax.default_backend(),
+        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
         "reps": REPS,
         "dispatch": overhead,
         "ops": results,
