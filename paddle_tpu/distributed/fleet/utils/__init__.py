@@ -1,1 +1,3 @@
+from . import fs  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
+from .fs import FS, HDFSClient, LocalFS  # noqa: F401

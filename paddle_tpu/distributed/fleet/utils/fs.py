@@ -1,0 +1,246 @@
+"""Filesystem abstraction: LocalFS + HDFSClient.
+
+TPU-native equivalent of the reference's fleet fs layer (reference:
+python/paddle/distributed/fleet/utils/fs.py — an FS interface with a
+LocalFS implementation and an HDFSClient shelling out to the hadoop
+CLI). LocalFS is fully implemented over the stdlib; HDFSClient keeps
+the same surface and drives a ``hadoop fs`` binary when one exists
+(zero-egress container ships none — construction raises with guidance
+unless the binary is found).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "FSFileExistsError",
+           "FSFileNotExistsError"]
+
+
+class FSFileExistsError(RuntimeError):
+    pass
+
+
+class FSFileNotExistsError(RuntimeError):
+    pass
+
+
+class FS:
+    """(reference fs.py:50) abstract surface."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        raise NotImplementedError
+
+    def is_file(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self) -> bool:
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path) -> List[str]:
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None) -> str:
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem (reference fs.py:113) — same semantics:
+    ls_dir returns (dirs, files); mv raises on a missing source when
+    ``test_exists`` and on an existing destination unless
+    ``overwrite``."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for e in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, e))
+             else files).append(e)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def upload(self, local_path, fs_path):
+        # local->local copy keeps API parity for code written against
+        # a remote FS
+        if self.is_dir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            if test_exists:
+                raise FSFileNotExistsError(f"{src_path} not found")
+            return
+        if self.is_exist(dst_path):
+            if not overwrite:
+                raise FSFileExistsError(f"{dst_path} exists")
+            self.delete(dst_path)
+        os.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def cat(self, fs_path=None):
+        with open(fs_path) as f:
+            return f.read()
+
+
+class HDFSClient(FS):
+    """``hadoop fs`` CLI wrapper (reference fs.py:447). The zero-egress
+    image ships no hadoop binary — construction probes for one and
+    raises with guidance otherwise, keeping the API importable for
+    code paths that select an FS by config."""
+
+    def __init__(self, hadoop_home: Optional[str] = None,
+                 configs: Optional[dict] = None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        hadoop_home = hadoop_home or os.environ.get("HADOOP_HOME", "")
+        binary = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else shutil.which("hadoop")
+        if not binary or not os.path.exists(binary):
+            raise RuntimeError(
+                "HDFSClient needs a hadoop CLI (set HADOOP_HOME or put "
+                "`hadoop` on PATH); this zero-egress image ships none — "
+                "use LocalFS, or mount your cluster's client")
+        self._binary = binary
+        self._configs = configs or {}
+        self._timeout_s = max(time_out, 1000) / 1000.0
+
+    def _run(self, *args) -> str:
+        cmd = [self._binary, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=self._timeout_s)
+        if proc.returncode != 0:
+            raise RuntimeError(f"hadoop {' '.join(args)} failed: "
+                               f"{proc.stderr[-500:]}")
+        return proc.stdout
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except RuntimeError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except RuntimeError:
+            return False
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        self._run("-mv", src_path, dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path=None):
+        return self._run("-cat", fs_path)
+
+    def need_upload_download(self):
+        return True

@@ -309,11 +309,11 @@ class FusedMultiTransformer(Layer):
         (hidden [b, d], cache').
 
         ``weights`` may be the stacked dict (fori_loop layer loop —
-        compact program) or a LIST of per-layer dicts from
-        ``unstack_weights`` (Python-unrolled — the serving-speed path:
-        no per-layer slice materialization). Either way the pool is
-        carried through the loop and only scatter-written/gather-read —
-        never copied.
+        the DEFAULT and measured-fastest serving path) or a LIST of
+        per-layer dicts from ``unstack_weights`` (Python-unrolled —
+        experimental, measured slower end-to-end; see that method's
+        docstring). Either way the pool is carried through the loop and
+        only scatter-written/gather-read — never copied.
         """
         npages = self._pages_per_layer(cache)
         lens1 = (seq_lens + 1).astype(jnp.int32)

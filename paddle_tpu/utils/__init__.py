@@ -14,10 +14,12 @@ import os
 import warnings
 
 from . import cpp_extension  # noqa: F401
+from . import crypto  # noqa: F401
 from . import unique_name  # noqa: F401
 
 __all__ = ["deprecated", "try_import", "require_version", "run_check",
-           "unique_name", "download", "dlpack", "cpp_extension"]
+           "unique_name", "download", "dlpack", "cpp_extension",
+           "crypto"]
 
 
 def deprecated(update_to="", since="", reason="", level=0):

@@ -42,9 +42,25 @@ def test_auto_backend_off_tpu_is_xla():
     tables = jnp.asarray(
         np.arange(b * pp, dtype=np.int32).reshape(b, pp))
     out = paged_attention(q, kc, vc, lens, tables)
-    ref = _xla_paged(q, kc, vc, lens, tables)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=1e-6)
+    # independent dense reference (not _xla_paged — auto IS _xla_paged
+    # off-TPU, which would compare the function to itself)
+    max_len = pp * ps
+    k_full = np.zeros((b, max_len, n, d), np.float32)
+    v_full = np.zeros((b, max_len, n, d), np.float32)
+    tb = np.asarray(tables)
+    for i in range(b):
+        for t in range(max_len):
+            k_full[i, t] = np.asarray(kc)[tb[i, t // ps], t % ps]
+            v_full[i, t] = np.asarray(vc)[tb[i, t // ps], t % ps]
+    logits = np.einsum("bhd,blhd->bhl", np.asarray(q), k_full) \
+        * (d ** -0.5)
+    mask = np.arange(max_len)[None, :] < np.asarray(lens)[:, None]
+    logits = np.where(mask[:, None, :], logits, -1e30)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    ref = np.einsum("bhl,blhd->bhd", w, v_full)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5,
+                               atol=2e-5)
 
 
 def test_page_major_scatter_roundtrip_dtype_cast():

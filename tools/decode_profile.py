@@ -556,8 +556,6 @@ def mode_engine_knockout(batch=32, knock="attn"):
             return jnp.zeros((logits.shape[0],), jnp.int32)
         GenerationEngine._pick_token = fake_pick
     elif knock == "scatter":
-        import paddle_tpu.nn.functional.paged_attention as pa
-
         def fake_write(ck, cv, k, v, pos, tables):
             return ck, cv
         ft.write_kv_pages = fake_write
