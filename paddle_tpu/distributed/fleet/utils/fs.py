@@ -184,22 +184,23 @@ class HDFSClient(FS):
                                f"{proc.stderr[-500:]}")
         return proc.stdout
 
-    def is_exist(self, fs_path):
+    def _test(self, flag: str, fs_path) -> bool:
         try:
-            self._run("-test", "-e", fs_path)
+            self._run("-test", flag, fs_path)
             return True
-        except RuntimeError:
+        except (RuntimeError, subprocess.TimeoutExpired):
+            # a hung CLI must not escape a boolean predicate
             return False
+
+    def is_exist(self, fs_path):
+        return self._test("-e", fs_path)
 
     def is_dir(self, fs_path):
-        try:
-            self._run("-test", "-d", fs_path)
-            return True
-        except RuntimeError:
-            return False
+        return self._test("-d", fs_path)
 
     def is_file(self, fs_path):
-        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+        # single -test -f round trip (each hadoop call is a JVM start)
+        return self._test("-f", fs_path)
 
     def ls_dir(self, fs_path):
         out = self._run("-ls", fs_path)
@@ -228,7 +229,16 @@ class HDFSClient(FS):
         self._run("-get", fs_path, local_path)
 
     def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
-        if overwrite and self.is_exist(dst_path):
+        # honor the FS contract LocalFS implements: typed errors for a
+        # missing source / existing destination (a bare `hadoop fs -mv`
+        # onto an existing dir would silently nest the source into it)
+        if not self.is_exist(src_path):
+            if test_exists:
+                raise FSFileNotExistsError(f"{src_path} not found")
+            return
+        if self.is_exist(dst_path):
+            if not overwrite:
+                raise FSFileExistsError(f"{dst_path} exists")
             self.delete(dst_path)
         self._run("-mv", src_path, dst_path)
 

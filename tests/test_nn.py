@@ -411,3 +411,36 @@ class TestAttention:
         q = paddle.randn([2, 8, 2, 16])
         out, _ = F.flash_attention(q, q, q, causal=True)
         assert out.shape == [2, 8, 2, 16]
+
+
+class TestMHAFusedQKV:
+    """The fused self-attention QKV path (r4) must not bypass wrapped
+    projections (quantization observers) and must match the unfused
+    branch exactly."""
+
+    def test_fused_matches_unfused(self):
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 6, 16).astype("float32"))
+        np.testing.assert_allclose(mha(x).numpy(), mha(x, x, x).numpy(),
+                                   atol=1e-5)
+
+    def test_quantized_projections_take_wrapped_path(self):
+        from paddle_tpu.quantization import PTQ
+
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.mha = nn.MultiHeadAttention(16, 4)
+
+            def forward(self, x):
+                return self.mha(x)
+
+        net = PTQ().quantize(Net())
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 4, 16).astype("float32"))
+        out = net(x)  # crashed pre-fix: fused branch read .weight
+        assert list(out.shape) == [2, 4, 16]
