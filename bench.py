@@ -193,7 +193,7 @@ def _chip_hbm_bw(device) -> float:
 
 def run_decode_bench(batch=32, prompt=128, new_tokens=129,
                      d_model=2048, n_layers=24, n_heads=16,
-                     decode_chunk=64, quant=None):
+                     decode_chunk=128, quant=None):
     # Flagship-comparable serving rung: the decode model matches the
     # gpt3-1.3b training rung (d2048 L24). Round-4 redesign (each step
     # diagnosed in tools/decode_profile.py + HLO inspection):
@@ -207,6 +207,9 @@ def run_decode_bench(batch=32, prompt=128, new_tokens=129,
     #   accumulation; KV pool bf16
     # - batch 32 measured best (b16: 1662, b32: 2504, b64 regresses as
     #   KV gather reads outgrow the weight-stream amortization)
+    # - decode_chunk 128 (one scan program for the whole generation:
+    #   chunk-boundary pool relayout + host sync amortize; 64 -> 128
+    #   measured +7%)
     # - quant="int8" additionally halves weight reads via per-channel
     #   weight-only int8 (scales applied on matmul outputs)
     """Serving decode throughput through inference.GenerationEngine

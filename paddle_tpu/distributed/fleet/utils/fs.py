@@ -188,9 +188,11 @@ class HDFSClient(FS):
         try:
             self._run("-test", flag, fs_path)
             return True
-        except (RuntimeError, subprocess.TimeoutExpired):
-            # a hung CLI must not escape a boolean predicate
+        except RuntimeError:
             return False
+        # TimeoutExpired propagates: a hung cluster must fail LOUDLY —
+        # mapping it to False would let mv's guards silently skip or
+        # nest moves
 
     def is_exist(self, fs_path):
         return self._test("-e", fs_path)
@@ -229,17 +231,18 @@ class HDFSClient(FS):
         self._run("-get", fs_path, local_path)
 
     def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
-        # honor the FS contract LocalFS implements: typed errors for a
-        # missing source / existing destination (a bare `hadoop fs -mv`
-        # onto an existing dir would silently nest the source into it)
-        if not self.is_exist(src_path):
-            if test_exists:
-                raise FSFileNotExistsError(f"{src_path} not found")
-            return
-        if self.is_exist(dst_path):
-            if not overwrite:
-                raise FSFileExistsError(f"{dst_path} exists")
-            self.delete(dst_path)
+        # honor the FS contract LocalFS implements (typed errors for a
+        # missing source / existing destination — a bare `hadoop fs
+        # -mv` onto an existing dir silently nests the source into it)
+        # with the fewest CLI round-trips (each is a JVM start):
+        if test_exists and not self.is_exist(src_path):
+            raise FSFileNotExistsError(f"{src_path} not found")
+        if overwrite:
+            self.delete(dst_path)        # -rm -f: no error if absent
+        elif self.is_exist(dst_path):
+            raise FSFileExistsError(f"{dst_path} exists")
+        # (without test_exists a missing source surfaces as the CLI's
+        # own RuntimeError rather than LocalFS's silent return)
         self._run("-mv", src_path, dst_path)
 
     def touch(self, fs_path, exist_ok=True):

@@ -413,12 +413,11 @@ class TestAttention:
         assert out.shape == [2, 8, 2, 16]
 
 
-class TestMHAFusedQKV:
-    """The fused self-attention QKV path (r4) must not bypass wrapped
-    projections (quantization observers) and must match the unfused
-    branch exactly."""
+class TestMHAQuantized:
+    """MHA forward must route through (possibly wrapped) projection
+    layers — quantization observers/QAT wrappers replace the Linears."""
 
-    def test_fused_matches_unfused(self):
+    def test_self_attn_implicit_equals_explicit(self):
         paddle.seed(0)
         mha = nn.MultiHeadAttention(16, 4)
         x = paddle.to_tensor(
@@ -442,5 +441,5 @@ class TestMHAFusedQKV:
         net = PTQ().quantize(Net())
         x = paddle.to_tensor(
             np.random.RandomState(1).randn(2, 4, 16).astype("float32"))
-        out = net(x)  # crashed pre-fix: fused branch read .weight
+        out = net(x)
         assert list(out.shape) == [2, 4, 16]
