@@ -238,6 +238,11 @@ class HDFSClient(FS):
         if test_exists and not self.is_exist(src_path):
             raise FSFileNotExistsError(f"{src_path} not found")
         if overwrite:
+            # confirm the source exists BEFORE destroying the
+            # destination — otherwise a missing src leaves dst deleted
+            # with nothing moved in (ADVICE r4)
+            if not (test_exists or self.is_exist(src_path)):
+                raise FSFileNotExistsError(f"{src_path} not found")
             self.delete(dst_path)        # -rm -f: no error if absent
         elif self.is_exist(dst_path):
             raise FSFileExistsError(f"{dst_path} exists")

@@ -218,10 +218,17 @@ def _launch_multinode(args) -> int:
             master.store.set(coord_key,
                              f"{peers[0]['host']}:{_free_port()}")
         coord = master.store.get(coord_key).decode()
+        # real per-rank ports (single-node convention: base_port+i per
+        # node) so ParallelEnv endpoints are distinct and addressable
+        # rather than duplicate host:0 placeholders (ADVICE r4)
+        base_port = int(args.master.rsplit(":", 1)[1]) + 1
         endpoints = []
-        for nr, peer in enumerate(peers):
-            for lr in range(peer["nproc"]):
-                endpoints.append(f"{peer['host']}:0")
+        rank_off = 0  # global offset: two nodes on one host (a
+        for nr, peer in enumerate(peers):  # supported topology) must
+            for lr in range(peer["nproc"]):  # not reuse ports
+                endpoints.append(
+                    f"{peer['host']}:{base_port + rank_off + lr}")
+            rank_off += peer["nproc"]
         endpoints = ",".join(endpoints)
         master.start_heartbeat(node_rank, generation)
 

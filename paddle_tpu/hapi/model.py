@@ -67,13 +67,19 @@ class Model:
                 and not isinstance(self.network, dist.DataParallel)):
             self.network = dist.DataParallel(self.network)
             self._distributed = True
+        self._amp_level = None
+        self._amp_dtype = "bfloat16"
         if isinstance(amp_configs, (str, dict)):
             level = amp_configs if isinstance(amp_configs, str) \
                 else amp_configs.get("level", "O1")
+            self._amp_level = level if level in ("O1", "O2") else None
+            if isinstance(amp_configs, dict):
+                self._amp_dtype = amp_configs.get("dtype", "bfloat16")
             if level == "O2" and optimizer is not None:
                 from ..amp import decorate
 
-                decorate(self.network, optimizer, level="O2")
+                decorate(self.network, optimizer, level="O2",
+                         dtype=self._amp_dtype)
         return self
 
     def _ensure_step(self):
@@ -85,11 +91,20 @@ class Model:
                 # DP runs on the eager tape: the DataParallel backward-
                 # final hook performs the bucketed grad allreduce (the
                 # reference dygraph adapter's reducer path)
+                from ..amp import auto_cast
+
                 def eager_step(inputs, labels):
-                    out = self.network(*inputs)
-                    outs = out if isinstance(out, (list, tuple)) \
-                        else (out,)
-                    loss = self._loss(*outs, *labels)
+                    # honor prepare(amp_configs=...) on the DP eager
+                    # path too (ADVICE r4: it used to silently run
+                    # fp32 under the launcher); O1 autocasts here, O2
+                    # was applied as decorate in prepare()
+                    level = getattr(self, "_amp_level", None)
+                    with auto_cast(enable=level == "O1",
+                                   dtype=self._amp_dtype):
+                        out = self.network(*inputs)
+                        outs = out if isinstance(out, (list, tuple)) \
+                            else (out,)
+                        loss = self._loss(*outs, *labels)
                     loss.backward()
                     self._optimizer.step()
                     self._optimizer.clear_grad()
@@ -99,8 +114,10 @@ class Model:
             else:
                 from ..jit.train_step import TrainStep
 
-                self._train_step = TrainStep(self.network, self._loss,
-                                             self._optimizer)
+                self._train_step = TrainStep(
+                    self.network, self._loss, self._optimizer,
+                    amp_level=getattr(self, "_amp_level", None),
+                    amp_dtype=getattr(self, "_amp_dtype", "bfloat16"))
         return self._train_step
 
     def _loader(self, data, batch_size, shuffle, drop_last, num_workers):

@@ -35,10 +35,16 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn: Callable, optimizer,
-                 in_sharding=None, donate: bool = True):
+                 in_sharding=None, donate: bool = True,
+                 amp_level: Optional[str] = None,
+                 amp_dtype: str = "bfloat16"):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # O1 autocast applied around the traced forward+loss (O2 is a
+        # param-dtype property via amp.decorate and needs nothing here)
+        self._amp_level = amp_level if amp_level == "O1" else None
+        self._amp_dtype = amp_dtype
         self._params = [p for _, p in model.named_parameters()]
         self._buffers = [b for _, b in model.named_buffers()]
         self._trainable_idx = [i for i, p in enumerate(self._params)
@@ -81,9 +87,14 @@ class TrainStep:
             full = list(param_arrays)
             for i, a in zip(t_idx, trainable_arrays):
                 full[i] = a
+            from ..amp import auto_cast
+
+            amp_ctx = auto_cast(enable=self._amp_level is not None,
+                                level=self._amp_level or "O1",
+                                dtype=self._amp_dtype)
             with _SwappedState(params + buffers,
                                full + list(buffer_arrays)), \
-                    use_trace_key(key), engine.no_grad():
+                    use_trace_key(key), engine.no_grad(), amp_ctx:
                 inputs = [Tensor(a) for a in input_arrays]
                 labels = [Tensor(a, stop_gradient=True)
                           for a in label_arrays]

@@ -108,8 +108,11 @@ class BertForPretraining(Layer):
         h = self.transform_norm(self.transform_act(self.transform(seq)))
         # tied decoder: h @ word_embeddings.T + bias
         w = self.bert.embeddings.word_embeddings.weight
+        # bias must be the Parameter itself so BOTH the eager tape and
+        # the traced path see a trainable leaf (ADVICE r4: wrapping in a
+        # fresh Tensor made it stop_gradient on the tape)
         mlm_logits = paddle.matmul(h, w, transpose_y=True) \
-            + paddle.Tensor(self.decoder_bias._data)
+            + self.decoder_bias
         nsp_logits = self.nsp(pooled)
         return mlm_logits, nsp_logits
 

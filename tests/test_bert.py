@@ -82,6 +82,25 @@ class TestBertModel:
         np.testing.assert_allclose(float(loss2.numpy()),
                                    float(ref_nsp.numpy()), rtol=1e-5)
 
+    def test_decoder_bias_gets_eager_tape_grad(self):
+        """ADVICE r4 regression: the MLM decoder bias must be a
+        trainable leaf on the eager autograd tape (the DataParallel /
+        hapi path), not just under jit.TrainStep."""
+        paddle.seed(0)
+        model = BertForPretraining(bert_tiny())
+        crit = BertPretrainingCriterion()
+        rng = np.random.RandomState(4)
+        ids, types, mask, mlm, nsp = _batch(rng)
+        mlm_logits, nsp_logits = model(
+            paddle.to_tensor(ids), paddle.to_tensor(types),
+            paddle.to_tensor(mask))
+        loss = crit(mlm_logits, nsp_logits, paddle.to_tensor(mlm),
+                    paddle.to_tensor(nsp))
+        loss.backward()
+        g = model.decoder_bias.grad
+        assert g is not None
+        assert float(np.abs(g.numpy()).sum()) > 0
+
     def test_pretraining_converges_in_train_step(self):
         paddle.seed(0)
         model = BertForPretraining(bert_tiny())

@@ -87,6 +87,35 @@ class TestCrypto:
         c = Cipher(b"0" * 32)
         assert c.encrypt(b"x") != c.encrypt(b"x")
 
+    def test_v1_format_still_decrypts(self):
+        """Blobs written by the r4 per-block-HMAC format (v1 magic)
+        must keep decrypting after the SHAKE-256 v2 keystream switch."""
+        import hashlib
+        import hmac as hmac_mod
+
+        from paddle_tpu.utils import crypto as C
+
+        c = Cipher(b"0" * 32)
+        msg = os.urandom(4096) + b"legacy"
+        nonce = os.urandom(16)
+        ks = c._keystream_v1(nonce, len(msg))
+        ct = c._xor(msg, ks)
+        tag = hmac_mod.new(c._mac_key, C._MAGIC_V1 + nonce + ct,
+                           hashlib.sha256).digest()
+        assert c.decrypt(C._MAGIC_V1 + nonce + tag + ct) == msg
+
+    def test_keystream_is_one_shot_xof(self):
+        """v2 keystream must be the single-call SHAKE-256 XOF (the
+        revert-to-per-block-HMAC-loop regression, ADVICE r4) —
+        asserted structurally, no load-sensitive wall-clock bound."""
+        import hashlib
+
+        c = Cipher(b"0" * 32)
+        nonce = b"n" * 16
+        n = 1 << 20
+        assert c._keystream(nonce, n) == \
+            hashlib.shake_256(c._enc_key + nonce).digest(n)
+
     def test_encrypted_model_artifact_roundtrip(self, tmp_path):
         """End-to-end: encrypt a jit.save params artifact at rest."""
         import paddle_tpu as paddle
