@@ -74,14 +74,19 @@ def black_list() -> Set[str]:
 
 
 def _amp_cast_arrays(op_name: str, arrays):
-    """Dispatch-time cast hook; no-op when autocast is off."""
+    """Dispatch-time cast hook; no-op when autocast is off.
+
+    O1: white list → low precision, black list → fp32, rest untouched.
+    O2: EVERYTHING → low precision except the black list (reference O2
+    semantics — without this, fp32 activations re-promote bf16-decorated
+    params to fp32 at every op under jnp promotion rules)."""
     if not _STATE.enabled:
         return arrays
     target = None
-    if op_name in _STATE.eff_white:
-        target = _STATE.dtype
-    elif op_name in _STATE.eff_black:
+    if op_name in _STATE.eff_black:
         target = jnp.float32
+    elif _STATE.level == "O2" or op_name in _STATE.eff_white:
+        target = _STATE.dtype
     if target is None:
         return arrays
     out = []

@@ -558,8 +558,16 @@ class PipelineParallel(Layer):
         return jax.jit(step)
 
     def _split_micro_arrays(self, data):
-        """Global batch tensor(s) → [M, micro_batch, ...] arrays."""
+        """Global batch tensor(s) → [M, micro_batch, ...] arrays. When
+        the topology has a dp axis, each micro-batch is sharded over it
+        — dp, mp and pp then compose inside the ONE compiled step (the
+        reference needs a separate DP reducer around the pipeline;
+        here GSPMD derives the dp grad all-reduce from the input
+        sharding — reference: test/collective/multinode/
+        dygraph_hybrid_dpppmp.py composes the same three axes)."""
         n = self.accumulate_steps
+        dp_deg = self._hcg.get_data_parallel_world_size()
+        dp_mesh = self._hcg.mesh.jax_mesh() if dp_deg > 1 else None
 
         def one(d):
             arr = d._data if isinstance(d, Tensor) else jnp.asarray(d)
@@ -567,7 +575,14 @@ class PipelineParallel(Layer):
                 raise ValueError(
                     f"batch dim {arr.shape[0]} not divisible by "
                     f"accumulate_steps {n}")
-            return arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
+            arr = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
+            if dp_mesh is not None and arr.shape[1] % dp_deg == 0:
+                import jax as _jax
+
+                sh = NamedSharding(dp_mesh, PartitionSpec(
+                    None, "dp", *([None] * (arr.ndim - 2))))
+                arr = _jax.device_put(arr, sh)
+            return arr
 
         if isinstance(data, (tuple, list)):
             return tuple(one(d) for d in data)

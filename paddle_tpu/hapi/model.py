@@ -96,10 +96,11 @@ class Model:
                 def eager_step(inputs, labels):
                     # honor prepare(amp_configs=...) on the DP eager
                     # path too (ADVICE r4: it used to silently run
-                    # fp32 under the launcher); O1 autocasts here, O2
-                    # was applied as decorate in prepare()
+                    # fp32 under the launcher); O2 additionally had
+                    # its params cast by decorate() in prepare()
                     level = getattr(self, "_amp_level", None)
-                    with auto_cast(enable=level == "O1",
+                    with auto_cast(enable=level in ("O1", "O2"),
+                                   level=level or "O1",
                                    dtype=self._amp_dtype):
                         out = self.network(*inputs)
                         outs = out if isinstance(out, (list, tuple)) \

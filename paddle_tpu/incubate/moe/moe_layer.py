@@ -96,9 +96,23 @@ class MoELayer(Layer):
                  num_experts: Optional[int] = None, top_k: int = 2,
                  d_hidden: Optional[int] = None, capacity_factor=1.25,
                  moe_group=None, mp_group=None, recompute_interval=0,
-                 name=None):
+                 ep_mesh=None, name=None):
         super().__init__()
+        # ep_mesh=(mesh, axis_name): explicit expert parallelism via the
+        # all-to-all dispatch the reference's MoE stack uses (reference:
+        # incubate/distributed/models/moe/global_scatter → all-to-all;
+        # moe/gate communication in moe_layer.py). Tokens stay sharded on
+        # `axis`, experts live sharded on `axis`, and the dispatch /
+        # combine are two lax.all_to_all inside a shard_map — O(tokens)
+        # comm instead of the dense one-hot partial-sum reduce that the
+        # GSPMD lowering of the einsum form produces.
+        self._ep_mesh = ep_mesh
         if isinstance(experts, (list, LayerList)):
+            if ep_mesh is not None:
+                raise ValueError(
+                    "ep_mesh expert parallelism needs the stacked "
+                    "ExpertFFN form (pass num_experts/d_hidden or an "
+                    "ExpertFFN, not a list of per-expert Layers)")
             self.experts = LayerList(list(experts))
             num_experts = len(self.experts)
             self.stacked = None
@@ -127,6 +141,74 @@ class MoELayer(Layer):
                                        capacity_factor)
         self.aux_loss: Optional[Tensor] = None
 
+    def _ep_forward(self, x):
+        """Expert-parallel stacked path: shard_map over the ep axis with
+        all-to-all dispatch/combine (see __init__ ep_mesh note)."""
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax.experimental.shard_map import shard_map
+        except ImportError:  # jax >= 0.7 moved it
+            from jax import shard_map
+
+        mesh, axis = self._ep_mesh
+        jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+        ep = jmesh.shape[axis]
+        E, K, d = self.num_experts, self.top_k, self.d_model
+        if E % ep:
+            raise ValueError(f"num_experts {E} not divisible by "
+                             f"ep degree {ep}")
+        orig_shape = x.shape
+        # shard_map shards the LEADING dim — that is the divisibility
+        # that matters, not the flattened token count
+        if orig_shape[0] % ep:
+            raise ValueError(f"batch dim {orig_shape[0]} not divisible "
+                             f"by ep degree {ep}")
+        tokens = int(np.prod(orig_shape[:-1]))
+        # capacity is per (expert, shard): receive buffers CONCAT across
+        # shards (no cross-shard sum), which is what makes the exchange
+        # an all-to-all instead of a reduce
+        capacity = max(int(math.ceil((tokens // ep) * K *
+                                     self.capacity_factor / E)), 1)
+        st = self.stacked
+        act = jax.nn.gelu if st.activation == "gelu" else jax.nn.relu
+        aux_w = getattr(self.gate, "aux_loss_weight", 0.0)
+        nd = len(orig_shape)
+        x_spec = P(*([axis] + [None] * (nd - 1)))
+        w_spec = P(axis)
+
+        def raw(xa, wg, w1, b1, w2, b2):
+            def body(x_loc, wg_, w1_loc, b1_loc, w2_loc, b2_loc):
+                xt = x_loc.reshape(-1, d)
+                probs = jax.nn.softmax(xt @ wg_, -1)
+                combine, dispatch, aux = _gshard_dispatch(
+                    probs, E, K, capacity)
+                exp_in = jnp.einsum("tec,td->ecd", dispatch, xt)
+                # [E, c, d] -> [E/ep, ep*c, d]: rows for MY experts from
+                # every shard land here, capacities concatenated
+                recv = jax.lax.all_to_all(exp_in, axis, split_axis=0,
+                                          concat_axis=1, tiled=True)
+                h = act(jnp.einsum("ecd,edf->ecf", recv, w1_loc) + b1_loc)
+                out = jnp.einsum("ecf,efd->ecd", h, w2_loc) + b2_loc
+                # reverse exchange: [E/ep, ep*c, d] -> [E, c, d]
+                back = jax.lax.all_to_all(out, axis, split_axis=1,
+                                          concat_axis=0, tiled=True)
+                y = jnp.einsum("tec,ecd->td", combine, back)
+                return y.reshape(x_loc.shape), jax.lax.pmean(aux, axis)
+
+            return shard_map(
+                body, mesh=jmesh,
+                in_specs=(x_spec, P(), w_spec, w_spec, w_spec, w_spec),
+                out_specs=(x_spec, P()))(xa, wg, w1, b1, w2, b2)
+
+        tensors = as_tensor_args(x, self.gate.weight, st.w1, st.b1,
+                                 st.w2, st.b2)
+        out, aux = eager_apply("moe_layer_ep", raw, tensors, n_outputs=2)
+        self.aux_loss = aux * aux_w if aux_w else aux
+        return out
+
     def forward(self, x):
         orig_shape = x.shape
         d = self.d_model
@@ -135,6 +217,9 @@ class MoELayer(Layer):
         capacity = max(int(math.ceil(tokens * K * self.capacity_factor / E)),
                        1)
         aux_w = getattr(self.gate, "aux_loss_weight", 0.0)
+
+        if self._ep_mesh is not None and self.stacked is not None:
+            return self._ep_forward(x)
 
         if self.stacked is not None:
             st = self.stacked
@@ -198,11 +283,18 @@ def _gshard_dispatch(probs, E, K, capacity):
 
     combine = jnp.zeros((T, E, capacity), probs.dtype)
     dispatch = jnp.zeros((T, E, capacity), probs.dtype)
+    # running per-expert slot base across the K passes: k=0 assignments
+    # claim the leading slots, k=1 continues after them (GShard's
+    # priority ordering) — WITHOUT this, pass k's counts restart at 0
+    # and two different tokens share a slot, so the expert sees the SUM
+    # of their activations (r5 fix; pinned by the identity-property test)
+    base = jnp.zeros((E,), probs.dtype)
     for k in range(K):
         idx = topk_idx[:, k]                                  # [T]
         onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)    # [T, E]
         # position within expert buffer (running count per expert)
-        pos_in_e = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [T, E]
+        pos_in_e = (jnp.cumsum(onehot, axis=0) - 1
+                    + base[None, :]) * onehot                 # [T, E]
         pos = jnp.sum(pos_in_e, axis=-1).astype(jnp.int32)    # [T]
         keep = pos < capacity
         pos_cap = jnp.clip(pos, 0, capacity - 1)
@@ -212,6 +304,7 @@ def _gshard_dispatch(probs, E, K, capacity):
         disp_k = mask[:, :, None] * cap_onehot[:, None, :]    # [T, E, C]
         dispatch = dispatch + disp_k
         combine = combine + disp_k * topk_val[:, k][:, None, None]
+        base = base + jnp.sum(onehot, axis=0)
 
     # load-balance aux loss (gshard): E * sum_e(frac_tokens_e * mean_prob_e)
     me = jnp.mean(probs, axis=0)

@@ -15,7 +15,65 @@ import jax.numpy as jnp
 
 from ...core.tensor import Tensor
 
-__all__ = ["LookAhead", "ModelAverage"]
+__all__ = ["LookAhead", "ModelAverage", "LocalSGD"]
+
+
+class LocalSGD:
+    """Local SGD (reference:
+    distributed/fleet/meta_optimizers/localsgd_optimizer.py
+    LocalSGDOptimizer): run ``k_steps`` purely-local inner steps, then
+    synchronize by averaging parameters across the data-parallel group
+    — trading gradient-every-step communication for param-every-k.
+    Wrap any pytree optimizer; with no initialized parallel env (or a
+    1-process world) the sync is a no-op and the wrapper is just the
+    inner optimizer.
+
+    The reference implements this as a static-graph meta-optimizer
+    rewriting the program with snapshot vars + c_allreduce; here the
+    sync is one eager collective per param every k steps.
+    """
+
+    def __init__(self, inner_optimizer, k_steps: int = 1):
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = k_steps
+        self._step_count = 0
+
+    @property
+    def _params(self) -> List[Tensor]:
+        return self.inner_optimizer._parameter_list
+
+    def _sync(self):
+        import paddle_tpu.distributed as dist
+
+        if not (dist.is_initialized() and dist.get_world_size() > 1):
+            return
+        scale = 1.0 / dist.get_world_size()
+        for p in self._params:
+            t = Tensor(p._data * scale)
+            dist.all_reduce(t)
+            p._rebind(t._data)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k_steps == 0:
+            self._sync()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def __getattr__(self, item):
+        if item == "inner_optimizer":  # pickle/copy before __init__
+            raise AttributeError(item)
+        return getattr(self.inner_optimizer, item)
 
 
 class LookAhead:

@@ -41,9 +41,11 @@ class TrainStep:
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
-        # O1 autocast applied around the traced forward+loss (O2 is a
-        # param-dtype property via amp.decorate and needs nothing here)
-        self._amp_level = amp_level if amp_level == "O1" else None
+        # autocast applied around the traced forward+loss: O1 = per-op
+        # white/black lists; O2 = cast-everything-except-blacklist (the
+        # decorate() param cast alone is not enough — fp32 activations
+        # would re-promote bf16 params at every op)
+        self._amp_level = amp_level if amp_level in ("O1", "O2") else None
         self._amp_dtype = amp_dtype
         self._params = [p for _, p in model.named_parameters()]
         self._buffers = [b for _, b in model.named_buffers()]

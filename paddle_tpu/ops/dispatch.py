@@ -18,6 +18,8 @@ from ops.yaml + backward.yaml.
 """
 from __future__ import annotations
 
+import weakref
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -28,6 +30,99 @@ from ..core.flags import flag
 from ..core.tensor import Tensor
 
 __all__ = ["eager_apply", "as_tensor_args", "defun"]
+
+
+# ---------------------------------------------------------------------------
+# Taped-backward vjp cache.
+#
+# ``jax.vjp`` retraces the op on every tape-recorded call (~750µs/op on the
+# tunneled chip — OPBENCH r4), which eager ``backward()`` training pays per
+# op per step. The reference amortizes this with codegen'd GradNodes
+# (eager_gen.py); we amortize it by jitting the (primals, residuals) forward
+# and the residual->cotangent backward once per (op, static kwargs, input
+# avals) — the same aval-keyed trick that fixed eager flash-attention
+# forwards in r4. Residuals cross the jit boundary as flattened leaves (the
+# VJP pytree's treedef is cached host-side; hashing it per call is what made
+# the naive "return the VJP object" scheme slow).
+#
+# Admission: an entry is built only for a ``raw_fn`` OBJECT seen at least
+# twice (weakref sighting). Per-call closures — dropout's fresh mask,
+# gumbel's noise — get a fresh function object every call, so they are never
+# admitted, which is also what makes caching them SAFE to skip: their closed-
+# over randomness must not be baked into a compiled trace. Ops whose trace
+# needs concrete values (TracerBool/Concretization errors under jit) are
+# blocklisted on first failure and permanently fall back to plain jax.vjp.
+# ---------------------------------------------------------------------------
+
+class _CachedVJP:
+    __slots__ = ("fwd", "bwd", "box", "raw_fn")
+
+    def __init__(self, op_name, raw_fn, static_kwargs, n_args, diff_idx):
+        self.raw_fn = raw_fn  # strong ref: pins id() while entry lives
+        self.box = box = {}
+        const_idx = [i for i in range(n_args) if i not in set(diff_idx)]
+        from jax import tree_util as jtu
+
+        def fwd(*arrays):
+            cmap = {i: arrays[i] for i in const_idx}
+
+            def f(*diff):
+                full = _interleave(cmap, n_args, diff)
+                out = raw_fn(*full, **static_kwargs)
+                box["was_tuple"] = isinstance(out, tuple)
+                return out if isinstance(out, tuple) else (out,)
+
+            primals, vf = jax.vjp(f, *(arrays[i] for i in diff_idx))
+            leaves, td = jtu.tree_flatten(vf)
+            box["td"], box["n_out"] = td, len(primals)
+            box["n_res"] = len(leaves)
+            return tuple(primals) + tuple(leaves)
+
+        def bwd(*args):
+            vf = jtu.tree_unflatten(box["td"], list(args[:box["n_res"]]))
+            return tuple(vf(tuple(args[box["n_res"]:])))
+
+        self.fwd = jax.jit(fwd)
+        self.bwd = jax.jit(bwd)
+
+
+_VJP_CACHE: "OrderedDict[tuple, _CachedVJP]" = OrderedDict()
+_VJP_CACHE_MAX = 1024
+_VJP_BLOCK: set = set()          # keys whose trace needs concrete values
+_VJP_SEEN: Dict[int, Any] = {}   # id(raw_fn) -> weakref (admission count)
+
+
+def _vjp_cache_key(raw_fn, static_kwargs, arrays, diff_idx):
+    """Hashable cache key, or None when static kwargs aren't hashable
+    (arrays, lists) — those calls just use plain jax.vjp."""
+    try:
+        skey = tuple(sorted(static_kwargs.items()))
+        hash(skey)
+    except TypeError:
+        return None
+    avals = tuple(
+        (a.shape, str(a.dtype), bool(getattr(a, "weak_type", False)))
+        for a in arrays)
+    return (id(raw_fn), skey, avals, tuple(diff_idx))
+
+
+def _vjp_cache_admit(key, op_name, raw_fn, static_kwargs, n_args,
+                     diff_idx):
+    """After a successful uncached call: build an entry on the second
+    sighting of the same raw_fn object (first sighting just records a
+    weakref — per-call closures never come back, so never pollute)."""
+    ref = _VJP_SEEN.get(id(raw_fn))
+    if ref is None or ref() is not raw_fn:
+        _VJP_SEEN[id(raw_fn)] = weakref.ref(raw_fn)
+        if len(_VJP_SEEN) > 4 * _VJP_CACHE_MAX:
+            dead = [k for k, r in _VJP_SEEN.items() if r() is None]
+            for k in dead:
+                del _VJP_SEEN[k]
+        return
+    _VJP_CACHE[key] = _CachedVJP(op_name, raw_fn, static_kwargs, n_args,
+                                 diff_idx)
+    while len(_VJP_CACHE) > _VJP_CACHE_MAX:
+        _VJP_CACHE.popitem(last=False)
 
 
 def _is_diff_dtype(arr) -> bool:
@@ -116,19 +211,49 @@ def eager_apply(
         if (not t.stop_gradient) and _is_diff_dtype(t._data)
     ]
     diff_set = set(diff_idx)
-    const_arrays = {i: a for i, a in enumerate(arrays) if i not in diff_set}
 
-    was_tuple = [False]
+    cache_key = _vjp_cache_key(raw_fn, static_kwargs, arrays, diff_idx)
+    if cache_key is not None and cache_key in _VJP_BLOCK:
+        cache_key = None
+    entry = _VJP_CACHE.get(cache_key) if cache_key is not None else None
 
-    def f(*diff_arrays):
-        full = _interleave(const_arrays, len(arrays), diff_arrays)
-        out = raw_fn(*full, **static_kwargs)
-        was_tuple[0] = isinstance(out, tuple)
-        return out if isinstance(out, tuple) else (out,)
+    primals_out = vjp_fn = None
+    if entry is not None:
+        try:
+            out_flat = entry.fwd(*arrays)
+        except (jax.errors.JAXTypeError, jax.errors.UnexpectedTracerError):
+            # trace needs concrete values — permanent plain-vjp fallback
+            # (cache_key cleared so the fallback below can't re-admit a
+            # zombie entry under the blocked key)
+            _VJP_BLOCK.add(cache_key)
+            del _VJP_CACHE[cache_key]
+            cache_key = None
+        else:
+            box = entry.box
+            primals_out = out_flat[:box["n_out"]]
+            res_leaves = out_flat[box["n_out"]:]
+            bwd = entry.bwd
+            vjp_fn = lambda cots, _b=bwd, _r=res_leaves: _b(*_r, *cots)
+            if n_outputs is None:
+                n_outputs = box["n_out"] if box["was_tuple"] else 1
 
-    primals_out, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
-    if n_outputs is None:  # auto: single unless raw returned a tuple
-        n_outputs = len(primals_out) if was_tuple[0] else 1
+    if primals_out is None:
+        const_arrays = {i: a for i, a in enumerate(arrays)
+                        if i not in diff_set}
+        was_tuple = [False]
+
+        def f(*diff_arrays):
+            full = _interleave(const_arrays, len(arrays), diff_arrays)
+            out = raw_fn(*full, **static_kwargs)
+            was_tuple[0] = isinstance(out, tuple)
+            return out if isinstance(out, tuple) else (out,)
+
+        primals_out, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
+        if n_outputs is None:  # auto: single unless raw returned a tuple
+            n_outputs = len(primals_out) if was_tuple[0] else 1
+        if cache_key is not None:
+            _vjp_cache_admit(cache_key, op_name, raw_fn, static_kwargs,
+                             len(arrays), diff_idx)
 
     if flag("check_nan_inf"):
         _check_finite(op_name, primals_out)

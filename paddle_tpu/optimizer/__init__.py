@@ -1,11 +1,13 @@
 """paddle_tpu.optimizer — mirrors python/paddle/optimizer."""
 from . import lr  # noqa: F401
-from .adam import Adam, AdamW, Lamb  # noqa: F401
+from .adam import Adam, Adamax, AdamW, Lamb  # noqa: F401
+from .lars import Lars  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizer import (  # noqa: F401
-    Adadelta, Adagrad, Momentum, Optimizer, RMSProp, SGD,
+    Adadelta, Adagrad, Momentum, Optimizer, RMSProp, Rprop, SGD,
 )
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
-    "Adam", "AdamW", "Lamb", "lr",
+    "Rprop", "Adam", "AdamW", "Adamax", "Lamb", "LBFGS", "Lars", "lr",
 ]

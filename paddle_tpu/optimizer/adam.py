@@ -10,7 +10,7 @@ from ..core.tensor import Tensor
 from ..regularizer import L2Decay
 from .optimizer import Optimizer
 
-__all__ = ["Adam", "AdamW", "Lamb"]
+__all__ = ["Adam", "AdamW", "Lamb", "Adamax"]
 
 
 class Adam(Optimizer):
@@ -67,6 +67,40 @@ class Adam(Optimizer):
         if self._amsgrad:
             st["moment2_max"] = self._moment_store(m2_max)
         return new_p, st
+
+
+class Adamax(Optimizer):
+    """Adamax — Adam with an infinity-norm second moment (reference:
+    python/paddle/optimizer/adamax.py:27, kernel
+    phi/kernels/impl/adamax_kernel_impl.h): m = b1*m + (1-b1)*g,
+    u = max(|g|, b2*u + eps), p -= lr/(1-b1^t) * m/u. No bias
+    correction on u (the max recursion is already scale-stable); the
+    epsilon rides inside the max (keeps u > 0), reference semantics."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        return {
+            "moment": jnp.zeros_like(p._data),
+            "inf_norm": jnp.zeros_like(p._data),
+            "beta1_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _rule(self, p, g, state, hyper):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(jnp.abs(g), b2 * state["inf_norm"] + eps)
+        b1p = state["beta1_pow"] * b1
+        lr_t = hyper["lr"] / (1 - b1p).astype(p.dtype)
+        new_p = p - lr_t * m / u
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
 
 
 class AdamW(Adam):

@@ -1,0 +1,69 @@
+"""LARS — layer-wise adaptive rate scaling for large-batch SGD.
+
+TPU-native counterpart of the reference's LARS stack (reference:
+python/paddle/distributed/fleet/meta_optimizers/lars_optimizer.py wraps
+fluid's LarsMomentumOptimizer; kernel
+phi/kernels/gpu/lars_momentum_kernel.cu). Here it is a plain pytree
+optimizer — the trust-ratio rule runs inside the same single compiled
+multi-tensor update every other optimizer uses, so it composes with
+TrainStep/data-parallel meshes with no meta-optimizer plumbing.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["Lars"]
+
+
+class Lars(Optimizer):
+    """Per-layer trust ratio:
+
+        local_lr = lr * lars_coeff * ||p|| /
+                   (||g|| + lars_weight_decay * ||p|| + epsilon)
+        v        = momentum * v + local_lr * (g + lars_weight_decay * p)
+        p       -= v
+
+    ``exclude_from_weight_decay`` entries (name substrings, reference
+    semantics) skip both the decay term and the trust-ratio scaling —
+    those layers fall back to plain momentum SGD.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, epsilon=0.0,
+                 exclude_from_weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+        self._epsilon = float(epsilon)
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._data)}
+
+    def _per_param_hyper(self, p):
+        h = super()._per_param_hyper(p)
+        excluded = any(s in (p.name or "") for s in self._exclude)
+        h["lars_mask"] = 0.0 if excluded else 1.0
+        return h
+
+    def _rule(self, p, g, state, hyper):
+        f32 = jnp.float32
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(f32))))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(f32))))
+        mask = hyper["lars_mask"]
+        wd = self._lars_wd * mask
+        trust = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self._lars_coeff * p_norm /
+            (g_norm + wd * p_norm + self._epsilon),
+            1.0)
+        local_lr = hyper["lr"] * jnp.where(mask > 0, trust, 1.0)
+        v = self._momentum * state["velocity"] + \
+            local_lr.astype(p.dtype) * (g + wd * p)
+        return p - v, {"velocity": v}

@@ -1,0 +1,299 @@
+"""L-BFGS with optional strong-Wolfe line search.
+
+TPU-native counterpart of the reference's full line-search optimizer
+(reference: python/paddle/optimizer/lbfgs.py:307 — ``LBFGS.step(closure)``
+re-evaluates the loss through a user closure; two-loop recursion over an
+(s, y) history approximates the inverse Hessian). Quasi-Newton iteration
+is inherently host-sequential (each inner iteration's direction depends on
+the previous loss/gradient values), so the driver loop runs in Python over
+FLAT device arrays: the two-loop recursion, directional derivatives, and
+parameter writes are jnp expressions XLA executes on-device; only the
+scalar loss/convergence checks cross to the host.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.engine import no_grad
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Minimum of the cubic through (x1,f1,g1),(x2,f2,g2), clipped to
+    bounds — the standard interpolation step of strong-Wolfe zoom."""
+    if bounds is not None:
+        lo, hi = bounds
+    else:
+        lo, hi = min(x1, x2), max(x1, x2)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    sq = d1 * d1 - g1 * g2
+    if sq >= 0:
+        d2 = np.sqrt(sq)
+        if x1 <= x2:
+            t = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            t = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(t, lo), hi)
+    return (lo + hi) / 2.0
+
+
+def _strong_wolfe(obj_func, t, d, f, g, gtd, c1=1e-4, c2=0.9,
+                  tolerance_change=1e-9, max_ls=25):
+    """Line search satisfying the strong Wolfe conditions (sufficient
+    decrease + curvature), bracketing then zooming with cubic
+    interpolation. ``obj_func(t)`` evaluates loss and flat grad at step
+    size t along d. Returns (f_new, g_new, t, n_evals)."""
+    d_norm = float(jnp.max(jnp.abs(d)))
+    f0, g0, gtd0 = f, g, gtd
+    t_prev, f_prev, g_prev, gtd_prev = 0.0, f0, g0, gtd0
+    ls_iter = 0
+    # --- bracketing phase ---
+    while ls_iter < max_ls:
+        f_new, g_new = obj_func(t)
+        gtd_new = float(jnp.dot(g_new, d))
+        ls_iter += 1
+        if f_new > f0 + c1 * t * gtd0 or (ls_iter > 1 and f_new >= f_prev):
+            bracket = [(t_prev, f_prev, g_prev, gtd_prev),
+                       (t, f_new, g_new, gtd_new)]
+            break
+        if abs(gtd_new) <= -c2 * gtd0:
+            return f_new, g_new, t, ls_iter
+        if gtd_new >= 0:
+            bracket = [(t, f_new, g_new, gtd_new),
+                       (t_prev, f_prev, g_prev, gtd_prev)]
+            break
+        t_next = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_new,
+                                    gtd_new, bounds=(2 * t, 10 * t))
+        t_prev, f_prev, g_prev, gtd_prev = t, f_new, g_new, gtd_new
+        t = t_next
+    else:
+        bracket = [(0.0, f0, g0, gtd0), (t, f_new, g_new, gtd_new)]
+    # --- zoom phase ---
+    while ls_iter < max_ls:
+        lo, hi = (bracket[0], bracket[1]) \
+            if bracket[0][1] <= bracket[1][1] else (bracket[1], bracket[0])
+        if abs(hi[0] - lo[0]) * d_norm < tolerance_change:
+            break
+        t = _cubic_interpolate(bracket[0][0], bracket[0][1], bracket[0][3],
+                               bracket[1][0], bracket[1][1], bracket[1][3])
+        f_new, g_new = obj_func(t)
+        gtd_new = float(jnp.dot(g_new, d))
+        ls_iter += 1
+        if f_new > f0 + c1 * t * gtd0 or f_new >= lo[1]:
+            hi_new = (t, f_new, g_new, gtd_new)
+            bracket = [lo, hi_new]
+        else:
+            if abs(gtd_new) <= -c2 * gtd0:
+                return f_new, g_new, t, ls_iter
+            if gtd_new * (hi[0] - lo[0]) >= 0:
+                bracket = [(t, f_new, g_new, gtd_new), lo]
+            else:
+                bracket = [(t, f_new, g_new, gtd_new), hi]
+    lo = bracket[0] if bracket[0][1] <= bracket[1][1] else bracket[1]
+    return lo[1], lo[2], lo[0], ls_iter
+
+
+class LBFGS(Optimizer):
+    """``step(closure)`` minimizes the closure's loss with L-BFGS
+    (reference API: optimizer/lbfgs.py:307). The closure must
+    zero grads, compute the loss, call backward, and return the loss."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn: Optional[str] = None,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                f"line_search_fn must be None or 'strong_wolfe', "
+                f"got {line_search_fn!r}")
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history_size = history_size
+        self._line_search_fn = line_search_fn
+        # global (not per-param) quasi-Newton state over the flat vector
+        self._state = {"n_func_evals": 0, "n_iter": 0,
+                       "old_sk": [], "old_yk": [], "ro": [],
+                       "d": None, "t": None, "prev_flat_grad": None,
+                       "H_diag": 1.0}
+
+    # ---- flat-vector plumbing ----
+    def _flat_params(self):
+        return jnp.concatenate(
+            [p._data.astype(jnp.float32).reshape(-1)
+             for p in self._parameter_list])
+
+    def _flat_grad(self):
+        parts = []
+        for p in self._parameter_list:
+            if p.grad is None:
+                parts.append(jnp.zeros(int(np.prod(p.shape) or 1),
+                                       jnp.float32))
+            else:
+                parts.append(p.grad._data.astype(jnp.float32).reshape(-1))
+        return jnp.concatenate(parts)
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p.shape) or 1)
+            p._rebind(flat[off:off + n].reshape(p.shape)
+                      .astype(p._data.dtype))
+            off += n
+
+    def _evaluate(self, closure, x, t, d):
+        """Loss and flat grad at x + t*d (params restored by caller)."""
+        self._set_flat_params(x + t * d)
+        loss = closure()
+        return float(loss.numpy() if isinstance(loss, Tensor) else loss), \
+            self._flat_grad()
+
+    @no_grad()
+    def step(self, closure: Callable = None):  # noqa: C901
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "re-evaluates the model and returns the loss")
+
+        from ..core.engine import enable_grad
+
+        def run_closure():
+            with enable_grad():
+                return closure()
+
+        st = self._state
+        lr = self.get_lr()
+        loss = run_closure()
+        orig_loss = loss
+        loss_f = float(loss.numpy() if isinstance(loss, Tensor) else loss)
+        st["n_func_evals"] += 1
+        current_evals = 1
+        flat_grad = self._flat_grad()
+        if float(jnp.max(jnp.abs(flat_grad))) <= self._tol_grad:
+            return orig_loss
+
+        n_iter = 0
+        while n_iter < self._max_iter:
+            n_iter += 1
+            st["n_iter"] += 1
+            # ---- direction: two-loop recursion over (s, y) history ----
+            if st["n_iter"] == 1:
+                d = -flat_grad
+                st["old_sk"], st["old_yk"], st["ro"] = [], [], []
+                st["H_diag"] = 1.0
+            else:
+                y = flat_grad - st["prev_flat_grad"]
+                s = st["d"] * st["t"]
+                ys = float(jnp.dot(y, s))
+                if ys > 1e-10:
+                    if len(st["old_yk"]) >= self._history_size:
+                        st["old_yk"].pop(0)
+                        st["old_sk"].pop(0)
+                        st["ro"].pop(0)
+                    st["old_yk"].append(y)
+                    st["old_sk"].append(s)
+                    st["ro"].append(1.0 / ys)
+                    st["H_diag"] = ys / float(jnp.dot(y, y))
+                num = len(st["old_yk"])
+                q = -flat_grad
+                al = [0.0] * num
+                for i in range(num - 1, -1, -1):
+                    al[i] = float(jnp.dot(st["old_sk"][i], q)) * st["ro"][i]
+                    q = q - al[i] * st["old_yk"][i]
+                d = q * st["H_diag"]
+                for i in range(num):
+                    be_i = float(jnp.dot(st["old_yk"][i], d)) * st["ro"][i]
+                    d = d + st["old_sk"][i] * (al[i] - be_i)
+            st["prev_flat_grad"] = flat_grad
+
+            # ---- step size ----
+            if st["n_iter"] == 1:
+                t = min(1.0, 1.0 / float(jnp.sum(jnp.abs(flat_grad)))) * lr
+            else:
+                t = lr
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -self._tol_change:
+                break
+
+            if self._line_search_fn == "strong_wolfe":
+                x_init = self._flat_params()
+
+                def obj_func(tt):
+                    f, g = self._evaluate(run_closure, x_init, tt, d)
+                    return f, g
+
+                loss_f, flat_grad, t, ls_evals = _strong_wolfe(
+                    obj_func, t, d, loss_f, flat_grad, gtd,
+                    tolerance_change=self._tol_change)
+                self._set_flat_params(x_init + t * d)
+                current_evals += ls_evals
+                st["n_func_evals"] += ls_evals
+            else:
+                self._set_flat_params(self._flat_params() + t * d)
+                if n_iter != self._max_iter:
+                    loss = run_closure()
+                    loss_f = float(loss.numpy()
+                                   if isinstance(loss, Tensor) else loss)
+                    flat_grad = self._flat_grad()
+                    current_evals += 1
+                    st["n_func_evals"] += 1
+            st["d"], st["t"] = d, t
+
+            # ---- convergence ----
+            if current_evals >= self._max_eval:
+                break
+            if float(jnp.max(jnp.abs(flat_grad))) <= self._tol_grad:
+                break
+            if float(jnp.max(jnp.abs(d * t))) <= self._tol_change:
+                break
+        return orig_loss
+
+    # the quasi-Newton state is global over the flat vector, not
+    # per-parameter — serialize it wholesale
+    def state_dict(self):
+        st = self._state
+        sd = {"n_func_evals": st["n_func_evals"], "n_iter": st["n_iter"],
+              "H_diag": st["H_diag"], "ro": list(st["ro"]),
+              "global_step": self._global_step}
+        for k in ("old_sk", "old_yk"):
+            for i, v in enumerate(st[k]):
+                sd[f"{k}_{i}"] = Tensor(v)
+        for k in ("d", "prev_flat_grad"):
+            if st[k] is not None:
+                sd[k] = Tensor(st[k])
+        if st["t"] is not None:
+            sd["t"] = st["t"]
+        return sd
+
+    def set_state_dict(self, sd):
+        st = self._state
+        st["n_func_evals"] = int(sd.get("n_func_evals", 0))
+        st["n_iter"] = int(sd.get("n_iter", 0))
+        st["H_diag"] = float(sd.get("H_diag", 1.0))
+        st["ro"] = list(sd.get("ro", []))
+        self._global_step = int(sd.get("global_step", 0))
+        for k in ("old_sk", "old_yk"):
+            vals = []
+            i = 0
+            while f"{k}_{i}" in sd:
+                v = sd[f"{k}_{i}"]
+                vals.append(v._data if isinstance(v, Tensor)
+                            else jnp.asarray(v))
+                i += 1
+            st[k] = vals
+        for k in ("d", "prev_flat_grad"):
+            if k in sd:
+                v = sd[k]
+                st[k] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+        if "t" in sd:
+            st["t"] = float(sd["t"])

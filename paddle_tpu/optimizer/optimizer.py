@@ -24,7 +24,8 @@ from ..nn.clip import ClipGradBase
 from ..regularizer import WeightDecayRegularizer, L2Decay
 from .lr import LRScheduler
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp"]
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta",
+           "RMSProp", "Rprop"]
 
 
 def _stochastic_round_bf16(x: jnp.ndarray, key) -> jnp.ndarray:
@@ -276,7 +277,10 @@ class Optimizer:
                 continue
             for k, v in st.items():
                 if isinstance(v, jnp.ndarray) or hasattr(v, "shape"):
-                    sd[f"{p.name}_{k}"] = Tensor(v)
+                    # COPY: the live accumulator buffers are donated to
+                    # the next fused update — a checkpoint that aliases
+                    # them would be deleted by the following step()
+                    sd[f"{p.name}_{k}"] = Tensor(jnp.array(v, copy=True))
                 else:
                     sd[f"{p.name}_{k}"] = v
         if isinstance(self._learning_rate, LRScheduler):
@@ -301,7 +305,12 @@ class Optimizer:
                 sk = f"{p.name}_{k}"
                 if sk in state_dict:
                     v = state_dict[sk]
-                    st[k] = v._data if isinstance(v, Tensor) else v
+                    v = v._data if isinstance(v, Tensor) else v
+                    # copy arrays: the restored state will be donated by
+                    # step(); never let that delete the caller's dict
+                    if hasattr(v, "shape") and hasattr(v, "dtype"):
+                        v = jnp.array(v, copy=True)
+                    st[k] = v
                     consumed.add(sk)
                     found = True
                 else:
@@ -406,6 +415,46 @@ class Adadelta(Optimizer):
         asu = rho * state["avg_squared_update"] + (1 - rho) * update * update
         return p - hyper["lr"] * update, \
             {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Rprop(Optimizer):
+    """Resilient backpropagation (reference:
+    python/paddle/optimizer/rprop.py:28, kernel
+    phi/kernels/impl/rprop_kernel_impl.h). Sign-based updates with a
+    per-element step size: agreeing consecutive gradient signs grow the
+    step by eta+ (capped at lr_range[1]), disagreeing signs shrink it
+    by eta- (floored at lr_range[0]) and suppress that element's update
+    for the step. ``learning_rate`` seeds the per-element step sizes;
+    the rule never reads the scalar lr again."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name, multi_precision)
+        self._lr_min, self._lr_max = map(float, learning_rate_range)
+        self._eta_n, self._eta_p = map(float, etas)
+        if not (0.0 < self._eta_n < 1.0 < self._eta_p):
+            raise ValueError(f"etas must satisfy 0<eta-<1<eta+: {etas}")
+
+    def _init_state(self, p):
+        return {"prev_grad": jnp.zeros_like(p._data),
+                "learning_rate": jnp.full(p._data.shape,
+                                          self.get_lr(), p._data.dtype)}
+
+    def _rule(self, p, g, state, hyper):
+        prod = g * state["prev_grad"]
+        lr = state["learning_rate"].astype(p.dtype)
+        lr = jnp.where(prod > 0,
+                       jnp.minimum(lr * self._eta_p, self._lr_max),
+                       jnp.where(prod < 0,
+                                 jnp.maximum(lr * self._eta_n,
+                                             self._lr_min), lr))
+        g_eff = jnp.where(prod < 0, jnp.zeros_like(g), g)
+        new_p = p - lr * jnp.sign(g_eff)
+        return new_p, {"prev_grad": g_eff,
+                       "learning_rate": lr.astype(p.dtype)}
 
 
 class RMSProp(Optimizer):

@@ -27,7 +27,17 @@ __all__ = [
     "MovingAverageObserver", "QuantedLinear", "FakeQuant", "quant_dequant",
     "BaseObserver", "BaseQuanter", "QuanterFactory", "ObserverFactory",
     "quanter", "observer", "FakeQuanterWithAbsMaxObserver",
+    "post_training_quantize",
 ]
+
+
+def post_training_quantize(model, calib_reader=None, **kw):
+    """Quantize a SAVED inference artifact (the serving-team workflow;
+    reference static/quantization/post_training_quantization.py). See
+    paddle_tpu.static.quantization.post_training_quantize."""
+    from ..static.quantization import post_training_quantize as _ptq
+
+    return _ptq(model, calib_reader, **kw)
 
 
 class BaseObserver:

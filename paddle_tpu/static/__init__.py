@@ -6,6 +6,7 @@ Static-graph user APIs are provided for compat where they have a natural
 traced equivalent.
 """
 from . import nn  # noqa: F401
+from . import quantization  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
 from .program import (  # noqa: F401
     CompiledProgram, Executor, Program, data, default_main_program,
@@ -14,7 +15,7 @@ from .program import (  # noqa: F401
 )
 
 __all__ = [
-    "nn",
+    "nn", "quantization",
     "InputSpec", "Program", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "scope_guard",
     "save_inference_model", "load_inference_model", "CompiledProgram",

@@ -1,0 +1,200 @@
+"""Post-training quantization of SAVED inference artifacts.
+
+TPU-native counterpart of the reference's static PTQ toolkit (reference:
+python/paddle/static/quantization/post_training_quantization.py —
+PostTrainingQuantization loads a saved inference program, calibrates on a
+reader, and writes a quantized program the serving stack deploys).
+
+Design divergence, by design: the reference emits activation-int8
+programs for int8 GEMM hardware. On TPU the serving bottleneck is HBM
+weight bandwidth (SURVEY §6 decode roofline), so this toolkit emits
+WEIGHT-ONLY int8 artifacts — int8 weights + per-channel scales stored in
+the params file, dequantized inside the re-exported StableHLO program
+where XLA fuses the scale multiply into the consuming matmul. This is
+the same scheme the live serving path uses
+(inference/engine.py quantize_weight_only_int8). The calibration reader
+plays the validation role: the fp and int8 programs are run side by side
+on its batches and the output deviation is reported, so a serving team
+can gate deployment on a numeric budget.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Iterable, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["post_training_quantize", "PTQResult"]
+
+
+class PTQResult:
+    """What happened + how close the int8 artifact tracks the original."""
+
+    def __init__(self, output_prefix, quantized, skipped, calib_stats):
+        self.output_prefix = output_prefix
+        self.quantized = list(quantized)
+        self.skipped = list(skipped)
+        #: {"batches": N, "max_abs_err": x, "mean_abs_err": y,
+        #:  "out_scale": typical |output|} — empty without a reader
+        self.calib_stats = dict(calib_stats)
+
+    def __repr__(self):
+        return (f"PTQResult(prefix={self.output_prefix!r}, "
+                f"quantized={len(self.quantized)}, "
+                f"skipped={len(self.skipped)}, "
+                f"calib={self.calib_stats})")
+
+
+def _channel_axes(shape) -> tuple:
+    """Reduction axes for per-channel scales: 2-D weights keep the last
+    (output) axis, conv-style >=3-D weights keep axis 0 (out channels)."""
+    nd = len(shape)
+    if nd == 2:
+        return (0,)
+    return tuple(range(1, nd))
+
+
+def post_training_quantize(model, calib_reader: Optional[Iterable] = None,
+                           output_prefix: Optional[str] = None,
+                           weight_bits: int = 8, per_channel: bool = True,
+                           skip_params: Sequence[str] = (),
+                           min_numel: int = 1024,
+                           max_calib_batches: int = 8) -> PTQResult:
+    """Quantize a saved jit.save/static.save_inference_model artifact.
+
+    ``model`` is a path prefix, an ``inference.Config``, or a
+    ``Predictor``. Writes ``output_prefix{.pdmodel,.pdiparams}``
+    (default: ``<prefix>_int8``) loadable by ``jit.load`` and
+    ``inference.Predictor``. Returns a :class:`PTQResult`.
+    """
+    from jax import export as jexport
+
+    from ..jit.api import load as jit_load
+
+    if weight_bits != 8:
+        raise NotImplementedError("only weight_bits=8 is supported")
+    prefix = model
+    if hasattr(prefix, "_config"):           # Predictor
+        prefix = prefix._config
+    if hasattr(prefix, "model_path"):        # Config
+        prefix = prefix.model_path()
+    layer = jit_load(prefix)
+    if layer._exported is None:
+        raise ValueError(
+            "artifact was saved without input_spec (no compiled program) "
+            "— re-save with input_spec, then quantize")
+    meta = layer._meta
+    names = list(meta["param_names"])
+    n_params = layer._n_params
+    param_names, buffer_names = names[:n_params], names[n_params:]
+    state = layer._state
+
+    qmax = (1 << (weight_bits - 1)) - 1      # 127
+    quantized, skipped = [], []
+    new_state: Dict[str, jnp.ndarray] = {}
+    scales: Dict[str, jnp.ndarray] = {}
+    for n in param_names:
+        w = state[n]
+        if (not jnp.issubdtype(w.dtype, jnp.floating) or w.ndim < 2
+                or w.size < min_numel or n in skip_params):
+            skipped.append(n)
+            new_state[n] = w
+            continue
+        axes = _channel_axes(w.shape) if per_channel \
+            else tuple(range(w.ndim))
+        s = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes,
+                    keepdims=True)
+        s = jnp.maximum(s, 1e-8) / qmax
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / s), -qmax - 1,
+                     qmax).astype(jnp.int8)
+        quantized.append(n)
+        new_state[n] = q
+        scales[n] = s.astype(jnp.float32)
+
+    exp = layer._exported
+
+    # the wrapper keeps TranslatedLayer's 2-way (params, buffers) call
+    # convention: its "params" list is the q weights followed by scales
+    n_w = len(param_names)
+
+    def fwd(qs_arrays, buffer_arrays, *arg_arrays):
+        it = iter(qs_arrays[n_w:])           # the scales tail
+        deq = []
+        for n, a in zip(param_names, qs_arrays[:n_w]):
+            if n in scales:
+                s = next(it)
+                orig_dt = state[n].dtype
+                deq.append((a.astype(jnp.float32) * s).astype(orig_dt))
+            else:
+                deq.append(a)
+        return exp.call(deq, list(buffer_arrays), *arg_arrays)
+
+    qs_avals = [jax.ShapeDtypeStruct(new_state[n].shape,
+                                     new_state[n].dtype)
+                for n in param_names] + \
+               [jax.ShapeDtypeStruct(scales[n].shape, scales[n].dtype)
+                for n in param_names if n in scales]
+    b_avals = [jax.ShapeDtypeStruct(state[n].shape, state[n].dtype)
+               for n in buffer_names]
+    # original program input avals past (params, buffers) are the data
+    # args — reuse them (symbolic batch dims survive the re-export)
+    n_state_leaves = len(param_names) + len(buffer_names)
+    arg_avals = list(exp.in_avals)[n_state_leaves:]
+    new_exp = jexport.export(jax.jit(fwd))(qs_avals, b_avals, *arg_avals)
+
+    # ---- artifact: params = q weights + scales, buffers unchanged ----
+    out_prefix = output_prefix or (prefix + "_int8")
+    scale_names = [f"{n}@scale" for n in param_names if n in scales]
+    all_names = param_names + scale_names + buffer_names
+    out_state = {}
+    out_state.update({n: np.asarray(new_state[n]) for n in param_names})
+    out_state.update({f"{n}@scale": np.asarray(scales[n])
+                      for n in param_names if n in scales})
+    out_state.update({n: np.asarray(state[n]) for n in buffer_names})
+    os.makedirs(os.path.dirname(out_prefix) or ".", exist_ok=True)
+    with open(out_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(out_state, f, protocol=4)
+    new_meta = {
+        "class_name": meta.get("class_name", "Layer") + "Int8",
+        "n_outputs": meta.get("n_outputs"),
+        "exported": [new_exp.serialize()],
+        "param_names": all_names,
+        # TranslatedLayer splits state as (params, buffers) by n_params:
+        # the (q weights + scales) block is the "params" pytree leaves…
+        "n_params": len(param_names) + len(scale_names),
+        "ptq": {"weight_bits": weight_bits, "per_channel": per_channel,
+                "quantized": quantized},
+    }
+    with open(out_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(new_meta, f, protocol=4)
+
+    calib_stats = {}
+    if calib_reader is not None:
+        q_layer = jit_load(out_prefix)
+        max_err, mean_err, out_mag, batches = 0.0, 0.0, 0.0, 0
+        for batch in calib_reader:
+            if batches >= max_calib_batches:
+                break
+            args = batch if isinstance(batch, (list, tuple)) else (batch,)
+            ref_out = layer(*args)
+            q_out = q_layer(*args)
+            refs = ref_out if isinstance(ref_out, tuple) else (ref_out,)
+            qs = q_out if isinstance(q_out, tuple) else (q_out,)
+            for r, q in zip(refs, qs):
+                d = np.abs(np.asarray(r.numpy(), np.float32)
+                           - np.asarray(q.numpy(), np.float32))
+                max_err = max(max_err, float(d.max()))
+                mean_err += float(d.mean())
+                out_mag = max(out_mag, float(
+                    np.abs(np.asarray(r.numpy(), np.float32)).max()))
+            batches += 1
+        if batches:
+            calib_stats = {"batches": batches,
+                           "max_abs_err": max_err,
+                           "mean_abs_err": mean_err / batches,
+                           "out_scale": out_mag}
+    return PTQResult(out_prefix, quantized, skipped, calib_stats)

@@ -152,6 +152,42 @@ def test_recompute_swaps_buffers_batchnorm():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_amp_o2_autocast_keeps_bf16_through_promotion():
+    """O2 must not silently run fp32 (r5 review): fp32 activations
+    promote bf16-decorated params back to f32 at every op unless the O2
+    autocast casts non-blacklist op inputs to bf16."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    class Toy(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+            self.out = nn.Linear(8, 2)
+            self.seen = []
+
+        def forward(self, x):
+            h = F.relu(self.fc(x))  # relu is in NO amp list
+            self.seen.append(str(h.dtype))
+            return self.out(h)
+
+    net = Toy()
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    net, opt = paddle.amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    step = paddle.jit.TrainStep(net, nn.CrossEntropyLoss(), opt,
+                                amp_level="O2")
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.array([0, 1, 0, 1]))
+    loss = step([x], [y])
+    assert np.isfinite(float(loss.numpy()))
+    # the post-relu activation stayed bf16 (not promoted to f32)
+    assert any("bfloat16" in d for d in net.seen), net.seen
+
+
 def test_reduce_scatter_single_host_semantics():
     """reduce_scatter degenerate path still binds the right slice."""
     import paddle_tpu.distributed as dist

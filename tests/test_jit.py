@@ -176,6 +176,29 @@ class TestResNetAMPAnchor:
         opt.clear_grad()
         assert np.isfinite(float(loss.numpy()))
 
+    def test_resnet50_static_amp_o2_step(self):
+        """BASELINE configs[1] names ResNet-50 — exercise it e2e under
+        its own name (to_static + AMP O2 + optimizer step; CPU-sized
+        input, the chip bench scales it up)."""
+        from paddle_tpu.vision.models import resnet50
+
+        paddle.seed(0)
+        model = resnet50(num_classes=10)
+        opt = paddle.optimizer.Momentum(0.01, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+        model = paddle.jit.to_static(model)
+        x = paddle.randn([2, 3, 32, 32]).astype("bfloat16")
+        y = paddle.to_tensor(np.random.randint(0, 10, (2,)))
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            logits = model(x)
+            loss = F.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert logits.shape[-1] == 10
+        assert np.isfinite(float(loss.numpy()))
+
     def test_resnet18_train_step_compiled(self):
         from paddle_tpu.vision.models import resnet18
 

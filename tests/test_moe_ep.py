@@ -1,0 +1,137 @@
+"""MoE expert parallelism via all-to-all (MoELayer ep_mesh path).
+
+Reference: incubate/distributed/models/moe — global_scatter /
+global_gather are all-to-all ops; here the exchange is two
+lax.all_to_all inside a shard_map over the ep axis. Pinned: HLO
+contains all-to-all, numerics match the dense (single-device GShard
+einsum) path when capacity doesn't bind, and gradients flow to experts
+and gate.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.incubate.moe import MoELayer
+
+D = 16
+E = 4
+
+
+def _mesh():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        **strategy.hybrid_configs,
+        "dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+        "sharding_degree": 1, "sep_degree": 1,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group().mesh
+
+
+def _shard_experts(moe, mesh, axis="dp"):
+    st = moe.stacked
+    for pname in ("w1", "b1", "w2", "b2"):
+        p = getattr(st, pname)
+        pls = [dist.Replicate()] * mesh.ndim
+        pls[mesh.dim_names.index(axis)] = dist.Shard(0)
+        st._parameters[pname] = dist.shard_tensor(p, mesh, pls)
+
+
+class TestGShardDispatch:
+    def test_identity_property_no_slot_collisions(self):
+        """With ample capacity, dispatch->combine must reconstruct each
+        token exactly (r5 regression: per-k cumsum restarted at slot 0,
+        so k=0/k=1 assignments to one expert summed two tokens)."""
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.moe.moe_layer import _gshard_dispatch
+
+        rng = np.random.RandomState(0)
+        T, Ex, K, Dx = 32, 4, 2, 16
+        x = jnp.asarray(rng.randn(T, Dx).astype(np.float32))
+        wg = jnp.asarray(rng.randn(Dx, Ex).astype(np.float32) * 0.3)
+        probs = jax.nn.softmax(x @ wg, -1)
+        combine, dispatch, _ = _gshard_dispatch(probs, Ex, K, T * K)
+        out = jnp.einsum("tec,ecd->td", combine,
+                         jnp.einsum("tec,td->ecd", dispatch, x))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                                   atol=1e-5)
+        assert float(dispatch.sum(0).max()) == 1.0  # one token per slot
+
+
+class TestMoEExpertParallel:
+    def test_matches_dense_path_when_capacity_ample(self):
+        mesh = _mesh()
+        paddle.seed(0)
+        # generous capacity so neither the global nor per-shard
+        # formulation drops tokens -> identical outputs
+        ep = MoELayer(d_model=D, num_experts=E, gate="gshard",
+                      d_hidden=32, capacity_factor=8.0,
+                      ep_mesh=(mesh, "dp"))
+        paddle.seed(0)
+        dense = MoELayer(d_model=D, num_experts=E, gate="gshard",
+                         d_hidden=32, capacity_factor=8.0)
+        # same init by construction (same seed); verify then shard
+        np.testing.assert_allclose(np.asarray(ep.stacked.w1._data),
+                                   np.asarray(dense.stacked.w1._data))
+        _shard_experts(ep, mesh)
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 4, D).astype(np.float32)
+        pls = [dist.Replicate()] * mesh.ndim
+        pls[mesh.dim_names.index("dp")] = dist.Shard(0)
+        xe = dist.shard_tensor(paddle.to_tensor(x), mesh, pls)
+        out_ep = ep(xe).numpy()
+        out_dense = dense(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out_ep, out_dense, rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_all_to_all_in_hlo_and_grads_flow(self):
+        mesh = _mesh()
+        paddle.seed(1)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.moe = MoELayer(d_model=D, num_experts=E,
+                                    gate="gshard", d_hidden=32,
+                                    ep_mesh=(mesh, "dp"))
+                _shard_experts(self.moe, mesh)
+
+            def forward(self, x):
+                return x + self.moe(x)
+
+        net = Net()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+        step = paddle.jit.TrainStep(
+            net, lambda o, y: ((o - y) ** 2).mean(), opt)
+        rng = np.random.RandomState(0)
+        pls = [dist.Replicate()] * mesh.ndim
+        pls[mesh.dim_names.index("dp")] = dist.Shard(0)
+        x = dist.shard_tensor(paddle.to_tensor(
+            rng.randn(8, 4, D).astype(np.float32)), mesh, pls)
+        y = dist.shard_tensor(paddle.to_tensor(
+            rng.randn(8, 4, D).astype(np.float32)), mesh, pls)
+        txt = step.lower_hlo([x], [y])
+        assert "all-to-all" in txt
+        w1_before = np.asarray(net.moe.stacked.w1._data).copy()
+        gate_before = np.asarray(net.moe.gate.weight._data).copy()
+        l0 = float(step([x], [y]).numpy())
+        for _ in range(10):
+            loss = step([x], [y])
+        assert float(loss.numpy()) < l0
+        assert not np.allclose(np.asarray(net.moe.stacked.w1._data),
+                               w1_before)
+        assert not np.allclose(np.asarray(net.moe.gate.weight._data),
+                               gate_before)
+
+    def test_rejects_indivisible_experts(self):
+        mesh = _mesh()
+        moe = MoELayer(d_model=D, num_experts=6, gate="gshard",
+                       d_hidden=32, ep_mesh=(mesh, "dp"))
+        x = paddle.to_tensor(np.ones((8, 4, D), np.float32))
+        with pytest.raises(ValueError, match="divisible"):
+            moe(x)

@@ -1,0 +1,309 @@
+"""Adamax / Rprop / LBFGS / Lars — the r5 optimizer-roster closure.
+
+Numerics are pinned against independent numpy reimplementations of the
+reference rules (reference: python/paddle/optimizer/{adamax.py:27,
+rprop.py:28, lbfgs.py:307}, fleet/meta_optimizers/lars_optimizer.py),
+plus convergence and state-dict round-trips.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _make_param(vals):
+    from paddle_tpu.core.tensor import Parameter
+    import jax.numpy as jnp
+
+    return Parameter(jnp.asarray(np.asarray(vals, np.float32)))
+
+
+def _apply_grads(opt, p, g_seq):
+    from paddle_tpu.core.tensor import Tensor
+
+    traj = []
+    for g in g_seq:
+        p.grad = Tensor(np.asarray(g, np.float32))
+        opt.step()
+        opt.clear_grad()
+        traj.append(np.asarray(p.numpy(), np.float64).copy())
+    return traj
+
+
+class TestAdamax:
+    def test_matches_reference_rule(self):
+        lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+        rng = np.random.RandomState(0)
+        g_seq = [rng.randn(3) for _ in range(5)]
+        p = _make_param([1.0, -2.0, 3.0])
+        opt = paddle.optimizer.Adamax(lr, beta1=b1, beta2=b2, epsilon=eps,
+                                      parameters=[p])
+        traj = _apply_grads(opt, p, g_seq)
+        # independent numpy model of the reference kernel
+        w = np.array([1.0, -2.0, 3.0], np.float64)
+        m = np.zeros(3)
+        u = np.zeros(3)
+        b1p = 1.0
+        for t, g in enumerate(g_seq):
+            g = g.astype(np.float64)
+            m = b1 * m + (1 - b1) * g
+            u = np.maximum(np.abs(g), b2 * u + eps)
+            b1p *= b1
+            w = w - (lr / (1 - b1p)) * m / u
+            np.testing.assert_allclose(traj[t], w, rtol=2e-5, atol=1e-6)
+
+    def test_converges(self):
+        paddle.seed(1)
+        net = nn.Linear(4, 1)
+        opt = paddle.optimizer.Adamax(0.05, parameters=net.parameters())
+        target = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        rng = np.random.RandomState(0)
+        for _ in range(200):
+            xb = rng.randn(32, 4).astype(np.float32)
+            loss = F.mse_loss(net(paddle.to_tensor(xb)),
+                              paddle.to_tensor(xb @ target))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 0.05
+
+    def test_state_dict_roundtrip(self):
+        p = _make_param([1.0, 2.0, 3.0])
+        opt = paddle.optimizer.Adamax(0.1, parameters=[p])
+        _apply_grads(opt, p, [np.ones(3)] * 3)
+        sd = opt.state_dict()
+        p2 = _make_param([1.0, 2.0, 3.0])
+        import jax.numpy as jnp
+
+        # optimizer state excludes params (model sd); copy — the donated
+        # fused update would otherwise delete the shared buffer
+        p2._rebind(jnp.array(p._data, copy=True))
+        p2.name = p.name
+        opt2 = paddle.optimizer.Adamax(0.1, parameters=[p2])
+        opt2.set_state_dict(sd)
+        t1 = _apply_grads(opt, p, [np.ones(3)])
+        t2 = _apply_grads(opt2, p2, [np.ones(3)])
+        np.testing.assert_allclose(t1[0], t2[0], rtol=1e-6)
+
+
+class TestRprop:
+    def test_sign_logic_matches_reference(self):
+        # grad sign flip must shrink the step and SKIP the update;
+        # agreement must grow the step (reference rprop.py math block)
+        lr0, lr_min, lr_max = 0.1, 1e-5, 50.0
+        en, ep = 0.5, 1.2
+        p = _make_param([0.0])
+        opt = paddle.optimizer.Rprop(
+            lr0, learning_rate_range=(lr_min, lr_max), parameters=[p],
+            etas=(en, ep))
+        # step 1: prev=0 -> product==0 -> lr unchanged, update -lr*sign(g)
+        t1 = _apply_grads(opt, p, [np.array([1.0])])[0]
+        np.testing.assert_allclose(t1, [-lr0], rtol=1e-6)
+        # step 2: same sign -> lr*eta+ and update
+        t2 = _apply_grads(opt, p, [np.array([1.0])])[0]
+        np.testing.assert_allclose(t2, [-lr0 - lr0 * ep], rtol=1e-6)
+        # step 3: sign flip -> lr*eta-, NO update this step
+        t3 = _apply_grads(opt, p, [np.array([-1.0])])[0]
+        np.testing.assert_allclose(t3, t2, rtol=1e-6)
+        # step 4: prev grad was zeroed -> product==0 -> update resumes
+        # with the shrunk step
+        t4 = _apply_grads(opt, p, [np.array([-1.0])])[0]
+        np.testing.assert_allclose(t4, t2 + lr0 * ep * en, rtol=1e-6)
+
+    def test_lr_clamped_to_range(self):
+        p = _make_param([0.0])
+        opt = paddle.optimizer.Rprop(1.0, learning_rate_range=(0.5, 1.5),
+                                     parameters=[p], etas=(0.5, 1.2))
+        for _ in range(10):
+            _apply_grads(opt, p, [np.array([1.0])])
+        lr = np.asarray(opt._accumulators[id(p)]["learning_rate"])
+        assert lr[0] == pytest.approx(1.5)
+
+    def test_full_batch_convergence(self):
+        # Rprop is a full-batch method: fixed batch, quadratic objective
+        paddle.seed(2)
+        net = nn.Linear(4, 1)
+        opt = paddle.optimizer.Rprop(0.01, parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        xb = rng.randn(64, 4).astype(np.float32)
+        target = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        x, y = paddle.to_tensor(xb), paddle.to_tensor(xb @ target)
+        for _ in range(150):
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 1e-3
+
+
+class TestLBFGS:
+    def test_linear_regression_exact(self):
+        paddle.seed(3)
+        net = nn.Linear(4, 1)
+        opt = paddle.optimizer.LBFGS(parameters=net.parameters(),
+                                     line_search_fn="strong_wolfe")
+        rng = np.random.RandomState(0)
+        xb = rng.randn(64, 4).astype(np.float32)
+        target = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        x, y = paddle.to_tensor(xb), paddle.to_tensor(xb @ target)
+
+        def closure():
+            opt.clear_grad()
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            opt.step(closure)
+        final = float(F.mse_loss(net(x), y).numpy())
+        assert final < 1e-6, final
+        np.testing.assert_allclose(net.weight.numpy().reshape(-1),
+                                   target.reshape(-1), atol=1e-3)
+
+    def test_rosenbrock_strong_wolfe(self):
+        # the canonical curved-valley test: plain GD crawls, LBFGS nails
+        # it in a handful of outer steps
+        from paddle_tpu.core.tensor import Parameter
+        import jax.numpy as jnp
+
+        xy = Parameter(jnp.asarray(np.array([-1.2, 1.0], np.float32)))
+        opt = paddle.optimizer.LBFGS(parameters=[xy], max_iter=40,
+                                     line_search_fn="strong_wolfe")
+
+        def closure():
+            opt.clear_grad()
+            a = xy[0]
+            b = xy[1]
+            loss = (1 - a) ** 2 + 100 * (b - a * a) ** 2
+            loss.backward()
+            return loss
+
+        for _ in range(8):
+            opt.step(closure)
+        np.testing.assert_allclose(xy.numpy(), [1.0, 1.0], atol=1e-3)
+
+    def test_no_line_search_path(self):
+        paddle.seed(4)
+        net = nn.Linear(2, 1)
+        opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=10,
+                                     parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        xb = rng.randn(32, 2).astype(np.float32)
+        target = np.array([[2.0], [-1.0]], np.float32)
+        x, y = paddle.to_tensor(xb), paddle.to_tensor(xb @ target)
+
+        def closure():
+            opt.clear_grad()
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            return loss
+
+        l0 = float(closure().numpy())
+        for _ in range(10):
+            opt.step(closure)
+        assert float(closure().numpy()) < l0 * 1e-3
+
+    def test_state_dict_roundtrip(self):
+        from paddle_tpu.core.tensor import Parameter
+        import jax.numpy as jnp
+
+        def make():
+            q = Parameter(jnp.asarray(np.array([0.5, -0.5], np.float32)))
+            o = paddle.optimizer.LBFGS(parameters=[q], max_iter=4,
+                                       line_search_fn="strong_wolfe")
+
+            def closure():
+                o.clear_grad()
+                loss = ((q - paddle.to_tensor(
+                    np.array([1.0, 2.0], np.float32))) ** 2).sum()
+                loss.backward()
+                return loss
+
+            return q, o, closure
+
+        q1, o1, c1 = make()
+        o1.step(c1)
+        sd = o1.state_dict()
+        q2, o2, c2 = make()
+        q2._rebind(q1._data)
+        o2.set_state_dict(sd)
+        o1.step(c1)
+        o2.step(c2)
+        np.testing.assert_allclose(q1.numpy(), q2.numpy(), rtol=1e-6)
+
+
+class TestLocalSGD:
+    def test_single_process_equals_inner(self):
+        paddle.seed(6)
+        net = nn.Linear(4, 1)
+        import copy
+
+        w0 = net.weight.numpy().copy()
+        from paddle_tpu.incubate.optimizer import LocalSGD
+
+        opt = LocalSGD(paddle.optimizer.SGD(0.1,
+                                            parameters=net.parameters()),
+                       k_steps=2)
+        rng = np.random.RandomState(0)
+        xb = rng.randn(8, 4).astype(np.float32)
+        for _ in range(4):
+            loss = F.mse_loss(net(paddle.to_tensor(xb)),
+                              paddle.to_tensor(np.zeros((8, 1), np.float32)))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert not np.allclose(net.weight.numpy(), w0)
+
+    def test_sync_fires_every_k_steps(self, monkeypatch):
+        from paddle_tpu.incubate.optimizer import LocalSGD
+
+        p = _make_param([1.0, 2.0])
+        opt = LocalSGD(paddle.optimizer.SGD(0.1, parameters=[p]),
+                       k_steps=3)
+        calls = []
+        monkeypatch.setattr(opt, "_sync", lambda: calls.append(
+            opt._step_count))
+        for _ in range(7):
+            _apply_grads(opt, p, [np.ones(2)])
+        assert calls == [3, 6]
+
+
+class TestLars:
+    def test_trust_ratio_matches_rule(self):
+        lr, mom, coeff, wd = 0.5, 0.0, 0.001, 0.0005
+        p = _make_param([3.0, 4.0])          # ||p|| = 5
+        g = np.array([0.6, 0.8], np.float64)  # ||g|| = 1
+        opt = paddle.optimizer.Lars(lr, momentum=mom, lars_coeff=coeff,
+                                    lars_weight_decay=wd, parameters=[p])
+        t1 = _apply_grads(opt, p, [g])[0]
+        local_lr = lr * coeff * 5.0 / (1.0 + wd * 5.0)
+        expect = np.array([3.0, 4.0]) - local_lr * (g + wd * np.array([3.0, 4.0]))
+        np.testing.assert_allclose(t1, expect, rtol=1e-5)
+
+    def test_exclude_falls_back_to_momentum_sgd(self):
+        p = _make_param([3.0, 4.0])
+        p.name = "bn_scale"
+        g = np.array([0.6, 0.8], np.float64)
+        opt = paddle.optimizer.Lars(0.5, momentum=0.0, parameters=[p],
+                                    exclude_from_weight_decay=["bn_"])
+        t1 = _apply_grads(opt, p, [g])[0]
+        np.testing.assert_allclose(t1, np.array([3.0, 4.0]) - 0.5 * g,
+                                   rtol=1e-5)
+
+    def test_converges(self):
+        paddle.seed(5)
+        net = nn.Linear(4, 1)
+        opt = paddle.optimizer.Lars(0.5, momentum=0.9, lars_coeff=0.01,
+                                    parameters=net.parameters())
+        target = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        rng = np.random.RandomState(0)
+        for _ in range(400):
+            xb = rng.randn(32, 4).astype(np.float32)
+            loss = F.mse_loss(net(paddle.to_tensor(xb)),
+                              paddle.to_tensor(xb @ target))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 0.1

@@ -1,0 +1,97 @@
+"""Static-artifact PTQ: jit.save artifact -> int8 artifact -> Predictor.
+
+Reference workflow:
+python/paddle/static/quantization/post_training_quantization.py (load a
+saved inference program, calibrate, emit a quantized program). Here the
+emitted artifact is weight-only int8 (TPU serving is HBM-bound — see
+static/quantization.py docstring) and must round-trip through jit.load
+AND inference.Predictor.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static.input_spec import InputSpec
+
+
+def _make_artifact(tmp_path, d=64):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(d, 2 * d), nn.GELU(),
+                        nn.Linear(2 * d, d), nn.LayerNorm(d),
+                        nn.Linear(d, 32))
+    net.eval()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([4, d], "float32")])
+    return net, prefix
+
+
+class TestStaticPTQ:
+    def test_roundtrip_from_saved_artifact(self, tmp_path):
+        net, prefix = _make_artifact(tmp_path)
+        rng = np.random.RandomState(0)
+        calib = [paddle.to_tensor(rng.randn(4, 64).astype(np.float32))
+                 for _ in range(3)]
+
+        from paddle_tpu.quantization import post_training_quantize
+
+        res = post_training_quantize(prefix, calib_reader=calib)
+        # the three Linear weights quantize; LN/bias params skip
+        assert len(res.quantized) == 3, res
+        assert res.calib_stats["batches"] == 3
+        # weight-only int8 of a well-scaled model stays close
+        assert res.calib_stats["max_abs_err"] < \
+            0.05 * max(res.calib_stats["out_scale"], 1.0), res.calib_stats
+
+        loaded = paddle.jit.load(res.output_prefix)
+        x = calib[0]
+        ref = net(x).numpy()
+        out = loaded(x).numpy()
+        np.testing.assert_allclose(out, ref, atol=0.05 * np.abs(ref).max())
+        # int8 weights really are int8 in the artifact
+        sd = loaded.state_dict()
+        w_names = [n for n in sd if n in res.quantized]
+        assert w_names and all(
+            str(sd[n]._data.dtype) == "int8" for n in w_names)
+        assert any(n.endswith("@scale") for n in sd)
+
+    def test_predictor_loads_int8_artifact(self, tmp_path):
+        net, prefix = _make_artifact(tmp_path)
+        from paddle_tpu.quantization import post_training_quantize
+
+        res = post_training_quantize(prefix)
+        from paddle_tpu.inference import Config, create_predictor
+
+        pred = create_predictor(Config(res.output_prefix))
+        x = np.random.RandomState(1).randn(4, 64).astype(np.float32)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(x)
+        assert pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]) \
+            .copy_to_cpu()
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref,
+                                   atol=0.05 * np.abs(ref).max())
+
+    def test_accepts_config_and_skip_params(self, tmp_path):
+        net, prefix = _make_artifact(tmp_path)
+        from paddle_tpu.inference import Config
+        from paddle_tpu.static.quantization import post_training_quantize
+
+        first_w = [n for n, p in net.named_parameters()
+                   if p._data.ndim == 2][0]
+        res = post_training_quantize(Config(prefix),
+                                     skip_params=(first_w,),
+                                     output_prefix=str(tmp_path / "q2"))
+        assert first_w in res.skipped
+        assert len(res.quantized) == 2
+
+    def test_artifact_without_program_raises(self, tmp_path):
+        net = nn.Linear(4, 4)
+        prefix = str(tmp_path / "noprog")
+        paddle.jit.save(net, prefix)  # no input_spec -> state only
+        from paddle_tpu.static.quantization import post_training_quantize
+
+        with pytest.raises(ValueError, match="input_spec"):
+            post_training_quantize(prefix)

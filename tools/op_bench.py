@@ -203,6 +203,13 @@ def compare(prev_path: str, cur_path: str, tol: float = 0.10) -> int:
               "comparison skipped")
         return 0
     bad = []
+    # dispatch overheads are gated too (taped dispatch in particular:
+    # the r5 vjp-trace cache took it 753us -> ~50us; a revert must fail)
+    for k in ("eager_dispatch_us", "taped_dispatch_us"):
+        p, c = prev["dispatch"].get(k), cur["dispatch"].get(k)
+        if p and c and c > max(p * (1 + tol), p + 10.0):
+            bad.append(f"dispatch.{k}: {p} -> {c} us "
+                       f"(+{100 * (c / p - 1):.0f}%)")
     for name, c in cur["ops"].items():
         p = prev["ops"].get(name)
         if not p:
