@@ -42,6 +42,9 @@ LADDER = [
     ("gpt-760m", 1536, 24, 16, 1024, 8, dict(_FAST)),
     ("gpt-350m", 1024, 24, 16, 1024, 8, dict(_FAST)),
 ]
+# canonical GPT-3 1.3B context (BASELINE configs[3]): same tokens/step
+# as the s1024 rung (b*s = 4096); reported as the s2048_* keys
+S2048 = ("gpt3-1.3b-s2048", 2048, 24, 16, 2048, 2, dict(_FAST))
 VOCAB = 51200
 PEAK_BF16 = {
     # chip device_kind substring -> peak bf16 FLOP/s
@@ -362,13 +365,23 @@ def _run_secondary(kind):
         tps, mfu = run_bert_bench()
         print(json.dumps({"bert_train_tokens_per_sec": round(tps, 1),
                           "bert_mfu": mfu}))
+    elif kind == "--s2048":
+        import jax
+
+        name, d, L, h, s, b, ok = S2048
+        tps, n_params, fpt = run_config(name, d, L, h, s, b, steps=10,
+                                        opt_kwargs=ok)
+        mfu = tps * fpt / _chip_peak(jax.devices()[0])
+        print(json.dumps({"s2048_tokens_per_sec": round(tps, 1),
+                          "s2048_mfu": round(mfu, 4),
+                          "s2048_batch": b}))
 
 
 def main():
     if "--config" in sys.argv:
         _run_one(sys.argv[sys.argv.index("--config") + 1])
         return
-    for kind in ("--decode", "--decode-int8", "--bert"):
+    for kind in ("--decode", "--decode-int8", "--bert", "--s2048"):
         if kind in sys.argv:
             _run_secondary(kind)
             return
@@ -404,7 +417,7 @@ def main():
             continue
         # secondary rungs each get a FRESH process (and a fresh chip —
         # the training rung's buffers die with its process)
-        for kind in ("--decode", "--decode-int8", "--bert"):
+        for kind in ("--s2048", "--decode", "--decode-int8", "--bert"):
             extra, err = _sub([kind], 1500)
             if extra is None:
                 key = kind.strip("-").replace("-", "_")

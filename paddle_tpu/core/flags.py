@@ -71,10 +71,10 @@ define_flag("record_double_grad", True,
             "record primal recipes on the tape for paddle.grad(create_graph=True); disable to save memory in first-order-only runs")
 define_flag("benchmark", False, "synchronize after each op for timing")
 define_flag("paged_attention_backend", "auto",
-            "decode paged-attention backend: auto (XLA gather path — "
-            "measured fastest end-to-end, see "
-            "nn/functional/paged_attention.py) | xla | fused "
-            "(hand-written page-DMA Pallas kernel, opt-in) | pallas "
+            "decode paged-attention backend: auto (pool-streaming "
+            "Pallas kernel on TPU, XLA gather elsewhere — see "
+            "nn/functional/paged_attention.py) | stream | xla | fused "
+            "(r4 per-sequence page-DMA Pallas kernel, opt-in) | pallas "
             "(stock jax kernel via a layout transpose)")
 define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
 define_flag("eager_jit_ops", True, "dispatch eager ops through cached jit computations")

@@ -288,10 +288,15 @@ def _gshard_dispatch(probs, E, K, capacity):
     # priority ordering) — WITHOUT this, pass k's counts restart at 0
     # and two different tokens share a slot, so the expert sees the SUM
     # of their activations (r5 fix; pinned by the identity-property test)
-    base = jnp.zeros((E,), probs.dtype)
+    # slot bookkeeping runs in fp32 regardless of probs.dtype: under AMP
+    # O2 probs are bf16, which represents integers exactly only up to
+    # 256 — a bf16 cumsum over more tokens rounds increments away and
+    # two tokens silently share a slot (the exact corruption the `base`
+    # fix prevents)
+    base = jnp.zeros((E,), jnp.float32)
     for k in range(K):
         idx = topk_idx[:, k]                                  # [T]
-        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)    # [T, E]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # [T, E]
         # position within expert buffer (running count per expert)
         pos_in_e = (jnp.cumsum(onehot, axis=0) - 1
                     + base[None, :]) * onehot                 # [T, E]
@@ -300,7 +305,8 @@ def _gshard_dispatch(probs, E, K, capacity):
         pos_cap = jnp.clip(pos, 0, capacity - 1)
         cap_onehot = jax.nn.one_hot(pos_cap, capacity,
                                     dtype=probs.dtype)        # [T, C]
-        mask = (onehot * keep[:, None].astype(probs.dtype))
+        mask = (onehot.astype(probs.dtype)
+                * keep[:, None].astype(probs.dtype))
         disp_k = mask[:, :, None] * cap_onehot[:, None, :]    # [T, E, C]
         dispatch = dispatch + disp_k
         combine = combine + disp_k * topk_val[:, k][:, None, None]

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor
 from ...nn.layer_base import Layer
 from ...nn.functional.paged_attention import (
-    paged_attention, write_kv_pages, write_prefill_kv_pages)
+    paged_attention, paged_decode_attention_inplace, write_kv_pages,
+    write_prefill_kv_pages)
 
 __all__ = ["qkv_split_rope_fused", "rope_table", "FusedMultiTransformer"]
 
@@ -87,11 +88,13 @@ class PagedKV(NamedTuple):
     layout ([L, n_kv, pages, ...] shuttled through scan xs→ys) copied
     the whole pool every token: measured 10.8ms/step of pure copy on
     the 1.3B config vs 0.7ms for this carry design (tools/decode_profile
-    cache_copy vs carry_cache). Page-major ([P, ps, n_kv, d]) makes
-    each page one contiguous block: the scatter's indexed dims lead and
-    the fused Pallas decode kernel DMAs pages whole.
+    cache_copy vs carry_cache). Page-major ([P, n_kv, ps, d], heads
+    outer within the page — r5) makes each page one contiguous block
+    whose per-head slices are contiguous too: the scatter's indexed page
+    dim leads and the stream decode kernel consumes whole [C, d] head
+    runs with zero relayout.
     """
-    k: jax.Array   # [num_layers * num_pages, page_size, n_kv, head_dim]
+    k: jax.Array   # [num_layers * num_pages, n_kv, page_size, head_dim]
     v: jax.Array
 
 
@@ -210,7 +213,8 @@ class FusedMultiTransformer(Layer):
         """One pre-LN transformer layer over hidden ``h`` (any leading
         dims). Compute dtype FOLLOWS h (bf16 weights + bf16 h → pure
         bf16 MXU dots; LN statistics promote to fp32 internally and are
-        cast back)."""
+        cast back). ``attend`` may return (att, ck, cv) — the fused
+        append+attend kernel path, where kv_write is skipped."""
         eps = self.epsilon
         sc = w.get
         hn = self._ln(h, w["ln1_scale"], w["ln1_bias"], eps) \
@@ -226,8 +230,11 @@ class FusedMultiTransformer(Layer):
                 hn, qkv_w, w["qkv_bias"], positions,
                 self.num_heads, self.num_kv_heads, self.head_dim,
                 cos_t, sin_t)
-        ck, cv = kv_write(k, v)
-        att = attend(q, k, v, ck, cv)
+        if kv_write is None:
+            att, ck, cv = attend(q, k, v, None, None)
+        else:
+            ck, cv = kv_write(k, v)
+            att = attend(q, k, v, ck, cv)
         att = att.reshape(*h.shape[:-1],
                           self.num_heads * self.head_dim).astype(h.dtype)
         h = (h + self._mm(att, w["out_weight"], sc("out_scale"))
@@ -317,31 +324,62 @@ class FusedMultiTransformer(Layer):
         """
         npages = self._pages_per_layer(cache)
         lens1 = (seq_lens + 1).astype(jnp.int32)
+        # token-level pool ownership (the stream kernels' mask) is
+        # layer-independent: compute ONCE per decode step, share across
+        # the 24-layer loop
+        from ...core.flags import flag
+        from ...nn.functional.paged_attention import (
+            _on_tpu, build_pool_ownership)
 
-        def attend_paged(tbl):
-            def attend(q, k, v, ck, cv):
-                return paged_attention(q, ck, cv, lens1, tbl)
-            return attend
+        backend = flag("paged_attention_backend")
+        fused_stream = (backend in ("auto", "stream") and _on_tpu()
+                        and self.head_dim % 128 == 0)
+        if fused_stream:
+            # fused append+attend kernel masks with seq_lens (current
+            # token joins from the operands)
+            ownership = build_pool_ownership(
+                block_tables, seq_lens.astype(jnp.int32), npages,
+                cache.k.shape[2])
 
-        def run_layer(w, h, ck, cv, tbl):
-            return self._layer_body(
-                w, h, seq_lens,
-                lambda k, v: write_kv_pages(ck, cv, k, v, seq_lens, tbl),
-                attend_paged(tbl), cos_t, sin_t)
+            def run_layer(w, h, ck, cv, tbl, base):
+                def attend(q, k, v, _ck, _cv):
+                    return paged_decode_attention_inplace(
+                        q, k, v, ck, cv, seq_lens, tbl,
+                        pool_base=base, pool_pages=npages,
+                        ownership=ownership)
+                return self._layer_body(w, h, seq_lens, None, attend,
+                                        cos_t, sin_t)
+        else:
+            ownership = build_pool_ownership(block_tables, lens1,
+                                             npages, cache.k.shape[2])
+
+            def attend_paged(tbl, base):
+                def attend(q, k, v, ck, cv):
+                    return paged_attention(q, ck, cv, lens1, tbl,
+                                           pool_base=base,
+                                           pool_pages=npages,
+                                           ownership=ownership)
+                return attend
+
+            def run_layer(w, h, ck, cv, tbl, base):
+                return self._layer_body(
+                    w, h, seq_lens,
+                    lambda k, v: write_kv_pages(ck, cv, k, v, seq_lens,
+                                                tbl + base),
+                    attend_paged(tbl, base), cos_t, sin_t)
 
         if isinstance(weights, (list, tuple)):
             h, ck, cv = x, cache.k, cache.v
             for l, w in enumerate(weights):
-                h, ck, cv = run_layer(w, h, ck, cv,
-                                      block_tables + l * npages)
+                h, ck, cv = run_layer(w, h, ck, cv, block_tables,
+                                      l * npages)
             return h, PagedKV(ck, cv)
 
         def body(l, carry):
             h, ck, cv = carry
             w = {n: jax.lax.dynamic_index_in_dim(a, l, 0, False)
                  for n, a in weights.items()}
-            h, ck, cv = run_layer(w, h, ck, cv,
-                                  block_tables + l * npages)
+            h, ck, cv = run_layer(w, h, ck, cv, block_tables, l * npages)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(

@@ -5,8 +5,9 @@ block_multi_head_attention serving kernel (reference:
 paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu — its
 ``block_tables`` input; allocation policy lives in serving frontends).
 Pages are rows of a preallocated PAGE-MAJOR pool
-[num_layers * num_pages, page_size, n_kv_heads, head_dim] (each page one
-contiguous block — see nn/functional/paged_attention.py layout notes);
+[num_layers * num_pages, n_kv_heads, page_size, head_dim] (each page one
+contiguous head-major block — see nn/functional/paged_attention.py
+layout notes);
 the manager hands out LOGICAL page ids from a free list so sequences of
 different lengths share one pool with no copies.
 """
@@ -45,8 +46,8 @@ class BlockKVCacheManager:
         # layer-FOLDED page-major pool (see PagedKV): layer l's logical
         # page p is physical page l * num_pages + p — decode updates it
         # in place; each page is one contiguous DMA block
-        shape = (self.num_layers * self.num_pages, self.page_size,
-                 self.num_kv_heads, self.head_dim)
+        shape = (self.num_layers * self.num_pages, self.num_kv_heads,
+                 self.page_size, self.head_dim)
         return PagedKV(jnp.zeros(shape, self.dtype),
                        jnp.zeros(shape, self.dtype))
 

@@ -13,22 +13,26 @@ softmax reading pages straight from HBM); elsewhere an XLA gather +
 masked dense attention computes the same thing (fake-device test
 precedent, SURVEY §4).
 
-Layouts (PAGE-MAJOR — r4 redesign):
+Layouts (PAGE-MAJOR, head-major pages — r5 redesign):
   q            [batch, num_q_heads, head_dim]        one decode token/seq
-  key_cache    [num_pages, page_size, num_kv_heads, head_dim]
-  value_cache  [num_pages, page_size, num_kv_heads, head_dim]
+  key_cache    [num_pages, num_kv_heads, page_size, head_dim]
+  value_cache  [num_pages, num_kv_heads, page_size, head_dim]
   seq_lens     [batch] int32   tokens already in cache (incl. current)
   block_tables [batch, pages_per_seq] int32          page ids per sequence
 
-Why page-major: one page is a CONTIGUOUS [page_size, n_kv, d] block in
+Why page-major: one page is a CONTIGUOUS [n_kv, page_size, d] block in
 the default XLA layout, so (a) the decode scatter writes token rows
-in-place with no layout transition, (b) the fused Pallas decode kernel
-DMAs whole pages HBM→VMEM, and (c) the XLA gather fallback gathers on
-the leading dim. The stock jax paged_attention kernel wants the old
-[n_kv, P, ps, d] layout and imposes it on operands, which fought the
-scatter's preferred layout (two full-pool copies per layer per token);
-it remains available behind FLAGS_paged_attention_backend=pallas via an
-explicit transpose.
+in-place with no layout transition, (b) the Pallas decode kernels DMA
+whole pages HBM→VMEM, and (c) the XLA gather fallback gathers on the
+leading dim. Heads-major WITHIN the page (r5, vs r4's [ps, n_kv, d]):
+the streaming decode kernel consumes one kv head at a time, and with
+heads outer each per-head slice of a page is a contiguous
+[page_size, d] block — the r4 token-major page made that a 256-byte
+strided gather that cost ~40% of kernel time (decode ablation r5). The
+stock jax paged_attention kernel wants [n_kv, P, ps, d] and imposes it
+on operands, which fought the scatter's preferred layout (two full-pool
+copies per layer per token); it remains available behind
+FLAGS_paged_attention_backend=pallas via an explicit transpose.
 """
 from __future__ import annotations
 
@@ -56,8 +60,8 @@ def _pallas_paged(q, key_cache, value_cache, seq_lens, block_tables):
         paged_attention as kernel,
     )
 
-    key_cache = jnp.transpose(key_cache, (2, 0, 1, 3))
-    value_cache = jnp.transpose(value_cache, (2, 0, 1, 3))
+    key_cache = jnp.transpose(key_cache, (1, 0, 2, 3))
+    value_cache = jnp.transpose(value_cache, (1, 0, 2, 3))
     page_size = key_cache.shape[2]
     pages_per_seq = block_tables.shape[1]
     # one compute block ≥ 512 tokens of K keeps the MXU fed
@@ -77,24 +81,27 @@ def _pallas_paged(q, key_cache, value_cache, seq_lens, block_tables):
 
 def _xla_paged(q, key_cache, value_cache, seq_lens, block_tables):
     b, n_q, d = q.shape
-    _, page_size, n_kv, _ = key_cache.shape
+    _, n_kv, page_size, _ = key_cache.shape
     pages_per_seq = block_tables.shape[1]
     max_len = pages_per_seq * page_size
 
-    # gather pages: [b, pages, page, n_kv, d] -> [b, max_len, n_kv, d]
-    k = key_cache[block_tables].reshape(b, max_len, n_kv, d)
-    v = value_cache[block_tables].reshape(b, max_len, n_kv, d)
+    # gather pages on the leading dim: [b, pages, n_kv, page, d];
+    # the einsums consume the head-major page layout directly
+    k = key_cache[block_tables]
+    v = value_cache[block_tables]
 
     group = n_q // n_kv  # GQA: q heads per kv head
     qh = q.reshape(b, n_kv, group, d)
-    logits = jnp.einsum("bngd,bknd->bngk", qh.astype(jnp.float32),
+    logits = jnp.einsum("bngd,bpnsd->bngps", qh.astype(jnp.float32),
                         k.astype(jnp.float32)) * (d ** -0.5)
+    logits = logits.reshape(b, n_kv, group, max_len)
     pos = jnp.arange(max_len)
     mask = pos[None, :] < seq_lens[:, None]           # [b, max_len]
     logits = jnp.where(mask[:, None, None, :], logits,
                        jnp.finfo(jnp.float32).min)
-    w = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bngk,bknd->bngd", w, v.astype(jnp.float32))
+    w = jax.nn.softmax(logits, axis=-1) \
+        .reshape(b, n_kv, group, pages_per_seq, page_size)
+    out = jnp.einsum("bngps,bpnsd->bngd", w, v.astype(jnp.float32))
     return out.reshape(b, n_q, d).astype(q.dtype)
 
 
@@ -113,7 +120,7 @@ def _fused_paged(q, key_cache, value_cache, seq_lens, block_tables):
     from jax.experimental.pallas import tpu as pltpu
 
     b, n_q, d = q.shape
-    P, ps, n_kv, _ = key_cache.shape
+    P, n_kv, ps, _ = key_cache.shape
     pp = block_tables.shape[1]
     group = n_q // n_kv
     scale = d ** -0.5
@@ -164,10 +171,9 @@ def _fused_paged(q, key_cache, value_cache, seq_lens, block_tables):
                 start_dma(nxt, jax.lax.rem(nxt, jnp.int32(2)))
 
             wait_dma(p, slot)
-            # lane-preserving transpose to put the batch (head) dim
-            # first: Mosaic requires equal batch dim POSITIONS
-            k = jnp.swapaxes(k_buf[slot], 0, 1).astype(jnp.float32)
-            v = jnp.swapaxes(v_buf[slot], 0, 1).astype(jnp.float32)
+            # head-major pages: [n_kv, ps, d] already batch-dim-first
+            k = k_buf[slot].astype(jnp.float32)
+            v = v_buf[slot].astype(jnp.float32)
             # [n_kv, group, ps] <- [n_kv, g, d] x [n_kv, ps, d]
             logits = jax.lax.dot_general(
                 q3, k, (((2,), (2,)), ((0,), (0,))),
@@ -217,8 +223,8 @@ def _fused_paged(q, key_cache, value_cache, seq_lens, block_tables):
         ],
         out_specs=pl.BlockSpec((1, n_q, d), lambda i, *_: (i, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, ps, n_kv, d), key_cache.dtype),
-            pltpu.VMEM((2, ps, n_kv, d), value_cache.dtype),
+            pltpu.VMEM((2, n_kv, ps, d), key_cache.dtype),
+            pltpu.VMEM((2, n_kv, ps, d), value_cache.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ])
@@ -235,40 +241,535 @@ def _fused_paged(q, key_cache, value_cache, seq_lens, block_tables):
     return out.astype(q.dtype)
 
 
-def paged_attention(q, key_cache, value_cache, seq_lens, block_tables):
+def build_pool_ownership(block_tables, seq_lens, pool_pages, page_size):
+    """Token-level inverse of the block tables: for each token slot of
+    one layer's page pool, which batch row owns it and at what position.
+
+    Returns (owner_tok [P*ps] int32 — owning row or -1, pos_tok [P*ps]
+    int32 — the token's position in its owner's sequence). Page entries
+    whose page-start position is already >= the row's seq_len are
+    treated as unallocated padding (block tables are padded with page 0;
+    the reserved scratch page must not inherit an owner). Layer-
+    independent for the layer-folded pool — compute ONCE per decode
+    step and share across layers (the stream kernel's mask operands).
+    """
+    b, pp = block_tables.shape
+    ps = page_size
+    jstart = jnp.arange(pp, dtype=jnp.int32)[None, :] * ps    # [1, pp]
+    validj = jstart < seq_lens.astype(jnp.int32)[:, None]     # [b, pp]
+    # invalid entries are redirected out of range and dropped
+    idx = jnp.where(validj, block_tables.astype(jnp.int32),
+                    jnp.int32(pool_pages)).ravel()
+    rows = jnp.broadcast_to(
+        jnp.arange(b, dtype=jnp.int32)[:, None], (b, pp)).ravel()
+    pidx = jnp.broadcast_to(
+        jnp.arange(pp, dtype=jnp.int32)[None, :], (b, pp)).ravel()
+    owner_page = jnp.full((pool_pages,), -1, jnp.int32) \
+        .at[idx].set(rows, mode="drop")
+    page_index = jnp.zeros((pool_pages,), jnp.int32) \
+        .at[idx].set(pidx, mode="drop")
+    owner_tok = jnp.repeat(owner_page, ps)
+    pos_tok = (jnp.repeat(page_index, ps) * ps
+               + jnp.tile(jnp.arange(ps, dtype=jnp.int32), (pool_pages,)))
+    return owner_tok, pos_tok
+
+
+# target token count per stream chunk; the engine rounds its pool
+# allocation to a multiple of the resulting page count (see
+# inference/engine.py _round_pool_pages, which imports this) so the
+# kernels get full-size chunks
+STREAM_CHUNK_TOKENS = 1024
+
+
+def stream_chunk_pages(page_size: int) -> int:
+    """Full-target pages-per-chunk for a page size (the pool-size
+    rounding quantum)."""
+    return max(1, STREAM_CHUNK_TOKENS // max(page_size, 1))
+
+
+def _pick_chunk_pages(pool_pages: int, page_size: int) -> int:
+    """Pages per stream chunk: the largest divisor of the pool size
+    whose token count stays near STREAM_CHUNK_TOKENS (DMA blocks of a
+    few MB keep the HBM stream saturated; a divisor keeps every block
+    in bounds)."""
+    for cp in range(min(stream_chunk_pages(page_size), pool_pages),
+                    0, -1):
+        if pool_pages % cp == 0:
+            return cp
+    return 1
+
+
+def _stream_paged(q, key_cache, value_cache, seq_lens, block_tables,
+                  pool_base=None, pool_pages=None, ownership=None):
+    """Pool-STREAMING Pallas decode attention (the r5 winning design).
+
+    The r4 fused kernel gridded one SEQUENCE per program: 32 seqs x 17
+    pages of scalar-driven DMAs with tiny [1, d] x [ps, d] dots — it
+    serialized on the single TensorCore and lost to the XLA gather.
+    This kernel inverts the loop: the sequential grid walks the LAYER'S
+    WHOLE PAGE POOL in multi-page chunks (BlockSpec-driven, so Pallas
+    double-buffers the HBM stream automatically), and every chunk is
+    one batched MXU matmul for ALL sequences at once —
+    [n_kv, b*g, d] x [n_kv, C, d] -> [n_kv, b*g, C] logits, masked by
+    token ownership (which row owns each pool slot), online-softmax
+    accumulated in VMEM scratch across chunks. Each KV byte is read
+    exactly once, in perfectly sequential HBM order, with zero gather
+    materialization (the XLA path writes + re-reads a gathered copy of
+    every attended byte).
+
+    Design target: the reference's dedicated decode kernels
+    (paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu,
+    block_multi_head_attention_kernel.cu) — same job, TPU-shaped.
+
+    pool_base: first physical page of this layer's region in a layer-
+    folded pool (block_tables hold LAYER-LOCAL logical page ids).
+    ownership: optional precomputed (owner_tok, pos_tok) from
+    build_pool_ownership — pass it from the decode loop so the 24
+    layers share one computation.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, n_q, d = q.shape
+    _, n_kv, ps, _ = key_cache.shape
+    P = int(pool_pages) if pool_pages is not None else key_cache.shape[0]
+    g = n_q // n_kv
+    bg = b * g
+    scale = d ** -0.5
+    NEG = -1e30
+
+    cp = _pick_chunk_pages(P, ps)
+    C = cp * ps
+    nchunks = P // cp
+
+    if ownership is None:
+        ownership = build_pool_ownership(block_tables, seq_lens, P, ps)
+    owner_tok, pos_tok = ownership
+    # full [b, tokens] validity mask, computed in XLA (one fused
+    # compare, ~P*ps*b int32) and streamed per chunk as a [1, b, C]
+    # block — satisfies Mosaic tiling, and the kernel does zero mask
+    # arithmetic
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    valid_full = ((owner_tok[None, :] == rows)
+                  & (pos_tok[None, :]
+                     < seq_lens.astype(jnp.int32)[:, None]))
+    mask3 = jnp.transpose(
+        valid_full.astype(jnp.int32).reshape(b, nchunks, C), (1, 0, 2))
+
+    # q -> [n_kv, b*g, d] in the kernel's batched-dot layout (transpose
+    # done once here in XLA, not per chunk in the kernel)
+    qt = jnp.transpose(q.reshape(b, n_kv, g, d), (1, 0, 2, 3)) \
+        .reshape(n_kv, bg, d).astype(key_cache.dtype)
+
+    # layer base in chunk units (pool_base = l * P and cp | P -> exact);
+    # pool_base may be a traced loop index
+    base_chunk = jnp.reshape(
+        jnp.asarray(0 if pool_base is None else pool_base, jnp.int32)
+        // jnp.int32(cp), (1,))
+
+    def kernel(base_ref, q_ref, mask_ref, k_ref, v_ref, o_ref,
+               m_ref, l_ref, acc_ref):
+        c = pl.program_id(0)
+
+        @pl.when(c == 0)
+        def _():
+            m_ref[...] = jnp.full((n_kv, bg), NEG, jnp.float32)
+            l_ref[...] = jnp.zeros((n_kv, bg), jnp.float32)
+            acc_ref[...] = jnp.zeros((n_kv, bg, d), jnp.float32)
+
+        valid = mask_ref[0] != 0                         # [b, C]
+        if g > 1:
+            valid = jnp.repeat(valid, g, axis=0)         # [bg, C]
+
+        # head loop (python-unrolled): with heads OUTER in the page
+        # layout, each slice is one contiguous [C, d] block — no
+        # relayout, no strided gather (both measured 40-60% of kernel
+        # time in the r5 decode ablation)
+        for h in range(n_kv):
+            k_h = k_ref[:, h].reshape(C, d)
+            v_h = v_ref[:, h].reshape(C, d)
+            logits = jax.lax.dot_general(
+                q_ref[h], k_h, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.DEFAULT,
+                preferred_element_type=jnp.float32) * jnp.float32(scale)
+            logits = jnp.where(valid, logits, jnp.float32(NEG))
+            m = m_ref[h]
+            pm = jnp.maximum(m, logits.max(-1))          # [bg]
+            alpha = jnp.exp(m - pm)
+            w = jnp.exp(logits - pm[:, None])            # [bg, C]
+            w = jnp.where(valid, w, jnp.float32(0.0))
+            l_ref[h] = l_ref[h] * alpha + w.sum(-1)
+            pv = jax.lax.dot_general(
+                w.astype(v_h.dtype), v_h, (((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.DEFAULT,
+                preferred_element_type=jnp.float32)      # [bg, d]
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + pv
+            m_ref[h] = pm
+
+        @pl.when(c == nchunks - 1)
+        def _():
+            o_ref[...] = acc_ref[...] / jnp.maximum(
+                l_ref[...], jnp.float32(1e-30))[..., None]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nchunks,),
+        in_specs=[
+            pl.BlockSpec((n_kv, bg, d), lambda c, base: (0, 0, 0)),
+            pl.BlockSpec((1, b, C), lambda c, base: (c, 0, 0)),
+            pl.BlockSpec((cp, n_kv, ps, d),
+                         lambda c, base: (base[0] + c, 0, 0, 0)),
+            pl.BlockSpec((cp, n_kv, ps, d),
+                         lambda c, base: (base[0] + c, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n_kv, bg, d), lambda c, base: (0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_kv, bg), jnp.float32),
+            pltpu.VMEM((n_kv, bg), jnp.float32),
+            pltpu.VMEM((n_kv, bg, d), jnp.float32),
+        ])
+    # x64 off for the whole trace (axon enables x64 globally; weak-typed
+    # python scalars would become f64/i64 inside the kernel); interpret
+    # mode off-TPU so the kernel's numerics are testable on CPU
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((n_kv, bg, d), jnp.float32),
+            # double-buffered multi-MB stream chunks overflow the
+            # conservative 16MB default scoped-VMEM budget; v5e has
+            # 128MB physical
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=not _on_tpu(),
+        )(base_chunk, qt, mask3, key_cache, value_cache)
+    out = jnp.transpose(out.reshape(n_kv, b, g, d), (1, 0, 2, 3))
+    return out.reshape(b, n_q, d).astype(q.dtype)
+
+
+def paged_decode_attention_inplace(q, new_k, new_v, key_cache,
+                                   value_cache, seq_lens, block_tables,
+                                   pool_base=None, pool_pages=None,
+                                   ownership=None):
+    """Fused KV-append + pool-streaming decode attention, IN PLACE.
+
+    One Pallas kernel per layer does what the reference's
+    masked_multihead_attention_kernel.cu does on GPU: append the current
+    token's K/V to the paged cache AND attend over it. Returns
+    (out [b, n_q, d], key_cache', value_cache') with the pools aliased
+    in place (``input_output_aliases``).
+
+    Why fusion is load-bearing on TPU (r5 HLO diagnosis): with a
+    separate XLA scatter in the decode loop, layout assignment pins the
+    loop-carried pool to the scatter's preferred token-major physical
+    layout while the Pallas custom call constrains the default
+    head-major layout — XLA inserts two FULL-POOL copies per layer per
+    token (measured 2502 -> 281 tok/s end-to-end). Fused, the pool is
+    touched only by this kernel, so it stays in the default layout and
+    is never copied.
+
+    Mechanics: the sequential grid walks the layer's page region in
+    multi-page chunks (manual double-buffered chunk DMA); every chunk
+    is one batched-per-head MXU matmul for ALL sequences with an
+    ownership mask, online-softmax accumulated in VMEM. The current
+    token's K/V arrive as OPERANDS: they join the softmax as a virtual
+    chunk (diagonal mask), while 2b small DMAs write them into their
+    page slots concurrently — the streamed reads of those rows are
+    masked out (where-before-max also kills any NaN garbage), so the
+    write/read race is benign and the writes land before the kernel
+    returns (waited on the last chunk).
+
+    seq_lens = tokens already cached EXCLUDING the current token (the
+    current token's write position, and its softmax entry comes from
+    the operand, not the pool).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, n_q, d = q.shape
+    _, n_kv, ps, _ = key_cache.shape
+    P = int(pool_pages) if pool_pages is not None else key_cache.shape[0]
+    g = n_q // n_kv
+    bg = b * g
+    scale = d ** -0.5
+    NEG = -1e30
+
+    cp = _pick_chunk_pages(P, ps)
+    C = cp * ps
+    nchunks = P // cp
+
+    if ownership is None:
+        ownership = build_pool_ownership(block_tables, seq_lens, P, ps)
+    owner_tok, pos_tok = ownership
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    valid_full = ((owner_tok[None, :] == rows)
+                  & (pos_tok[None, :]
+                     < seq_lens.astype(jnp.int32)[:, None]))
+    mask3 = jnp.transpose(
+        valid_full.astype(jnp.int32).reshape(b, nchunks, C), (1, 0, 2))
+
+    qt = jnp.transpose(q.reshape(b, n_kv, g, d), (1, 0, 2, 3)) \
+        .reshape(n_kv, bg, d).astype(key_cache.dtype)
+    # two views of the current K/V: [n_kv, b, d] for the compute slices,
+    # [b, n_kv, d] for the page patch (broadcast over slots)
+    nk_t = jnp.swapaxes(new_k, 0, 1).astype(key_cache.dtype)
+    nv_t = jnp.swapaxes(new_v, 0, 1).astype(value_cache.dtype)
+    # page-shaped broadcast for the patch select (Mosaic can't insert a
+    # sub-minor dim on 16-bit values in-kernel)
+    nk_w = jnp.broadcast_to(new_k.astype(key_cache.dtype)[:, :, None, :],
+                            (b, n_kv, ps, d))
+    nv_w = jnp.broadcast_to(
+        new_v.astype(value_cache.dtype)[:, :, None, :], (b, n_kv, ps, d))
+
+    base = jnp.asarray(0 if pool_base is None else pool_base, jnp.int32)
+    lens_i = seq_lens.astype(jnp.int32)
+    wpages = (jnp.take_along_axis(
+        block_tables.astype(jnp.int32),
+        (lens_i // ps)[:, None], axis=1)[:, 0] + base)     # [b] abs page
+    # slot selector as a 4-D f32 operand (single-slot DMA slices violate
+    # Mosaic's sublane tiling — the kernel read-modify-writes WHOLE
+    # pages and blends the slot row arithmetically; f32 because Mosaic
+    # supports only 32-bit sub-minor broadcasts, and pre-shaped 4-D
+    # because i1/bf16 dim insertion doesn't lower)
+    slotmask = (jnp.arange(ps, dtype=jnp.int32)[None, :]
+                == (lens_i % ps)[:, None]) \
+        .astype(jnp.float32)[:, None, :, None]           # [b,1,ps,1]
+    scalars = jnp.concatenate(
+        [jnp.reshape(base // jnp.int32(cp), (1,)), wpages])
+
+    def kernel(s_ref, q_ref, mask_ref, nk_ref, nv_ref, nkw_ref, nvw_ref,
+               sm_ref, k_in, v_in, o_ref, k_hbm, v_hbm,
+               kb, vb, pgk, pgv, m_ref, l_ref, acc_ref, rsem, pin_sem,
+               pout_sem):
+        c = pl.program_id(0)
+        base_c = s_ref[0]
+
+        def chunk_copy(idx, slot):
+            return (
+                pltpu.make_async_copy(
+                    k_hbm.at[pl.ds((base_c + idx) * cp, cp)],
+                    kb.at[slot], rsem.at[slot, 0]),
+                pltpu.make_async_copy(
+                    v_hbm.at[pl.ds((base_c + idx) * cp, cp)],
+                    vb.at[slot], rsem.at[slot, 1]))
+
+        def page_in(i):
+            pid = s_ref[1 + i]
+            return (
+                pltpu.make_async_copy(k_hbm.at[pid], pgk.at[i],
+                                      pin_sem.at[i, 0]),
+                pltpu.make_async_copy(v_hbm.at[pid], pgv.at[i],
+                                      pin_sem.at[i, 1]))
+
+        def page_out(i):
+            pid = s_ref[1 + i]
+            return (
+                pltpu.make_async_copy(pgk.at[i], k_hbm.at[pid],
+                                      pout_sem.at[i, 0]),
+                pltpu.make_async_copy(pgv.at[i], v_hbm.at[pid],
+                                      pout_sem.at[i, 1]))
+
+        @pl.when(c == 0)
+        def _():
+            m_ref[...] = jnp.full((n_kv, bg), NEG, jnp.float32)
+            l_ref[...] = jnp.zeros((n_kv, bg), jnp.float32)
+            acc_ref[...] = jnp.zeros((n_kv, bg, d), jnp.float32)
+            for cpy in chunk_copy(jnp.int32(0), jnp.int32(0)):
+                cpy.start()
+            # current token's K/V: read-modify-write each row's page
+            # (whole-page DMAs; the slot row is patched by vector
+            # select). Page-outs overlap the stream — raced reads see
+            # identical bytes except the masked current row — and are
+            # waited on the last chunk.
+            for i in range(b):
+                for cpy in page_in(i):
+                    cpy.start()
+            for i in range(b):
+                for cpy in page_in(i):
+                    cpy.wait()
+            sel = sm_ref[...]                            # [b,1,ps,1] f32
+            inv = jnp.float32(1.0) - sel
+            pgk[...] = (pgk[...].astype(jnp.float32) * inv
+                        + nkw_ref[...].astype(jnp.float32) * sel) \
+                .astype(pgk.dtype)
+            pgv[...] = (pgv[...].astype(jnp.float32) * inv
+                        + nvw_ref[...].astype(jnp.float32) * sel) \
+                .astype(pgv.dtype)
+            for i in range(b):
+                for cpy in page_out(i):
+                    cpy.start()
+
+        @pl.when(c + 1 < nchunks)
+        def _():
+            for cpy in chunk_copy(c + 1, jax.lax.rem(c + 1,
+                                                     jnp.int32(2))):
+                cpy.start()
+
+        slot = jax.lax.rem(c, jnp.int32(2))
+        for cpy in chunk_copy(c, slot):
+            cpy.wait()
+
+        valid = mask_ref[0] != 0                         # [b, C]
+        if g > 1:
+            valid = jnp.repeat(valid, g, axis=0)         # [bg, C]
+
+        # current-token virtual chunk: row i attends to operand column i
+        diag = (jax.lax.broadcasted_iota(jnp.int32, (bg, b), 0) // g
+                == jax.lax.broadcasted_iota(jnp.int32, (bg, b), 1))
+        last = c == nchunks - 1
+
+        for h in range(n_kv):
+            k_h = kb[slot, :, h].reshape(C, d)
+            v_h = vb[slot, :, h].reshape(C, d)
+            logits = jax.lax.dot_general(
+                q_ref[h], k_h, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.DEFAULT,
+                preferred_element_type=jnp.float32) * jnp.float32(scale)
+            logits = jnp.where(valid, logits, jnp.float32(NEG))
+            m = m_ref[h]
+            pm = jnp.maximum(m, logits.max(-1))          # [bg]
+            alpha = jnp.exp(m - pm)
+            w = jnp.exp(logits - pm[:, None])            # [bg, C]
+            w = jnp.where(valid, w, jnp.float32(0.0))
+            l_h = l_ref[h] * alpha + w.sum(-1)
+            pv = jax.lax.dot_general(
+                w.astype(v_h.dtype), v_h, (((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.DEFAULT,
+                preferred_element_type=jnp.float32)      # [bg, d]
+            acc_h = acc_ref[h] * alpha[:, None] + pv
+            m_ref[h] = pm
+            l_ref[h] = l_h
+            acc_ref[h] = acc_h
+
+        @pl.when(c == nchunks - 1)
+        def _():
+            # fold in the current token from the operands, normalize
+            for h in range(n_kv):
+                lc = jax.lax.dot_general(
+                    q_ref[h], nk_ref[h], (((1,), (1,)), ((), ())),
+                    precision=jax.lax.Precision.DEFAULT,
+                    preferred_element_type=jnp.float32) \
+                    * jnp.float32(scale)                 # [bg, b]
+                lc = jnp.where(diag, lc, jnp.float32(NEG))
+                m = m_ref[h]
+                pm = jnp.maximum(m, lc.max(-1))
+                alpha = jnp.exp(m - pm)
+                wc = jnp.exp(lc - pm[:, None])
+                wc = jnp.where(diag, wc, jnp.float32(0.0))
+                l_h = l_ref[h] * alpha + wc.sum(-1)
+                pv = jax.lax.dot_general(
+                    wc.astype(nv_ref.dtype), nv_ref[h],
+                    (((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.DEFAULT,
+                    preferred_element_type=jnp.float32)
+                acc_h = acc_ref[h] * alpha[:, None] + pv
+                o_ref[h] = acc_h / jnp.maximum(
+                    l_h, jnp.float32(1e-30))[:, None]
+            for i in range(b):
+                for cpy in page_out(i):
+                    cpy.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nchunks,),
+        in_specs=[
+            pl.BlockSpec((n_kv, bg, d), lambda c, s: (0, 0, 0)),
+            pl.BlockSpec((1, b, C), lambda c, s: (c, 0, 0)),
+            pl.BlockSpec((n_kv, b, d), lambda c, s: (0, 0, 0)),
+            pl.BlockSpec((n_kv, b, d), lambda c, s: (0, 0, 0)),
+            pl.BlockSpec((b, n_kv, ps, d), lambda c, s: (0, 0, 0, 0)),
+            pl.BlockSpec((b, n_kv, ps, d), lambda c, s: (0, 0, 0, 0)),
+            pl.BlockSpec((b, 1, ps, 1), lambda c, s: (0, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((n_kv, bg, d), lambda c, s: (0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, cp, n_kv, ps, d), key_cache.dtype),
+            pltpu.VMEM((2, cp, n_kv, ps, d), value_cache.dtype),
+            pltpu.VMEM((b, n_kv, ps, d), key_cache.dtype),
+            pltpu.VMEM((b, n_kv, ps, d), value_cache.dtype),
+            pltpu.VMEM((n_kv, bg), jnp.float32),
+            pltpu.VMEM((n_kv, bg), jnp.float32),
+            pltpu.VMEM((n_kv, bg, d), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((b, 2)),
+            pltpu.SemaphoreType.DMA((b, 2)),
+        ])
+    with jax.enable_x64(False):
+        out, ck, cv = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((n_kv, bg, d), jnp.float32),
+                jax.ShapeDtypeStruct(key_cache.shape, key_cache.dtype),
+                jax.ShapeDtypeStruct(value_cache.shape,
+                                     value_cache.dtype),
+            ],
+            # inputs are numbered with the scalar-prefetch operand as 0:
+            # key_cache is arg 8, value_cache arg 9 -> outputs 1, 2
+            input_output_aliases={8: 1, 9: 2},
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=not _on_tpu(),
+        )(scalars, qt, mask3, nk_t, nv_t, nk_w, nv_w, slotmask,
+          key_cache, value_cache)
+    out = jnp.transpose(out.reshape(n_kv, b, g, d), (1, 0, 2, 3))
+    return out.reshape(b, n_q, d).astype(q.dtype), ck, cv
+
+
+def paged_attention(q, key_cache, value_cache, seq_lens, block_tables,
+                    pool_base=None, pool_pages=None, ownership=None):
     """Single-token decode attention over a paged KV cache.
 
     Raw-array functional op (used inside compiled decode steps).
+    ``pool_base``/``pool_pages`` describe a layer-folded pool: the
+    block_tables hold LAYER-LOCAL page ids and the layer's region
+    starts at physical page ``pool_base`` (defaults: whole pool).
 
-    Backend selection (FLAGS_paged_attention_backend: auto|fused|xla|pallas):
-    ``auto`` uses the XLA gather+masked-attention path on TPU. Measured
-    reason (r4, 1.3B decode): the stock Pallas kernel imposes the
-    default ``{3,2,1,0}`` layout on the cache operands while the
-    in-place page scatter prefers ``{3,0,2,1}``, so mixing them makes
-    XLA insert two full-pool layout copies per layer per token —
-    catastrophically slower than the gather it avoids. All-XLA keeps
-    one layout end-to-end. The Pallas kernel stays available for
-    layouts/configs where it wins (requires head_dim % 128 == 0).
+    Backend selection (FLAGS_paged_attention_backend:
+    auto|stream|fused|xla|pallas): ``auto`` uses the pool-streaming
+    Pallas kernel on TPU when its layout constraints hold (head_dim a
+    lane multiple, layer region a whole number of stream chunks) and
+    the XLA gather+masked-attention path otherwise. The r4 measured
+    ranking (stock jax kernel forces a pool relayout the scatter hates;
+    the per-sequence fused kernel serializes) is documented on each
+    backend's function.
     """
     from ...core.flags import flag
 
     backend = flag("paged_attention_backend")
-    if backend not in ("auto", "fused", "xla", "pallas"):
+    if backend not in ("auto", "stream", "fused", "xla", "pallas"):
         raise ValueError(
             f"FLAGS_paged_attention_backend={backend!r}: valid values "
-            "are 'auto', 'fused', 'xla', 'pallas'")
+            "are 'auto', 'stream', 'fused', 'xla', 'pallas'")
+    P = int(pool_pages) if pool_pages is not None else key_cache.shape[0]
+    base = 0 if pool_base is None else pool_base
+    if backend == "auto":
+        d = q.shape[-1]
+        backend = "stream" if (_on_tpu() and d % 128 == 0
+                               and pool_base is not None) else "xla"
+    if backend == "stream":
+        return _stream_paged(q, key_cache, value_cache, seq_lens,
+                             block_tables, pool_base=pool_base,
+                             pool_pages=pool_pages, ownership=ownership)
+    abs_tables = block_tables + base if pool_base is not None \
+        else block_tables
     if backend == "pallas":
         return _pallas_paged(q, key_cache, value_cache, seq_lens,
-                             block_tables)
+                             abs_tables)
     if backend == "fused":
-        # hand-written page-DMA kernel: numerically verified, but the
-        # per-sequence grid serializes on the single TensorCore and
-        # loses to the XLA gather end-to-end on v5e (2019 vs 2531 tok/s
-        # on the 1.3B b32 rung; page 32/64 didn't close it) — explicit
-        # opt-in only until a multi-sequence-per-program variant wins
+        # r4 kernel: one sequence per grid program — numerically
+        # verified but serializes on the single TensorCore and loses to
+        # the XLA gather end-to-end (2019 vs 2531 tok/s, 1.3B b32);
+        # kept for comparison
         return _fused_paged(q, key_cache, value_cache, seq_lens,
-                            block_tables)
-    return _xla_paged(q, key_cache, value_cache, seq_lens, block_tables)
+                            abs_tables)
+    return _xla_paged(q, key_cache, value_cache, seq_lens, abs_tables)
 
 
 def write_kv_pages(key_cache, value_cache, new_k, new_v, positions,
@@ -277,17 +778,19 @@ def write_kv_pages(key_cache, value_cache, new_k, new_v, positions,
 
     new_k/new_v: [batch, num_kv_heads, head_dim]; positions: [batch] slot
     index of the new token (0-based). Returns updated caches. The page-
-    major layout makes this a natural scatter: indexed dims (page, slot)
-    lead, the updated [n_kv, d] rows are contiguous — XLA keeps it in
-    place on a loop-carried pool.
+    major layout keeps this a natural in-place scatter on a loop-carried
+    pool: the indexed page dim leads; within the page the token's
+    [n_kv, d] rows land at slot stride (head-major pages trade the r4
+    contiguous token row for contiguous per-head READS — the decode
+    loop reads ~100x more than it writes).
     """
-    page_size = key_cache.shape[1]
+    page_size = key_cache.shape[2]
     b = positions.shape[0]
     page_ids = block_tables[jnp.arange(b), positions // page_size]  # [b]
     slots = positions % page_size                                   # [b]
-    key_cache = key_cache.at[page_ids, slots].set(
+    key_cache = key_cache.at[page_ids, :, slots].set(
         new_k.astype(key_cache.dtype))
-    value_cache = value_cache.at[page_ids, slots].set(
+    value_cache = value_cache.at[page_ids, :, slots].set(
         new_v.astype(value_cache.dtype))
     return key_cache, value_cache
 
@@ -298,12 +801,12 @@ def write_prefill_kv_pages(key_cache, value_cache, k, v, block_tables):
     Assumes the prompt starts at position 0 (fresh sequences).
     """
     b, s, n_kv, d = k.shape
-    page_size = key_cache.shape[1]
+    page_size = key_cache.shape[2]
     pos = jnp.arange(s)
     page_ids = block_tables[:, pos // page_size]      # [b, s]
     slots = jnp.broadcast_to(pos % page_size, (b, s))  # [b, s]
-    key_cache = key_cache.at[page_ids, slots].set(
+    key_cache = key_cache.at[page_ids, :, slots].set(
         k.astype(key_cache.dtype))
-    value_cache = value_cache.at[page_ids, slots].set(
+    value_cache = value_cache.at[page_ids, :, slots].set(
         v.astype(value_cache.dtype))
     return key_cache, value_cache

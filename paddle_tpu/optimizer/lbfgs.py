@@ -187,7 +187,11 @@ class LBFGS(Optimizer):
             n_iter += 1
             st["n_iter"] += 1
             # ---- direction: two-loop recursion over (s, y) history ----
-            if st["n_iter"] == 1:
+            if st["n_iter"] == 1 or st["d"] is None:
+                # st["d"] is None when a previous step() broke on the
+                # directional-derivative check before ever taking a
+                # step — restart from steepest descent instead of
+                # dereferencing the never-stored (d, t)
                 d = -flat_grad
                 st["old_sk"], st["old_yk"], st["ro"] = [], [], []
                 st["H_diag"] = 1.0

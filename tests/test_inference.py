@@ -46,17 +46,17 @@ class TestPagedAttention:
         k_full = rng.randn(b, max_len, n_kv, d).astype(np.float32)
         v_full = rng.randn(b, max_len, n_kv, d).astype(np.float32)
 
-        # scatter the dense kv into PAGE-MAJOR pages via contiguous
-        # tables ([P, page, n_kv, d] — r4 layout)
-        key_cache = np.zeros((b * pages_per_seq, page, n_kv, d), np.float32)
+        # scatter the dense kv into PAGE-MAJOR head-major pages via
+        # contiguous tables ([P, n_kv, page, d] — r5 layout)
+        key_cache = np.zeros((b * pages_per_seq, n_kv, page, d), np.float32)
         val_cache = np.zeros_like(key_cache)
         tables = np.arange(b * pages_per_seq,
                            dtype=np.int32).reshape(b, pages_per_seq)
         for i in range(b):
             for t in range(max_len):
                 pg, sl = tables[i, t // page], t % page
-                key_cache[pg, sl] = k_full[i, t]
-                val_cache[pg, sl] = v_full[i, t]
+                key_cache[pg, :, sl] = k_full[i, t]
+                val_cache[pg, :, sl] = v_full[i, t]
 
         out = paged_attention(jnp.asarray(q), jnp.asarray(key_cache),
                               jnp.asarray(val_cache),
@@ -68,7 +68,7 @@ class TestPagedAttention:
     def test_write_then_read_roundtrip(self):
         rng = np.random.RandomState(1)
         b, n_kv, d, page, pps = 2, 2, 4, 4, 3
-        cache_k = jnp.zeros((b * pps, page, n_kv, d))
+        cache_k = jnp.zeros((b * pps, n_kv, page, d))
         cache_v = jnp.zeros_like(cache_k)
         tables = jnp.asarray(
             np.arange(b * pps, dtype=np.int32).reshape(b, pps))

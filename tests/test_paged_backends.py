@@ -36,8 +36,8 @@ def test_auto_backend_off_tpu_is_xla():
     rng = np.random.RandomState(0)
     b, n, d, ps, pp = 2, 4, 8, 4, 3
     q = jnp.asarray(rng.randn(b, n, d).astype(np.float32))
-    kc = jnp.asarray(rng.randn(b * pp, ps, n, d).astype(np.float32))
-    vc = jnp.asarray(rng.randn(b * pp, ps, n, d).astype(np.float32))
+    kc = jnp.asarray(rng.randn(b * pp, n, ps, d).astype(np.float32))
+    vc = jnp.asarray(rng.randn(b * pp, n, ps, d).astype(np.float32))
     lens = jnp.asarray(np.array([5, 9], np.int32))
     tables = jnp.asarray(
         np.arange(b * pp, dtype=np.int32).reshape(b, pp))
@@ -50,8 +50,8 @@ def test_auto_backend_off_tpu_is_xla():
     tb = np.asarray(tables)
     for i in range(b):
         for t in range(max_len):
-            k_full[i, t] = np.asarray(kc)[tb[i, t // ps], t % ps]
-            v_full[i, t] = np.asarray(vc)[tb[i, t // ps], t % ps]
+            k_full[i, t] = np.asarray(kc)[tb[i, t // ps], :, t % ps]
+            v_full[i, t] = np.asarray(vc)[tb[i, t // ps], :, t % ps]
     logits = np.einsum("bhd,blhd->bhl", np.asarray(q), k_full) \
         * (d ** -0.5)
     mask = np.arange(max_len)[None, :] < np.asarray(lens)[:, None]
@@ -66,7 +66,7 @@ def test_auto_backend_off_tpu_is_xla():
 def test_page_major_scatter_roundtrip_dtype_cast():
     """bf16 pool accepts fp32 writes (serving KV dtype decoupled from
     compute dtype)."""
-    ck = jnp.zeros((4, 2, 3, 8), jnp.bfloat16)
+    ck = jnp.zeros((4, 3, 2, 8), jnp.bfloat16)
     cv = jnp.zeros_like(ck)
     k = jnp.ones((2, 3, 8), jnp.float32)
     v = jnp.full((2, 3, 8), 2.0, jnp.float32)
@@ -75,8 +75,8 @@ def test_page_major_scatter_roundtrip_dtype_cast():
     ck2, cv2 = write_kv_pages(ck, cv, k, v, pos, tables)
     assert ck2.dtype == jnp.bfloat16
     # seq 0 wrote page 0 slot 0; seq 1 wrote page 3 slot 1
-    np.testing.assert_allclose(np.asarray(ck2[0, 0], np.float32), 1.0)
-    np.testing.assert_allclose(np.asarray(cv2[3, 1], np.float32), 2.0)
+    np.testing.assert_allclose(np.asarray(ck2[0, :, 0], np.float32), 1.0)
+    np.testing.assert_allclose(np.asarray(cv2[3, :, 1], np.float32), 2.0)
     np.testing.assert_allclose(np.asarray(ck2[1], np.float32), 0.0)
 
 
@@ -90,9 +90,9 @@ def test_fused_kernel_matches_xla_on_tpu():
     P = b * pp + 1
     q = jnp.asarray(rng.randn(b, n_q, d).astype(np.float32)) \
         .astype(jnp.bfloat16)
-    kc = jnp.asarray(rng.randn(P, ps, n_kv, d).astype(np.float32)) \
+    kc = jnp.asarray(rng.randn(P, n_kv, ps, d).astype(np.float32)) \
         .astype(jnp.bfloat16)
-    vc = jnp.asarray(rng.randn(P, ps, n_kv, d).astype(np.float32)) \
+    vc = jnp.asarray(rng.randn(P, n_kv, ps, d).astype(np.float32)) \
         .astype(jnp.bfloat16)
     lens = jnp.asarray(rng.randint(1, pp * ps, (b,)).astype(np.int32))
     tables = jnp.asarray(
@@ -102,3 +102,111 @@ def test_fused_kernel_matches_xla_on_tpu():
     out_x = np.asarray(_xla_paged(q, kc, vc, lens, tables)
                        .astype(jnp.float32))
     np.testing.assert_allclose(out_f, out_x, atol=0.03)
+
+
+def _dense_paged_ref(q, kc, vc, lens, tables, ps):
+    """NumPy dense reference over gathered pages."""
+    b, n_q, d = q.shape
+    n_kv = kc.shape[2]
+    g = n_q // n_kv
+    pp = tables.shape[1]
+    max_len = pp * ps
+    k_full = np.zeros((b, max_len, n_kv, d), np.float32)
+    v_full = np.zeros((b, max_len, n_kv, d), np.float32)
+    for i in range(b):
+        for t in range(max_len):
+            k_full[i, t] = np.asarray(kc)[tables[i, t // ps], :, t % ps]
+            v_full[i, t] = np.asarray(vc)[tables[i, t // ps], :, t % ps]
+    qh = np.asarray(q, np.float32).reshape(b, n_kv, g, d)
+    logits = np.einsum("bngd,blnd->bngl", qh, k_full) * (d ** -0.5)
+    mask = np.arange(max_len)[None, :] < np.asarray(lens)[:, None]
+    logits = np.where(mask[:, None, None, :], logits, -1e30)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    return np.einsum("bngl,blnd->bngd", w, v_full).reshape(b, n_q, d)
+
+
+@pytest.mark.parametrize("g", [1, 2])
+def test_stream_kernel_parity(g):
+    """Pool-streaming kernel vs dense reference: MHA + GQA, ragged
+    lens incl. a zero-length (idle slot) row, layer-folded base offset.
+    Runs in Pallas interpret mode off-TPU, compiled on the chip."""
+    from paddle_tpu.nn.functional.paged_attention import (
+        _stream_paged, build_pool_ownership)
+
+    rng = np.random.RandomState(1)
+    b, n_kv, d, ps, pp = 4, 4, 128, 4, 6
+    n_q = n_kv * g
+    P, L = 24, 2
+    q = jnp.asarray(rng.randn(b, n_q, d).astype(np.float32))
+    kpool = jnp.asarray(rng.randn(L * P, n_kv, ps, d).astype(np.float32))
+    vpool = jnp.asarray(rng.randn(L * P, n_kv, ps, d).astype(np.float32))
+    lens_np = np.array([5, 17, 0, 24], np.int32)
+    tables_np = np.zeros((b, pp), np.int32)
+    perm = rng.permutation(np.arange(1, P))
+    i = 0
+    for r in range(b):
+        n = -(-int(lens_np[r]) // ps)
+        tables_np[r, :n] = perm[i:i + n]
+        i += n
+    lens, tables = jnp.asarray(lens_np), jnp.asarray(tables_np)
+    own = build_pool_ownership(tables, lens, P, ps)
+    for base in (0, P):
+        out = np.asarray(_stream_paged(
+            q, kpool, vpool, lens, tables, pool_base=base,
+            pool_pages=P, ownership=own))
+        ref = _dense_paged_ref(q, kpool[base:base + P],
+                               vpool[base:base + P], lens_np, tables_np,
+                               ps)
+        # the zero-length row is defined as 0 output by the kernel
+        ref[lens_np == 0] = 0.0
+        np.testing.assert_allclose(out, ref, atol=3e-2)
+
+
+@pytest.mark.parametrize("g", [1, 2])
+def test_fused_inplace_kernel_parity(g):
+    """paged_decode_attention_inplace (the default TPU serving path):
+    append + attend in one kernel must equal scatter-write followed by
+    the XLA gather attention with lens+1, AND must have patched exactly
+    the written rows of the layer's pool region in place (other layers'
+    regions untouched). Interpret mode off-TPU, compiled on the chip."""
+    from paddle_tpu.nn.functional.paged_attention import (
+        _xla_paged, paged_decode_attention_inplace, write_kv_pages)
+
+    rng = np.random.RandomState(5)
+    b, n_kv, d, ps = 4, 2, 128, 4
+    n_q = n_kv * g
+    pp, P, L = 6, 16, 2
+    q = jnp.asarray(rng.randn(b, n_q, d).astype(np.float32))
+    nk = jnp.asarray(rng.randn(b, n_kv, d).astype(np.float32))
+    nv = jnp.asarray(rng.randn(b, n_kv, d).astype(np.float32))
+    kpool = jnp.asarray(rng.randn(L * P, n_kv, ps, d).astype(np.float32))
+    vpool = jnp.asarray(rng.randn(L * P, n_kv, ps, d).astype(np.float32))
+    lens_np = np.array([5, 0, 13, 9], np.int32)  # incl. idle slot
+    tables_np = np.zeros((b, pp), np.int32)
+    perm = rng.permutation(np.arange(1, P))
+    i = 0
+    for r in range(b):
+        n = -(-int(lens_np[r] + 1) // ps)
+        tables_np[r, :n] = perm[i:i + n]
+        i += n
+    lens, tables = jnp.asarray(lens_np), jnp.asarray(tables_np)
+    for base in (0, P):
+        out, ck, cv = paged_decode_attention_inplace(
+            q, nk, nv, kpool, vpool, lens, tables,
+            pool_base=base, pool_pages=P)
+        ck_ref, cv_ref = write_kv_pages(
+            kpool[base:base + P], vpool[base:base + P], nk, nv, lens,
+            tables)
+        ref = _xla_paged(q, ck_ref, cv_ref, lens + 1, tables)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-2)
+        # in-place page writes: layer region equals the scatter result,
+        # the OTHER layer's region is bit-untouched
+        np.testing.assert_array_equal(np.asarray(ck[base:base + P]),
+                                      np.asarray(ck_ref))
+        np.testing.assert_array_equal(np.asarray(cv[base:base + P]),
+                                      np.asarray(cv_ref))
+        other = slice(P, 2 * P) if base == 0 else slice(0, P)
+        np.testing.assert_array_equal(np.asarray(ck[other]),
+                                      np.asarray(kpool[other]))

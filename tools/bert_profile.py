@@ -1,0 +1,135 @@
+"""BERT-rung ablation ladder (VERDICT r4 weak #2 diagnosis).
+
+Times the bert-base pretraining step (bench.py --bert geometry: b32
+s512, AMP O2 bf16, whole-step compiled) with one component changed per
+mode, in a fresh subprocess each:
+
+    python tools/bert_profile.py --mode full|nodrop|nohead|noce|...
+
+Each mode prints one JSON line {mode, tokens_per_sec, mfu}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+BATCH, SEQ, STEPS = 32, 512, 8
+
+
+def run(batch=BATCH, seq=SEQ, dropout=0.1, head="full", ce="full",
+        attn_dropout=0.0, fa_blocks=None, moment_dtype="bfloat16"):
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import (BertForPretraining,
+                                        BertPretrainingCriterion,
+                                        bert_base)
+
+    paddle.seed(0)
+    if fa_blocks is not None:
+        from paddle_tpu.nn.functional.attention import (
+            set_flash_block_sizes)
+
+        set_flash_block_sizes(*fa_blocks)
+    model = BertForPretraining(
+        bert_base(max_position_embeddings=seq,
+                  hidden_dropout_prob=dropout,
+                  attention_probs_dropout_prob=attn_dropout))
+    if head == "none":
+        # knock out the MLM decoder matmul: loss feeds on the transform
+        # output's first 2 vocab-ish columns instead
+        import jax.numpy as jnp
+
+        import paddle_tpu as pd
+
+        orig_forward = BertForPretraining.forward
+
+        def forward_nohead(self, input_ids, token_type_ids=None,
+                           attention_mask=None):
+            seq_h, pooled = self.bert(input_ids, token_type_ids,
+                                      attention_mask)
+            h = self.transform_norm(
+                self.transform_act(self.transform(seq_h)))
+            b, s, d = h.shape
+            vocab = self.decoder_bias.shape[0]
+            mlm = pd.zeros([b, s, vocab], dtype=h.dtype) + \
+                h[:, :, :1] + self.decoder_bias
+            return mlm, self.nsp(pooled)
+        BertForPretraining.forward = forward_nohead
+    crit = BertPretrainingCriterion()
+    if ce == "none":
+        class MeanCrit(paddle.nn.Layer):
+            def forward(self, mlm_logits, nsp_logits, mlm_labels,
+                        nsp_labels):
+                return mlm_logits.astype("float32").mean() \
+                    + nsp_logits.astype("float32").mean()
+        crit = MeanCrit()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 weight_decay=0.01,
+                                 moment_dtype=moment_dtype)
+    model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                     dtype="bfloat16")
+    step = paddle.jit.TrainStep(model, crit, opt)
+
+    rng = np.random.RandomState(0)
+    vocab = 30522
+    ids = paddle.to_tensor(rng.randint(0, vocab, (batch, seq)))
+    types = paddle.to_tensor(rng.randint(0, 2, (batch, seq)))
+    mlm = paddle.to_tensor(np.where(
+        rng.rand(batch, seq) < 0.15,
+        rng.randint(0, vocab, (batch, seq)), -100))
+    nsp = paddle.to_tensor(rng.randint(0, 2, (batch,)))
+    args, labels = [ids, types], [mlm, nsp]
+
+    loss = step(args, labels)
+    _ = float(loss.numpy())
+    t0 = time.perf_counter()
+    for _i in range(STEPS):
+        loss = step(args, labels)
+    final = float(loss.numpy())
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final)
+    n_params = sum(int(np.prod(p.shape))
+                   for _n, p in model.named_parameters())
+    tps = STEPS * batch * seq / dt
+    d_model, n_layers = 768, 12
+    fpt = 6 * n_params + 12 * n_layers * seq * d_model
+    peak = 197e12
+    return tps, round(tps * fpt / peak, 4)
+
+
+MODES = {
+    "full": lambda: run(),
+    "nodrop": lambda: run(dropout=0.0),
+    "nohead": lambda: run(head="none"),
+    "noce": lambda: run(ce="none"),
+    "nodrop_noce": lambda: run(dropout=0.0, ce="none"),
+    "nodrop_nohead": lambda: run(dropout=0.0, head="none"),
+    "b64": lambda: run(batch=64),
+    "nodrop_b64": lambda: run(batch=64, dropout=0.0),
+    "fa128": lambda: run(fa_blocks=(128, 128)),
+    "fa512": lambda: run(fa_blocks=(512, 512)),
+    "attndrop": lambda: run(attn_dropout=None),  # canonical attn dropout
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", required=True, choices=sorted(MODES))
+    args = ap.parse_args()
+    t0 = time.time()
+    tps, mfu = MODES[args.mode]()
+    print(json.dumps({"mode": args.mode, "tokens_per_sec": round(tps, 1),
+                      "mfu": mfu, "wall": round(time.time() - t0, 1)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -70,13 +70,11 @@ def mode_full(cache_dtype="float32", attn="pallas", bf16_embed=False):
                            max_length=PROMPT + CHUNK + 2,
                            decode_chunk=CHUNK)
     if attn == "xla":
-        import paddle_tpu.nn.functional.paged_attention as pa
+        import paddle_tpu as _p
 
-        def forced(q, kc, vc, lens, tables):
-            return pa._xla_paged(q, kc, vc, lens, tables)
-        pa.paged_attention = forced
-        import paddle_tpu.incubate.nn.fused_transformer as ft
-        ft.paged_attention = forced
+        # flag (not monkeypatch): decode_raw's fused-stream branch
+        # checks the flag and would bypass a patched paged_attention
+        _p.set_flags({"paged_attention_backend": "xla"})
     if cache_dtype != "float32":
         from paddle_tpu.inference import kv_cache as kvmod
         orig_init = kvmod.BlockKVCacheManager.__init__
@@ -197,9 +195,9 @@ def mode_pallas_attn(dtype="float32"):
     pages_per_seq = -(-(PROMPT + CHUNK + 2) // PAGE)
     npages = BATCH * pages_per_seq + 1
     dt = jnp.float32 if dtype == "float32" else jnp.bfloat16
-    # PAGE-MAJOR pool (r4 layout): [P, ps, n_kv, d]
-    ck = jnp.zeros((npages, PAGE, H, HD), dt)
-    cv = jnp.zeros((npages, PAGE, H, HD), dt)
+    # PAGE-MAJOR head-major pool (r5 layout): [P, n_kv, ps, d]
+    ck = jnp.zeros((npages, H, PAGE, HD), dt)
+    cv = jnp.zeros((npages, H, PAGE, HD), dt)
     tables = jnp.arange(1, 1 + BATCH * pages_per_seq, dtype=jnp.int32) \
         .reshape(BATCH, pages_per_seq)
     lens = jnp.full((BATCH,), PROMPT, jnp.int32)
@@ -230,7 +228,7 @@ def mode_carry_cache(dtype="float32"):
     pages_per_seq = -(-(PROMPT + CHUNK + 2) // PAGE)
     npages = BATCH * pages_per_seq + 1
     dt = jnp.float32 if dtype == "float32" else jnp.bfloat16
-    shape = (L * npages, PAGE, H, HD)  # page-major (r4 layout)
+    shape = (L * npages, H, PAGE, HD)  # page-major head-major (r5)
     ck, cv = jnp.zeros(shape, dt), jnp.zeros(shape, dt)
     tables = jnp.arange(1, 1 + BATCH * pages_per_seq, dtype=jnp.int32) \
         .reshape(BATCH, pages_per_seq)
@@ -246,8 +244,8 @@ def mode_carry_cache(dtype="float32"):
             def body(l, c):
                 ck, cv = c
                 pid = page_ids + l * npages
-                ck = ck.at[pid, slots].set(newk)
-                cv = cv.at[pid, slots].set(newk)
+                ck = ck.at[pid, :, slots].set(newk)
+                cv = cv.at[pid, :, slots].set(newk)
                 return (ck, cv)
             ck, cv = jax.lax.fori_loop(0, L, body, (ck, cv))
             return (ck, cv), ck[0, 0, 0, 0]
@@ -498,9 +496,9 @@ def mode_xla_paged_attn(batch=32, dtype="bfloat16"):
     pages_per_seq = -(-(PROMPT + CHUNK + 2) // PAGE)
     npages = batch * pages_per_seq + 1
     dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-    # PAGE-MAJOR pool (r4 layout): [P, ps, n_kv, d]
-    ck = jnp.zeros((L * npages, PAGE, H, HD), dt)
-    cv = jnp.zeros((L * npages, PAGE, H, HD), dt)
+    # PAGE-MAJOR head-major pool (r5 layout): [P, n_kv, ps, d]
+    ck = jnp.zeros((L * npages, H, PAGE, HD), dt)
+    cv = jnp.zeros((L * npages, H, PAGE, HD), dt)
     tables = jnp.arange(1, 1 + batch * pages_per_seq, dtype=jnp.int32) \
         .reshape(batch, pages_per_seq)
     lens = jnp.full((batch,), PROMPT, jnp.int32)
@@ -521,18 +519,74 @@ def mode_xla_paged_attn(batch=32, dtype="bfloat16"):
     return batch * CHUNK / sec
 
 
-def mode_engine_full(batch=32):
+def mode_engine_full(batch=32, backend=None, quant=None):
     """Current engine end-to-end at the given batch (bf16 stack; the
-    engine derives bf16 compute + bf16 KV from the weight dtype)."""
+    engine derives bf16 compute + bf16 KV from the weight dtype).
+    backend forces FLAGS_paged_attention_backend; quant='int8' applies
+    weight-only int8 to the stack (the bench's int8 rung)."""
+    import paddle_tpu as paddle
+
+    if backend:
+        paddle.set_flags({"paged_attention_backend": backend})
+    if quant == "int8":
+        orig_build = globals()["build"]
+
+        def build_q(*a, **kw):
+            model = orig_build(*a, **kw)
+            model.stack.quantize_weight_only_int8()
+            return model
+        globals()["build"] = build_q
     global BATCH
     old, BATCH = BATCH, batch
     try:
         return mode_full()
     finally:
         BATCH = old
+        if quant == "int8":
+            globals()["build"] = orig_build
 
 
-def mode_engine_knockout(batch=32, knock="attn"):
+def mode_stream_attn(batch=32, dtype="bfloat16"):
+    """Pool-streaming Pallas attention isolated over the folded pool:
+    64-step scan x 24 layers at the given batch (compare
+    xla_paged_attn_b32 — same traffic, no gather materialization)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.paged_attention import (
+        _stream_paged, build_pool_ownership)
+
+    pages_per_seq = -(-(PROMPT + CHUNK + 2) // PAGE)
+    chunk_pages = max(1, 1024 // PAGE)
+    npages = -(-(batch * pages_per_seq + 1) // chunk_pages) * chunk_pages
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    ck = jnp.zeros((L * npages, H, PAGE, HD), dt)
+    cv = jnp.zeros((L * npages, H, PAGE, HD), dt)
+    tables = jnp.arange(1, 1 + batch * pages_per_seq, dtype=jnp.int32) \
+        .reshape(batch, pages_per_seq)
+    lens = jnp.full((batch,), PROMPT, jnp.int32)
+
+    def chunk(q, ck, cv):
+        own = build_pool_ownership(tables, lens, npages, PAGE)
+
+        def tok_step(q, i):
+            def body(l, qq):
+                o = _stream_paged(qq, ck, cv, lens, tables,
+                                  pool_base=l * npages,
+                                  pool_pages=npages, ownership=own)
+                return o.astype(qq.dtype)
+            q = jax.lax.fori_loop(0, L, body, q)
+            return q, q[0, 0, 0]
+        q, _ = jax.lax.scan(tok_step, q, jnp.arange(CHUNK))
+        return q
+
+    q = jnp.ones((batch, H, HD), dt)
+    fn = jax.jit(chunk)
+    sec = time_chunk(fn, (q, ck, cv))
+    return batch * CHUNK / sec
+
+
+def mode_engine_knockout(batch=32, knock="attn", quant=None):
     """Engine end-to-end with ONE component knocked out in place —
     in-context component cost = full minus knockout."""
     import jax.numpy as jnp
@@ -540,10 +594,23 @@ def mode_engine_knockout(batch=32, knock="attn"):
     import paddle_tpu.incubate.nn.fused_transformer as ft
     from paddle_tpu.inference import GenerationEngine
 
+    if quant == "int8":
+        orig_build = globals()["build"]
+
+        def build_q(*a, **kw):
+            model = orig_build(*a, **kw)
+            model.stack.quantize_weight_only_int8()
+            return model
+        globals()["build"] = build_q
+
     if knock == "attn":
-        def fake_attn(q, ck, cv, lens, tables):
+        def fake_attn(q, ck, cv, lens, tables, **kw):
             return q  # [b, n_q, d] passthrough, no KV read
         ft.paged_attention = fake_attn
+
+        def fake_fused(q, nk, nv, ck, cv, lens, tables, **kw):
+            return q, ck, cv
+        ft.paged_decode_attention_inplace = fake_fused
     elif knock == "head":
         def fake_logits(self, h, head_t, lnf_s, lnf_b):
             b = h.shape[0]
@@ -611,7 +678,19 @@ MODES = {
     "weights_int8": mode_weights_int8,
     "xla_paged_attn_b32": lambda: mode_xla_paged_attn(32),
     "xla_paged_attn_b16": lambda: mode_xla_paged_attn(16),
+    "stream_attn_b32": lambda: mode_stream_attn(32),
+    "stream_attn_b64": lambda: mode_stream_attn(64),
     "engine_b32": lambda: mode_engine_full(32),
+    "engine_stream_b32": lambda: mode_engine_full(32, backend="stream"),
+    "engine_stream_b64": lambda: mode_engine_full(64, backend="stream"),
+    "engine_xla_b64": lambda: mode_engine_full(64, backend="xla"),
+    "engine_int8_b32": lambda: mode_engine_full(32, quant="int8"),
+    "engine_int8_stream_b32":
+        lambda: mode_engine_full(32, backend="stream", quant="int8"),
+    "engine_int8_noattn_b32":
+        lambda: mode_engine_knockout(32, "attn", quant="int8"),
+    "engine_int8_nohead_b32":
+        lambda: mode_engine_knockout(32, "head", quant="int8"),
     "engine_noattn_b32": lambda: mode_engine_knockout(32, "attn"),
     "engine_nohead_b32": lambda: mode_engine_knockout(32, "head"),
     "engine_noargmax_b32": lambda: mode_engine_knockout(32, "argmax"),
