@@ -76,6 +76,11 @@ define_flag("paged_attention_backend", "auto",
             "nn/functional/paged_attention.py) | stream | xla | fused "
             "(r4 per-sequence page-DMA Pallas kernel, opt-in) | pallas "
             "(stock jax kernel via a layout transpose)")
+define_flag("decode_linear", "auto",
+            "decode matmul path: auto/xla (XLA dots over loop-sliced "
+            "stacked weights — measured fastest end-to-end, r5) | "
+            "stream (opt-in Pallas weight-streaming kernel, "
+            "nn/functional/stream_linear.py)")
 define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
 define_flag("eager_jit_ops", True, "dispatch eager ops through cached jit computations")
 define_flag("stop_check_timeout", 900, "bound (seconds) on distributed store waits")

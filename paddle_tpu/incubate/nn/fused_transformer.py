@@ -209,27 +209,27 @@ class FusedMultiTransformer(Layer):
         return x @ w
 
     def _layer_body(self, w, h, positions, kv_write, attend, cos_t,
-                    sin_t):
+                    sin_t, linear=None):
         """One pre-LN transformer layer over hidden ``h`` (any leading
         dims). Compute dtype FOLLOWS h (bf16 weights + bf16 h → pure
         bf16 MXU dots; LN statistics promote to fp32 internally and are
         cast back). ``attend`` may return (att, ck, cv) — the fused
-        append+attend kernel path, where kv_write is skipped."""
+        append+attend kernel path, where kv_write is skipped.
+        ``linear(x, kind)`` computes x @ W_kind + bias (int8 scales
+        applied) — the decode loop overrides it with the weight-
+        streaming kernel over UNSLICED stacked weights."""
         eps = self.epsilon
-        sc = w.get
+        if linear is None:
+            def linear(x, kind):
+                return self._mm(x, w[f"{kind}_weight"],
+                                w.get(f"{kind}_scale")) \
+                    + w[f"{kind}_bias"]
         hn = self._ln(h, w["ln1_scale"], w["ln1_bias"], eps) \
             .astype(h.dtype)
-        qkv_w = w["qkv_weight"]
-        if qkv_w.dtype == jnp.int8:
-            proj = self._mm(hn, qkv_w, w["qkv_scale"]) + w["qkv_bias"]
-            q, k, v = _split_rope(proj, positions, self.num_heads,
-                                  self.num_kv_heads, self.head_dim,
-                                  cos_t, sin_t)
-        else:
-            q, k, v = qkv_split_rope_fused(
-                hn, qkv_w, w["qkv_bias"], positions,
-                self.num_heads, self.num_kv_heads, self.head_dim,
-                cos_t, sin_t)
+        proj = linear(hn, "qkv")
+        q, k, v = _split_rope(proj.astype(h.dtype), positions,
+                              self.num_heads, self.num_kv_heads,
+                              self.head_dim, cos_t, sin_t)
         if kv_write is None:
             att, ck, cv = attend(q, k, v, None, None)
         else:
@@ -237,14 +237,11 @@ class FusedMultiTransformer(Layer):
             att = attend(q, k, v, ck, cv)
         att = att.reshape(*h.shape[:-1],
                           self.num_heads * self.head_dim).astype(h.dtype)
-        h = (h + self._mm(att, w["out_weight"], sc("out_scale"))
-             + w["out_bias"]).astype(h.dtype)
+        h = (h + linear(att, "out")).astype(h.dtype)
         hn = self._ln(h, w["ln2_scale"], w["ln2_bias"], eps) \
             .astype(h.dtype)
-        ff = self._act(self._mm(hn, w["ffn1_weight"], sc("ffn1_scale"))
-                       + w["ffn1_bias"])
-        h = (h + self._mm(ff, w["ffn2_weight"], sc("ffn2_scale"))
-             + w["ffn2_bias"]).astype(h.dtype)
+        ff = self._act(linear(hn, "ffn1").astype(h.dtype))
+        h = (h + linear(ff, "ffn2")).astype(h.dtype)
         return h, ck, cv
 
     def _pages_per_layer(self, cache: PagedKV) -> int:
@@ -341,14 +338,14 @@ class FusedMultiTransformer(Layer):
                 block_tables, seq_lens.astype(jnp.int32), npages,
                 cache.k.shape[2])
 
-            def run_layer(w, h, ck, cv, tbl, base):
+            def run_layer(w, h, ck, cv, tbl, base, linear=None):
                 def attend(q, k, v, _ck, _cv):
                     return paged_decode_attention_inplace(
                         q, k, v, ck, cv, seq_lens, tbl,
                         pool_base=base, pool_pages=npages,
                         ownership=ownership)
                 return self._layer_body(w, h, seq_lens, None, attend,
-                                        cos_t, sin_t)
+                                        cos_t, sin_t, linear=linear)
         else:
             ownership = build_pool_ownership(block_tables, lens1,
                                              npages, cache.k.shape[2])
@@ -361,25 +358,55 @@ class FusedMultiTransformer(Layer):
                                            ownership=ownership)
                 return attend
 
-            def run_layer(w, h, ck, cv, tbl, base):
+            def run_layer(w, h, ck, cv, tbl, base, linear=None):
                 return self._layer_body(
                     w, h, seq_lens,
                     lambda k, v: write_kv_pages(ck, cv, k, v, seq_lens,
                                                 tbl + base),
-                    attend_paged(tbl, base), cos_t, sin_t)
+                    attend_paged(tbl, base), cos_t, sin_t,
+                    linear=linear)
 
         if isinstance(weights, (list, tuple)):
             h, ck, cv = x, cache.k, cache.v
             for l, w in enumerate(weights):
                 h, ck, cv = run_layer(w, h, ck, cv, block_tables,
-                                      l * npages)
+                                      l * npages, None)
             return h, PagedKV(ck, cv)
+
+        # matmul weights stay STACKED: the weight-streaming kernel reads
+        # layer l's block directly via a prefetched index, so the loop
+        # never materializes a per-layer [K, N] slice (a dynamic-slice
+        # operand to the kernel's custom call would copy ~100MB/layer)
+        from ...core.flags import flag as _flag
+        from ...nn.functional.stream_linear import stream_linear
+
+        # dtype-aware auto (r5 1.3B b32 end-to-end): bf16 weights run
+        # FASTER through XLA's sliced dots (2916 vs 2749 tok/s — the
+        # ~96 kernel dispatches/step eat the DMA gains), int8 weights
+        # run faster through the streaming kernel whose dequant fuses
+        # into the block DMA (3398 vs 3231)
+        lin_flag = _flag("decode_linear")
+        is_int8 = weights["qkv_weight"].dtype == jnp.int8
+        use_stream_lin = x.shape[0] % 8 == 0 and (
+            lin_flag == "stream" or (lin_flag == "auto" and is_int8))
+        small = {n: a for n, a in weights.items()
+                 if not n.startswith(("qkv_", "out_", "ffn1_", "ffn2_"))}
 
         def body(l, carry):
             h, ck, cv = carry
             w = {n: jax.lax.dynamic_index_in_dim(a, l, 0, False)
-                 for n, a in weights.items()}
-            h, ck, cv = run_layer(w, h, ck, cv, block_tables, l * npages)
+                 for n, a in (small if use_stream_lin else weights)
+                 .items()}
+            linear = None
+            if use_stream_lin:
+                def linear(xx, kind):
+                    return stream_linear(
+                        xx, weights[f"{kind}_weight"], layer=l,
+                        bias=weights[f"{kind}_bias"],
+                        scale=weights.get(f"{kind}_scale"),
+                        out_dtype=xx.dtype)
+            h, ck, cv = run_layer(w, h, ck, cv, block_tables,
+                                  l * npages, linear)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(

@@ -137,10 +137,16 @@ class GenerationEngine:
 
     def _logits(self, h, head_t, lnf_s, lnf_b):
         """LM head: final LN + pre-transposed [d, vocab] matmul with
-        fp32 accumulation (argmax/sampling happen on fp32 logits)."""
+        fp32 accumulation (argmax/sampling happen on fp32 logits);
+        weight-streamed on TPU (stream_linear) like the stack matmuls."""
+        from ..core.flags import flag
+        from ..nn.functional.stream_linear import stream_linear
+
         hl = FusedMultiTransformer._ln(
             h, lnf_s, lnf_b, self.model.stack.epsilon) \
             .astype(head_t.dtype)
+        if flag("decode_linear") == "stream" and hl.shape[0] % 8 == 0:
+            return stream_linear(hl, head_t, out_dtype=jnp.float32)
         return jax.lax.dot_general(
             hl, head_t, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)

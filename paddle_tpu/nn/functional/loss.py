@@ -34,6 +34,58 @@ def _reduce(v, reduction):
     return v
 
 
+@jax.custom_vjp
+def _fused_index_ce(logits, ids, valid):
+    """Per-token softmax cross entropy for index labels, last axis.
+
+    Closed-form custom VJP built from iota-compares and masked
+    reductions ONLY — no take_along_axis, no one_hot materialization,
+    and no autodiff through max/gather (whose VJPs lower to TPU
+    scatters). Measured on bert-base MLM (b32 s512, [16384, 30522]
+    bf16 logits): the gather-form CE with autodiff backward cost
+    102ms/step — a third of the whole pretraining step
+    (tools/bert_profile.py noce ablation, r5); this form is a few
+    fused passes over the logits. Reference comparator: the fused
+    phi softmax_with_cross_entropy kernel.
+
+    ids must be pre-clamped to [0, V); ``valid`` masks ignored tokens
+    (their loss and gradient are exactly 0). Accumulation is fp32; the
+    logits array itself is never copied to fp32.
+    """
+    return _fused_index_ce_fwd(logits, ids, valid)[0]
+
+
+def _fused_index_ce_fwd(logits, ids, valid):
+    m = jnp.max(logits, axis=-1)
+    sumexp = jnp.sum(
+        jnp.exp((logits - m[..., None]).astype(jnp.float32)), axis=-1)
+    eq = (jnp.arange(logits.shape[-1], dtype=ids.dtype)
+          == ids[..., None])
+    picked = jnp.sum(jnp.where(eq, logits, 0).astype(jnp.float32),
+                     axis=-1)
+    per = jnp.log(sumexp) + m.astype(jnp.float32) - picked
+    return jnp.where(valid, per, 0.0), (logits, ids, valid, m, sumexp)
+
+
+def _fused_index_ce_bwd(res, g):
+    logits, ids, valid, m, sumexp = res
+    # d_logits = (softmax - onehot) * g, zeroed on invalid tokens —
+    # one fused elementwise pass (exp/compare/sub/mul + bf16 cast)
+    gv = jnp.where(valid, g, 0.0)[..., None]
+    p = jnp.exp((logits - m[..., None]).astype(jnp.float32)
+                - jnp.log(sumexp)[..., None])
+    eq = (jnp.arange(logits.shape[-1], dtype=ids.dtype)
+          == ids[..., None])
+    d = (p - eq.astype(jnp.float32)) * gv
+    import numpy as _np
+
+    f0 = lambda a: _np.zeros(a.shape, jax.dtypes.float0)  # noqa: E731
+    return d.astype(logits.dtype), f0(ids), f0(valid)
+
+
+_fused_index_ce.defvjp(_fused_index_ce_fwd, _fused_index_ce_bwd)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
@@ -52,18 +104,11 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             ids = lab.astype(jnp.int32)
             if ids.ndim == logits.ndim:
                 ids = jnp.squeeze(ids, axis=axis)
+            if axis not in (-1, logits.ndim - 1):
+                logits = jnp.moveaxis(logits, axis, -1)
             safe_ids = jnp.where(ids == ignore_index, 0, ids)
-            m = jnp.max(logits, axis=axis)
-            shifted = logits - jnp.expand_dims(m, axis)
-            sumexp = jnp.sum(jnp.exp(shifted.astype(jnp.float32)),
-                             axis=axis)
-            picked = jnp.take_along_axis(
-                logits, jnp.expand_dims(safe_ids, axis), axis=axis)
-            picked = jnp.squeeze(picked, axis)
-            per = (jnp.log(sumexp) + m.astype(jnp.float32)
-                   - picked.astype(jnp.float32))
             valid = ids != ignore_index
-            per = jnp.where(valid, per, 0.0)
+            per = _fused_index_ce(logits, safe_ids, valid)
             if reduction == "mean":
                 denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
                 return jnp.sum(per) / denom

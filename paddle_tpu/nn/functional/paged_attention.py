@@ -754,6 +754,11 @@ def paged_attention(q, key_cache, value_cache, seq_lens, block_tables,
         backend = "stream" if (_on_tpu() and d % 128 == 0
                                and pool_base is not None) else "xla"
     if backend == "stream":
+        if q.shape[-1] % 128 != 0:
+            raise ValueError(
+                "paged_attention backend 'stream' requires head_dim to "
+                f"be a multiple of 128 (lane width); got {q.shape[-1]}. "
+                "Use 'auto' to fall back automatically.")
         return _stream_paged(q, key_cache, value_cache, seq_lens,
                              block_tables, pool_base=pool_base,
                              pool_pages=pool_pages, ownership=ownership)

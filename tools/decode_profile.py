@@ -626,10 +626,18 @@ def mode_engine_knockout(batch=32, knock="attn", quant=None):
         def fake_write(ck, cv, k, v, pos, tables):
             return ck, cv
         ft.write_kv_pages = fake_write
+    try:
+        return _with_batch(batch, mode_full)
+    finally:
+        if quant == "int8":
+            globals()["build"] = orig_build
+
+
+def _with_batch(batch, fn):
     global BATCH
     old, BATCH = BATCH, batch
     try:
-        return mode_full()
+        return fn()
     finally:
         BATCH = old
 
@@ -680,6 +688,9 @@ MODES = {
     "xla_paged_attn_b16": lambda: mode_xla_paged_attn(16),
     "stream_attn_b32": lambda: mode_stream_attn(32),
     "stream_attn_b64": lambda: mode_stream_attn(64),
+    "weights_only_b32": lambda: _with_batch(32, mode_weights_only),
+    "weights_unrolled_b32": lambda: _with_batch(32, mode_weights_unrolled),
+    "weights_int8_b32": lambda: _with_batch(32, mode_weights_int8),
     "engine_b32": lambda: mode_engine_full(32),
     "engine_stream_b32": lambda: mode_engine_full(32, backend="stream"),
     "engine_stream_b64": lambda: mode_engine_full(64, backend="stream"),

@@ -1,0 +1,73 @@
+"""Isolate the BERT MLM head matmul cost (fwd+bwd): [T, d] x [d, V]
+with T=16384, d=768, V=30522 bf16 — the bert_profile nohead ablation
+measured ~61ms/step (13% MFU); this locates the slow matmul form.
+
+    python tools/head_bench.py --form ty|pre_t|f32acc|untied
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+T, D, V = 16384, 768, 30522
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--form", default="ty",
+                    choices=["ty", "pre_t", "f32acc", "untied"])
+    args = ap.parse_args()
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    h = jnp.asarray(rng.randn(T, D).astype(np.float32)).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.randn(V, D).astype(np.float32)).astype(jnp.bfloat16)
+    wt = jnp.asarray(np.ascontiguousarray(
+        rng.randn(D, V).astype(np.float32))).astype(jnp.bfloat16)
+
+    if args.form == "ty":
+        # BertForPretraining form: matmul(h, w, transpose_y=True)
+        def f(h, w):
+            lg = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())))
+            return (lg * 1e-6).sum()
+        grad = jax.jit(jax.value_and_grad(f, argnums=(0, 1)))
+        arg2 = w
+    elif args.form == "pre_t":
+        def f(h, wt):
+            lg = jax.lax.dot_general(h, wt, (((1,), (0,)), ((), ())))
+            return (lg * 1e-6).sum()
+        grad = jax.jit(jax.value_and_grad(f, argnums=(0, 1)))
+        arg2 = wt
+    elif args.form == "f32acc":
+        def f(h, w):
+            lg = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            return (lg * 1e-6).sum()
+        grad = jax.jit(jax.value_and_grad(f, argnums=(0, 1)))
+        arg2 = w
+    else:  # untied: fwd only
+        def f(h, w):
+            lg = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())))
+            return (lg * 1e-6).sum()
+        grad = jax.jit(jax.value_and_grad(f, argnums=(0,)))
+        arg2 = w
+
+    out = grad(h, arg2)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = grad(h, arg2)
+    _ = np.asarray(out[0])
+    dt = (time.perf_counter() - t0) / 5
+    flops = (6 if args.form != "untied" else 4) * T * D * V
+    print(json.dumps({"form": args.form, "ms": round(dt * 1e3, 2),
+                      "tflops": round(flops / dt / 1e12, 1)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Training-step ablation for the flagship GPT rung (VERDICT r4 #6:
+is a fused LN+residual kernel needed, or does XLA already fuse the
+bf16 elementwise/LN chains?).
+
+Each mode runs bench.py's gpt3-1.3b config with ONE component altered
+and prints {mode, tokens_per_sec, mfu}. If `noln` (LayerNorms replaced
+by identity) moves MFU by ~nothing, the LN chains are already fused
+into neighbors by XLA and a hand-written kernel has no headroom.
+
+    python tools/train_profile.py --mode full|noln|nogelu|nosdpa
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def run(mode):
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    if mode == "noln":
+        # identity LayerNorm: same params (grads still flow via 0*),
+        # no normalization math — isolates the LN chains' cost
+        def fwd(self, x):
+            return x + 0.0 * (self.weight + self.bias).astype(x.dtype)
+        nn.LayerNorm.forward = fwd
+    elif mode == "nogelu":
+        import paddle_tpu.nn.functional as F
+
+        F.gelu = lambda x, approximate=False: x
+    name, d, L, h, s, b, ok = bench.LADDER[0]
+    tps, n_params, fpt = bench.run_config(name, d, L, h, s, b, steps=10,
+                                          opt_kwargs=dict(ok))
+    mfu = tps * fpt / bench._chip_peak(jax.devices()[0])
+    return tps, round(mfu, 4)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", required=True,
+                    choices=["full", "noln", "nogelu"])
+    args = ap.parse_args()
+    t0 = time.time()
+    tps, mfu = run(args.mode)
+    print(json.dumps({"mode": args.mode, "tokens_per_sec": round(tps, 1),
+                      "mfu": mfu, "wall": round(time.time() - t0, 1)}))
+
+
+if __name__ == "__main__":
+    main()
