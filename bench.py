@@ -196,7 +196,7 @@ def _chip_hbm_bw(device) -> float:
 
 def run_decode_bench(batch=32, prompt=128, new_tokens=129,
                      d_model=2048, n_layers=24, n_heads=16,
-                     decode_chunk=128, quant=None):
+                     decode_chunk=128, quant=None, kv_dtype=None):
     # Flagship-comparable serving rung: the decode model matches the
     # gpt3-1.3b training rung (d2048 L24). Round-4 redesign (each step
     # diagnosed in tools/decode_profile.py + HLO inspection):
@@ -237,7 +237,8 @@ def run_decode_bench(batch=32, prompt=128, new_tokens=129,
         st.quantize_weight_only_int8()
     engine = GenerationEngine(model, page_size=16,
                               max_length=prompt + new_tokens,
-                              decode_chunk=decode_chunk)
+                              decode_chunk=decode_chunk,
+                              kv_dtype=kv_dtype)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, VOCAB, (batch, prompt))
     # warmup with the SAME token count: compiles prefill + every chunk-k
@@ -365,6 +366,14 @@ def _run_secondary(kind):
         tps, pct = run_decode_bench(quant="int8")
         print(json.dumps({"decode_int8_tokens_per_sec": round(tps, 1),
                           "decode_int8_pct_of_hbm_roofline": pct}))
+    elif kind == "--decode-int8kv":
+        # best-throughput serving config: int8 weights + int8 KV cache
+        # (cache-KV quant pays once KV traffic rivals the weight
+        # stream: +14% at b64, r5) at batch 64
+        tps, _pct = run_decode_bench(batch=64, quant="int8",
+                                     kv_dtype="int8")
+        print(json.dumps(
+            {"decode_int8kv_b64_tokens_per_sec": round(tps, 1)}))
     elif kind == "--bert":
         tps, mfu = run_bert_bench()
         print(json.dumps({"bert_train_tokens_per_sec": round(tps, 1),
@@ -385,7 +394,8 @@ def main():
     if "--config" in sys.argv:
         _run_one(sys.argv[sys.argv.index("--config") + 1])
         return
-    for kind in ("--decode", "--decode-int8", "--bert", "--s2048"):
+    for kind in ("--decode", "--decode-int8", "--decode-int8kv",
+                 "--bert", "--s2048"):
         if kind in sys.argv:
             _run_secondary(kind)
             return
@@ -421,8 +431,11 @@ def main():
             continue
         # secondary rungs each get a FRESH process (and a fresh chip —
         # the training rung's buffers die with its process)
-        for kind in ("--s2048", "--decode", "--decode-int8", "--bert"):
-            extra, err = _sub([kind], 1500)
+        for kind in ("--s2048", "--decode", "--decode-int8",
+                     "--decode-int8kv", "--bert"):
+            # s2048's flash-attention bwd compile alone can take ~25min
+            # cold (measured r5); the run itself is seconds
+            extra, err = _sub([kind], 2400 if kind == "--s2048" else 1500)
             if extra is None:
                 key = kind.strip("-").replace("-", "_")
                 result[f"{key}_error"] = err

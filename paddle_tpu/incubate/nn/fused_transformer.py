@@ -244,8 +244,17 @@ class FusedMultiTransformer(Layer):
         h = (h + linear(ff, "ffn2")).astype(h.dtype)
         return h, ck, cv
 
+    @staticmethod
+    def _pool_data(side):
+        """Raw page array of a cache side (quantized sides are
+        (int8_rows, f32_scale_plane) tuples)."""
+        return side[0] if isinstance(side, tuple) else side
+
     def _pages_per_layer(self, cache: PagedKV) -> int:
-        return cache.k.shape[0] // self.num_layers
+        return self._pool_data(cache.k).shape[0] // self.num_layers
+
+    def _pool_page_size(self, cache: PagedKV) -> int:
+        return self._pool_data(cache.k).shape[2]
 
     def prefill_raw(self, weights, x, cache, block_tables, cos_t, sin_t):
         """Prompt pass: x [b, s, d] → (hidden [b, s, d], filled cache).
@@ -326,11 +335,32 @@ class FusedMultiTransformer(Layer):
         # the 24-layer loop
         from ...core.flags import flag
         from ...nn.functional.paged_attention import (
-            _on_tpu, build_pool_ownership)
+            _on_tpu, build_pool_ownership,
+            paged_decode_attention_inplace_q)
 
-        backend = flag("paged_attention_backend")
-        fused_stream = (backend in ("auto", "stream") and _on_tpu()
-                        and self.head_dim % 128 == 0)
+        if isinstance(cache.k, tuple):
+            # int8 cache-KV mode: always the fused quantized kernel
+            # (interpret off-TPU); the pools never touch a non-Pallas op
+            ownership = build_pool_ownership(
+                block_tables, seq_lens.astype(jnp.int32), npages,
+                self._pool_page_size(cache))
+
+            def run_layer_q(w, h, kk, vv, tbl, base, linear=None):
+                def attend(q, k, v, _ck, _cv):
+                    att, kq2, ks2, vq2, vs2 = \
+                        paged_decode_attention_inplace_q(
+                            q, k, v, kk[0], kk[1], vv[0], vv[1],
+                            seq_lens, tbl, pool_base=base,
+                            pool_pages=npages, ownership=ownership)
+                    return att, (kq2, ks2), (vq2, vs2)
+                return self._layer_body(w, h, seq_lens, None, attend,
+                                        cos_t, sin_t, linear=linear)
+            run_layer = run_layer_q
+            fused_stream = False
+        else:
+            backend = flag("paged_attention_backend")
+            fused_stream = (backend in ("auto", "stream") and _on_tpu()
+                            and self.head_dim % 128 == 0)
         if fused_stream:
             # fused append+attend kernel masks with seq_lens (current
             # token joins from the operands)
@@ -346,7 +376,7 @@ class FusedMultiTransformer(Layer):
                         ownership=ownership)
                 return self._layer_body(w, h, seq_lens, None, attend,
                                         cos_t, sin_t, linear=linear)
-        else:
+        elif not isinstance(cache.k, tuple):
             ownership = build_pool_ownership(block_tables, lens1,
                                              npages, cache.k.shape[2])
 
@@ -377,7 +407,6 @@ class FusedMultiTransformer(Layer):
         # layer l's block directly via a prefetched index, so the loop
         # never materializes a per-layer [K, N] slice (a dynamic-slice
         # operand to the kernel's custom call would copy ~100MB/layer)
-        from ...core.flags import flag as _flag
         from ...nn.functional.stream_linear import stream_linear
 
         # dtype-aware auto (r5 1.3B b32 end-to-end): bf16 weights run
@@ -385,7 +414,7 @@ class FusedMultiTransformer(Layer):
         # ~96 kernel dispatches/step eat the DMA gains), int8 weights
         # run faster through the streaming kernel whose dequant fuses
         # into the block DMA (3398 vs 3231)
-        lin_flag = _flag("decode_linear")
+        lin_flag = flag("decode_linear")
         is_int8 = weights["qkv_weight"].dtype == jnp.int8
         use_stream_lin = x.shape[0] % 8 == 0 and (
             lin_flag == "stream" or (lin_flag == "auto" and is_int8))

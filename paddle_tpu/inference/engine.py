@@ -178,7 +178,11 @@ class GenerationEngine:
         idx = jnp.arange(logits.shape[-1], dtype=jnp.int32)
         cand = jnp.where(logits == m, idx[None, :],
                          jnp.int32(logits.shape[-1]))
-        return jnp.min(cand, axis=-1).astype(jnp.int32)
+        picked = jnp.min(cand, axis=-1).astype(jnp.int32)
+        # all-NaN row: NaN != NaN leaves no candidate — return 0 like
+        # jnp.argmax rather than an out-of-range id the embedding would
+        # silently clamp
+        return jnp.where(picked >= logits.shape[-1], 0, picked)
 
     @staticmethod
     def _pick_token(logits, key, sample_cfg):

@@ -45,9 +45,21 @@ class BlockKVCacheManager:
     def fresh_cache(self) -> PagedKV:
         # layer-FOLDED page-major pool (see PagedKV): layer l's logical
         # page p is physical page l * num_pages + p — decode updates it
-        # in place; each page is one contiguous DMA block
+        # in place; each page is one contiguous DMA block.
+        # dtype "int8" = quantized cache-KV mode: int8 token rows plus
+        # per-token-per-head f32 scale PLANES [n_kv, pages*page_size]
+        # (lane-major so the decode kernel applies them as logits-column
+        # multiplies; see paged_decode_attention_inplace_q)
         shape = (self.num_layers * self.num_pages, self.num_kv_heads,
                  self.page_size, self.head_dim)
+        if self.dtype == "int8" or self.dtype == jnp.int8:
+            plane = (self.num_kv_heads,
+                     self.num_layers * self.num_pages * self.page_size)
+            return PagedKV(
+                (jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(plane, jnp.float32)),
+                (jnp.zeros(shape, jnp.int8),
+                 jnp.zeros(plane, jnp.float32)))
         return PagedKV(jnp.zeros(shape, self.dtype),
                        jnp.zeros(shape, self.dtype))
 

@@ -60,9 +60,13 @@ def _fa_blocks(m, b, h, sq, sk, d):
     if _FA_BLOCKS is None:
         # measured on v5e (GPT-1.3B, d128, s1024): vs the 128 default,
         # 256x256 tiles lift train MFU 0.444 -> 0.504 and 256x512
-        # -> 0.527; 512-wide q tiles exhaust VMEM. Gate on shapes where
-        # the bigger tile is safe and divides the sequence.
-        if d <= 128 and sq % 256 == 0 and sk % 256 == 0:
+        # -> 0.527; 512-wide q tiles exhaust VMEM at d=128. At d<=64
+        # tile bytes halve, and 512x512 wins again (bert-base s512:
+        # MFU 0.330 -> 0.361, tools/bert_profile fa512, r5). Gate on
+        # shapes where the bigger tile is safe and divides the seq.
+        if d <= 64 and sq % 512 == 0 and sk % 512 == 0:
+            bq = bk = 512
+        elif d <= 128 and sq % 256 == 0 and sk % 256 == 0:
             bq = 256
             bk = 512 if sk % 512 == 0 else 256
         else:

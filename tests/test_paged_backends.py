@@ -210,3 +210,92 @@ def test_fused_inplace_kernel_parity(g):
         other = slice(P, 2 * P) if base == 0 else slice(0, P)
         np.testing.assert_array_equal(np.asarray(ck[other]),
                                       np.asarray(kpool[other]))
+
+
+def test_int8_kv_fused_kernel_parity():
+    """Cache-KV int8 mode: the quantized fused kernel must match the
+    dequantized-pool XLA reference within int8 tolerance, patch the
+    written int8 rows + scale-plane columns in place, and leave other
+    layers' regions untouched."""
+    from paddle_tpu.nn.functional.paged_attention import (
+        _xla_paged, paged_decode_attention_inplace_q, quantize_kv_rows,
+        write_kv_pages)
+
+    rng = np.random.RandomState(7)
+    b, n_kv, d, ps = 4, 2, 128, 4
+    pp, P, L = 6, 16, 2
+    T = P * ps
+    q = jnp.asarray(rng.randn(b, n_kv, d).astype(np.float32))
+    nk = jnp.asarray(rng.randn(b, n_kv, d).astype(np.float32))
+    nv = jnp.asarray(rng.randn(b, n_kv, d).astype(np.float32))
+    kf = rng.randn(L * P, n_kv, ps, d).astype(np.float32)
+    vf = rng.randn(L * P, n_kv, ps, d).astype(np.float32)
+    s_k = np.maximum(np.abs(kf).max(-1) / 127.0, 1e-8)
+    kq = np.clip(np.round(kf / s_k[..., None]), -127, 127) \
+        .astype(np.int8)
+    s_v = np.maximum(np.abs(vf).max(-1) / 127.0, 1e-8)
+    vq = np.clip(np.round(vf / s_v[..., None]), -127, 127) \
+        .astype(np.int8)
+    ks_plane = np.zeros((n_kv, L * T), np.float32)
+    vs_plane = np.zeros((n_kv, L * T), np.float32)
+    for p in range(L * P):
+        for s in range(ps):
+            ks_plane[:, p * ps + s] = s_k[p, :, s]
+            vs_plane[:, p * ps + s] = s_v[p, :, s]
+    lens_np = np.array([5, 0, 13, 9], np.int32)
+    tables_np = np.zeros((b, pp), np.int32)
+    perm = rng.permutation(np.arange(1, P))
+    i = 0
+    for r in range(b):
+        n = -(-int(lens_np[r] + 1) // ps)
+        tables_np[r, :n] = perm[i:i + n]
+        i += n
+    lens, tables = jnp.asarray(lens_np), jnp.asarray(tables_np)
+    for base in (0, P):
+        out, kq2, ks2, vq2, vs2 = paged_decode_attention_inplace_q(
+            q, nk, nv, jnp.asarray(kq), jnp.asarray(ks_plane),
+            jnp.asarray(vq), jnp.asarray(vs_plane), lens, tables,
+            pool_base=base, pool_pages=P)
+        kd = kq[base:base + P].astype(np.float32) \
+            * s_k[base:base + P][..., None]
+        vd = vq[base:base + P].astype(np.float32) \
+            * s_v[base:base + P][..., None]
+        ck_ref, cv_ref = write_kv_pages(
+            jnp.asarray(kd), jnp.asarray(vd), nk, nv, lens, tables)
+        ref = _xla_paged(q, ck_ref, cv_ref, lens + 1, tables)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=0.08)
+        kq2n, ks2n = np.asarray(kq2), np.asarray(ks2)
+        for r in range(b):
+            pos = int(lens_np[r])
+            pg = tables_np[r, pos // ps] + base
+            sl = pos % ps
+            want_q, want_s = quantize_kv_rows(nk[r][None])
+            np.testing.assert_array_equal(kq2n[pg, :, sl],
+                                          np.asarray(want_q)[0])
+            np.testing.assert_allclose(
+                ks2n[:, pg * ps + sl], np.asarray(want_s)[0],
+                rtol=1e-5)
+        other = slice(P, 2 * P) if base == 0 else slice(0, P)
+        np.testing.assert_array_equal(np.asarray(kq2)[other], kq[other])
+
+
+def test_int8_kv_engine_tokens():
+    """GenerationEngine kv_dtype='int8' end-to-end vs full-precision KV:
+    greedy tokens must agree on a small model."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import FusedCausalLM, GenerationEngine
+
+    paddle.seed(5)
+    mk = dict(vocab_size=256, embed_dim=256, num_heads=2,
+              dim_feedforward=512, num_layers=2, max_position=128)
+    model = FusedCausalLM(**mk)
+    ids = np.random.RandomState(2).randint(1, 256, (2, 12))
+    out_a = GenerationEngine(model, page_size=4, max_length=48,
+                             decode_chunk=4).generate(
+                                 ids, max_new_tokens=8)
+    out_b = GenerationEngine(model, page_size=4, max_length=48,
+                             decode_chunk=4, kv_dtype="int8").generate(
+                                 ids, max_new_tokens=8)
+    agree = float((out_a[:, 12:] == out_b[:, 12:]).mean())
+    assert agree >= 0.75, (out_a[:, 12:], out_b[:, 12:])

