@@ -62,6 +62,31 @@ def _chip_peak(device) -> float:
     return 197e12  # default: v5e
 
 
+def _telemetry():
+    """Runtime-telemetry block embedded into BENCH_*.json: the
+    profiler.stats registry snapshot for THIS process (per-op dispatch
+    counts, VJP-cache/jit-cache outcomes, compile-time histograms, pool
+    gauges). Each rung runs in its own subprocess, so the block
+    describes exactly that rung's work."""
+    from paddle_tpu.profiler import stats
+
+    snap = stats.snapshot()
+    ops = {k: v for k, v in snap["counters"].items()
+           if k.startswith("op.")}
+    out = {
+        "op_calls_top": dict(sorted(ops.items(),
+                                    key=lambda kv: -kv[1])[:20]),
+        "counters": {k: v for k, v in snap["counters"].items()
+                     if not k.startswith("op.")},
+        "gauges": snap["gauges"],
+        "histograms": snap["histograms"],
+    }
+    hr = stats.vjp_cache_hit_rate()
+    if hr is not None:
+        out["vjp_cache_hit_rate"] = round(hr, 4)
+    return out
+
+
 def build_model(d_model, n_layers, n_heads, seq, recompute=True,
                 remat="full"):
     import paddle_tpu as paddle
@@ -351,6 +376,7 @@ def _run_one(name):
         "cross_entropy": "bf16-logits-fp32-acc" if cfg[6].get("ce_bf16")
         else "fp32",
         "remat": cfg[6].get("remat", "full"),
+        "telemetry": _telemetry(),
     }))
 
 
@@ -361,7 +387,8 @@ def _run_secondary(kind):
         tps, pct = run_decode_bench()
         print(json.dumps({"decode_tokens_per_sec": round(tps, 1),
                           "decode_batch": 32,
-                          "decode_pct_of_hbm_roofline": pct}))
+                          "decode_pct_of_hbm_roofline": pct,
+                          "decode_telemetry": _telemetry()}))
     elif kind == "--decode-int8":
         tps, pct = run_decode_bench(quant="int8")
         print(json.dumps({"decode_int8_tokens_per_sec": round(tps, 1),
@@ -408,6 +435,7 @@ def main():
         print(json.dumps({
             "metric": "gpt_train_tokens_per_sec_cpu", "value": round(tps, 1),
             "unit": "tokens/s", "vs_baseline": 1.0, "model": "gpt-smoke",
+            "telemetry": _telemetry(),
         }))
         return
 

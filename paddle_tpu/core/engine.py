@@ -298,7 +298,24 @@ def run_backward(
     captures and returns raw grads of those tensors without touching any
     ``.grad`` (``paddle.grad`` semantics); intermediates are captured via a
     temporary entry in their producer's ``retain_map``.
+
+    Telemetry: each sweep runs under an ``autograd::backward`` span when
+    a profiler window is recording, and bumps the ``autograd.sweeps`` /
+    ``autograd.nodes`` counters (profiler.stats) so per-step backward
+    graph size is visible without a trace.
     """
+    from ..profiler import stats as _stats
+    from ..profiler.profiler import RecordEvent as _RecordEvent
+
+    _stats.inc("autograd.sweeps")
+    with _RecordEvent("autograd::backward"):
+        return _run_backward_impl(tensors, grad_tensors, retain_graph,
+                                  inputs, allow_unused, create_graph,
+                                  _stats)
+
+
+def _run_backward_impl(tensors, grad_tensors, retain_graph, inputs,
+                       allow_unused, create_graph, _stats):
     roots: List[GradNode] = []
     for t, g in zip(tensors, grad_tensors or [None] * len(tensors)):
         node = t._grad_node
@@ -372,8 +389,10 @@ def run_backward(
                     _accumulate_leaf(target, g)
 
     keep_graph = retain_graph or create_graph
+    nodes_run = 0
     while ready:
         node = ready.pop()
+        nodes_run += 1
         if node.vjp_fn is None:
             raise RuntimeError(
                 f"the grad graph through {node.name} has been freed; use "
@@ -409,6 +428,8 @@ def run_backward(
                 if indeg[id(p)] == 0 and id(p) not in queued:
                     ready.append(p)
                     queued.add(id(p))
+
+    _stats.inc("autograd.nodes", nodes_run)
 
     for node, slot, entry in temp_retains:
         targets = node.retain_map.get(slot)

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
+from ...profiler import stats as _stats
 from ..check import check_tensor_list, dynamic_check, watchdog
 from .group import Group, _get_default_group
 
@@ -49,6 +50,23 @@ _REDUCE_FNS = {
     ReduceOp.MIN: jnp.minimum,
     ReduceOp.PROD: jnp.multiply,
 }
+
+
+def _coll_stats(op_name: str, *tensors) -> None:
+    """Telemetry for the primitive data movers: per-op call counters and
+    local payload bytes (``dist.<op>.{calls,bytes}`` in profiler.stats)
+    — the reference reports the same per-collective volume through its
+    comm op stats. Counted at the public entry, whatever path (compiled
+    ICI, store-brokered, degenerate single-rank) serves the call."""
+    if not _stats.is_enabled():
+        return
+    _stats.inc(f"dist.{op_name}.calls")
+    nbytes = 0
+    for t in tensors:
+        d = getattr(t, "_data", t)
+        nbytes += int(getattr(d, "nbytes", 0) or 0)
+    if nbytes:
+        _stats.inc(f"dist.{op_name}.bytes", nbytes)
 
 
 class _CompletedTask:
@@ -283,6 +301,7 @@ def _compiled_reducescatter(stacked, op):
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group = None,
                sync_op: bool = True):
+    _coll_stats("all_reduce", tensor)
     if _is_dist(tensor):
         from ..auto_parallel.api import reshard
         from ..auto_parallel.placement import Replicate
@@ -317,6 +336,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group = None,
 
 def all_gather(tensor_list: List, tensor: Tensor, group: Group = None,
                sync_op: bool = True):
+    _coll_stats("all_gather", tensor)
     n = _world(group)
     if _is_dist(tensor):
         # gather the per-rank shards along the group's axis
@@ -401,6 +421,7 @@ def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group: Group = None,
 
 def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
                    op=ReduceOp.SUM, group: Group = None, sync_op: bool = True):
+    _coll_stats("reduce_scatter", *tensor_list)
     check_tensor_list(tensor_list, tensor, "reduce_scatter")
     n = _world(group)
     if n == 1 and not _multihost():
@@ -430,6 +451,7 @@ def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
 
 def broadcast(tensor: Tensor, src: int = 0, group: Group = None,
               sync_op: bool = True):
+    _coll_stats("broadcast", tensor)
     n = _world(group)
     if n == 1 and not _multihost():
         return _CompletedTask(tensor)
@@ -514,6 +536,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
 
 def all_to_all(out_tensor_list: List, in_tensor_list: List[Tensor],
                group: Group = None, sync_op: bool = True):
+    _coll_stats("all_to_all", *in_tensor_list)
     check_tensor_list(in_tensor_list, None, "all_to_all")
     n = _world(group)
     if n == 1 and not _multihost():
@@ -564,6 +587,7 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
     coordination KV (sizes differ per (src,dst) pair, so there is no
     uniform-shape program; uneven a2a is a control-plane-scale op —
     MoE capacity exchange — in the reference too)."""
+    _coll_stats("all_to_all_single", in_tensor)
     n = _world(group)
     uneven = out_split_sizes is not None or in_split_sizes is not None
     if not uneven:
@@ -667,6 +691,7 @@ def send(tensor: Tensor, dst: int = 0, group: Group = None,
     ProcessGroup::Send). Cross-process path serializes through the
     coordination service — matched send/recv pairs use a per-(src,dst)
     sequence number so repeated transfers don't collide."""
+    _coll_stats("send", tensor)
     if _world(group) == 1 and not _multihost():
         _P2P_BUF.setdefault(dst, []).append(jnp.asarray(tensor._data))
         return _CompletedTask(tensor)
@@ -684,6 +709,7 @@ def recv(tensor: Tensor, src: int = 0, group: Group = None,
          sync_op: bool = True):
     """Point-to-point recv matching ``send`` (reference:
     communication/recv.py). Blocks up to 120s for the matching key."""
+    _coll_stats("recv", tensor)
     if _world(group) == 1 and not _multihost():
         buf = _P2P_BUF.get(src or 0)
         if buf:

@@ -20,6 +20,7 @@ from ..core.tensor import Tensor
 from ..incubate.nn.fused_transformer import (
     FusedMultiTransformer, PagedKV, rope_table)
 from ..nn.layer_base import Layer
+from ..profiler import stats as _stats
 from .kv_cache import BlockKVCacheManager
 
 __all__ = ["FusedCausalLM", "GenerationEngine",
@@ -27,13 +28,26 @@ __all__ = ["FusedCausalLM", "GenerationEngine",
 
 
 def _round_pool_pages(n: int, page_size: int) -> int:
-    """Round a pool size up so the stream-attention kernels' full
-    chunk size divides it — the chunk DMA then never crosses the
-    layer-region boundary. Costs at most chunk-1 spare pages of HBM."""
+    """Round a pool size up so a stream-attention chunk size divides it
+    — the chunk DMA then never crosses the layer-region boundary.
+
+    The rounding quantum is the FULL chunk (stream_chunk_pages, 1024
+    tokens) capped at the next power of two >= n: without the cap, tiny
+    pools at small page sizes inflate drastically (page_size=4: 25
+    requested pages -> 256, ~10x HBM). With it, the pool stays within
+    2x of the request and remains a power-of-two multiple that
+    _pick_chunk_pages can divide exactly (the kernels then run with a
+    proportionally smaller chunk — fine for pools this small). The
+    engines expose the final rounded size via the
+    ``inference.pool_pages`` stats gauge."""
     from ..nn.functional.paged_attention import stream_chunk_pages
 
     chunk = stream_chunk_pages(page_size)
-    return -(-n // chunk) * chunk
+    next_pow2 = 1
+    while next_pow2 < n:
+        next_pow2 *= 2
+    quantum = min(chunk, next_pow2)
+    return -(-n // quantum) * quantum
 
 
 class FusedCausalLM(Layer):
@@ -314,12 +328,13 @@ class GenerationEngine:
         # defaulted or caller-specified (a caller's num_pages means
         # usable capacity); rounded up so the stream-attention kernel
         # gets whole chunks (see _round_pool_pages)
+        requested = (self._num_pages or b * pages_per_seq) + 1
         self._mgr = BlockKVCacheManager(
             st.num_layers, st.num_kv_heads, st.head_dim, self.page_size,
-            num_pages=_round_pool_pages(
-                (self._num_pages or b * pages_per_seq) + 1,
-                self.page_size),
+            num_pages=_round_pool_pages(requested, self.page_size),
             dtype=self._kv_dtype, reserve_scratch=True)
+        _stats.set_gauge("inference.pool_pages_requested", requested)
+        _stats.set_gauge("inference.pool_pages", self._mgr.num_pages)
         for i in range(b):
             self._mgr.allocate(i, int(lens[i]))
         tables = self._mgr.block_tables(range(b), pages_per_seq)
@@ -330,6 +345,7 @@ class GenerationEngine:
         lnf_s, lnf_b = (self.model.lnf_scale._data,
                         self.model.lnf_bias._data)
 
+        _stats.inc("inference.prefills")
         logits, ck, cv = self._prefill(
             weights, embed, self._head_t, lnf_s, lnf_b, jnp.asarray(ids),
             jnp.asarray(lens), cache.k, cache.v, tables)
@@ -369,6 +385,9 @@ class GenerationEngine:
             cur = lens + emitted - 1         # per-seq position just fed
             tables = self._grow_tables(range(b), lens + emitted, k,
                                        pages_per_seq)
+            _stats.inc("inference.decode_steps", k)
+            _stats.set_gauge("inference.kv_pages_in_use",
+                             self._mgr.num_pages - self._mgr.free_pages)
             toks, ck, cv = self._get_decode_k(k, static_cfg)(
                 weights, embed, self._head_t, lnf_s, lnf_b,
                 jnp.asarray(out[np.arange(b), cur].astype(np.int32)),
@@ -449,12 +468,13 @@ class ContinuousBatchingEngine:
         self._gen.page_size = self.page_size
         self._gen.decode_chunk = self.decode_chunk
         self._gen._init_serving_state(kv_dtype)
+        requested = (num_pages or self.max_batch * self._pages_per_seq) + 1
         self._mgr = BlockKVCacheManager(
             st.num_layers, st.num_kv_heads, st.head_dim, self.page_size,
-            num_pages=_round_pool_pages(
-                (num_pages or self.max_batch * self._pages_per_seq) + 1,
-                self.page_size),
+            num_pages=_round_pool_pages(requested, self.page_size),
             dtype=self._gen._kv_dtype, reserve_scratch=True)
+        _stats.set_gauge("serving.pool_pages_requested", requested)
+        _stats.set_gauge("serving.pool_pages", self._mgr.num_pages)
         cache = self._mgr.fresh_cache()
         self._ck, self._cv = cache.k, cache.v
         self._cos, self._sin = rope_table(st.max_position, st.head_dim,
@@ -506,6 +526,10 @@ class ContinuousBatchingEngine:
         tables = self._mgr.block_tables(
             [("slot", i) for i in range(self.max_batch)],
             self._pages_per_seq, allow_missing=True)
+        _stats.inc("serving.decode_steps", k)
+        _stats.set_gauge("serving.kv_pages_in_use",
+                         self._mgr.num_pages - self._mgr.free_pages)
+        _stats.set_gauge("serving.active_slots", len(active))
 
         m = self.model
         cur = np.where([r is not None for r in self._slots],
@@ -566,6 +590,7 @@ class ContinuousBatchingEngine:
                 break  # pool full — admit later when pages free up
             self.waiting.pop(0)
             self._slots[i] = req
+            _stats.inc("serving.admitted")
             L = len(req.prompt)
             self._mgr.allocate(("slot", i), L)
             tables = self._mgr.block_tables([("slot", i)],

@@ -246,9 +246,19 @@ class StaticFunction:
         kwargs_tmpl = _flatten_tensors(dict(kwargs), arg_tensors)
         sig = self._signature(arg_tensors, args_tmpl, kwargs_tmpl)
         prog = self._programs.get(sig)
+        # compile telemetry: a miss means a NEW traced program for this
+        # signature (a growing jit.trace count across steps with stable
+        # shapes = retrace storm; steady jit.cache_hit = healthy)
+        from ..profiler import stats as _stats
+
         if prog is None:
-            prog = _Program(self, args_tmpl, kwargs_tmpl, len(arg_tensors))
+            _stats.inc("jit.trace")
+            with _stats.timed("compile.jit_build_us"):
+                prog = _Program(self, args_tmpl, kwargs_tmpl,
+                                len(arg_tensors))
             self._programs[sig] = prog
+        else:
+            _stats.inc("jit.cache_hit")
         return prog.run(arg_tensors)
 
     # paddle API surface

@@ -53,6 +53,9 @@ class TrainStep:
                                if not p.stop_gradient]
         donate_args = (0, 1) if donate else ()
         self._compiled = jax.jit(self._pure_step, donate_argnums=donate_args)
+        from ..profiler import stats as _stats
+
+        _stats.inc("jit.train_step_build")
 
     # ---- functional grad-clip mirror of nn.ClipGradByGlobalNorm ----
     def _clip_grads(self, grads):

@@ -17,6 +17,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .paged_attention import _enable_x64
+
 from ...core.generator import next_rng_key
 from ...ops.dispatch import eager_apply, as_tensor_args
 
@@ -97,7 +99,7 @@ def _fa_blocks(m, b, h, sq, sk, d):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_core(q, k, v, causal, scale):
     m = _fa_mod()
-    with jax.enable_x64(False), \
+    with _enable_x64(False), \
             jax.default_matmul_precision("default"):
         return m._flash_attention(
             q, k, v, None, None, False, causal, scale,
@@ -106,7 +108,7 @@ def _flash_core(q, k, v, causal, scale):
 
 def _flash_core_fwd(q, k, v, causal, scale):
     m = _fa_mod()
-    with jax.enable_x64(False), \
+    with _enable_x64(False), \
             jax.default_matmul_precision("default"):
         out, res = m._flash_attention_fwd(
             q, k, v, None, None, False, causal, scale,
@@ -117,7 +119,7 @@ def _flash_core_fwd(q, k, v, causal, scale):
 def _flash_core_bwd(causal, scale, res, do):
     m = _fa_mod()
     q = res[0]
-    with jax.enable_x64(False), \
+    with _enable_x64(False), \
             jax.default_matmul_precision("default"):
         dq, dk, dv, _ds, _dseg = m._flash_attention_bwd(
             False, causal, scale, _fa_blocks(m, q.shape[0], q.shape[1], q.shape[2], q.shape[2], q.shape[3]), False, res, do)

@@ -89,6 +89,19 @@ _fused_index_ce.defvjp(_fused_index_ce_fwd, _fused_index_ce_bwd)
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
+    """Softmax cross entropy (reference: nn/functional/loss.py
+    cross_entropy over the phi softmax_with_cross_entropy kernel).
+
+    Label contract (index labels): entries equal to ``ignore_index``
+    contribute zero loss and zero gradient, and are excluded from the
+    ``'mean'`` denominator. Any OTHER out-of-range entry (negative, or
+    >= the class count) is clamped into ``[0, num_classes)`` before the
+    gather — the take_along_axis clamp semantics every path of this op
+    (including the fused closed-form big-vocab path) preserves. Garbage
+    labels therefore train against a clamped boundary class rather than
+    silently producing a zero-gradient row; pass ``ignore_index`` for
+    tokens that should not contribute.
+    """
     has_w = weight is not None
     tensors = as_tensor_args(*((input, label, weight) if has_w
                                else (input, label)))
@@ -106,7 +119,13 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                 ids = jnp.squeeze(ids, axis=axis)
             if axis not in (-1, logits.ndim - 1):
                 logits = jnp.moveaxis(logits, axis, -1)
-            safe_ids = jnp.where(ids == ignore_index, 0, ids)
+            # clamp to [0, V): the fused op's iota-compare matches NO
+            # column for an out-of-range id (silent zero-gradient row);
+            # clamping restores the gather path's take_along_axis
+            # behavior (see the public docstring's label contract)
+            safe_ids = jnp.clip(
+                jnp.where(ids == ignore_index, 0, ids),
+                0, logits.shape[-1] - 1)
             valid = ids != ignore_index
             per = _fused_index_ce(logits, safe_ids, valid)
             if reduction == "mean":

@@ -52,6 +52,38 @@ def _on_tpu() -> bool:
         return False
 
 
+def _pltpu_memspace(pltpu):
+    """Version shim: jax renamed TPUMemorySpace -> MemorySpace (~0.5);
+    resolve whichever this runtime ships."""
+    return getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+
+
+def _pltpu_compiler_params(pltpu):
+    """Version shim: TPUCompilerParams -> CompilerParams (~0.5)."""
+    return getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+
+
+def _enable_x64(flag: bool):
+    """Version shim: jax.enable_x64 was jax.experimental.enable_x64
+    before ~0.5. The x64-off guard protects MOSAIC lowering on TPU
+    (f64/i64 leaking into kernels doesn't legalize); in off-TPU
+    interpret mode the kernel is ordinary jax ops, and TOGGLING the x64
+    context mid-trace breaks older jax (lowered helper subfunctions
+    like floor_divide dedup across contexts with mismatched scalar
+    dtypes — 'func.call operand type mismatch'), so keep the ambient
+    setting there. Also no-op when the config already matches."""
+    import contextlib
+
+    if bool(jax.config.jax_enable_x64) == bool(flag) or not _on_tpu():
+        return contextlib.nullcontext()
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(flag)
+    from jax.experimental import enable_x64 as _ctx
+
+    return _ctx(flag)
+
+
 def _pallas_paged(q, key_cache, value_cache, seq_lens, block_tables):
     """Stock jax kernel path: transpose the page-major pool to the
     [n_kv, P, ps, d] layout it expects (a full-pool copy — opt-in
@@ -71,7 +103,7 @@ def _pallas_paged(q, key_cache, value_cache, seq_lens, block_tables):
     # the kernel computes raw q·k logits — fold the 1/sqrt(d) scale into q
     out_dtype = q.dtype
     q = q.astype(jnp.float32) * (q.shape[-1] ** -0.5)
-    with jax.enable_x64(False), jax.default_matmul_precision("default"):
+    with _enable_x64(False), jax.default_matmul_precision("default"):
         return kernel(
             q, key_cache, value_cache,
             seq_lens.astype(jnp.int32), block_tables.astype(jnp.int32),
@@ -218,8 +250,8 @@ def _fused_paged(q, key_cache, value_cache, seq_lens, block_tables):
         grid=(b,),
         in_specs=[
             pl.BlockSpec((1, n_q, d), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=_pltpu_memspace(pltpu).ANY),
+            pl.BlockSpec(memory_space=_pltpu_memspace(pltpu).ANY),
         ],
         out_specs=pl.BlockSpec((1, n_q, d), lambda i, *_: (i, 0, 0)),
         scratch_shapes=[
@@ -231,7 +263,7 @@ def _fused_paged(q, key_cache, value_cache, seq_lens, block_tables):
     # x64 off for the whole kernel trace: the axon env enables x64
     # globally, and weak-typed python scalars become f64/i64 inside the
     # kernel, which Mosaic cannot legalize
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         out = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -431,7 +463,7 @@ def _stream_paged(q, key_cache, value_cache, seq_lens, block_tables,
     # x64 off for the whole trace (axon enables x64 globally; weak-typed
     # python scalars would become f64/i64 inside the kernel); interpret
     # mode off-TPU so the kernel's numerics are testable on CPU
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         out = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -439,7 +471,7 @@ def _stream_paged(q, key_cache, value_cache, seq_lens, block_tables,
             # double-buffered multi-MB stream chunks overflow the
             # conservative 16MB default scoped-VMEM budget; v5e has
             # 128MB physical
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_pltpu_compiler_params(pltpu)(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=not _on_tpu(),
         )(base_chunk, qt, mask3, key_cache, value_cache)
@@ -482,6 +514,17 @@ def paged_decode_attention_inplace(q, new_k, new_v, key_cache,
     seq_lens = tokens already cached EXCLUDING the current token (the
     current token's write position, and its softmax entry comes from
     the operand, not the pool).
+
+    Precondition: every row needs a free slot, i.e.
+    ``seq_lens[i] < block_tables.shape[1] * page_size``. An exactly-full
+    sequence has nowhere to append; rather than let the clamped
+    ``lens // page_size`` index silently overwrite slot
+    ``lens % page_size`` of the row's LAST allocated page (HBM cache
+    corruption), overfull rows get a MASKED NO-OP write: the page
+    read-modify-write runs with an all-zero slot selector, writing back
+    identical bytes. The attention output for such a row still folds in
+    the operand K/V (the current token attends to itself) but the pool
+    is untouched — the caller must grow the table before retrying.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -523,16 +566,23 @@ def paged_decode_attention_inplace(q, new_k, new_v, key_cache,
 
     base = jnp.asarray(0 if pool_base is None else pool_base, jnp.int32)
     lens_i = seq_lens.astype(jnp.int32)
+    # seq_lens < pages_per_seq*page_size guard (see docstring): overfull
+    # rows clamp their write-page index in range and zero their slot
+    # selector, turning the page RMW into a no-op write-back
+    pp = block_tables.shape[1]
+    overfull = lens_i >= jnp.int32(pp * ps)                # [b]
     wpages = (jnp.take_along_axis(
         block_tables.astype(jnp.int32),
-        (lens_i // ps)[:, None], axis=1)[:, 0] + base)     # [b] abs page
+        jnp.minimum(lens_i // ps, pp - 1)[:, None],
+        axis=1)[:, 0] + base)                              # [b] abs page
     # slot selector as a 4-D f32 operand (single-slot DMA slices violate
     # Mosaic's sublane tiling — the kernel read-modify-writes WHOLE
     # pages and blends the slot row arithmetically; f32 because Mosaic
     # supports only 32-bit sub-minor broadcasts, and pre-shaped 4-D
     # because i1/bf16 dim insertion doesn't lower)
-    slotmask = (jnp.arange(ps, dtype=jnp.int32)[None, :]
-                == (lens_i % ps)[:, None]) \
+    slotmask = ((jnp.arange(ps, dtype=jnp.int32)[None, :]
+                 == (lens_i % ps)[:, None])
+                & ~overfull[:, None]) \
         .astype(jnp.float32)[:, None, :, None]           # [b,1,ps,1]
     scalars = jnp.concatenate(
         [jnp.reshape(base // jnp.int32(cp), (1,)), wpages])
@@ -680,13 +730,13 @@ def paged_decode_attention_inplace(q, new_k, new_v, key_cache,
             pl.BlockSpec((b, n_kv, ps, d), lambda c, s: (0, 0, 0, 0)),
             pl.BlockSpec((b, n_kv, ps, d), lambda c, s: (0, 0, 0, 0)),
             pl.BlockSpec((b, 1, ps, 1), lambda c, s: (0, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=_pltpu_memspace(pltpu).ANY),
+            pl.BlockSpec(memory_space=_pltpu_memspace(pltpu).ANY),
         ],
         out_specs=[
             pl.BlockSpec((n_kv, bg, d), lambda c, s: (0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=_pltpu_memspace(pltpu).ANY),
+            pl.BlockSpec(memory_space=_pltpu_memspace(pltpu).ANY),
         ],
         scratch_shapes=[
             pltpu.VMEM((2, cp, n_kv, ps, d), key_cache.dtype),
@@ -700,7 +750,7 @@ def paged_decode_attention_inplace(q, new_k, new_v, key_cache,
             pltpu.SemaphoreType.DMA((b, 2)),
             pltpu.SemaphoreType.DMA((b, 2)),
         ])
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         out, ck, cv = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -713,7 +763,7 @@ def paged_decode_attention_inplace(q, new_k, new_v, key_cache,
             # inputs are numbered with the scalar-prefetch operand as 0:
             # key_cache is arg 8, value_cache arg 9 -> outputs 1, 2
             input_output_aliases={8: 1, 9: 2},
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_pltpu_compiler_params(pltpu)(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=not _on_tpu(),
         )(scalars, qt, mask3, nk_t, nv_t, nk_w, nv_w, slotmask,
@@ -871,6 +921,11 @@ def paged_decode_attention_inplace_q(q, new_k, new_v, kq_pool, ks_plane,
     Halves attention HBM traffic vs bf16 KV. Opt-in via the engine's
     ``kv_dtype="int8"``. Reference comparator: cache-KV int8 serving
     (block_multi_head_attention cache_*_quant_scales).
+
+    Same ``seq_lens < pages_per_seq*page_size`` precondition as
+    ``paged_decode_attention_inplace``: overfull rows take a masked
+    no-op write (zeroed page-slot selector, scale-plane patch dropped)
+    instead of corrupting their last allocated page.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -919,20 +974,26 @@ def paged_decode_attention_inplace_q(q, new_k, new_v, kq_pool, ks_plane,
 
     base = jnp.asarray(0 if pool_base is None else pool_base, jnp.int32)
     lens_i = seq_lens.astype(jnp.int32)
-    wpages = (jnp.take_along_axis(
+    # overfull-row guard (see docstring): clamp the page index, zero the
+    # slot selector, and push the scale-plane patch token out of range
+    # so its scatter drops — masked no-op write all the way down
+    pp = block_tables.shape[1]
+    overfull = lens_i >= jnp.int32(pp * ps)                # [b]
+    wpage_local = jnp.take_along_axis(
         block_tables.astype(jnp.int32),
-        (lens_i // ps)[:, None], axis=1)[:, 0] + base)     # [b] abs page
+        jnp.minimum(lens_i // ps, pp - 1)[:, None], axis=1)[:, 0]
+    wpages = wpage_local + base                            # [b] abs page
     # flat row selector for the int8 page patch: [b, n_kv*ps, 1] f32
-    slot_sel = (jnp.arange(ps, dtype=jnp.int32)[None, :]
-                == (lens_i % ps)[:, None]).astype(jnp.float32)
+    slot_sel = ((jnp.arange(ps, dtype=jnp.int32)[None, :]
+                 == (lens_i % ps)[:, None])
+                & ~overfull[:, None]).astype(jnp.float32)
     sel_flat = jnp.broadcast_to(slot_sel[:, None, :], (b, n_kv, ps)) \
         .reshape(b, rows_pp)[..., None]                    # [b,rp,1]
 
     # scale-plane patch operands (LAYER-LOCAL token space [T]):
     # one-hot columns at each row's write position + the new values
-    wtok = (jnp.take_along_axis(block_tables.astype(jnp.int32),
-                                (lens_i // ps)[:, None], axis=1)[:, 0]
-            * ps + lens_i % ps)                            # [b] 0..T
+    wtok = jnp.where(overfull, jnp.int32(T),
+                     wpage_local * ps + lens_i % ps)       # [b] 0..T
     sel_col = jnp.zeros((1, T), jnp.float32).at[0, wtok].set(
         1.0, mode="drop")
     kval = jnp.zeros((n_kv, T), jnp.float32).at[:, wtok].set(
@@ -1113,13 +1174,13 @@ def paged_decode_attention_inplace_q(q, new_k, new_v, kq_pool, ks_plane,
             pl.BlockSpec((n_kv, C), lambda c, s: (0, c)),
             pl.BlockSpec((n_kv, C), lambda c, s: (0, s[1] + c)),
             pl.BlockSpec((n_kv, C), lambda c, s: (0, s[1] + c)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=_pltpu_memspace(pltpu).ANY),
+            pl.BlockSpec(memory_space=_pltpu_memspace(pltpu).ANY),
         ],
         out_specs=[
             pl.BlockSpec((n_kv, bg, d), lambda c, s: (0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=_pltpu_memspace(pltpu).ANY),
+            pl.BlockSpec(memory_space=_pltpu_memspace(pltpu).ANY),
             pl.BlockSpec((n_kv, C), lambda c, s: (0, s[1] + c)),
             pl.BlockSpec((n_kv, C), lambda c, s: (0, s[1] + c)),
         ],
@@ -1135,7 +1196,7 @@ def paged_decode_attention_inplace_q(q, new_k, new_v, kq_pool, ks_plane,
             pltpu.SemaphoreType.DMA((b, 2)),
             pltpu.SemaphoreType.DMA((b, 2)),
         ])
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         out, kq2, vq2, ks2, vs2 = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -1151,7 +1212,7 @@ def paged_decode_attention_inplace_q(q, new_k, new_v, kq_pool, ks_plane,
             # nk4, nv5, nkq6, nvq7, self8, selc9, kval10, vval11,
             # ks12, vs13, kq14, vq15]
             input_output_aliases={14: 1, 15: 2, 12: 3, 13: 4},
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_pltpu_compiler_params(pltpu)(
                 vmem_limit_bytes=100 * 1024 * 1024),
             interpret=not _on_tpu(),
         )(scalars, qq, qs, mask3, nk_t, nv_t, nkq_w, nvq_w, sel_flat,

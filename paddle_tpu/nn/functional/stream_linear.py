@@ -25,7 +25,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .paged_attention import _on_tpu
+from .paged_attention import (_enable_x64, _on_tpu,
+                              _pltpu_compiler_params)
 
 __all__ = ["stream_linear"]
 
@@ -136,11 +137,11 @@ def stream_linear(x, w, layer=None, bias=None, scale=None,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((M, bn), lambda j, l: (0, j)),
         scratch_shapes=[])
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         return pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_pltpu_compiler_params(pltpu)(
                 vmem_limit_bytes=100 * 1024 * 1024),
         )(lidx, *operands)

@@ -28,8 +28,28 @@ import jax.numpy as jnp
 from ..core import engine
 from ..core.flags import flag
 from ..core.tensor import Tensor
+from ..profiler import stats as _stats
+from ..profiler.profiler import _SPANS, RecordEvent
 
 __all__ = ["eager_apply", "as_tensor_args", "defun"]
+
+# per-op call counters, cached so the hot dispatch path pays one dict
+# lookup (not a registry lock) per call; VJP-cache outcome counters are
+# module-bound for the same reason
+_OP_COUNTERS: Dict[str, Any] = {}
+_C_HIT = _stats.counter("vjp_cache.hit")
+_C_MISS = _stats.counter("vjp_cache.miss")
+_C_ADMIT = _stats.counter("vjp_cache.admit")
+_C_BLOCKLISTED = _stats.counter("vjp_cache.blocklisted")
+_C_BLOCKED = _stats.counter("vjp_cache.blocked")
+_C_UNCACHEABLE = _stats.counter("vjp_cache.uncacheable")
+
+
+def _op_counter(op_name: str):
+    c = _OP_COUNTERS.get(op_name)
+    if c is None:
+        c = _OP_COUNTERS[op_name] = _stats.counter("op." + op_name)
+    return c
 
 
 # ---------------------------------------------------------------------------
@@ -119,8 +139,10 @@ def _vjp_cache_admit(key, op_name, raw_fn, static_kwargs, n_args,
             for k in dead:
                 del _VJP_SEEN[k]
         return
-    _VJP_CACHE[key] = _CachedVJP(op_name, raw_fn, static_kwargs, n_args,
-                                 diff_idx)
+    _C_ADMIT.inc()
+    with _stats.timed("compile.vjp_build_us"):
+        _VJP_CACHE[key] = _CachedVJP(op_name, raw_fn, static_kwargs,
+                                     n_args, diff_idx)
     while len(_VJP_CACHE) > _VJP_CACHE_MAX:
         _VJP_CACHE.popitem(last=False)
 
@@ -172,7 +194,33 @@ def eager_apply(
     ``raw_fn(*arrays, **static_kwargs)`` is the functional implementation
     over raw jax arrays; ``tensor_inputs`` are the Tensor operands in
     positional order. Returns Tensor or tuple of Tensors (``n_outputs``).
+
+    Telemetry: every call bumps the ``op.<name>`` counter
+    (profiler.stats); when a profiler window is recording, the whole
+    dispatch additionally runs under an auto ``op::<name>`` RecordEvent
+    span, so ``Profiler.summary()`` sees per-op count/total/avg/max
+    without manual annotation.
     """
+    _op_counter(op_name).inc()
+    if not _SPANS.enabled:
+        return _eager_apply_impl(op_name, raw_fn, tensor_inputs,
+                                 static_kwargs, n_outputs)
+    ev = RecordEvent("op::" + op_name)
+    ev.begin()
+    try:
+        return _eager_apply_impl(op_name, raw_fn, tensor_inputs,
+                                 static_kwargs, n_outputs)
+    finally:
+        ev.end()
+
+
+def _eager_apply_impl(
+    op_name: str,
+    raw_fn: Callable,
+    tensor_inputs: Sequence[Tensor],
+    static_kwargs: Optional[Dict[str, Any]] = None,
+    n_outputs: Optional[int] = 1,
+):
     static_kwargs = static_kwargs or {}
     arrays = [t._data for t in tensor_inputs]
 
@@ -213,7 +261,10 @@ def eager_apply(
     diff_set = set(diff_idx)
 
     cache_key = _vjp_cache_key(raw_fn, static_kwargs, arrays, diff_idx)
-    if cache_key is not None and cache_key in _VJP_BLOCK:
+    if cache_key is None:
+        _C_UNCACHEABLE.inc()
+    elif cache_key in _VJP_BLOCK:
+        _C_BLOCKED.inc()
         cache_key = None
     entry = _VJP_CACHE.get(cache_key) if cache_key is not None else None
 
@@ -225,10 +276,12 @@ def eager_apply(
             # trace needs concrete values — permanent plain-vjp fallback
             # (cache_key cleared so the fallback below can't re-admit a
             # zombie entry under the blocked key)
+            _C_BLOCKLISTED.inc()
             _VJP_BLOCK.add(cache_key)
             del _VJP_CACHE[cache_key]
             cache_key = None
         else:
+            _C_HIT.inc()
             box = entry.box
             primals_out = out_flat[:box["n_out"]]
             res_leaves = out_flat[box["n_out"]:]
@@ -248,7 +301,9 @@ def eager_apply(
             was_tuple[0] = isinstance(out, tuple)
             return out if isinstance(out, tuple) else (out,)
 
-        primals_out, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
+        _C_MISS.inc()
+        with _stats.timed("compile.vjp_trace_us"):
+            primals_out, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
         if n_outputs is None:  # auto: single unless raw returned a tuple
             n_outputs = len(primals_out) if was_tuple[0] else 1
         if cache_key is not None:

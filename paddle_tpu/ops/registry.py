@@ -17,7 +17,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Optional, Sequence
 
-__all__ = ["OpDef", "register_op", "get_op", "all_ops"]
+__all__ = ["OpDef", "register_op", "get_op", "all_ops",
+           "op_call_counts"]
 
 
 class OpDef:
@@ -56,3 +57,20 @@ def get_op(name: str) -> OpDef:
 
 def all_ops() -> Dict[str, OpDef]:
     return dict(_REGISTRY)
+
+
+def op_call_counts(include_unused: bool = False) -> Dict[str, int]:
+    """Registry inventory joined with the runtime telemetry: how many
+    times each REGISTERED op was eager-dispatched this process (the
+    ``op.<name>`` counters profiler.stats accumulates in eager_apply).
+    With ``include_unused`` the never-dispatched ops appear as 0 —
+    the coverage view the reference derives from its op-stat tables."""
+    from ..profiler import stats
+
+    counts = stats.snapshot()["counters"]
+    out = {}
+    for name in _REGISTRY:
+        n = counts.get(f"op.{name}", 0)
+        if n or include_unused:
+            out[name] = n
+    return out

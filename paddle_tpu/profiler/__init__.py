@@ -1,3 +1,4 @@
+from . import stats  # noqa: F401
 from .profiler import (  # noqa: F401
     Profiler, ProfilerState, ProfilerTarget, RecordEvent,
     export_chrome_tracing, load_profiler_result, make_scheduler,
@@ -6,4 +7,4 @@ from .timer import Benchmark, benchmark  # noqa: F401
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing",
-           "load_profiler_result", "Benchmark", "benchmark"]
+           "load_profiler_result", "Benchmark", "benchmark", "stats"]

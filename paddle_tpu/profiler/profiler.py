@@ -9,7 +9,11 @@ platform/profiler/host_tracer.cc RecordEvent spans). Two layers:
 - host spans: ``RecordEvent`` context managers collected into a tree,
   exported in the chrome-trace JSON format the reference emits;
 - device trace: ``jax.profiler`` start/stop around the profiled window
-  (XLA's own profiler session → TensorBoard/XPlane dump directory).
+  (XLA's own profiler session → TensorBoard/XPlane dump directory);
+- runtime counters: the process-wide ``profiler.stats`` registry
+  (per-op dispatch counts, VJP-cache hits, compile histograms, pool
+  gauges) is sampled at start/step/stop into chrome-trace counter
+  events (``"ph": "C"``) and folded into ``summary()``.
 """
 from __future__ import annotations
 
@@ -163,6 +167,23 @@ class Profiler:
                 pass
             self._device_active = False
 
+    # ---- runtime-counter sampling (profiler.stats -> "ph": "C") ----
+    def _sample_counters(self):
+        """One chrome-trace counter event per live stats metric — the
+        counter timeline interleaves with the "X" spans in the same
+        exported file (the reference emits device counters the same
+        way through its chrome-trace serializer)."""
+        from . import stats
+
+        snap = stats.snapshot()
+        ts = time.perf_counter_ns() / 1e3
+        pid = os.getpid()
+        for name, val in {**snap["counters"], **snap["gauges"]}.items():
+            self._events.append({
+                "name": name, "ph": "C", "pid": pid, "tid": 0,
+                "ts": ts, "cat": "counter", "args": {"value": val},
+            })
+
     # ---- lifecycle ----
     def start(self):
         self.benchmark.begin()
@@ -173,6 +194,7 @@ class Profiler:
         if self.state in (ProfilerState.RECORD,
                           ProfilerState.RECORD_AND_RETURN):
             self._device_start()
+        self._sample_counters()
         return self
 
     def stop(self):
@@ -180,6 +202,7 @@ class Profiler:
         _SPANS.enabled = False
         self._events.extend(_SPANS.events)
         _SPANS.events = []
+        self._sample_counters()
         self.state = ProfilerState.CLOSED
         if self.on_trace_ready:
             self.on_trace_ready(self)
@@ -188,6 +211,7 @@ class Profiler:
         self.benchmark.step(num_samples, sync_value=sync_value)
         self._events.extend(_SPANS.events)
         _SPANS.events = []
+        self._sample_counters()
         self.step_num += 1
         if self.scheduler is None:
             return
@@ -223,17 +247,52 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        """Aggregate span table (profiler_statistic.py parity)."""
+        """Aggregate span table (profiler_statistic.py parity): per-name
+        count / total / avg / max over the recorded "X" spans (the auto
+        ``op::`` dispatch spans give per-op call counts for free), plus
+        a cache section reading the stats registry (VJP-cache hit rate,
+        jit tracings) — the counters that distinguish a retrace storm
+        from steady cache hits. Returns ``{name: [total_ms, calls]}``."""
         agg = {}
+        maxes = {}
         for e in self._events:
+            if e.get("ph") != "X":
+                continue
             a = agg.setdefault(e["name"], [0.0, 0])
             a[0] += e["dur"] / 1e3
             a[1] += 1
-        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+            maxes[e["name"]] = max(maxes.get(e["name"], 0.0),
+                                   e["dur"] / 1e3)
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"
+                 f"{'Avg(ms)':>12}{'Max(ms)':>12}"]
         for name, (tot, cnt) in sorted(agg.items(), key=lambda x: -x[1][0]):
             lines.append(f"{name:<40}{cnt:>8}{tot:>12.3f}"
-                         f"{tot / cnt:>12.3f}")
-        out = "\n".join(lines)
+                         f"{tot / cnt:>12.3f}{maxes[name]:>12.3f}")
+        from . import stats
+
+        hit_rate = stats.vjp_cache_hit_rate()
+        cache_lines = ["", f"{'Cache / compile counters':<40}"]
+        if hit_rate is not None:
+            cache_lines.append(
+                f"{'vjp_cache hit rate':<40}"
+                f"{100 * hit_rate:>11.1f}%"
+                f"  (hit={stats.counter('vjp_cache.hit').value}"
+                f" miss={stats.counter('vjp_cache.miss').value}"
+                f" admit={stats.counter('vjp_cache.admit').value}"
+                f" blocklisted="
+                f"{stats.counter('vjp_cache.blocklisted').value})")
+        for cname in ("jit.trace", "jit.cache_hit"):
+            v = stats.counter(cname).value
+            if v:
+                cache_lines.append(f"{cname:<40}{v:>8}")
+        for hname in ("compile.vjp_trace_us", "compile.vjp_build_us"):
+            h = stats.histogram(hname)
+            if h.count:
+                cache_lines.append(
+                    f"{hname:<40}{h.count:>8}{h.total / 1e3:>12.3f}"
+                    f"{h.avg / 1e3:>12.3f}{(h.max or 0) / 1e3:>12.3f}")
+        out = "\n".join(lines + (cache_lines
+                                 if len(cache_lines) > 2 else []))
         print(out)
         return agg
 

@@ -1,0 +1,288 @@
+"""Process-wide runtime metrics registry: counters, gauges, histograms.
+
+TPU-native equivalent of the reference's per-op statistic tables
+(reference: python/paddle/profiler/profiler_statistic.py aggregating the
+host tracer's RecordEvent stream, plus the op-count tables the C++
+HostTraceLevel machinery feeds). Where the reference derives counts from
+the trace, this registry is written DIRECTLY by the hot layers — eager
+dispatch (per-op call counts, VJP-cache hit/miss), the autograd engine
+(sweeps, nodes), jit compile caches (tracings vs hits), the inference
+engine (pool pages, decode steps) and the collectives (op counts,
+bytes) — so telemetry exists even when no profiler window is open.
+
+Design constraints:
+
+- near-zero cost when disabled: every mutation checks one module-level
+  bool before touching the metric (`disable()` turns the whole registry
+  into no-ops);
+- thread-safe: each metric guards its state with one lock (metrics are
+  updated from dispatch on any thread; snapshot() sees consistent
+  values);
+- JSON-able: ``snapshot()`` returns plain dicts so bench entry points
+  (bench.py, tools/op_bench.py) can embed telemetry into BENCH_*.json,
+  and the profiler can emit chrome-trace counter events ("ph": "C")
+  from the same source.
+
+Conventions for the built-in instrumentation (all optional reading):
+
+- ``op.<name>``                per-op eager dispatch call counters
+- ``vjp_cache.{hit,miss,admit,blocklisted,uncacheable}``  taped-VJP
+  trace cache outcomes (ops/dispatch.py)
+- ``compile.{vjp_trace_us,vjp_build_us}``   histograms of uncached
+  jax.vjp trace time / cache-entry build time
+- ``jit.{trace,cache_hit}``    to_static program-cache outcomes
+- ``autograd.{sweeps,nodes}``  run_backward sweeps and executed nodes
+- ``inference.*`` / ``serving.*``  pool sizes, decode steps
+- ``dist.<op>.{calls,bytes}``  collective op counts and payload bytes
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
+    "inc", "set_gauge", "observe", "snapshot", "reset", "enable",
+    "disable", "is_enabled", "timed",
+]
+
+_ENABLED = True
+_REGISTRY_LOCK = threading.Lock()
+_COUNTERS: Dict[str, "Counter"] = {}
+_GAUGES: Dict[str, "Gauge"] = {}
+_HISTOGRAMS: Dict[str, "Histogram"] = {}
+
+
+def enable() -> None:
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+class Counter:
+    """Monotonic event counter."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-written instantaneous value (pool pages in use, ...)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n=1) -> None:
+        if not _ENABLED:
+            return
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class Histogram:
+    """Streaming distribution summary (count/total/min/max + powers-of-2
+    buckets) — enough to tell a retrace storm (many large observations)
+    from steady cache hits without storing samples."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_buckets",
+                 "_lock")
+
+    #: bucket upper bounds double from 1; observations are expected in
+    #: microseconds for the compile/wall-time histograms
+    N_BUCKETS = 32
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._buckets = [0] * self.N_BUCKETS
+        self._lock = threading.Lock()
+
+    def observe(self, v) -> None:
+        if not _ENABLED:
+            return
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            b = 0
+            edge = 1.0
+            while v > edge and b < self.N_BUCKETS - 1:
+                edge *= 2.0
+                b += 1
+            self._buckets[b] += 1
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "count": self.count,
+                "total": round(self.total, 3),
+                "avg": round(self.avg, 3),
+                "min": self.min,
+                "max": self.max,
+            }
+
+    def _reset(self) -> None:
+        with self._lock:
+            self.count = 0
+            self.total = 0.0
+            self.min = None
+            self.max = None
+            self._buckets = [0] * self.N_BUCKETS
+
+
+def counter(name: str) -> Counter:
+    c = _COUNTERS.get(name)
+    if c is None:
+        with _REGISTRY_LOCK:
+            c = _COUNTERS.setdefault(name, Counter(name))
+    return c
+
+
+def gauge(name: str) -> Gauge:
+    g = _GAUGES.get(name)
+    if g is None:
+        with _REGISTRY_LOCK:
+            g = _GAUGES.setdefault(name, Gauge(name))
+    return g
+
+
+def histogram(name: str) -> Histogram:
+    h = _HISTOGRAMS.get(name)
+    if h is None:
+        with _REGISTRY_LOCK:
+            h = _HISTOGRAMS.setdefault(name, Histogram(name))
+    return h
+
+
+def inc(name: str, n: int = 1) -> None:
+    if _ENABLED:
+        counter(name).inc(n)
+
+
+def set_gauge(name: str, v) -> None:
+    if _ENABLED:
+        gauge(name).set(v)
+
+
+def observe(name: str, v) -> None:
+    if _ENABLED:
+        histogram(name).observe(v)
+
+
+class timed:
+    """Context manager observing its wall time (µs) into a histogram,
+    and counting into an optional companion counter::
+
+        with stats.timed("compile.vjp_trace_us"):
+            ...  # traced work
+    """
+
+    __slots__ = ("_name", "_t0")
+
+    def __init__(self, name: str):
+        self._name = name
+        self._t0 = None
+
+    def __enter__(self):
+        if _ENABLED:
+            self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None and _ENABLED:
+            observe(self._name,
+                    (time.perf_counter_ns() - self._t0) / 1e3)
+        return False
+
+
+def snapshot(prefix: Optional[str] = None) -> dict:
+    """JSON-able view of every metric (optionally name-prefixed):
+    ``{"counters": {...}, "gauges": {...}, "histograms": {...}}``."""
+    def keep(name):
+        return prefix is None or name.startswith(prefix)
+
+    return {
+        "counters": {n: c.value for n, c in sorted(_COUNTERS.items())
+                     if keep(n) and c.value},
+        "gauges": {n: g.value for n, g in sorted(_GAUGES.items())
+                   if keep(n)},
+        "histograms": {n: h.summary()
+                       for n, h in sorted(_HISTOGRAMS.items())
+                       if keep(n) and h.count},
+    }
+
+
+def reset() -> None:
+    """Zero every metric (keeps the registry's objects alive — cached
+    references in hot paths stay valid)."""
+    for c in list(_COUNTERS.values()):
+        c._reset()
+    for g in list(_GAUGES.values()):
+        g._reset()
+    for h in list(_HISTOGRAMS.values()):
+        h._reset()
+
+
+def vjp_cache_hit_rate() -> Optional[float]:
+    """hit / (hit + miss) over the taped-VJP trace cache, or None before
+    any taped dispatch ran."""
+    hit = counter("vjp_cache.hit").value
+    miss = counter("vjp_cache.miss").value
+    return hit / (hit + miss) if (hit + miss) else None

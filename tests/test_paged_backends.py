@@ -212,6 +212,66 @@ def test_fused_inplace_kernel_parity(g):
                                       np.asarray(kpool[other]))
 
 
+def test_inplace_overfull_row_masked_noop_write():
+    """seq_lens < pages_per_seq*page_size precondition guard (ADVICE
+    r5): a row that is exactly full has no free slot — the in-place
+    kernel must NOT overwrite slot lens%ps of its last allocated page
+    (the clamped-index corruption), while other rows' appends still
+    land. Covers both the bf16 and the int8 in-place kernels."""
+    from paddle_tpu.nn.functional.paged_attention import (
+        paged_decode_attention_inplace, paged_decode_attention_inplace_q,
+        quantize_kv_rows)
+
+    rng = np.random.RandomState(11)
+    b, n_kv, d, ps, pp, P = 2, 2, 128, 4, 6, 32
+    q = jnp.asarray(rng.randn(b, n_kv, d).astype(np.float32))
+    nk = jnp.asarray(rng.randn(b, n_kv, d).astype(np.float32))
+    nv = jnp.asarray(rng.randn(b, n_kv, d).astype(np.float32))
+    kpool = jnp.asarray(rng.randn(P, n_kv, ps, d).astype(np.float32))
+    vpool = jnp.asarray(rng.randn(P, n_kv, ps, d).astype(np.float32))
+    lens_np = np.array([pp * ps, 5], np.int32)   # row 0 exactly full
+    tables_np = np.zeros((b, pp), np.int32)
+    tables_np[0] = np.arange(1, 1 + pp)
+    tables_np[1, :2] = [7, 8]
+    lens, tables = jnp.asarray(lens_np), jnp.asarray(tables_np)
+
+    out, ck, cv = paged_decode_attention_inplace(
+        q, nk, nv, kpool, vpool, lens, tables, pool_base=0,
+        pool_pages=P)
+    # expected: ONLY row 1's token written (page tables[1, 5//4]=8,
+    # slot 1); row 0's pages — last one included — bit-identical
+    exp_k = kpool.at[8, :, 1].set(nk[1])
+    exp_v = vpool.at[8, :, 1].set(nv[1])
+    np.testing.assert_array_equal(np.asarray(ck), np.asarray(exp_k))
+    np.testing.assert_array_equal(np.asarray(cv), np.asarray(exp_v))
+    assert np.isfinite(np.asarray(out)).all()
+
+    # int8 variant: quantized pools + scale planes equally untouched for
+    # the overfull row
+    kq, s_k = quantize_kv_rows(kpool)
+    vq, s_v = quantize_kv_rows(vpool)
+    # build planes positionally: scale of (page p, slot s) at col p*ps+s
+    ks_np = np.zeros((n_kv, P * ps), np.float32)
+    vs_np = np.zeros((n_kv, P * ps), np.float32)
+    for p in range(P):
+        for s in range(ps):
+            ks_np[:, p * ps + s] = np.asarray(s_k)[p, :, s]
+            vs_np[:, p * ps + s] = np.asarray(s_v)[p, :, s]
+    out_q, kq2, ks2, vq2, vs2 = paged_decode_attention_inplace_q(
+        q, nk, nv, kq, jnp.asarray(ks_np), vq, jnp.asarray(vs_np),
+        lens, tables, pool_base=0, pool_pages=P)
+    nkq, nks = quantize_kv_rows(nk)
+    nvq, nvs = quantize_kv_rows(nv)
+    exp_kq = kq.at[8, :, 1].set(nkq[1])
+    exp_ks = jnp.asarray(ks_np).at[:, 8 * ps + 1].set(nks[1])
+    np.testing.assert_array_equal(np.asarray(kq2), np.asarray(exp_kq))
+    np.testing.assert_allclose(np.asarray(ks2), np.asarray(exp_ks),
+                               rtol=1e-6)
+    exp_vq = vq.at[8, :, 1].set(nvq[1])
+    np.testing.assert_array_equal(np.asarray(vq2), np.asarray(exp_vq))
+    assert np.isfinite(np.asarray(out_q)).all()
+
+
 def test_int8_kv_fused_kernel_parity():
     """Cache-KV int8 mode: the quantized fused kernel must match the
     dequantized-pool XLA reference within int8 tolerance, patch the

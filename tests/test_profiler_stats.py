@@ -1,0 +1,249 @@
+"""profiler.stats registry + dispatch/engine telemetry wiring.
+
+Covers the framework-wide runtime-telemetry subsystem: metric
+semantics (counter/gauge/histogram), the auto ``op::`` spans emitted by
+eager dispatch under a profiler window, VJP-cache outcome counters,
+zero-emission when no window is open, and the chrome-trace export
+round-trip carrying both "X" spans and "C" counter events."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops import dispatch
+from paddle_tpu.profiler import Profiler, load_profiler_result, stats
+from paddle_tpu.profiler.profiler import _SPANS
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    stats.enable()
+    stats.reset()
+    yield
+    stats.enable()
+
+
+class TestMetricSemantics:
+    def test_counter(self):
+        c = stats.counter("t.counter")
+        assert c.value == 0
+        c.inc()
+        c.inc(5)
+        assert c.value == 6
+        assert stats.counter("t.counter") is c  # get-or-create
+
+    def test_gauge(self):
+        g = stats.gauge("t.gauge")
+        g.set(3.5)
+        assert g.value == 3.5
+        g.inc(2)
+        g.dec()
+        assert g.value == 4.5
+        g.set(7)  # last write wins
+        assert g.value == 7.0
+
+    def test_histogram(self):
+        h = stats.histogram("t.hist")
+        for v in (1.0, 3.0, 8.0):
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 3
+        assert s["min"] == 1.0 and s["max"] == 8.0
+        np.testing.assert_allclose(s["avg"], 4.0)
+
+    def test_snapshot_json_roundtrip_and_reset(self):
+        stats.inc("t.snap", 2)
+        stats.set_gauge("t.snapg", 1.5)
+        stats.observe("t.snaph", 10.0)
+        snap = stats.snapshot()
+        # JSON-able end to end (bench.py embeds this into BENCH_*.json)
+        again = json.loads(json.dumps(snap))
+        assert again["counters"]["t.snap"] == 2
+        assert again["gauges"]["t.snapg"] == 1.5
+        assert again["histograms"]["t.snaph"]["count"] == 1
+        stats.reset()
+        snap2 = stats.snapshot()
+        assert "t.snap" not in snap2["counters"]  # zeroed drop out
+        assert stats.counter("t.snap").value == 0
+
+    def test_disable_makes_mutations_noops(self):
+        c = stats.counter("t.disabled")
+        stats.disable()
+        try:
+            c.inc(100)
+            stats.inc("t.disabled", 100)
+            stats.set_gauge("t.disabled_g", 9)
+            stats.observe("t.disabled_h", 9)
+            with stats.timed("t.disabled_h"):
+                pass
+        finally:
+            stats.enable()
+        assert c.value == 0
+        assert stats.gauge("t.disabled_g").value == 0
+        assert stats.histogram("t.disabled_h").count == 0
+
+    def test_timed_observes_microseconds(self):
+        with stats.timed("t.timed_us"):
+            pass
+        h = stats.histogram("t.timed_us")
+        assert h.count == 1
+        assert 0 <= h.total < 1e6  # sane µs range for a no-op body
+
+
+class TestDispatchTelemetry:
+    def test_per_op_call_counters(self):
+        before = stats.counter("op.matmul").value
+        a = paddle.to_tensor(np.ones((4, 4), np.float32))
+        _ = a @ a
+        _ = a @ a
+        assert stats.counter("op.matmul").value == before + 2
+
+    def test_auto_spans_only_inside_profiler_window(self):
+        a = paddle.to_tensor(np.ones((4, 4), np.float32))
+        assert not _SPANS.enabled
+        _ = a @ a                       # no window open
+        assert _SPANS.events == []      # zero span records emitted
+        with Profiler(on_trace_ready=lambda p: None) as prof:
+            _ = a @ a
+        agg = prof.summary()
+        assert "op::matmul" in agg
+        assert agg["op::matmul"][1] == 1
+        assert not _SPANS.enabled       # window closed again
+
+    def test_vjp_cache_hit_counters(self):
+        dispatch._VJP_CACHE.clear()
+        dispatch._VJP_SEEN.clear()
+        dispatch._VJP_BLOCK.clear()
+        x_np = np.linspace(-1, 1, 8).astype(np.float32)
+
+        def run():
+            x = paddle.to_tensor(x_np, stop_gradient=False)
+            y = paddle.tanh(x)
+            y.sum().backward()
+
+        run()   # sighting 1: miss
+        run()   # sighting 2: miss + admit
+        run()   # hit
+        snap = stats.snapshot()["counters"]
+        assert snap["vjp_cache.hit"] >= 1
+        assert snap["vjp_cache.miss"] >= 2
+        assert snap["vjp_cache.admit"] >= 1
+        rate = stats.vjp_cache_hit_rate()
+        assert rate is not None and 0 < rate < 1
+        # the uncached traces observed wall time into the histogram
+        assert stats.histogram("compile.vjp_trace_us").count >= 2
+
+    def test_registry_op_call_counts(self):
+        from paddle_tpu.ops.registry import op_call_counts
+
+        a = paddle.to_tensor(np.ones((3, 3), np.float32))
+        _ = a + a
+        counts = op_call_counts()
+        assert counts.get("add", 0) >= 1
+        full = op_call_counts(include_unused=True)
+        assert len(full) > len(counts)  # unused registered ops at 0
+        assert all(v == 0 for k, v in full.items() if k not in counts)
+
+    def test_backward_sweep_counters(self):
+        before_sweeps = stats.counter("autograd.sweeps").value
+        x = paddle.to_tensor(np.ones((3,), np.float32),
+                             stop_gradient=False)
+        ((x * x).sum()).backward()
+        assert stats.counter("autograd.sweeps").value == before_sweeps + 1
+        assert stats.counter("autograd.nodes").value >= 2  # mul + sum
+
+    def test_backward_span_recorded_in_window(self):
+        x = paddle.to_tensor(np.ones((3,), np.float32),
+                             stop_gradient=False)
+        with Profiler(on_trace_ready=lambda p: None) as prof:
+            (x * x).sum().backward()
+        agg = prof.summary()
+        assert "autograd::backward" in agg
+
+
+class TestChromeTraceExport:
+    def test_counter_events_round_trip(self, tmp_path):
+        a = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with Profiler(on_trace_ready=lambda p: None) as prof:
+            for _ in range(3):
+                _ = a @ a
+            prof.step()
+        path = prof.export(str(tmp_path / "trace.json"))
+        tr = load_profiler_result(path)
+        evs = tr["traceEvents"]
+        x_names = {e["name"] for e in evs if e["ph"] == "X"}
+        c_events = [e for e in evs if e["ph"] == "C"]
+        assert "op::matmul" in x_names
+        assert c_events, "no counter events exported"
+        by_name = {e["name"] for e in c_events}
+        assert any(n.startswith("op.") for n in by_name)
+        for e in c_events:
+            assert isinstance(e["args"]["value"], (int, float))
+        # step() sampled mid-window: at least two samples per counter
+        matmul_samples = [e for e in c_events if e["name"] == "op.matmul"]
+        assert len(matmul_samples) >= 2
+
+    def test_summary_has_max_column_and_cache_section(self, capsys):
+        x = paddle.to_tensor(np.linspace(-1, 1, 8).astype(np.float32),
+                             stop_gradient=False)
+        with Profiler(on_trace_ready=lambda p: None) as prof:
+            for _ in range(3):
+                y = paddle.tanh(x)
+                y.sum().backward()
+        prof.summary()
+        out = capsys.readouterr().out
+        assert "Max(ms)" in out
+        assert "vjp_cache hit rate" in out
+
+
+class TestInferenceTelemetry:
+    def test_round_pool_pages_caps_inflation(self):
+        from paddle_tpu.inference.engine import _round_pool_pages
+        from paddle_tpu.nn.functional.paged_attention import (
+            stream_chunk_pages)
+
+        # the ADVICE r5 case: 25 requested pages at page_size=4 must not
+        # balloon to 256 (a full 1024-token chunk); the cap keeps it
+        # within 2x of the request
+        assert _round_pool_pages(25, 4) <= 64
+        # the rounded pool still divides into stream chunks exactly
+        for n, ps in ((25, 4), (7, 16), (100, 16), (1040, 16)):
+            pool = _round_pool_pages(n, ps)
+            assert pool >= n
+            quantum = min(stream_chunk_pages(ps), pool)
+            # some chunk size <= the full target divides the pool
+            assert any(pool % cp == 0
+                       for cp in range(1, quantum + 1))
+        # large pools keep the old full-chunk rounding
+        assert _round_pool_pages(1040, 16) == 1088
+
+    def test_generate_sets_pool_gauges_and_decode_counters(self):
+        from paddle_tpu.inference import FusedCausalLM, GenerationEngine
+
+        paddle.seed(0)
+        lm = FusedCausalLM(vocab_size=32, embed_dim=16, num_heads=2,
+                           dim_feedforward=32, num_layers=1,
+                           max_position=64)
+        eng = GenerationEngine(lm, page_size=4, max_length=32,
+                               decode_chunk=4)
+        before = stats.counter("inference.decode_steps").value
+        out = eng.generate(np.zeros((2, 4), np.int64), max_new_tokens=8)
+        assert out.shape == (2, 12)
+        snap = stats.snapshot()
+        assert snap["gauges"]["inference.pool_pages"] >= \
+            snap["gauges"]["inference.pool_pages_requested"]
+        assert stats.counter("inference.decode_steps").value > before
+        assert stats.counter("inference.prefills").value >= 1
+
+
+class TestCollectiveTelemetry:
+    def test_all_reduce_counts_calls_and_bytes(self):
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.ones((8,), np.float32))
+        before = stats.counter("dist.all_reduce.calls").value
+        dist.all_reduce(t)
+        assert stats.counter("dist.all_reduce.calls").value == before + 1
+        assert stats.counter("dist.all_reduce.bytes").value >= 32

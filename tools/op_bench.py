@@ -180,12 +180,30 @@ def run_bench():
         "taped_dispatch_us": round(tape_us, 1),
         "tape_overhead_us": round(tape_us - nograd_us, 1),
     }
+    # runtime telemetry for the whole bench run (profiler.stats): VJP
+    # trace-cache outcomes + compile-time histograms — the hit rate here
+    # is what the taped_dispatch_us number is made of
+    from paddle_tpu.profiler import stats
+
+    snap = stats.snapshot()
+    telemetry = {
+        "counters": {k: v for k, v in snap["counters"].items()
+                     if not k.startswith("op.")},
+        "histograms": snap["histograms"],
+        "total_op_dispatches": sum(
+            v for k, v in snap["counters"].items()
+            if k.startswith("op.")),
+    }
+    hr = stats.vjp_cache_hit_rate()
+    if hr is not None:
+        telemetry["vjp_cache_hit_rate"] = round(hr, 4)
     return {
         "backend": jax.default_backend(),
         "device": getattr(jax.devices()[0], "device_kind", "cpu"),
         "reps": REPS,
         "dispatch": overhead,
         "ops": results,
+        "telemetry": telemetry,
     }
 
 
