@@ -55,6 +55,13 @@ def init_parallel_env(*args, **kwargs) -> Group:
             coordinator_address=f"{addr}:{port}",
             num_processes=world, process_id=rank)
     _env._mark_initialized()
+    # fleet-observability stamp: every rank's stats snapshot carries its
+    # coordinates as gauges, so merged snapshots (tools/trace_merge.py)
+    # show the world shape even before any collective runs
+    from ..profiler import stats as _stats
+
+    _stats.set_gauge("dist.process_index", _env.get_rank())
+    _stats.set_gauge("dist.process_count", _env.get_world_size())
     g = Group(rank, 0, list(range(max(world, 1))), "default")
     _set_default_group(g)
     return g

@@ -20,6 +20,7 @@ from ..core.tensor import Tensor
 from ..incubate.nn.fused_transformer import (
     FusedMultiTransformer, PagedKV, rope_table)
 from ..nn.layer_base import Layer
+from ..profiler import roofline as _roofline
 from ..profiler import stats as _stats
 from .kv_cache import BlockKVCacheManager
 
@@ -129,8 +130,13 @@ class GenerationEngine:
         self._head_t = jnp.array(self.model.embed._data.T) \
             .astype(self._cdtype)
         # one jitted prefill; decode programs are per-chunk-size (k=1
-        # is the single-token step); cache operands are donated
-        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(7, 8))
+        # is the single-token step); cache operands are donated. Both
+        # dispatch through the explicit-AOT wrapper so each program's
+        # XLA cost model (flops, bytes accessed — the decode step's
+        # weight+KV traffic) feeds the roofline telemetry
+        # (profiler/roofline.py) instead of a hand-derived byte count.
+        self._prefill = _roofline.AotProgram(
+            "prefill", jax.jit(self._prefill_fn, donate_argnums=(7, 8)))
         self._decode_k_jit = {}
 
     def _get_decode_k(self, k: int, sample_cfg=None):
@@ -141,10 +147,11 @@ class GenerationEngine:
         if key not in self._decode_k_jit:
             import functools
 
-            self._decode_k_jit[key] = jax.jit(
-                functools.partial(self._decode_k_fn, k=k,
-                                  sample_cfg=sample_cfg),
-                donate_argnums=(7, 8))
+            self._decode_k_jit[key] = _roofline.AotProgram(
+                f"decode[k={k}]",
+                jax.jit(functools.partial(self._decode_k_fn, k=k,
+                                          sample_cfg=sample_cfg),
+                        donate_argnums=(7, 8)))
         return self._decode_k_jit[key]
 
     # ---------- pure programs ----------
@@ -388,12 +395,19 @@ class GenerationEngine:
             _stats.inc("inference.decode_steps", k)
             _stats.set_gauge("inference.kv_pages_in_use",
                              self._mgr.num_pages - self._mgr.free_pages)
+            import time as _time
+
+            t0 = _time.perf_counter()
             toks, ck, cv = self._get_decode_k(k, static_cfg)(
                 weights, embed, self._head_t, lnf_s, lnf_b,
                 jnp.asarray(out[np.arange(b), cur].astype(np.int32)),
                 jnp.asarray(cur, dtype=jnp.int32), ck, cv, tables,
                 next_rng_key() if do_sample else None, params)
             toks_np = np.asarray(toks)
+            # honest wall time: the np.asarray fetch synced the chunk,
+            # so this roofline reflects executed work, not dispatch
+            _roofline.analyze(f"decode[k={k}]",
+                              _time.perf_counter() - t0)
             for j in range(k):
                 col = toks_np[:, j].astype(ids.dtype)
                 if eos_token_id is not None:
@@ -534,6 +548,9 @@ class ContinuousBatchingEngine:
         m = self.model
         cur = np.where([r is not None for r in self._slots],
                        self._lens - 1, 0).astype(np.int64)
+        import time as _time
+
+        t0 = _time.perf_counter()
         toks, self._ck, self._cv = self._gen._get_decode_k(k)(
             m.stack._stack(), m.embed._data,
             self._gen._head_t, m.lnf_scale._data, m.lnf_bias._data,
@@ -541,6 +558,8 @@ class ContinuousBatchingEngine:
             jnp.asarray(cur, jnp.int32),
             self._ck, self._cv, tables)
         toks_np = np.asarray(toks)
+        # synced by the fetch above — an honest per-chunk roofline
+        _roofline.analyze(f"decode[k={k}]", _time.perf_counter() - t0)
 
         done_now = []
         for i in active:

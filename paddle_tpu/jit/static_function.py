@@ -97,7 +97,16 @@ class _Program:
         self.kwargs_tmpl = kwargs_tmpl
         self.n_args = n_args
         self.out_tmpl = None
-        self._fwd = jax.jit(self._pure_fwd)
+        # forward dispatches through the explicit-AOT wrapper: the same
+        # single compilation jit would do, but the executable's XLA cost
+        # model (flops, bytes accessed) is captured into compile.* /
+        # roofline.* telemetry (profiler/roofline.py). The backward has
+        # static_argnums (value-bearing), which the wrapper's
+        # value-blind signature cannot key — it stays plain jit.
+        from ..profiler import roofline as _roofline
+
+        self._fwd = _roofline.AotProgram(
+            f"to_static[{sf._name}]", jax.jit(self._pure_fwd))
         self._bwd = jax.jit(self._pure_bwd, static_argnums=4)
 
     # ---- the pure functions (traced by jax.jit) ----

@@ -52,9 +52,19 @@ class TrainStep:
         self._trainable_idx = [i for i, p in enumerate(self._params)
                                if not p.stop_gradient]
         donate_args = (0, 1) if donate else ()
-        self._compiled = jax.jit(self._pure_step, donate_argnums=donate_args)
+        # explicit-AOT dispatch (profiler/roofline.py): the whole-step
+        # executable's XLA cost model (flops, bytes accessed) lands in
+        # compile.{flops,bytes} at compile time, so bench.py and
+        # tools/*_profile.py derive MFU / bandwidth utilization from the
+        # compiler's own accounting via self.roofline() instead of a
+        # hand-derived flops-per-token formula
+        from ..profiler import roofline as _roofline
         from ..profiler import stats as _stats
 
+        self._program_name = f"TrainStep[{type(model).__name__}]"
+        self._compiled = _roofline.AotProgram(
+            self._program_name, jax.jit(self._pure_step,
+                                        donate_argnums=donate_args))
         _stats.inc("jit.train_step_build")
 
     # ---- functional grad-clip mirror of nn.ClipGradByGlobalNorm ----
@@ -191,8 +201,18 @@ class TrainStep:
         """Lower the whole-step program for these inputs and return the
         optimized HLO text (used by HLO-assertion tests and the
         multichip dryrun; does NOT execute the step)."""
-        return self._compiled.lower(*self._build_args(inputs, labels)) \
-            .compile().as_text()
+        return self._compiled.jitted \
+            .lower(*self._build_args(inputs, labels)).compile().as_text()
+
+    def roofline(self, wall_s_per_step: float):
+        """Roofline for the compiled step from the XLA cost model and an
+        honestly measured per-step wall time: returns a RooflineResult
+        (achieved FLOP/s, achieved bytes/s, MFU, %-of-bandwidth-roofline
+        vs the device peak table) and refreshes the roofline.* gauges.
+        None until the step has compiled."""
+        from ..profiler import roofline as _roofline
+
+        return _roofline.analyze(self._program_name, wall_s_per_step)
 
     def __call__(self, inputs, labels=()):
         if isinstance(inputs, Tensor):

@@ -26,7 +26,20 @@ from enum import Enum
 from typing import Callable, List, Optional
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+           "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result", "dump_rank"]
+
+
+def _process_index() -> int:
+    """Rank of this process (0 when jax is uninitialized): stamps trace
+    metadata, worker names, and fleet snapshots so multi-host runs stay
+    distinguishable after merging."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
 
 
 class ProfilerState(Enum):
@@ -172,9 +185,15 @@ class Profiler:
         """One chrome-trace counter event per live stats metric — the
         counter timeline interleaves with the "X" spans in the same
         exported file (the reference emits device counters the same
-        way through its chrome-trace serializer)."""
-        from . import stats
+        way through its chrome-trace serializer). HBM telemetry is
+        refreshed first so the ``hbm.*`` gauges ride the same timeline
+        (memory sampled at step boundaries, reference memory view)."""
+        from . import memory, stats
 
+        try:
+            memory.sample()
+        except Exception:
+            pass
         snap = stats.snapshot()
         ts = time.perf_counter_ns() / 1e3
         pid = os.getpid()
@@ -236,13 +255,18 @@ class Profiler:
 
     # ---- export ----
     def export(self, path: str, format: str = "json"):
-        """(export_chrome_tracing:215): chrome-trace JSON."""
+        """(export_chrome_tracing:215): chrome-trace JSON. The
+        ``metadata`` block stamps the producing rank/pid so
+        tools/trace_merge.py can fold per-rank traces into one
+        fleet timeline without relying on filenames."""
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
             json.dump({"traceEvents": self._events,
-                       "displayTimeUnit": "ms"}, f)
+                       "displayTimeUnit": "ms",
+                       "metadata": {"process_index": _process_index(),
+                                    "pid": os.getpid()}}, f)
         return path
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
@@ -291,23 +315,104 @@ class Profiler:
                 cache_lines.append(
                     f"{hname:<40}{h.count:>8}{h.total / 1e3:>12.3f}"
                     f"{h.avg / 1e3:>12.3f}{(h.max or 0) / 1e3:>12.3f}")
+        extra_lines = self._roofline_lines() + self._hbm_lines()
         out = "\n".join(lines + (cache_lines
-                                 if len(cache_lines) > 2 else []))
+                                 if len(cache_lines) > 2 else [])
+                        + extra_lines)
         print(out)
         return agg
+
+    @staticmethod
+    def _roofline_lines():
+        """Per-program cost-model roofline section (programs recorded by
+        the jit layers via profiler.roofline)."""
+        from . import roofline
+
+        text = roofline.format_report()
+        if not text:
+            return []
+        return ["", f"{'Roofline (XLA cost model)':<40}"] + text.split("\n")
+
+    @staticmethod
+    def _hbm_lines():
+        """HBM peak-watermark section: allocator peak vs limit (PJRT),
+        or the live-buffer census on backends without counters."""
+        from . import memory
+
+        try:
+            wm = memory.watermark()
+        except Exception:
+            wm = None
+        if not wm:
+            return []
+        lines = ["", f"{'HBM memory watermark':<40}"]
+        if wm["source"] == "pjrt":
+            pct = wm.get("peak_pct_of_limit")
+            lines.append(
+                f"{'peak_bytes_in_use':<40}"
+                f"{wm['peak_bytes_in_use'] / 2**30:>11.3f}GiB"
+                + (f"  ({pct:.1f}% of limit)" if pct is not None else ""))
+            lines.append(f"{'bytes_in_use':<40}"
+                         f"{wm['bytes_in_use'] / 2**30:>11.3f}GiB")
+            if wm.get("bytes_limit"):
+                lines.append(f"{'bytes_limit':<40}"
+                             f"{wm['bytes_limit'] / 2**30:>11.3f}GiB")
+        else:
+            lines.append(f"{'live buffers':<40}{wm['live_buffers']:>8}"
+                         f"{wm['bytes_in_use'] / 2**20:>12.3f}MiB")
+            for s in wm.get("top_shapes", [])[:3]:
+                lines.append(f"  {s['shape']:<38}{s['count']:>8}"
+                             f"{s['bytes'] / 2**20:>12.3f}MiB")
+        return lines
 
 
 def export_chrome_tracing(dir_name: str, worker_name: str = None):
     """(profiler.py export_chrome_tracing:215): returns an
-    on_trace_ready callback writing into ``dir_name``."""
+    on_trace_ready callback writing into ``dir_name``.
+
+    The default worker name includes ``jax.process_index()`` — a plain
+    ``host_{pid}`` collides when two hosts of a multi-host run land the
+    same pid and write into a shared run dir."""
     def handler(prof: Profiler):
         os.makedirs(dir_name, exist_ok=True)
-        name = worker_name or f"host_{os.getpid()}"
+        name = worker_name or f"rank{_process_index()}_host_{os.getpid()}"
         prof.export(os.path.join(
             dir_name, f"{name}_time_{int(time.time())}"
                       f".paddle_trace.json"))
 
     return handler
+
+
+def dump_rank(run_dir: str, profiler: "Profiler" = None) -> dict:
+    """Write THIS rank's observability artifacts into a shared run dir:
+
+    - ``stats_rank{i}.json`` — ``stats.snapshot()`` (rank-stamped meta)
+      with a fresh HBM sample folded in first;
+    - ``trace_rank{i}.json`` — the given profiler's chrome trace, when
+      one is passed.
+
+    Every rank of a multiproc run calls this with the SAME ``run_dir``
+    (each writes only its own files — no cross-rank coordination), then
+    ``tools/trace_merge.py RUN_DIR`` folds the rank files into one
+    merged trace + one fleet stats snapshot. Returns the paths written.
+    """
+    from . import memory, stats
+
+    os.makedirs(run_dir, exist_ok=True)
+    rank = _process_index()
+    try:
+        memory.sample()
+    except Exception:
+        pass
+    out = {}
+    stats_path = os.path.join(run_dir, f"stats_rank{rank}.json")
+    with open(stats_path, "w") as f:
+        json.dump(stats.snapshot(), f)
+    out["stats"] = stats_path
+    if profiler is not None:
+        out["trace"] = profiler.export(
+            os.path.join(run_dir, f"trace_rank{rank}.json"))
+    return out
 
 
 def load_profiler_result(filename: str):

@@ -34,6 +34,16 @@ Conventions for the built-in instrumentation (all optional reading):
 - ``autograd.{sweeps,nodes}``  run_backward sweeps and executed nodes
 - ``inference.*`` / ``serving.*``  pool sizes, decode steps
 - ``dist.<op>.{calls,bytes}``  collective op counts and payload bytes
+- ``roofline.*``               achieved FLOP/s / bytes/s / MFU / BW
+  utilization vs device peaks (profiler/roofline.py)
+- ``hbm.*``                    device memory telemetry
+  (profiler/memory.py)
+- ``t.*``                      scratch namespace reserved for tests
+
+Every metric the framework registers MUST use one of these prefixes
+(``CONVENTION_PREFIXES``) — tests/test_profiler_stats.py lints the live
+registry against it, so fleet aggregation (tools/trace_merge.py) and
+the bench gate (tools/bench_gate.py) can rely on stable names.
 """
 from __future__ import annotations
 
@@ -44,8 +54,16 @@ from typing import Dict, Optional
 __all__ = [
     "Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
     "inc", "set_gauge", "observe", "snapshot", "reset", "enable",
-    "disable", "is_enabled", "timed",
+    "disable", "is_enabled", "timed", "CONVENTION_PREFIXES",
 ]
+
+#: documented metric-name namespaces (see module docstring / README
+#: conventions table); the naming lint asserts every registered metric
+#: starts with one of these
+CONVENTION_PREFIXES = (
+    "op.", "vjp_cache.", "compile.", "jit.", "autograd.",
+    "inference.", "serving.", "dist.", "roofline.", "hbm.", "t.",
+)
 
 _ENABLED = True
 _REGISTRY_LOCK = threading.Lock()
@@ -168,14 +186,50 @@ class Histogram:
     def avg(self) -> float:
         return self.total / self.count if self.count else 0.0
 
+    def _percentile_locked(self, q: float):
+        """Bucket-derived percentile estimate (linear interpolation
+        within the winning power-of-2 bucket, clamped to the exact
+        min/max). Callers hold self._lock."""
+        if not self.count:
+            return None
+        target = q * self.count
+        cum = 0
+        for b, n in enumerate(self._buckets):
+            if not n:
+                continue
+            prev, cum = cum, cum + n
+            if cum >= target:
+                lo = 0.0 if b == 0 else 2.0 ** (b - 1)
+                hi = 2.0 ** b
+                est = lo + (hi - lo) * (target - prev) / n
+                lo_clamp = self.min if self.min is not None else est
+                hi_clamp = self.max if self.max is not None else est
+                return round(min(max(est, lo_clamp), hi_clamp), 3)
+        return self.max
+
+    def percentile(self, q: float):
+        """Estimated q-quantile (q in [0, 1]) from the power-of-2
+        buckets; None before any observation."""
+        with self._lock:
+            return self._percentile_locked(q)
+
     def summary(self) -> dict:
         with self._lock:
+            # buckets as [upper_edge, count] pairs (nonzero only) so the
+            # retrace-storm-vs-steady-hits shape survives into snapshots
+            # and can be re-folded across ranks (tools/trace_merge.py)
+            buckets = [[(1.0 if b == 0 else 2.0 ** b), n]
+                       for b, n in enumerate(self._buckets) if n]
             return {
                 "count": self.count,
                 "total": round(self.total, 3),
                 "avg": round(self.avg, 3),
                 "min": self.min,
                 "max": self.max,
+                "p50": self._percentile_locked(0.50),
+                "p90": self._percentile_locked(0.90),
+                "p99": self._percentile_locked(0.99),
+                "buckets": buckets,
             }
 
     def _reset(self) -> None:
@@ -252,13 +306,32 @@ class timed:
         return False
 
 
+def _process_meta() -> dict:
+    """Rank stamp for multi-host aggregation: which process produced
+    this snapshot (tools/trace_merge.py folds per-rank snapshots into
+    one fleet view keyed on this)."""
+    pi, pc = 0, 1
+    try:
+        import jax
+
+        pi, pc = jax.process_index(), jax.process_count()
+    except Exception:
+        pass
+    import os
+
+    return {"process_index": int(pi), "process_count": int(pc),
+            "pid": os.getpid()}
+
+
 def snapshot(prefix: Optional[str] = None) -> dict:
     """JSON-able view of every metric (optionally name-prefixed):
-    ``{"counters": {...}, "gauges": {...}, "histograms": {...}}``."""
+    ``{"meta": {...}, "counters": {...}, "gauges": {...},
+    "histograms": {...}}`` — ``meta`` stamps the producing rank."""
     def keep(name):
         return prefix is None or name.startswith(prefix)
 
     return {
+        "meta": _process_meta(),
         "counters": {n: c.value for n, c in sorted(_COUNTERS.items())
                      if keep(n) and c.value},
         "gauges": {n: g.value for n, g in sorted(_GAUGES.items())

@@ -146,6 +146,17 @@ WORKER = textwrap.dedent("""
         recv(buf2, src=0)
         np.testing.assert_allclose(buf2.numpy(), np.full(3, 9.0))
 
+    # fleet observability: every rank runs one profiled collective and
+    # dumps its trace + stats snapshot into the SHARED run dir; the
+    # parent test merges them with tools/trace_merge.py
+    from paddle_tpu.profiler import Profiler, dump_rank
+    with Profiler(on_trace_ready=lambda p: None) as prof:
+        t = paddle.to_tensor(np.full(4, rank + 1.0, np.float32))
+        all_reduce(t)
+        prof.step()
+    written = dump_rank(os.environ["PADDLE_RUN_DIR"], profiler=prof)
+    assert written["stats"].endswith(f"stats_rank{rank}.json")
+
     print(f"RANK{rank}_OK")
 """)
 
@@ -156,9 +167,111 @@ def _free_port():
         return s.getsockname()[1]
 
 
+# Fleet-observability worker: initializes the 2-process distributed
+# context (the coordinator rendezvous works on CPU; only COMPILED
+# cross-process collectives don't — see the note in the main worker),
+# runs rank-local profiled work, and dumps this rank's trace + stats
+# snapshot into the shared run dir for tools/trace_merge.py.
+FLEET_WORKER = textwrap.dedent("""
+    import os, sys
+    for var in list(os.environ):
+        if var.startswith(("PALLAS_AXON", "AXON_", "TPU_")):
+            os.environ.pop(var)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.profiler import Profiler, dump_rank, stats
+
+    dist.init_parallel_env()
+    rank = jax.process_index()
+    assert jax.process_count() == 2
+
+    with Profiler(on_trace_ready=lambda p: None) as prof:
+        a = paddle.to_tensor(np.ones((8, 8), np.float32))
+        for _ in range(rank + 1):    # rank1 does MORE matmuls than rank0
+            _ = a @ a
+        prof.step()
+    written = dump_rank(os.environ["PADDLE_RUN_DIR"], profiler=prof)
+    assert written["stats"].endswith(f"stats_rank{rank}.json")
+    assert written["trace"].endswith(f"trace_rank{rank}.json")
+    print(f"RANK{rank}_OK")
+""")
+
+
+def test_two_process_fleet_dump_and_merge(tmp_path):
+    """≥2-rank multiproc run → per-rank dumps → ONE merged chrome trace
+    (pid = rank) + ONE fleet stats snapshot (counters summed, gauges
+    maxed). Rank-local work only: compiled cross-process collectives
+    are unimplemented on the CPU backend, but the coordinator
+    rendezvous — and therefore real distinct process_index stamps —
+    works, which is exactly what the aggregation layer needs."""
+    import json
+
+    script = tmp_path / "fleet_worker.py"
+    script.write_text(FLEET_WORKER)
+    run_dir = tmp_path / "run"
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "PADDLE_RUN_DIR": str(run_dir),
+            "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    for rank, p in enumerate(procs):
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"rank {rank} failed:\n{err[-3000:]}"
+        assert f"RANK{rank}_OK" in out
+
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import trace_merge
+    finally:
+        sys.path.pop(0)
+    assert trace_merge.main([str(run_dir)]) == 0
+
+    merged = json.load(open(run_dir / "merged_trace.json"))
+    assert merged["metadata"]["ranks"] == [0, 1]
+    # one timeline: every event re-pid'd to its rank, both ranks named
+    assert {e["pid"] for e in merged["traceEvents"]} == {0, 1}
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in spans} == {0, 1}
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert any("rank 0" in n for n in names)
+    assert any("rank 1" in n for n in names)
+
+    fleet = json.load(open(run_dir / "fleet_stats.json"))
+    per_rank = [json.load(open(run_dir / f"stats_rank{r}.json"))
+                for r in (0, 1)]
+    # rank stamps are REAL process indices, not env echoes
+    assert sorted(s["meta"]["process_index"] for s in per_rank) == [0, 1]
+    # counters summed: rank0 ran 1 matmul, rank1 ran 2 -> fleet 3
+    assert fleet["counters"]["op.matmul"] == sum(
+        s["counters"]["op.matmul"] for s in per_rank) == 3
+    # gauges maxed: the fleet view keeps the high-water rank coords
+    assert fleet["gauges"]["dist.process_index"] == 1
+    assert fleet["gauges"]["dist.process_count"] == 2
+
+
 def test_two_process_collectives(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
+    run_dir = tmp_path / "run"
     port = _free_port()
     procs = []
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -169,6 +282,7 @@ def test_two_process_collectives(tmp_path):
             "PADDLE_TRAINERS_NUM": "2",
             "MASTER_ADDR": "127.0.0.1",
             "MASTER_PORT": str(port),
+            "PADDLE_RUN_DIR": str(run_dir),
             "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
         })
         procs.append(subprocess.Popen(
@@ -179,3 +293,35 @@ def test_two_process_collectives(tmp_path):
         out, err = p.communicate(timeout=300)
         assert p.returncode == 0, f"rank {rank} failed:\n{err[-3000:]}"
         assert f"RANK{rank}_OK" in out
+
+    # ---- fleet aggregation over the real 2-rank artifacts ----
+    import json
+
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import trace_merge
+    finally:
+        sys.path.pop(0)
+    rc = trace_merge.main([str(run_dir)])
+    assert rc == 0
+    merged = json.load(open(run_dir / "merged_trace.json"))
+    # one timeline, pid = rank, both ranks present and named
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {0, 1}
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert any("rank 0" in n for n in names)
+    assert any("rank 1" in n for n in names)
+    fleet = json.load(open(run_dir / "fleet_stats.json"))
+    assert sorted(fleet["meta"]["ranks"]) == [0, 1]
+    # counters summed across ranks: the fleet total is the SUM of the
+    # per-rank counts (each rank ran the same >= 4 all_reduces), not
+    # either rank's own count
+    per_rank = [json.load(open(run_dir / f"stats_rank{r}.json"))
+                for r in (0, 1)]
+    want = sum(s["counters"]["dist.all_reduce.calls"] for s in per_rank)
+    assert want >= 8
+    assert fleet["counters"]["dist.all_reduce.calls"] == want
+    # gauges maxed: the fleet view shows the highest rank index/world
+    assert fleet["gauges"]["dist.process_index"] == 1
+    assert fleet["gauges"]["dist.process_count"] == 2

@@ -91,6 +91,49 @@ class TestMetricSemantics:
         assert h.count == 1
         assert 0 <= h.total < 1e6  # sane µs range for a no-op body
 
+    def test_histogram_exports_buckets(self):
+        """The power-of-2 buckets the docstring promises actually leave
+        the process: summary()/snapshot() carry [upper_edge, count]
+        pairs, so a retrace storm (mass in the big-edge buckets) is
+        distinguishable from steady cache hits (mass at the bottom)."""
+        h = stats.histogram("t.buckets")
+        for v in (0.5, 1.5, 3.0, 100.0):
+            h.observe(v)
+        s = h.summary()
+        assert s["buckets"] == [[1.0, 1], [2.0, 1], [4.0, 1], [128.0, 1]]
+        # snapshot carries the same buckets (JSON-able)
+        snap = json.loads(json.dumps(stats.snapshot()))
+        assert snap["histograms"]["t.buckets"]["buckets"] == \
+            [[1.0, 1], [2.0, 1], [4.0, 1], [128.0, 1]]
+
+    def test_histogram_percentiles(self):
+        h = stats.histogram("t.pct")
+        # steady-state: 90 fast observations, 10 slow outliers
+        for _ in range(90):
+            h.observe(3.0)
+        for _ in range(10):
+            h.observe(1000.0)
+        s = h.summary()
+        # p50 lives in the fast bucket, p99 in the slow tail
+        assert s["p50"] <= 4.0
+        assert s["p99"] >= 512.0
+        assert s["min"] <= s["p50"] <= s["p90"] <= s["p99"] <= s["max"]
+        # direct API agrees with the summary view
+        assert h.percentile(0.5) == s["p50"]
+        assert stats.histogram("t.empty").percentile(0.5) is None
+
+    def test_percentiles_clamped_by_min_max(self):
+        h = stats.histogram("t.clamp")
+        h.observe(5.0)   # single sample: every percentile IS the sample
+        s = h.summary()
+        assert s["p50"] == s["p90"] == s["p99"] == 5.0
+
+    def test_snapshot_meta_stamps_rank(self):
+        snap = stats.snapshot()
+        assert snap["meta"]["process_index"] == 0
+        assert snap["meta"]["process_count"] >= 1
+        assert snap["meta"]["pid"] > 0
+
 
 class TestDispatchTelemetry:
     def test_per_op_call_counters(self):
@@ -247,3 +290,31 @@ class TestCollectiveTelemetry:
         dist.all_reduce(t)
         assert stats.counter("dist.all_reduce.calls").value == before + 1
         assert stats.counter("dist.all_reduce.bytes").value >= 32
+
+
+class TestNamingConventions:
+    def test_registered_names_match_conventions(self):
+        """Lint the LIVE registry: every metric any layer registered in
+        this process must use a documented namespace
+        (stats.CONVENTION_PREFIXES / README conventions table) — fleet
+        folding (tools/trace_merge.py) and the telemetry gate
+        (tools/bench_gate.py) key on these prefixes."""
+        # drive a cross-section of instrumented layers so the registry
+        # is populated even when this test runs alone
+        x = paddle.to_tensor(np.linspace(-1, 1, 8).astype(np.float32),
+                             stop_gradient=False)
+        (paddle.tanh(x).sum()).backward()
+        from paddle_tpu.profiler import memory, roofline
+
+        memory.sample()
+        roofline.record_program("roofline.lint", flops=1.0,
+                                bytes_accessed=1.0)
+
+        names = (list(stats._COUNTERS) + list(stats._GAUGES)
+                 + list(stats._HISTOGRAMS))
+        assert names
+        offenders = [n for n in names
+                     if not n.startswith(stats.CONVENTION_PREFIXES)]
+        assert not offenders, (
+            f"metrics outside documented namespaces "
+            f"{stats.CONVENTION_PREFIXES}: {offenders}")

@@ -1,0 +1,165 @@
+"""CI gate over BENCH_*/OPBENCH_* telemetry blocks.
+
+``tools/op_bench.py --compare`` gates op latencies; this gates the
+RUNTIME-TELEMETRY side of two bench JSONs — the counters/histograms
+that explain WHY a number moved (retrace storms, cache-hit-rate
+collapse, compile-time blowups, roofline regressions):
+
+    python tools/bench_gate.py BENCH_r05.json BENCH_r06.json
+    python tools/bench_gate.py --tol 0.2 OPBENCH_r05.json OPBENCH_r06.json
+    python tools/bench_gate.py --metrics jit.trace vjp_cache_hit_rate A B
+
+Exits nonzero when any gated metric regressed by more than ``--tol``
+(default 10%) between the two files. Direction is metric-aware:
+
+- count-like metrics (``jit.trace``, ``vjp_cache.miss``, compile-time
+  histogram avgs) regress UP;
+- rate/utilization metrics (``vjp_cache_hit_rate``, ``roofline.mfu``,
+  ``roofline.bw_util``) regress DOWN.
+
+Telemetry blocks are discovered anywhere in the JSON under keys named
+``telemetry`` / ``*_telemetry`` (bench.py nests one per rung;
+op_bench.py keeps one at top level) and same-named blocks are compared
+pairwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["gate", "extract_telemetry", "main"]
+
+#: metric -> direction ("up" = an increase is a regression, "down" = a
+#: decrease is). The default gate set; extend via --metrics.
+DEFAULT_METRICS: Dict[str, str] = {
+    # a growing trace count across rounds with the same workload is a
+    # retrace storm
+    "jit.trace": "up",
+    "vjp_cache.miss": "up",
+    "vjp_cache.uncacheable": "up",
+    "vjp_cache.blocklisted": "up",
+    # cache effectiveness / device utilization must not collapse
+    "vjp_cache_hit_rate": "down",
+    "roofline.mfu": "down",
+    "roofline.bw_util": "down",
+    # compile-time histograms gate on their mean
+    "compile.vjp_trace_us": "up",
+    "compile.vjp_build_us": "up",
+    "compile.jit_build_us": "up",
+}
+
+#: absolute-change floors so tiny counts/latencies don't trip the
+#: relative gate on noise
+_ABS_FLOOR_COUNT = 3.0
+_ABS_FLOOR_US = 10.0
+
+
+def extract_telemetry(doc: dict, prefix: str = "") -> Dict[str, dict]:
+    """Every telemetry block in the JSON, keyed by its path — bench.py
+    emits ``telemetry`` and ``decode_telemetry``, op_bench.py a
+    top-level ``telemetry``."""
+    out: Dict[str, dict] = {}
+    if not isinstance(doc, dict):
+        return out
+    for k, v in doc.items():
+        path = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            if k == "telemetry" or k.endswith("_telemetry"):
+                out[path] = v
+            else:
+                out.update(extract_telemetry(v, path))
+    return out
+
+
+def _metric_value(block: dict, name: str) -> Optional[float]:
+    """Find ``name`` in a telemetry block: counters, gauges, top-level
+    scalars (vjp_cache_hit_rate), or histogram means."""
+    for section in ("counters", "gauges"):
+        v = block.get(section, {}).get(name)
+        if v is not None:
+            return float(v)
+    v = block.get(name)
+    if isinstance(v, (int, float)):
+        return float(v)
+    h = block.get("histograms", {}).get(name)
+    if isinstance(h, dict) and h.get("count"):
+        return float(h.get("avg", 0.0))
+    return None
+
+
+def _regressed(name: str, direction: str, prev: float, cur: float,
+               tol: float) -> bool:
+    floor = _ABS_FLOOR_US if name.endswith("_us") else _ABS_FLOOR_COUNT
+    if direction == "up":
+        return cur > max(prev * (1 + tol), prev + floor)
+    # "down": rates in [0, 1] — relative drop with a small abs floor
+    return cur < min(prev * (1 - tol), prev - 0.01)
+
+
+def gate(prev_doc: dict, cur_doc: dict,
+         metrics: Optional[Dict[str, str]] = None,
+         tol: float = 0.10) -> Tuple[List[str], int]:
+    """(regression lines, #compared). Same-path telemetry blocks are
+    compared metric-by-metric; blocks present on only one side are
+    skipped (a new rung is not a regression)."""
+    metrics = metrics or DEFAULT_METRICS
+    prev_blocks = extract_telemetry(prev_doc)
+    cur_blocks = extract_telemetry(cur_doc)
+    bad: List[str] = []
+    compared = 0
+    for path in sorted(set(prev_blocks) & set(cur_blocks)):
+        pb, cb = prev_blocks[path], cur_blocks[path]
+        for name, direction in metrics.items():
+            p, c = _metric_value(pb, name), _metric_value(cb, name)
+            if p is None or c is None:
+                continue
+            compared += 1
+            if _regressed(name, direction, p, c, tol):
+                arrow = "+" if c > p else "-"
+                delta = (100.0 * (c / p - 1.0)) if p else float("inf")
+                bad.append(f"{path}:{name}: {p:g} -> {c:g} "
+                           f"({arrow}{abs(delta):.0f}%, "
+                           f"regress-{direction})")
+    return bad, compared
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate the telemetry blocks of two BENCH_*/"
+                    "OPBENCH_* JSONs (nonzero exit on regression)")
+    ap.add_argument("prev", help="previous round's JSON")
+    ap.add_argument("cur", help="current round's JSON")
+    ap.add_argument("--tol", type=float, default=0.10,
+                    help="relative regression tolerance (default 0.10)")
+    ap.add_argument("--metrics", nargs="*", default=None,
+                    help="explicit metric names to gate (direction "
+                         "taken from the default table; unknown names "
+                         "gate 'up')")
+    args = ap.parse_args(argv)
+
+    with open(args.prev) as f:
+        prev_doc = json.load(f)
+    with open(args.cur) as f:
+        cur_doc = json.load(f)
+    metrics = None
+    if args.metrics:
+        metrics = {m: DEFAULT_METRICS.get(m, "up") for m in args.metrics}
+    bad, compared = gate(prev_doc, cur_doc, metrics, args.tol)
+    if not compared:
+        print("bench_gate: no comparable telemetry metrics found "
+              "(missing telemetry blocks?)", file=sys.stderr)
+        return 2
+    if bad:
+        print(f"bench_gate REGRESSIONS (> {100 * args.tol:.0f}%):")
+        for line in bad:
+            print(" ", line)
+        return 1
+    print(f"bench_gate: no telemetry regressions "
+          f"({compared} metrics compared)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,7 +103,10 @@ def run(batch=BATCH, seq=SEQ, dropout=0.1, head="full", ce="full",
     d_model, n_layers = 768, 12
     fpt = 6 * n_params + 12 * n_layers * seq * d_model
     peak = 197e12
-    return tps, round(tps * fpt / peak, 4)
+    # cost-model roofline for the compiled step (XLA's flops/bytes, not
+    # the 6N+12Lsd estimate) from the same timed window
+    rl = step.roofline(dt / STEPS)
+    return tps, round(tps * fpt / peak, 4), (rl.as_dict() if rl else None)
 
 
 MODES = {
@@ -127,9 +130,10 @@ def main():
     ap.add_argument("--mode", required=True, choices=sorted(MODES))
     args = ap.parse_args()
     t0 = time.time()
-    tps, mfu = MODES[args.mode]()
+    tps, mfu, roofline = MODES[args.mode]()
     print(json.dumps({"mode": args.mode, "tokens_per_sec": round(tps, 1),
-                      "mfu": mfu, "wall": round(time.time() - t0, 1)}))
+                      "mfu": mfu, "roofline": roofline,
+                      "wall": round(time.time() - t0, 1)}))
 
 
 if __name__ == "__main__":

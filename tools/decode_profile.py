@@ -729,8 +729,18 @@ def main():
     args = ap.parse_args()
     t0 = time.time()
     tps = MODES[args.mode]()
-    print(json.dumps({"mode": args.mode, "tokens_per_sec": round(tps, 1),
-                      "wall": round(time.time() - t0, 1)}))
+    out = {"mode": args.mode, "tokens_per_sec": round(tps, 1),
+           "wall": round(time.time() - t0, 1)}
+    # engine-path modes record each compiled program's XLA cost model
+    # and the synced per-chunk wall time (profiler/roofline.py): attach
+    # the achieved-rate table so an ablation shows WHERE on the roofline
+    # each variant lands, not just tokens/sec
+    from paddle_tpu.profiler import roofline
+
+    rl = roofline.report()
+    if rl:
+        out["roofline"] = rl
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

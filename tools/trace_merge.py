@@ -1,0 +1,214 @@
+"""Fleet observability: merge per-rank chrome traces + stats snapshots.
+
+Each rank of a multiproc run dumps its own artifacts into a shared run
+dir via ``paddle_tpu.profiler.dump_rank(run_dir, profiler)`` —
+``trace_rank{i}.json`` and ``stats_rank{i}.json`` (plus any
+``*.paddle_trace.json`` written by ``export_chrome_tracing``). This tool
+folds them into ONE fleet view:
+
+- **merged trace**: every rank's events on one timeline with
+  ``pid = rank`` (chrome://tracing / Perfetto then shows one process
+  row per rank, named "rank N"), instead of N files whose pid-only
+  worker names collide across hosts;
+- **fleet stats snapshot**: counters summed, gauges maxed, histograms
+  folded bucket-by-bucket (count/total summed, min/max widened,
+  percentiles re-estimated from the folded power-of-2 buckets).
+
+Usage::
+
+    python tools/trace_merge.py RUN_DIR \
+        [--out-trace merged_trace.json] [--out-stats fleet_stats.json]
+
+Prints one JSON line {ranks, events, out_trace, out_stats}.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+__all__ = ["merge_traces", "fold_stats", "find_rank_files", "main"]
+
+
+def _rank_of(trace: dict, path: str, fallback: int) -> int:
+    """Producing rank: trace metadata stamp first (authoritative),
+    filename ``rank<N>`` second, enumeration order last."""
+    meta = trace.get("metadata") or {}
+    if isinstance(meta.get("process_index"), int):
+        return meta["process_index"]
+    m = re.search(r"rank(\d+)", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    return fallback
+
+
+def merge_traces(paths: List[str]) -> dict:
+    """One chrome trace with each input's events re-pid'd to its rank
+    and a process_name metadata row per rank."""
+    events = []
+    ranks = []
+    for i, path in enumerate(sorted(paths)):
+        with open(path) as f:
+            trace = json.load(f)
+        rank = _rank_of(trace, path, i)
+        ranks.append(rank)
+        src_pid = (trace.get("metadata") or {}).get("pid")
+        events.append({
+            "name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+            "args": {"name": f"rank {rank}"
+                             + (f" (host pid {src_pid})" if src_pid
+                                else "")},
+        })
+        events.append({
+            "name": "process_sort_index", "ph": "M", "pid": rank,
+            "tid": 0, "args": {"sort_index": rank},
+        })
+        for e in trace.get("traceEvents", []):
+            e = dict(e)
+            e["pid"] = rank
+            events.append(e)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {"merged_from": [os.path.basename(p)
+                                     for p in sorted(paths)],
+                     "ranks": sorted(ranks)},
+    }
+
+
+def _fold_hist(summaries: List[dict]) -> dict:
+    """Fold per-rank histogram summaries: counts/totals add, min/max
+    widen, buckets add edge-wise, percentiles re-estimated from the
+    folded buckets (same estimator as stats.Histogram.percentile)."""
+    count = sum(s.get("count", 0) for s in summaries)
+    total = sum(s.get("total", 0.0) for s in summaries)
+    mins = [s["min"] for s in summaries if s.get("min") is not None]
+    maxes = [s["max"] for s in summaries if s.get("max") is not None]
+    buckets: dict = {}
+    for s in summaries:
+        for edge, n in s.get("buckets", []):
+            buckets[float(edge)] = buckets.get(float(edge), 0) + n
+    folded = sorted(buckets.items())
+    mn = min(mins) if mins else None
+    mx = max(maxes) if maxes else None
+
+    def pct(q):
+        if not count or not folded:
+            return None
+        target = q * count
+        cum = 0
+        for edge, n in folded:
+            prev, cum = cum, cum + n
+            if cum >= target:
+                lo = edge / 2.0 if edge > 1.0 else 0.0
+                est = lo + (edge - lo) * (target - prev) / n
+                lo_c = mn if mn is not None else est
+                hi_c = mx if mx is not None else est
+                return round(min(max(est, lo_c), hi_c), 3)
+        return mx
+
+    return {
+        "count": count,
+        "total": round(total, 3),
+        "avg": round(total / count, 3) if count else 0.0,
+        "min": mn,
+        "max": mx,
+        "p50": pct(0.50),
+        "p90": pct(0.90),
+        "p99": pct(0.99),
+        "buckets": [[e, n] for e, n in folded],
+    }
+
+
+def fold_stats(snapshots: List[dict]) -> dict:
+    """Fold per-rank ``stats.snapshot()`` dicts into one fleet view:
+    counters are event totals (sum), gauges are instantaneous levels
+    (max — the fleet's high-water value), histograms fold by bucket."""
+    counters: dict = {}
+    gauges: dict = {}
+    hists: dict = {}
+    ranks = []
+    for snap in snapshots:
+        meta = snap.get("meta") or {}
+        if "process_index" in meta:
+            ranks.append(meta["process_index"])
+        for k, v in snap.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for k, v in snap.get("gauges", {}).items():
+            gauges[k] = max(gauges.get(k, float("-inf")), v)
+        for k, v in snap.get("histograms", {}).items():
+            hists.setdefault(k, []).append(v)
+    return {
+        "meta": {"ranks": sorted(ranks), "num_snapshots": len(snapshots),
+                 "fold": {"counters": "sum", "gauges": "max",
+                          "histograms": "bucket-fold"}},
+        "counters": dict(sorted(counters.items())),
+        "gauges": dict(sorted(gauges.items())),
+        "histograms": {k: _fold_hist(v)
+                       for k, v in sorted(hists.items())},
+    }
+
+
+def find_rank_files(run_dir: str) -> Tuple[List[str], List[str]]:
+    """(trace_paths, stats_paths) inside a shared run dir: the
+    ``dump_rank`` layout plus any ``export_chrome_tracing`` outputs."""
+    traces = sorted(
+        set(glob.glob(os.path.join(run_dir, "trace_rank*.json")))
+        | set(glob.glob(os.path.join(run_dir, "*.paddle_trace.json"))))
+    stats = sorted(glob.glob(os.path.join(run_dir, "stats_rank*.json")))
+    return traces, stats
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-rank chrome traces + stats snapshots "
+                    "into one fleet timeline / snapshot")
+    ap.add_argument("run_dir", help="shared dir the ranks dumped into")
+    ap.add_argument("--out-trace", default=None,
+                    help="merged trace path "
+                         "(default RUN_DIR/merged_trace.json)")
+    ap.add_argument("--out-stats", default=None,
+                    help="fleet snapshot path "
+                         "(default RUN_DIR/fleet_stats.json)")
+    args = ap.parse_args(argv)
+
+    traces, stats = find_rank_files(args.run_dir)
+    if not traces and not stats:
+        print(f"trace_merge: no rank files under {args.run_dir} "
+              "(expected trace_rank*.json / stats_rank*.json / "
+              "*.paddle_trace.json)", file=sys.stderr)
+        return 2
+
+    out = {"ranks": 0, "events": 0,
+           "out_trace": None, "out_stats": None}
+    if traces:
+        merged = merge_traces(traces)
+        out_trace = args.out_trace or os.path.join(
+            args.run_dir, "merged_trace.json")
+        with open(out_trace, "w") as f:
+            json.dump(merged, f)
+        out["out_trace"] = out_trace
+        out["events"] = len(merged["traceEvents"])
+        out["ranks"] = len(merged["metadata"]["ranks"])
+    if stats:
+        snapshots = []
+        for p in stats:
+            with open(p) as f:
+                snapshots.append(json.load(f))
+        fleet = fold_stats(snapshots)
+        out_stats = args.out_stats or os.path.join(
+            args.run_dir, "fleet_stats.json")
+        with open(out_stats, "w") as f:
+            json.dump(fleet, f, indent=1)
+        out["out_stats"] = out_stats
+        out["ranks"] = max(out["ranks"], len(snapshots))
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

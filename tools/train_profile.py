@@ -40,10 +40,10 @@ def run(mode):
 
         F.gelu = lambda x, approximate=False: x
     name, d, L, h, s, b, ok = bench.LADDER[0]
-    tps, n_params, fpt = bench.run_config(name, d, L, h, s, b, steps=10,
-                                          opt_kwargs=dict(ok))
+    tps, n_params, fpt, roofline = bench.run_config(
+        name, d, L, h, s, b, steps=10, opt_kwargs=dict(ok))
     mfu = tps * fpt / bench._chip_peak(jax.devices()[0])
-    return tps, round(mfu, 4)
+    return tps, round(mfu, 4), roofline
 
 
 def main():
@@ -52,9 +52,12 @@ def main():
                     choices=["full", "noln", "nogelu"])
     args = ap.parse_args()
     t0 = time.time()
-    tps, mfu = run(args.mode)
+    tps, mfu, roofline = run(args.mode)
+    # roofline: XLA cost-model MFU/bandwidth for the compiled step
+    # (see paddle_tpu/profiler/roofline.py) next to the analytic mfu
     print(json.dumps({"mode": args.mode, "tokens_per_sec": round(tps, 1),
-                      "mfu": mfu, "wall": round(time.time() - t0, 1)}))
+                      "mfu": mfu, "roofline": roofline,
+                      "wall": round(time.time() - t0, 1)}))
 
 
 if __name__ == "__main__":
