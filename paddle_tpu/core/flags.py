@@ -82,6 +82,14 @@ define_flag("decode_linear", "auto",
             "stream (opt-in Pallas weight-streaming kernel, "
             "nn/functional/stream_linear.py)")
 define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
+define_flag("eager_fwd_cache", True,
+            "no-grad eager dispatch through the signature-keyed "
+            "compiled-forward cache (ops/dispatch.py); disable to force "
+            "primitive-by-primitive eager execution")
+define_flag("optimizer_donate_grads", False,
+            "donate gradient buffers to the optimizer's fused update; "
+            "grads are consumed by step() (p.grad is cleared), halving "
+            "the step's transient gradient footprint")
 define_flag("eager_jit_ops", True, "dispatch eager ops through cached jit computations")
 define_flag("stop_check_timeout", 900, "bound (seconds) on distributed store waits")
 define_flag("allocator_strategy", "auto_growth", "kept for API parity; PJRT owns memory")

@@ -11,7 +11,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...ops.dispatch import defun, eager_apply, as_tensor_args
+from ...ops.dispatch import defun, eager_apply, as_tensor_args, inplace_apply
+from ...ops.registry import register_op
 
 __all__ = [
     "relu", "relu_", "relu6", "gelu", "sigmoid", "log_sigmoid", "silu",
@@ -57,9 +58,8 @@ def softmax(x, axis=-1, dtype=None):
 
 
 def softmax_(x, axis=-1, dtype=None):
-    out = softmax(x, axis=axis, dtype=dtype)
-    x._rebind(out._data, out._grad_node, out._out_idx)
-    return x
+    return inplace_apply("softmax_", softmax.raw_fn, as_tensor_args(x),
+                         {"axis": axis, "dtype": dtype})
 
 
 @defun("log_softmax", n_tensor_args=1)
@@ -113,9 +113,8 @@ def elu(x, alpha=1.0):
 
 
 def elu_(x, alpha=1.0):
-    out = elu(x, alpha=alpha)
-    x._rebind(out._data, out._grad_node, out._out_idx)
-    return x
+    return inplace_apply("elu_", elu.raw_fn, as_tensor_args(x),
+                         {"alpha": alpha})
 
 
 @defun("celu", n_tensor_args=1)
@@ -176,12 +175,18 @@ def maxout(x, groups, axis=1):
 
 
 def relu_(x):
-    out = relu(x)
-    x._rebind(out._data, out._grad_node, out._out_idx)
-    return x
+    return inplace_apply("relu_", relu.raw_fn, as_tensor_args(x))
 
 
 def tanh_(x):
-    out = tanh(x)
-    x._rebind(out._data, out._grad_node, out._out_idx)
-    return x
+    return inplace_apply("tanh_", tanh.raw_fn, as_tensor_args(x))
+
+
+# the in-place family is registered with its donation contract so the
+# registry stays the single source of truth for which ops may donate
+# their target buffer on the compiled no-grad fast path
+for _name, _fn, _of in (("relu_", relu_, "relu"), ("tanh_", tanh_, "tanh"),
+                        ("elu_", elu_, "elu"), ("softmax_", softmax_,
+                                                "softmax")):
+    register_op(_name, _fn, inplace_of=_of, donates=(0,),
+                tags=("activation", "inplace"))

@@ -53,15 +53,22 @@ def _keep_mask(key, keep_prob, shape):
     return jax.random.bernoulli(key, keep_prob, tuple(shape))
 
 
+def _linear_raw(a, w):
+    return jnp.matmul(a, w)
+
+
+def _linear_bias_raw(a, w, b):
+    return jnp.matmul(a, w) + b
+
+
 def linear(x, weight, bias=None, name=None):
     """y = x @ W (+ b); W is [in, out] per paddle convention — a single MXU
-    matmul with XLA-fused bias add."""
+    matmul with XLA-fused bias add. Module-level raw fns (not per-call
+    closures) so the signature-keyed dispatch caches can admit them."""
     if bias is None:
-        return eager_apply(
-            "linear", lambda a, w: jnp.matmul(a, w), as_tensor_args(x, weight))
-    return eager_apply(
-        "linear", lambda a, w, b: jnp.matmul(a, w) + b,
-        as_tensor_args(x, weight, bias))
+        return eager_apply("linear", _linear_raw, as_tensor_args(x, weight))
+    return eager_apply("linear", _linear_bias_raw,
+                       as_tensor_args(x, weight, bias))
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
@@ -122,16 +129,18 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     return eager_apply("alpha_dropout", raw, [t])
 
 
-def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    def raw(w, ids):
-        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
-        if padding_idx is not None:
-            mask = (ids == padding_idx)[..., None]
-            out = jnp.where(mask, 0.0, out)
-        return out
+def _embedding_raw(w, ids, padding_idx=None):
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
 
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     # weight first so its gradient flows (ids are integer → non-diff)
-    return eager_apply("embedding", raw, as_tensor_args(weight, x))
+    return eager_apply("embedding", _embedding_raw, as_tensor_args(weight, x),
+                       {"padding_idx": padding_idx})
 
 
 @defun("one_hot", n_tensor_args=1)
@@ -162,12 +171,17 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     jmode = {"constant": "constant", "reflect": "reflect",
              "replicate": "edge", "circular": "wrap"}[mode]
 
-    def raw(a):
-        if jmode == "constant":
-            return jnp.pad(a, pairs, mode="constant", constant_values=value)
-        return jnp.pad(a, pairs, mode=jmode)
+    # pairs as a nested tuple + scalar statics: hashable, so padded
+    # forwards are admissible to the dispatch caches
+    return eager_apply("pad", _pad_raw, [t],
+                       {"pairs": tuple(map(tuple, pairs)), "jmode": jmode,
+                        "value": value})
 
-    return eager_apply("pad", raw, [t])
+
+def _pad_raw(a, pairs=(), jmode="constant", value=0.0):
+    if jmode == "constant":
+        return jnp.pad(a, pairs, mode="constant", constant_values=value)
+    return jnp.pad(a, pairs, mode=jmode)
 
 
 def zeropad2d(x, padding, data_format="NCHW", name=None):
@@ -334,14 +348,16 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
     return eager_apply("fold", raw, as_tensor_args(x))
 
 
-def cosine_similarity(x1, x2, axis=1, eps=1e-8):
-    def raw(a, b):
-        dot = jnp.sum(a * b, axis=axis)
-        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
-        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
-        return dot / jnp.maximum(na * nb, eps)
+def _cosine_similarity_raw(a, b, axis=1, eps=1e-8):
+    dot = jnp.sum(a * b, axis=axis)
+    na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+    nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+    return dot / jnp.maximum(na * nb, eps)
 
-    return eager_apply("cosine_similarity", raw, as_tensor_args(x1, x2))
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return eager_apply("cosine_similarity", _cosine_similarity_raw,
+                       as_tensor_args(x1, x2), {"axis": axis, "eps": eps})
 
 
 def bilinear(x1, x2, weight, bias=None, name=None):

@@ -106,69 +106,77 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     tensors = as_tensor_args(*((input, label, weight) if has_w
                                else (input, label)))
 
-    def raw(logits, lab, *maybe_w):
-        # Fast path for plain index-label CE over a big vocab: gather-form
-        # with fp32 accumulation inside the reductions. Never materializes
-        # a full fp32 logits/log-probs array — for bf16 logits at GPT
-        # vocab sizes (51200) the fp32 copies are ~GBs of HBM traffic
-        # (reference fuses the same way: phi softmax_with_cross_entropy).
-        if (use_softmax and not soft_label and label_smoothing == 0.0
-                and not has_w):
-            ids = lab.astype(jnp.int32)
-            if ids.ndim == logits.ndim:
-                ids = jnp.squeeze(ids, axis=axis)
-            if axis not in (-1, logits.ndim - 1):
-                logits = jnp.moveaxis(logits, axis, -1)
-            # clamp to [0, V): the fused op's iota-compare matches NO
-            # column for an out-of-range id (silent zero-gradient row);
-            # clamping restores the gather path's take_along_axis
-            # behavior (see the public docstring's label contract)
-            safe_ids = jnp.clip(
-                jnp.where(ids == ignore_index, 0, ids),
-                0, logits.shape[-1] - 1)
-            valid = ids != ignore_index
-            per = _fused_index_ce(logits, safe_ids, valid)
-            if reduction == "mean":
-                denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
-                return jnp.sum(per) / denom
-            return _reduce(per, reduction)
-        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
-            else jnp.log(jnp.clip(logits, 1e-10))
-        nclass = logits.shape[axis]
-        if soft_label:
-            soft = lab
-            if label_smoothing > 0.0:
-                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
-            per = -jnp.sum(soft * logp, axis=axis)
-            return _reduce(per, reduction)
+    return eager_apply("cross_entropy", _cross_entropy_raw, tensors,
+                       {"use_softmax": bool(use_softmax),
+                        "soft_label": bool(soft_label),
+                        "label_smoothing": float(label_smoothing),
+                        "ignore_index": int(ignore_index),
+                        "reduction": reduction, "axis": int(axis),
+                        "has_w": has_w})
+
+
+def _cross_entropy_raw(logits, lab, *maybe_w, use_softmax=True,
+                       soft_label=False, label_smoothing=0.0,
+                       ignore_index=-100, reduction="mean", axis=-1,
+                       has_w=False):
+    # Fast path for plain index-label CE over a big vocab: gather-form
+    # with fp32 accumulation inside the reductions. Never materializes
+    # a full fp32 logits/log-probs array — for bf16 logits at GPT
+    # vocab sizes (51200) the fp32 copies are ~GBs of HBM traffic
+    # (reference fuses the same way: phi softmax_with_cross_entropy).
+    if (use_softmax and not soft_label and label_smoothing == 0.0
+            and not has_w):
         ids = lab.astype(jnp.int32)
-        squeeze = False
-        if ids.ndim == logp.ndim:
+        if ids.ndim == logits.ndim:
             ids = jnp.squeeze(ids, axis=axis)
-            squeeze = True
-        safe_ids = jnp.where(ids == ignore_index, 0, ids)
-        picked = jnp.take_along_axis(
-            logp, jnp.expand_dims(safe_ids, axis), axis=axis)
-        per = -jnp.squeeze(picked, axis)
-        if label_smoothing > 0.0:
-            smooth_term = -jnp.mean(logp, axis=axis)
-            per = (1 - label_smoothing) * per + label_smoothing * smooth_term
+        if axis not in (-1, logits.ndim - 1):
+            logits = jnp.moveaxis(logits, axis, -1)
+        # clamp to [0, V): the fused op's iota-compare matches NO
+        # column for an out-of-range id (silent zero-gradient row);
+        # clamping restores the gather path's take_along_axis
+        # behavior (see the public docstring's label contract)
+        safe_ids = jnp.clip(
+            jnp.where(ids == ignore_index, 0, ids),
+            0, logits.shape[-1] - 1)
         valid = ids != ignore_index
-        if has_w:
-            w = maybe_w[0][safe_ids]
-            per = per * w
-            per = jnp.where(valid, per, 0.0)
-            if reduction == "mean":
-                denom = jnp.sum(jnp.where(valid, w, 0.0))
-                return jnp.sum(per) / jnp.maximum(denom, 1e-12)
-            return _reduce(per, reduction)
-        per = jnp.where(valid, per, 0.0)
+        per = _fused_index_ce(logits, safe_ids, valid)
         if reduction == "mean":
             denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
             return jnp.sum(per) / denom
         return _reduce(per, reduction)
-
-    return eager_apply("cross_entropy", raw, tensors)
+    logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+        else jnp.log(jnp.clip(logits, 1e-10))
+    nclass = logits.shape[axis]
+    if soft_label:
+        soft = lab
+        if label_smoothing > 0.0:
+            soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+        per = -jnp.sum(soft * logp, axis=axis)
+        return _reduce(per, reduction)
+    ids = lab.astype(jnp.int32)
+    if ids.ndim == logp.ndim:
+        ids = jnp.squeeze(ids, axis=axis)
+    safe_ids = jnp.where(ids == ignore_index, 0, ids)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe_ids, axis), axis=axis)
+    per = -jnp.squeeze(picked, axis)
+    if label_smoothing > 0.0:
+        smooth_term = -jnp.mean(logp, axis=axis)
+        per = (1 - label_smoothing) * per + label_smoothing * smooth_term
+    valid = ids != ignore_index
+    if has_w:
+        w = maybe_w[0][safe_ids]
+        per = per * w
+        per = jnp.where(valid, per, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(valid, w, 0.0))
+            return jnp.sum(per) / jnp.maximum(denom, 1e-12)
+        return _reduce(per, reduction)
+    per = jnp.where(valid, per, 0.0)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+        return jnp.sum(per) / denom
+    return _reduce(per, reduction)
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,
@@ -186,23 +194,33 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
     return loss
 
 
+def _mse_loss_raw(a, b, reduction="mean"):
+    return _reduce(jnp.square(a - b), reduction)
+
+
 def mse_loss(input, label, reduction="mean", name=None):
-    return eager_apply(
-        "mse_loss",
-        lambda a, b: _reduce(jnp.square(a - b), reduction),
-        as_tensor_args(input, label))
+    return eager_apply("mse_loss", _mse_loss_raw,
+                       as_tensor_args(input, label),
+                       {"reduction": reduction})
+
+
+def _square_error_cost_raw(a, b):
+    return jnp.square(a - b)
 
 
 def square_error_cost(input, label):
-    return eager_apply("square_error_cost",
-                       lambda a, b: jnp.square(a - b),
+    return eager_apply("square_error_cost", _square_error_cost_raw,
                        as_tensor_args(input, label))
 
 
+def _l1_loss_raw(a, b, reduction="mean"):
+    return _reduce(jnp.abs(a - b), reduction)
+
+
 def l1_loss(input, label, reduction="mean", name=None):
-    return eager_apply(
-        "l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction),
-        as_tensor_args(input, label))
+    return eager_apply("l1_loss", _l1_loss_raw,
+                       as_tensor_args(input, label),
+                       {"reduction": reduction})
 
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",

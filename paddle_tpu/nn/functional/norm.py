@@ -56,41 +56,68 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                 (momentum * running_var._data
                  + (1.0 - momentum) * unbiased).astype(running_var._data.dtype))
 
-        def raw(a, *wb):
-            # stats recomputed INSIDE the differentiated fn so gradients flow
-            # through mean/var (the true BN backward)
-            mean = jnp.mean(a, axis=axes)
-            var = jnp.var(a, axis=axes)
-            shape = [1] * a.ndim
-            shape[ch_axis] = a.shape[ch_axis]
-            xhat = (a - mean.reshape(shape)) * \
-                (1.0 / jnp.sqrt(var + epsilon)).reshape(shape)
-            i = 0
-            if has_w:
-                xhat = xhat * wb[i].reshape(shape)
-                i += 1
-            if has_b:
-                xhat = xhat + wb[i].reshape(shape)
-            return xhat.astype(a.dtype)
+        return eager_apply("batch_norm", _bn_train_raw,
+                           as_tensor_args(*tensors),
+                           {"axes": axes, "ch_axis": ch_axis,
+                            "epsilon": float(epsilon), "has_w": has_w,
+                            "has_b": has_b})
 
-        return eager_apply("batch_norm", raw, as_tensor_args(*tensors))
+    # eval path: running stats enter as (non-diff) tensor inputs so the
+    # raw fn is a stable module-level object — inference-mode batch_norm
+    # is admissible to the compiled-forward cache
+    tensors = [tensors[0], running_mean, running_var] + tensors[1:]
+    return eager_apply("batch_norm", _bn_eval_raw, as_tensor_args(*tensors),
+                       {"ch_axis": ch_axis, "epsilon": float(epsilon),
+                        "has_w": has_w, "has_b": has_b})
 
-    rm, rv = running_mean._data, running_var._data
 
-    def raw(a, *wb):
-        shape = [1] * a.ndim
-        shape[ch_axis] = a.shape[ch_axis]
-        xhat = (a - rm.reshape(shape)) * \
-            (1.0 / jnp.sqrt(rv + epsilon)).reshape(shape)
-        i = 0
-        if has_w:
-            xhat = xhat * wb[i].reshape(shape)
-            i += 1
-        if has_b:
-            xhat = xhat + wb[i].reshape(shape)
-        return xhat.astype(a.dtype)
+def _bn_train_raw(a, *wb, axes=(), ch_axis=1, epsilon=1e-5, has_w=False,
+                  has_b=False):
+    # stats recomputed INSIDE the differentiated fn so gradients flow
+    # through mean/var (the true BN backward)
+    mean = jnp.mean(a, axis=axes)
+    var = jnp.var(a, axis=axes)
+    shape = [1] * a.ndim
+    shape[ch_axis] = a.shape[ch_axis]
+    xhat = (a - mean.reshape(shape)) * \
+        (1.0 / jnp.sqrt(var + epsilon)).reshape(shape)
+    i = 0
+    if has_w:
+        xhat = xhat * wb[i].reshape(shape)
+        i += 1
+    if has_b:
+        xhat = xhat + wb[i].reshape(shape)
+    return xhat.astype(a.dtype)
 
-    return eager_apply("batch_norm", raw, as_tensor_args(*tensors))
+
+def _bn_eval_raw(a, rm, rv, *wb, ch_axis=1, epsilon=1e-5, has_w=False,
+                 has_b=False):
+    shape = [1] * a.ndim
+    shape[ch_axis] = a.shape[ch_axis]
+    xhat = (a - rm.reshape(shape)) * \
+        (1.0 / jnp.sqrt(rv + epsilon)).reshape(shape)
+    i = 0
+    if has_w:
+        xhat = xhat * wb[i].reshape(shape)
+        i += 1
+    if has_b:
+        xhat = xhat + wb[i].reshape(shape)
+    return xhat.astype(a.dtype)
+
+
+def _layer_norm_raw(a, *wb, n_norm=1, epsilon=1e-5, has_w=False,
+                    has_b=False):
+    axes = tuple(range(a.ndim - n_norm, a.ndim))
+    mean = jnp.mean(a, axis=axes, keepdims=True)
+    var = jnp.var(a, axis=axes, keepdims=True)
+    xhat = (a - mean) / jnp.sqrt(var + epsilon)
+    i = 0
+    if has_w:
+        xhat = xhat * wb[i]
+        i += 1
+    if has_b:
+        xhat = xhat + wb[i]
+    return xhat.astype(a.dtype)
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
@@ -101,20 +128,17 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
     has_w, has_b = weight is not None, bias is not None
     tensors = [x] + ([weight] if has_w else []) + ([bias] if has_b else [])
 
-    def raw(a, *wb):
-        axes = tuple(range(a.ndim - n_norm, a.ndim))
-        mean = jnp.mean(a, axis=axes, keepdims=True)
-        var = jnp.var(a, axis=axes, keepdims=True)
-        xhat = (a - mean) / jnp.sqrt(var + epsilon)
-        i = 0
-        if has_w:
-            xhat = xhat * wb[i]
-            i += 1
-        if has_b:
-            xhat = xhat + wb[i]
-        return xhat.astype(a.dtype)
+    return eager_apply("layer_norm", _layer_norm_raw, as_tensor_args(*tensors),
+                       {"n_norm": n_norm, "epsilon": float(epsilon),
+                        "has_w": has_w, "has_b": has_b})
 
-    return eager_apply("layer_norm", raw, as_tensor_args(*tensors))
+
+def _rms_norm_raw(a, *w, epsilon=1e-6, has_w=False):
+    ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = a * (1.0 / jnp.sqrt(ms + epsilon)).astype(a.dtype)
+    if has_w:
+        out = out * w[0]
+    return out
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
@@ -122,14 +146,29 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     has_w = weight is not None
     tensors = [x] + ([weight] if has_w else [])
 
-    def raw(a, *w):
-        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
-        out = a * (1.0 / jnp.sqrt(ms + epsilon)).astype(a.dtype)
-        if has_w:
-            out = out * w[0]
-        return out
+    return eager_apply("rms_norm", _rms_norm_raw, as_tensor_args(*tensors),
+                       {"epsilon": float(epsilon), "has_w": has_w})
 
-    return eager_apply("rms_norm", raw, as_tensor_args(*tensors))
+
+def _group_norm_raw(a, *wb, num_groups=1, epsilon=1e-5, has_w=False,
+                    has_b=False):
+    n, c = a.shape[0], a.shape[1]
+    g = num_groups
+    rest = a.shape[2:]
+    r = a.reshape((n, g, c // g) + rest)
+    axes = tuple(range(2, r.ndim))
+    mean = jnp.mean(r, axis=axes, keepdims=True)
+    var = jnp.var(r, axis=axes, keepdims=True)
+    xhat = ((r - mean) / jnp.sqrt(var + epsilon)).reshape(a.shape)
+    shape = [1] * a.ndim
+    shape[1] = c
+    i = 0
+    if has_w:
+        xhat = xhat * wb[i].reshape(shape)
+        i += 1
+    if has_b:
+        xhat = xhat + wb[i].reshape(shape)
+    return xhat.astype(a.dtype)
 
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
@@ -139,26 +178,26 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
     has_w, has_b = weight is not None, bias is not None
     tensors = [x] + ([weight] if has_w else []) + ([bias] if has_b else [])
 
-    def raw(a, *wb):
-        n, c = a.shape[0], a.shape[1]
-        g = num_groups
-        rest = a.shape[2:]
-        r = a.reshape((n, g, c // g) + rest)
-        axes = tuple(range(2, r.ndim))
-        mean = jnp.mean(r, axis=axes, keepdims=True)
-        var = jnp.var(r, axis=axes, keepdims=True)
-        xhat = ((r - mean) / jnp.sqrt(var + epsilon)).reshape(a.shape)
-        shape = [1] * a.ndim
-        shape[1] = c
-        i = 0
-        if has_w:
-            xhat = xhat * wb[i].reshape(shape)
-            i += 1
-        if has_b:
-            xhat = xhat + wb[i].reshape(shape)
-        return xhat.astype(a.dtype)
+    return eager_apply("group_norm", _group_norm_raw, as_tensor_args(*tensors),
+                       {"num_groups": int(num_groups),
+                        "epsilon": float(epsilon), "has_w": has_w,
+                        "has_b": has_b})
 
-    return eager_apply("group_norm", raw, as_tensor_args(*tensors))
+
+def _instance_norm_raw(a, *wb, eps=1e-5, has_w=False, has_b=False):
+    axes = tuple(range(2, a.ndim))
+    mean = jnp.mean(a, axis=axes, keepdims=True)
+    var = jnp.var(a, axis=axes, keepdims=True)
+    xhat = (a - mean) / jnp.sqrt(var + eps)
+    shape = [1] * a.ndim
+    shape[1] = a.shape[1]
+    i = 0
+    if has_w:
+        xhat = xhat * wb[i].reshape(shape)
+        i += 1
+    if has_b:
+        xhat = xhat + wb[i].reshape(shape)
+    return xhat.astype(a.dtype)
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
@@ -167,22 +206,9 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
     has_w, has_b = weight is not None, bias is not None
     tensors = [x] + ([weight] if has_w else []) + ([bias] if has_b else [])
 
-    def raw(a, *wb):
-        axes = tuple(range(2, a.ndim))
-        mean = jnp.mean(a, axis=axes, keepdims=True)
-        var = jnp.var(a, axis=axes, keepdims=True)
-        xhat = (a - mean) / jnp.sqrt(var + eps)
-        shape = [1] * a.ndim
-        shape[1] = a.shape[1]
-        i = 0
-        if has_w:
-            xhat = xhat * wb[i].reshape(shape)
-            i += 1
-        if has_b:
-            xhat = xhat + wb[i].reshape(shape)
-        return xhat.astype(a.dtype)
-
-    return eager_apply("instance_norm", raw, as_tensor_args(*tensors))
+    return eager_apply("instance_norm", _instance_norm_raw,
+                       as_tensor_args(*tensors),
+                       {"eps": float(eps), "has_w": has_w, "has_b": has_b})
 
 
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,

@@ -32,18 +32,21 @@ def _as_tensor(x):
     return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
 
 
-def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
-    def raw(a, b):
-        if transpose_x:
-            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
-        if transpose_y:
-            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        # f32 accumulation for low-precision inputs: MXU-native
-        if a.dtype in (jnp.bfloat16, jnp.float16):
-            return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
-        return jnp.matmul(a, b)
+def _matmul_raw(a, b, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    # f32 accumulation for low-precision inputs: MXU-native
+    if a.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    return jnp.matmul(a, b)
 
-    return eager_apply("matmul", raw, [_as_tensor(x), _as_tensor(y)], {})
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return eager_apply("matmul", _matmul_raw, [_as_tensor(x), _as_tensor(y)],
+                       {"transpose_x": bool(transpose_x),
+                        "transpose_y": bool(transpose_y)})
 
 
 def mm(input, mat2, name=None):

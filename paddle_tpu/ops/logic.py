@@ -126,17 +126,33 @@ def where(condition, x=None, y=None, name=None):
         from .manipulation import nonzero
 
         return nonzero(condition, as_tuple=True)
-    cond = _as_tensor(condition)._data
+    cond_t = _as_tensor(condition)
+    cond = cond_t._data
 
     xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
     if xt and yt:
-        return eager_apply("where", lambda a, b: jnp.where(cond, a, b),
-                           [x, y], {})
+        # condition as a (bool, non-diff) tensor input keeps the raw fn
+        # stable — admissible to the dispatch caches
+        return eager_apply("where", _where_raw, [cond_t, x, y], {})
     if xt:
-        return eager_apply("where", lambda a: jnp.where(cond, a, y), [x], {})
+        return eager_apply("where", _where_scalar_y_raw, [cond_t, x],
+                           {"y": y})
     if yt:
-        return eager_apply("where", lambda b: jnp.where(cond, x, b), [y], {})
+        return eager_apply("where", _where_scalar_x_raw, [cond_t, y],
+                           {"x": x})
     return Tensor(jnp.where(cond, x, y))
+
+
+def _where_raw(c, a, b):
+    return jnp.where(c, a, b)
+
+
+def _where_scalar_y_raw(c, a, y=0):
+    return jnp.where(c, a, y)
+
+
+def _where_scalar_x_raw(c, b, x=0):
+    return jnp.where(c, x, b)
 
 
 globals()["where"] = where

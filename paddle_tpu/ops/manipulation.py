@@ -48,15 +48,20 @@ def _ints(seq):
 
 
 # ------------------------------------------------------------- reshape
+def _reshape_raw(a, shape=()):
+    return jnp.reshape(a, shape)
+
+
 def reshape(x, shape, name=None):
-    shape = _ints(shape)
-    return eager_apply("reshape", lambda a: jnp.reshape(a, shape), [x], {})
+    return eager_apply("reshape", _reshape_raw, [x],
+                       {"shape": tuple(_ints(shape))})
 
 
 def reshape_(x, shape, name=None):
-    out = reshape(x, shape)
-    x._rebind(out._data, out._grad_node, out._out_idx)
-    return x
+    from .dispatch import inplace_apply
+
+    return inplace_apply("reshape", _reshape_raw, [x],
+                         {"shape": tuple(_ints(shape))})
 
 
 def view(x, shape_or_dtype, name=None):
@@ -101,12 +106,16 @@ for _n, _f in (("reshape", reshape), ("reshape_", reshape_), ("view", view),
     _export(_n, _f, methods=[_n])
 
 
+def _transpose_raw(a, perm=()):
+    return jnp.transpose(a, perm)
+
+
 def transpose(x, perm=None, name=None):
     x = _as_tensor(x)
     if perm is None:
         perm = list(range(x.ndim))[::-1]
-    return eager_apply("transpose",
-                       lambda a: jnp.transpose(a, _ints(perm)), [x], {})
+    return eager_apply("transpose", _transpose_raw, [x],
+                       {"perm": tuple(_ints(perm))})
 
 
 def moveaxis(x, source, destination, name=None):
@@ -145,17 +154,23 @@ for _n, _f in (("transpose", transpose), ("moveaxis", moveaxis),
 
 
 # ------------------------------------------------------- concat / split
+def _concat_raw(*arrs, ax=0):
+    return jnp.concatenate(arrs, ax)
+
+
 def concat(x: Sequence[Tensor], axis=0, name=None):
     tensors = [_as_tensor(t) for t in x]
     ax = int(axis.item() if isinstance(axis, Tensor) else axis)
-    return eager_apply("concat", lambda *arrs: jnp.concatenate(arrs, ax),
-                       tensors, {})
+    return eager_apply("concat", _concat_raw, tensors, {"ax": ax})
+
+
+def _stack_raw(*arrs, ax=0):
+    return jnp.stack(arrs, ax)
 
 
 def stack(x: Sequence[Tensor], axis=0, name=None):
     tensors = [_as_tensor(t) for t in x]
-    return eager_apply("stack", lambda *arrs: jnp.stack(arrs, int(axis)),
-                       tensors, {})
+    return eager_apply("stack", _stack_raw, tensors, {"ax": int(axis)})
 
 
 def split(x, num_or_sections, axis=0, name=None):
@@ -174,15 +189,17 @@ def split(x, num_or_sections, axis=0, name=None):
         if neg:
             known = sum(s for s in sections if s >= 0)
             sections[neg[0]] = dim - known
-    offsets = np.cumsum([0] + sections)
+    offsets = tuple(int(o) for o in np.cumsum([0] + sections))
 
-    def raw(a):
-        return tuple(jax.lax.slice_in_dim(a, int(offsets[i]),
-                                          int(offsets[i + 1]), axis=ax)
-                     for i in range(len(sections)))
-
-    outs = eager_apply("split", raw, [x], {}, n_outputs=len(sections))
+    outs = eager_apply("split", _split_raw, [x],
+                       {"offsets": offsets, "ax": ax},
+                       n_outputs=len(sections))
     return list(outs)
+
+
+def _split_raw(a, offsets=(), ax=0):
+    return tuple(jax.lax.slice_in_dim(a, offsets[i], offsets[i + 1], axis=ax)
+                 for i in range(len(offsets) - 1))
 
 
 def chunk(x, chunks, axis=0, name=None):
@@ -259,17 +276,18 @@ for _n in ("concat", "stack", "split", "chunk", "unstack", "unbind", "tile",
 
 
 # ------------------------------------------------------- gather/scatter
+def _gather_raw(a, ind, ax=0):
+    if ind.ndim == 2 and ind.shape[1] == 1:
+        ind = ind.reshape(-1)
+    return jnp.take(a, ind, axis=ax)
+
+
 def gather(x, index, axis=0, name=None):
     ax = int(axis.item() if isinstance(axis, Tensor) else axis)
-    idx = _as_tensor(index)
-
-    def raw(a):
-        ind = idx._data
-        if ind.ndim == 2 and ind.shape[1] == 1:
-            ind = ind.reshape(-1)
-        return jnp.take(a, ind, axis=ax)
-
-    return eager_apply("gather", raw, [x], {})
+    # index as a (non-diff, integer) tensor input keeps the raw fn a
+    # stable module-level object — admissible to the dispatch caches
+    return eager_apply("gather", _gather_raw,
+                       [_as_tensor(x), _as_tensor(index)], {"ax": ax})
 
 
 def gather_nd(x, index, name=None):
@@ -356,10 +374,14 @@ def scatter_nd(index, updates, shape, name=None):
     return scatter_nd_add(zeros, index, u)
 
 
+def _index_select_raw(a, ind, ax=0):
+    return jnp.take(a, ind.reshape(-1), axis=ax)
+
+
 def index_select(x, index, axis=0, name=None):
-    idx = _as_tensor(index)._data.reshape(-1)
-    return eager_apply("index_select",
-                       lambda a: jnp.take(a, idx, axis=int(axis)), [x], {})
+    return eager_apply("index_select", _index_select_raw,
+                       [_as_tensor(x), _as_tensor(index)],
+                       {"ax": int(axis)})
 
 
 def index_sample(x, index):
@@ -443,30 +465,35 @@ _export("pad", pad)
 
 
 # ------------------------------------------------------ sort / search
+def _topk_raw(a, kk=1, ax=-1, largest=True):
+    src = jnp.moveaxis(a, ax, -1)
+    if largest:
+        v, i = jax.lax.top_k(src, kk)
+    else:
+        v, i = jax.lax.top_k(-src, kk)
+        v = -v
+    return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax)
+
+
 def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
     x = _as_tensor(x)
     kk = int(k.item() if isinstance(k, Tensor) else k)
     ax = int(axis)
 
-    def raw(a):
-        src = jnp.moveaxis(a, ax, -1)
-        if largest:
-            v, i = jax.lax.top_k(src, kk)
-        else:
-            v, i = jax.lax.top_k(-src, kk)
-            v = -v
-        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax)
-
-    vals, idx = eager_apply("topk", raw, [x], {}, n_outputs=2)
+    vals, idx = eager_apply("topk", _topk_raw, [x],
+                            {"kk": kk, "ax": ax, "largest": bool(largest)},
+                            n_outputs=2)
     return vals, Tensor(idx._data.astype(jnp.int64))
 
 
-def sort(x, axis=-1, descending=False, stable=False, name=None):
-    def raw(a):
-        s = jnp.sort(a, axis=int(axis), stable=True)
-        return jnp.flip(s, int(axis)) if descending else s
+def _sort_raw(a, ax=-1, descending=False):
+    s = jnp.sort(a, axis=ax, stable=True)
+    return jnp.flip(s, ax) if descending else s
 
-    return eager_apply("sort", raw, [x], {})
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return eager_apply("sort", _sort_raw, [x],
+                       {"ax": int(axis), "descending": bool(descending)})
 
 
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
@@ -574,11 +601,15 @@ for _n in ("nonzero", "masked_select", "masked_fill", "unique",
 
 
 # ------------------------------------------------------------- casting
+def _cast_raw(a, d=None):
+    return a.astype(d)
+
+
 def cast(x, dtype):
     x = _as_tensor(x)
     d = to_jax_dtype(dtype)
     if jnp.issubdtype(d, jnp.inexact) and jnp.issubdtype(x._data.dtype, jnp.inexact):
-        return eager_apply("cast", lambda a: a.astype(d), [x], {})
+        return eager_apply("cast", _cast_raw, [x], {"d": np.dtype(d)})
     return Tensor(x._data.astype(d))
 
 

@@ -74,20 +74,29 @@ Tensor._attach_method("__abs__", globals()["abs"])
 
 # --------------------------------------------------------------- binary
 def _make_binary(name, jfn, int_to_float=False):
+    # stable per-op raws (created once at registration, not per call) so
+    # the scalar-operand and int-promoting paths stay cache-admissible
+    def promoted(a, b, d=None, _jfn=jfn):
+        return _jfn(a.astype(d), b.astype(d))
+
+    def right_scalar(a, y=None, _jfn=jfn):
+        return _jfn(a, y)
+
+    def left_scalar(b, x=None, _jfn=jfn):
+        return _jfn(x, b)
+
     def op(x, y, name=None, _jfn=jfn, _opname=name):
         xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
         if xt and yt:
             if int_to_float and (jnp.issubdtype(x._data.dtype, jnp.integer)
                                  and jnp.issubdtype(y._data.dtype, jnp.integer)):
-                d = get_default_dtype().np_dtype
-                return eager_apply(
-                    _opname,
-                    lambda a, b: _jfn(a.astype(d), b.astype(d)), [x, y], {})
+                return eager_apply(_opname, promoted, [x, y],
+                                   {"d": get_default_dtype().np_dtype})
             return eager_apply(_opname, _jfn, [x, y], {})
         if xt:
-            return eager_apply(_opname, lambda a: _jfn(a, y), [x], {})
+            return eager_apply(_opname, right_scalar, [x], {"y": y})
         if yt:
-            return eager_apply(_opname, lambda b: _jfn(x, b), [y], {})
+            return eager_apply(_opname, left_scalar, [y], {"x": x})
         return Tensor(jnp.asarray(_jfn(x, y)))
 
     op.__name__ = name
@@ -139,14 +148,16 @@ Tensor._attach_method("__rpow__", _rpow)
 
 
 # ---------------------------------------------------- scalar-attr ops
+def _scale_raw(a, s=1.0, bias=0.0, bias_after_scale=True):
+    out = a * s + bias if bias_after_scale else (a + bias) * s
+    return out.astype(a.dtype)
+
+
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     s = scale.item() if isinstance(scale, Tensor) else scale
-
-    def raw(a):
-        out = a * s + bias if bias_after_scale else (a + bias) * s
-        return out.astype(a.dtype)
-
-    out = eager_apply("scale", raw, [_as_tensor(x)], {})
+    out = eager_apply("scale", _scale_raw, [_as_tensor(x)],
+                      {"s": s, "bias": bias,
+                       "bias_after_scale": bool(bias_after_scale)})
     if act is not None:
         out = globals()[act](out)
     return out
@@ -155,38 +166,57 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 _export("scale", scale, methods=["scale"])
 
 
+def _clip_raw(a, mn=None, mx=None):
+    return jnp.clip(a, mn, mx)
+
+
 def clip(x, min=None, max=None, name=None):
     tensors = [_as_tensor(x)]
     mn = min.item() if isinstance(min, Tensor) else min
     mx = max.item() if isinstance(max, Tensor) else max
-    return eager_apply("clip", lambda a: jnp.clip(a, mn, mx), tensors, {})
+    return eager_apply("clip", _clip_raw, tensors, {"mn": mn, "mx": mx})
 
 
 _export("clip", clip, methods=["clip"])
 
 
+def _lerp_raw(a, b, w):
+    return a + w * (b - a)
+
+
+def _lerp_scalar_raw(a, b, weight=0.0):
+    return a + weight * (b - a)
+
+
 def lerp(x, y, weight, name=None):
     if isinstance(weight, Tensor):
-        return eager_apply("lerp", lambda a, b, w: a + w * (b - a),
-                           [x, y, weight], {})
-    return eager_apply("lerp", lambda a, b: a + weight * (b - a), [x, y], {})
+        return eager_apply("lerp", _lerp_raw, [x, y, weight], {})
+    return eager_apply("lerp", _lerp_scalar_raw, [x, y],
+                       {"weight": weight})
 
 
 _export("lerp", lerp, methods=["lerp"])
 
 
+def _stanh_raw(a, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * a)
+
+
 def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
-    return eager_apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a),
-                       [_as_tensor(x)], {})
+    return eager_apply("stanh", _stanh_raw, [_as_tensor(x)],
+                       {"scale_a": scale_a, "scale_b": scale_b})
 
 
 _export("stanh", stanh)
 
 
+def _addmm_raw(i, a, b, beta=1.0, alpha=1.0):
+    return beta * i + alpha * (a @ b)
+
+
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    return eager_apply("addmm",
-                       lambda i, a, b: beta * i + alpha * (a @ b),
-                       [input, x, y], {})
+    return eager_apply("addmm", _addmm_raw, [input, x, y],
+                       {"beta": beta, "alpha": alpha})
 
 
 _export("addmm", addmm)
@@ -219,19 +249,22 @@ def _axis_arg(axis):
 
 
 def _make_reduce(name, jfn, int_promote=False):
+    # one stable raw per reduce op (axis/keepdim/dtype as static kwargs)
+    # so reductions are admissible to the signature-keyed dispatch caches
+    def raw(a, ax=None, keepdim=False, dtype=None, _jfn=jfn,
+            _promote=int_promote):
+        if dtype is not None:
+            a = a.astype(to_jax_dtype(dtype))
+        elif _promote and jnp.issubdtype(a.dtype, jnp.integer):
+            a = a.astype(jnp.int64)
+        return _jfn(a, axis=ax, keepdims=keepdim)
+
     def op(x, axis=None, keepdim=False, name=None, dtype=None,
-           _jfn=jfn, _opname=name):
+           _opname=name, _raw=raw):
         x = _as_tensor(x)
-        ax = _axis_arg(axis)
-
-        def raw(a):
-            if dtype is not None:
-                a = a.astype(to_jax_dtype(dtype))
-            elif int_promote and jnp.issubdtype(a.dtype, jnp.integer):
-                a = a.astype(jnp.int64)
-            return _jfn(a, axis=ax, keepdims=keepdim)
-
-        return eager_apply(_opname, raw, [x], {})
+        return eager_apply(_opname, _raw, [x],
+                           {"ax": _axis_arg(axis), "keepdim": bool(keepdim),
+                            "dtype": dtype})
 
     op.__name__ = name
     return op
@@ -425,13 +458,20 @@ def log_softmax_raw(a, axis):
     return jax.nn.log_softmax(a, axis=axis)
 
 
+def _increment_raw(a, value=1.0):
+    return a + value
+
+
 def increment(x, value=1.0, name=None):
-    out = eager_apply("increment", lambda a: a + value, [x], {})
-    x._rebind(out._data, out._grad_node, out._out_idx)
-    return x
+    from .dispatch import inplace_apply
+
+    return inplace_apply("increment", _increment_raw, [x],
+                         {"value": value})
 
 
 _export("increment", increment)
+register_op("increment_", increment, inplace_of="increment",
+            donates=(0,), tags=("math", "inplace"))
 
 
 def outer(x, y, name=None):

@@ -18,21 +18,27 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional, Sequence
 
 __all__ = ["OpDef", "register_op", "get_op", "all_ops",
-           "op_call_counts"]
+           "op_call_counts", "inplace_ops"]
 
 
 class OpDef:
-    __slots__ = ("name", "fn", "methods", "differentiable", "inplace_of", "tags")
+    __slots__ = ("name", "fn", "methods", "differentiable", "inplace_of",
+                 "tags", "donates")
 
     def __init__(self, name: str, fn: Callable, methods: Sequence[str] = (),
                  differentiable: bool = True, inplace_of: Optional[str] = None,
-                 tags: Sequence[str] = ()):
+                 tags: Sequence[str] = (), donates: Sequence[int] = ()):
         self.name = name
         self.fn = fn
         self.methods = tuple(methods)
         self.differentiable = differentiable
         self.inplace_of = inplace_of
         self.tags = tuple(tags)
+        #: positional tensor slots whose buffers the op may DONATE to its
+        #: compiled no-grad executable (the in-place family: the slot is
+        #: rebound to the output, so its old buffer can die in place —
+        #: ops/dispatch.py inplace_apply)
+        self.donates = tuple(donates)
 
 
 _REGISTRY: Dict[str, OpDef] = {}
@@ -40,12 +46,13 @@ _REGISTRY: Dict[str, OpDef] = {}
 
 def register_op(name: str, fn: Callable, methods: Sequence[str] = (),
                 differentiable: bool = True, inplace_of: Optional[str] = None,
-                tags: Sequence[str] = ()) -> Callable:
+                tags: Sequence[str] = (), donates: Sequence[int] = ()) -> Callable:
     """Register ``fn`` as op ``name``; attach Tensor methods listed in
     ``methods``. Returns fn unchanged so it can be used at module level."""
     from ..core.tensor import Tensor
 
-    _REGISTRY[name] = OpDef(name, fn, methods, differentiable, inplace_of, tags)
+    _REGISTRY[name] = OpDef(name, fn, methods, differentiable, inplace_of,
+                            tags, donates)
     for m in methods:
         Tensor._attach_method(m, fn)
     return fn
@@ -57,6 +64,13 @@ def get_op(name: str) -> OpDef:
 
 def all_ops() -> Dict[str, OpDef]:
     return dict(_REGISTRY)
+
+
+def inplace_ops() -> Dict[str, OpDef]:
+    """The registered in-place family (``inplace_of`` set): ops that
+    rebind their target and therefore participate in buffer donation on
+    the compiled-forward fast path."""
+    return {n: d for n, d in _REGISTRY.items() if d.inplace_of}
 
 
 def op_call_counts(include_unused: bool = False) -> Dict[str, int]:

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.engine import no_grad
+from ..core.flags import flag
 from ..core.tensor import Parameter, Tensor
 from ..nn.clip import ClipGradBase
 from ..regularizer import WeightDecayRegularizer, L2Decay
@@ -80,6 +81,7 @@ class Optimizer:
         self._accumulators: Dict[int, Dict[str, Any]] = {}
         self._global_step = 0
         self._jit_update = None
+        self._donating_grads = False  # set when the fused update compiles
         self._multi_precision = multi_precision
         self._master_weights: Dict[int, jnp.ndarray] = {}
         # Master-free low-memory mode: bf16 params are upcast to fp32 for
@@ -212,6 +214,11 @@ class Optimizer:
         for p, np_, ns in zip(params, new_ps, new_states):
             p._rebind(np_)
             self._accumulators[id(p)] = ns
+        if self._donating_grads:
+            # gradient buffers were donated to (consumed by) the fused
+            # update — drop the now-dead Tensors so nothing can read them
+            for p in params:
+                p.grad = None
 
     def _decoupled_wd(self) -> bool:
         return False
@@ -250,10 +257,17 @@ class Optimizer:
 
     def _fused_update(self, p_arrays, g_arrays, states, hyper, per_param):
         """One compiled XLA program updating every parameter (the fused
-        multi-tensor path); cached by pytree structure via jax.jit."""
+        multi-tensor path); cached by pytree structure via jax.jit.
+        Parameter and accumulator buffers are always donated (updated in
+        place in HBM); with ``FLAGS_optimizer_donate_grads`` the gradient
+        buffers are donated too — step() then consumes the grads
+        (``p.grad`` comes back None), removing the step's transient
+        per-parameter gradient copy."""
         if self._jit_update is None:
+            self._donating_grads = flag("optimizer_donate_grads")
+            donate = (0, 1, 2) if self._donating_grads else (0, 2)
             self._jit_update = functools.partial(
-                jax.jit, donate_argnums=(0, 2))(self._update_arrays)
+                jax.jit, donate_argnums=donate)(self._update_arrays)
         return self._jit_update(p_arrays, g_arrays, states, hyper, per_param)
 
     def clear_grad(self, set_to_zero: bool = False):

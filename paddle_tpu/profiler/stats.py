@@ -28,8 +28,12 @@ Conventions for the built-in instrumentation (all optional reading):
 - ``op.<name>``                per-op eager dispatch call counters
 - ``vjp_cache.{hit,miss,admit,blocklisted,uncacheable}``  taped-VJP
   trace cache outcomes (ops/dispatch.py)
+- ``fwd_cache.{hit,miss,admit,blocklisted,blocked,uncacheable}``
+  compiled-forward no-grad fast-path outcomes (ops/dispatch.py)
 - ``compile.{vjp_trace_us,vjp_build_us}``   histograms of uncached
   jax.vjp trace time / cache-entry build time
+- ``compile.fwd_trace_us``     histogram of compiled-forward admission
+  trace+compile time
 - ``jit.{trace,cache_hit}``    to_static program-cache outcomes
 - ``autograd.{sweeps,nodes}``  run_backward sweeps and executed nodes
 - ``inference.*`` / ``serving.*``  pool sizes, decode steps
@@ -61,7 +65,7 @@ __all__ = [
 #: conventions table); the naming lint asserts every registered metric
 #: starts with one of these
 CONVENTION_PREFIXES = (
-    "op.", "vjp_cache.", "compile.", "jit.", "autograd.",
+    "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
     "inference.", "serving.", "dist.", "roofline.", "hbm.", "t.",
 )
 
@@ -358,4 +362,12 @@ def vjp_cache_hit_rate() -> Optional[float]:
     any taped dispatch ran."""
     hit = counter("vjp_cache.hit").value
     miss = counter("vjp_cache.miss").value
+    return hit / (hit + miss) if (hit + miss) else None
+
+
+def fwd_cache_hit_rate() -> Optional[float]:
+    """hit / (hit + miss) over the compiled-forward no-grad cache, or
+    None before any no-grad dispatch ran with the cache enabled."""
+    hit = counter("fwd_cache.hit").value
+    miss = counter("fwd_cache.miss").value
     return hit / (hit + miss) if (hit + miss) else None

@@ -1,0 +1,436 @@
+"""Signature-keyed compiled-forward cache for no-grad eager dispatch
+(ops/dispatch.py).
+
+The reference amortizes per-op eager dispatch with codegen'd PHI kernels
+(eager_gen.py + kernel_dispatch.h); we amortize the no-grad path with a
+jit-compiled executable per (raw_fn identity, static kwargs, input avals
+incl. weak_type), admitted under the shared seen-twice discipline and
+LRU bounded. These tests pin the cache's semantics: keying, eviction,
+per-call-closure randomness NEVER frozen, donation correctness for the
+in-place family, graceful blocklisting of concrete-value traces, the
+admission tracker's id-reuse purge, and a CPU mini op-bench keeping
+cached-eager within a generous multiple of jitted latency.
+"""
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops import dispatch
+from paddle_tpu.ops import registry
+from paddle_tpu.profiler import stats
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    dispatch._FWD_CACHE.clear()
+    dispatch._FWD_SEEN.clear()
+    dispatch._FWD_BLOCK.clear()
+    yield
+    dispatch._FWD_CACHE.clear()
+    dispatch._FWD_SEEN.clear()
+    dispatch._FWD_BLOCK.clear()
+
+
+def _counter(name):
+    return stats.counter(name).value
+
+
+class TestForwardCache:
+    def test_admit_on_second_sighting_then_hit(self):
+        x = paddle.to_tensor(np.linspace(-2, 2, 32).astype(np.float32))
+        h0, m0 = _counter("fwd_cache.hit"), _counter("fwd_cache.miss")
+        y0 = F.gelu(x)                       # sighting 1: plain path
+        assert len(dispatch._FWD_CACHE) == 0
+        y1 = F.gelu(x)                       # sighting 2: builds + runs
+        assert len(dispatch._FWD_CACHE) == 1
+        y2 = F.gelu(x)                       # hit: compiled executable
+        assert _counter("fwd_cache.hit") == h0 + 1
+        assert _counter("fwd_cache.miss") == m0 + 1
+        np.testing.assert_allclose(y2.numpy(), y0.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(y1.numpy(), y0.numpy(), rtol=1e-6)
+
+    def test_trace_time_histogram_observed(self):
+        h = stats.histogram("compile.fwd_trace_us")
+        before = h.count
+        x = paddle.to_tensor(np.ones((8, 8), np.float32))
+        F.gelu(x)
+        F.gelu(x)  # admission traces+compiles here
+        assert h.count == before + 1
+
+    def test_key_discriminates_shape_dtype_weak_type(self):
+        for shape in ((4,), (2, 3), (4,)):
+            for _ in range(2):
+                paddle.exp(paddle.to_tensor(np.ones(shape, np.float32)))
+        for _ in range(2):
+            paddle.exp(paddle.to_tensor(np.ones((4,), np.float64)))
+        # weak_type discriminates: a python-scalar array is weakly typed
+        for _ in range(2):
+            paddle.exp(paddle.Tensor(jnp.asarray(1.0)))
+        for _ in range(2):
+            paddle.exp(paddle.Tensor(jnp.asarray(np.float32(1.0))))
+        keys = list(dispatch._FWD_CACHE)
+        # (4,) f32, (2,3) f32, (4,) f64, scalar weak, scalar strong
+        assert len(keys) == 5
+
+    def test_static_kwargs_in_key(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 5).astype(np.float32))
+        for ax in (0, 1):
+            for _ in range(3):
+                s = F.softmax(x, axis=ax)
+        assert len(dispatch._FWD_CACHE) == 2
+        np.testing.assert_allclose(s.numpy().sum(axis=1), np.ones(3),
+                                   rtol=1e-5)
+
+    def test_unhashable_static_kwargs_fall_back(self):
+        u0 = _counter("fwd_cache.uncacheable")
+
+        def raw(a, factors=None):
+            return a * factors[0]
+
+        t = paddle.to_tensor(np.ones((4,), np.float32))
+        for _ in range(3):
+            out = dispatch.eager_apply("t_listkw", raw, [t],
+                                       {"factors": [2.0]})
+        assert len(dispatch._FWD_CACHE) == 0
+        assert _counter("fwd_cache.uncacheable") >= u0 + 3
+        np.testing.assert_allclose(out.numpy(), 2.0 * np.ones(4))
+
+    def test_tensor_valued_static_kwarg_never_baked(self):
+        # a Tensor hash()es by identity but must NOT be admitted: its
+        # VALUE would be frozen into the compiled executable
+        scale = paddle.to_tensor(np.float32(3.0))
+
+        def raw(a, s=None):
+            return a * s._data
+
+        t = paddle.to_tensor(np.ones((4,), np.float32))
+        for _ in range(3):
+            dispatch.eager_apply("t_tensorkw", raw, [t], {"s": scale})
+        assert len(dispatch._FWD_CACHE) == 0
+
+    def test_lru_eviction_at_bound(self, monkeypatch):
+        monkeypatch.setattr(dispatch, "_FWD_CACHE_MAX", 3)
+        for n in (1, 2, 3, 4, 5):
+            x = paddle.to_tensor(np.ones((n,), np.float32))
+            paddle.exp(x)
+            paddle.exp(x)  # admit entry for shape (n,)
+        assert len(dispatch._FWD_CACHE) == 3
+        shapes = [key[2][0][0] for key in dispatch._FWD_CACHE]
+        assert shapes == [(3,), (4,), (5,)]  # oldest two evicted
+
+    def test_lru_recency_on_hit(self, monkeypatch):
+        monkeypatch.setattr(dispatch, "_FWD_CACHE_MAX", 2)
+        a = paddle.to_tensor(np.ones((2,), np.float32))
+        b = paddle.to_tensor(np.ones((3,), np.float32))
+        c = paddle.to_tensor(np.ones((4,), np.float32))
+        for t in (a, a, b, b):
+            paddle.exp(t)
+        paddle.exp(a)          # hit refreshes (2,)'s recency
+        paddle.exp(c)
+        paddle.exp(c)          # admitting (4,) evicts (3,), not (2,)
+        shapes = [key[2][0][0] for key in dispatch._FWD_CACHE]
+        assert (2,) in shapes and (3,) not in shapes
+
+    def test_dropout_randomness_never_frozen(self):
+        paddle.seed(0)
+        x = paddle.to_tensor(np.ones((64,), np.float32))
+        masks = set()
+        for _ in range(6):
+            y = F.dropout(x, p=0.5, training=True)
+            masks.add(tuple((y.numpy() != 0).tolist()))
+        # fresh mask (fresh closure) every call: caching must not bake it
+        assert len(masks) >= 4
+        assert len(dispatch._FWD_CACHE) == 0
+
+    def test_gumbel_style_noise_not_frozen(self):
+        paddle.seed(0)
+        draws = set()
+        for _ in range(6):
+            t = paddle.rand([16])
+            draws.add(round(float(t.numpy().sum()), 6))
+        assert len(draws) >= 4
+
+    def test_blocklisted_concrete_trace_falls_back(self):
+        b0 = _counter("fwd_cache.blocklisted")
+        k0 = _counter("fwd_cache.blocked")
+
+        def raw(a):
+            if float(jnp.sum(a)) > 0:  # concretizes under jit
+                return a * 2.0
+            return a
+
+        t = paddle.to_tensor(np.ones((4,), np.float32))
+        outs = [dispatch.eager_apply("t_concrete", raw, [t])
+                for _ in range(4)]
+        for out in outs:
+            np.testing.assert_allclose(out.numpy(), 2.0 * np.ones(4))
+        assert _counter("fwd_cache.blocklisted") == b0 + 1
+        assert len(dispatch._FWD_BLOCK) == 1
+        assert _counter("fwd_cache.blocked") >= k0 + 1
+        assert len(dispatch._FWD_CACHE) == 0
+
+    def test_disabled_by_flag(self):
+        paddle.set_flags({"FLAGS_eager_fwd_cache": False})
+        try:
+            x = paddle.to_tensor(np.ones((4,), np.float32))
+            for _ in range(4):
+                y = paddle.exp(x)
+            assert len(dispatch._FWD_CACHE) == 0
+            np.testing.assert_allclose(y.numpy(), np.e * np.ones(4),
+                                       rtol=1e-6)
+        finally:
+            paddle.set_flags({"FLAGS_eager_fwd_cache": True})
+
+    def test_multi_output_op_cached(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(8).astype(np.float32))
+        for _ in range(3):
+            vals, idx = paddle.topk(x, k=3)
+        assert len(dispatch._FWD_CACHE) == 1
+        np.testing.assert_allclose(
+            np.sort(vals.numpy()), np.sort(np.sort(x.numpy())[-3:]))
+
+    def test_grad_mode_untouched_by_fwd_cache(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 8).astype(np.float32),
+                             stop_gradient=False)
+        for _ in range(3):
+            y = paddle.tanh(x)
+            y.sum().backward()
+            g = x.grad.numpy()
+            x.clear_grad()
+        np.testing.assert_allclose(g, 1 - np.tanh(x.numpy()) ** 2,
+                                   rtol=1e-5)
+        assert len(dispatch._FWD_CACHE) == 0  # taped calls use vjp cache
+
+
+class TestAdmissionTracker:
+    def test_seen_twice_same_object(self):
+        tr = dispatch._AdmissionTracker()
+        f = lambda a: a  # noqa: E731
+        assert tr.admit("k", f) is False
+        assert tr.admit("k", f) is True
+        assert tr.admit("k", f) is True
+
+    def test_fresh_closure_never_admitted(self):
+        tr = dispatch._AdmissionTracker()
+        for _ in range(8):
+            assert tr.admit("k", (lambda a: a)) is False
+
+    def test_id_reuse_purged_on_death(self):
+        # the latent bug: entries keyed by a dead referent must not let a
+        # recycled id inherit the sighting — the weakref callback purges
+        tr = dispatch._AdmissionTracker()
+
+        def make():
+            return lambda a: a + 1
+
+        f = make()
+        assert tr.admit(("k", id(f)), f) is False
+        assert len(tr) == 1
+        del f
+        gc.collect()
+        assert len(tr) == 0  # purged by the weakref callback
+        g = make()
+        assert tr.admit(("k", id(g)), g) is False  # no stale inheritance
+
+    def test_bound_evicts_dead_then_oldest(self):
+        tr = dispatch._AdmissionTracker(max_entries=4)
+        keep = [lambda a, _i=i: a for i in range(6)]
+        for i, f in enumerate(keep):
+            tr.admit(i, f)
+        assert len(tr) <= 4
+
+    def test_vjp_seen_shares_fixed_tracker(self):
+        assert isinstance(dispatch._VJP_SEEN, dispatch._AdmissionTracker)
+        assert isinstance(dispatch._FWD_SEEN, dispatch._AdmissionTracker)
+
+
+class TestDonation:
+    def test_inplace_relu_matches_functional(self):
+        x_np = np.linspace(-2, 2, 64).astype(np.float32)
+        ref = F.relu(paddle.to_tensor(x_np)).numpy()
+        for _ in range(4):  # warm the donated-signature entry
+            x = paddle.to_tensor(x_np)
+            out = F.relu_(x)
+            assert out is x
+            np.testing.assert_array_equal(x.numpy(), ref)
+
+    def test_aliased_buffer_never_donated(self):
+        x_np = np.linspace(-2, 2, 64).astype(np.float32)
+        for _ in range(4):
+            x = paddle.to_tensor(x_np)
+            alias = x.detach()          # shares the jax buffer
+            F.relu_(x)
+            # the alias must still be readable: donation was skipped
+            np.testing.assert_array_equal(alias.numpy(), x_np)
+
+    def test_donated_and_undonated_bit_identical(self):
+        x_np = np.random.RandomState(3).randn(128).astype(np.float32)
+        outs = []
+        for keep_alias in (False, True):
+            dispatch._FWD_CACHE.clear()
+            dispatch._FWD_SEEN.clear()
+            for _ in range(4):
+                x = paddle.to_tensor(x_np)
+                alias = x.detach() if keep_alias else None
+                F.tanh_(x)
+                outs.append(x.numpy())
+            del alias
+        first = outs[0]
+        for o in outs[1:]:
+            np.testing.assert_array_equal(o, first)
+
+    def test_inplace_family_registered_with_donation(self):
+        fam = registry.inplace_ops()
+        for name in ("relu_", "tanh_", "elu_", "softmax_"):
+            assert name in fam, name
+            assert fam[name].donates == (0,)
+            assert fam[name].inplace_of == name.rstrip("_")
+
+    def test_optimizer_donate_grads_flag(self):
+        paddle.seed(0)
+        import paddle_tpu.nn as nn
+
+        def train(donate):
+            paddle.set_flags({"FLAGS_optimizer_donate_grads": donate})
+            try:
+                paddle.seed(7)
+                net = nn.Linear(4, 4)
+                opt = paddle.optimizer.SGD(0.1,
+                                           parameters=net.parameters())
+                xs = paddle.to_tensor(
+                    np.random.RandomState(0).randn(8, 4).astype(np.float32))
+                for _ in range(3):
+                    loss = (net(xs) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    if donate:
+                        assert all(p.grad is None
+                                   for p in net.parameters())
+                    opt.clear_grad()
+                return [p.numpy().copy() for p in net.parameters()]
+            finally:
+                paddle.set_flags({"FLAGS_optimizer_donate_grads": False})
+
+        ref = train(False)
+        don = train(True)
+        for a, b in zip(ref, don):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestMiniOpBench:
+    """CPU stand-in for the on-TPU OPBENCH acceptance: cached-eager
+    composite ops must stay within a generous multiple of their jitted
+    latency (catches fast-path regressions without a TPU)."""
+
+    @staticmethod
+    def _median_us(fn, reps=15):
+        out = fn()
+        jax.block_until_ready(getattr(out, "_data", out))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(getattr(out, "_data", out))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts) * 1e6)
+
+    def test_cached_eager_within_bound_of_jit(self):
+        rng = np.random.RandomState(0)
+        big = paddle.to_tensor(rng.randn(512, 1024).astype(np.float32))
+        logits = paddle.to_tensor(rng.randn(256, 1000).astype(np.float32))
+        cases = [
+            ("gelu", lambda: F.gelu(big), big),
+            ("softmax", lambda: F.softmax(logits, axis=-1), logits),
+        ]
+        h0 = _counter("fwd_cache.hit")
+        for name, fn, src in cases:
+            for _ in range(3):  # sight + admit + first hit
+                fn()
+            eager_us = self._median_us(fn)
+            jit_fn = jax.jit(
+                {"gelu": lambda a: jax.nn.gelu(a, approximate=False),
+                 "softmax": lambda a: jax.nn.softmax(a, axis=-1)}[name])
+            arr = src._data
+            jit_fn(arr)
+            jit_us = self._median_us(lambda: jit_fn(arr))
+            # generous: CI boxes are noisy — the uncached composite path
+            # is O(5-50x), so 4x + 1ms slack still catches a fall-off
+            assert eager_us <= 4.0 * jit_us + 1000.0, \
+                (name, eager_us, jit_us)
+        assert _counter("fwd_cache.hit") > h0
+
+    def test_telemetry_block_carries_fwd_cache(self):
+        x = paddle.to_tensor(np.ones((16, 16), np.float32))
+        for _ in range(3):
+            F.gelu(x)
+        snap = stats.snapshot()
+        assert any(k.startswith("fwd_cache.") for k in snap["counters"])
+        assert stats.fwd_cache_hit_rate() is not None
+
+
+class TestBenchGateNewFields:
+    """bench_gate must cover the new OPBENCH telemetry fields."""
+
+    @staticmethod
+    def _doc(miss, hit_rate, trace_avg):
+        return {"telemetry": {
+            "counters": {"fwd_cache.miss": miss, "fwd_cache.hit": 50},
+            "fwd_cache_hit_rate": hit_rate,
+            "histograms": {"compile.fwd_trace_us": {
+                "count": 10, "avg": trace_avg}},
+        }}
+
+    def _gate(self, prev, cur):
+        import importlib
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        bench_gate = importlib.import_module("bench_gate")
+        return bench_gate.gate(prev, cur)
+
+    def test_miss_regresses_up(self):
+        bad, compared = self._gate(self._doc(10, 0.9, 100.0),
+                                   self._doc(40, 0.9, 100.0))
+        assert compared >= 3
+        assert any("fwd_cache.miss" in line for line in bad)
+
+    def test_hit_rate_regresses_down(self):
+        bad, _ = self._gate(self._doc(10, 0.9, 100.0),
+                            self._doc(10, 0.4, 100.0))
+        assert any("fwd_cache_hit_rate" in line for line in bad)
+
+    def test_trace_time_regresses_up(self):
+        bad, _ = self._gate(self._doc(10, 0.9, 100.0),
+                            self._doc(10, 0.9, 500.0))
+        assert any("compile.fwd_trace_us" in line for line in bad)
+
+    def test_clean_round_passes(self):
+        bad, compared = self._gate(self._doc(10, 0.9, 100.0),
+                                   self._doc(10, 0.92, 99.0))
+        assert bad == [] and compared >= 3
+
+    def test_op_bench_taped_backward_column(self):
+        import importlib
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        op_bench = importlib.import_module("op_bench")
+        t = paddle.to_tensor(np.ones((8,), np.float32))
+        us = op_bench._taped_backward_us(lambda a: a.exp(), (t,),
+                                         reps=3, warmup=1)
+        assert us is not None and us > 0
+        # int-only inputs have no taped path
+        ti = paddle.to_tensor(np.ones((8,), np.int32))
+        assert op_bench._taped_backward_us(lambda a: a + a, (ti,),
+                                           reps=2, warmup=1) is None

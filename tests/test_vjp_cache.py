@@ -33,11 +33,16 @@ def _grad_of(fn, x_np):
 
 class TestVjpCache:
     def test_cache_hit_after_two_sightings_same_grads(self):
+        # count only the tanh entries (empty static kwargs): sum() in the
+        # loss reduction is nowadays admissible too and shares the cache
+        def n_tanh_entries():
+            return len([k for k in dispatch._VJP_CACHE if k[1] == ()])
+
         x_np = np.linspace(-2, 2, 12).astype(np.float32)
         y0, g0 = _grad_of(paddle.tanh, x_np)      # sighting 1: uncached
-        assert len(dispatch._VJP_CACHE) == 0
+        assert n_tanh_entries() == 0
         y1, g1 = _grad_of(paddle.tanh, x_np)      # sighting 2: builds
-        assert len(dispatch._VJP_CACHE) == 1
+        assert n_tanh_entries() == 1
         y2, g2 = _grad_of(paddle.tanh, x_np)      # hit: jitted fwd+bwd
         np.testing.assert_allclose(y2, y0, rtol=1e-6)
         np.testing.assert_allclose(g2, g0, rtol=1e-6)
@@ -49,7 +54,9 @@ class TestVjpCache:
             _grad_of(paddle.exp, np.ones(shape, np.float32))
         _grad_of(paddle.exp, np.ones((4,), np.float64))
         _grad_of(paddle.exp, np.ones((4,), np.float64))
-        keys = list(dispatch._VJP_CACHE)
+        # exp entries carry empty static kwargs; the sum() reduction in
+        # the loss is separately admissible and must not be counted
+        keys = [k for k in dispatch._VJP_CACHE if k[1] == ()]
         assert len(keys) == 3  # (4,) f32, (2,3) f32, (4,) f64
 
     def test_static_kwargs_in_key(self):

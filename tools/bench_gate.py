@@ -40,13 +40,21 @@ DEFAULT_METRICS: Dict[str, str] = {
     "vjp_cache.miss": "up",
     "vjp_cache.uncacheable": "up",
     "vjp_cache.blocklisted": "up",
+    # the no-grad compiled-forward fast path (ops/dispatch.py): growing
+    # misses/blocklistings under the same workload mean ops fell off the
+    # fast path (a closure crept back in, or statics went unhashable)
+    "fwd_cache.miss": "up",
+    "fwd_cache.uncacheable": "up",
+    "fwd_cache.blocklisted": "up",
     # cache effectiveness / device utilization must not collapse
     "vjp_cache_hit_rate": "down",
+    "fwd_cache_hit_rate": "down",
     "roofline.mfu": "down",
     "roofline.bw_util": "down",
     # compile-time histograms gate on their mean
     "compile.vjp_trace_us": "up",
     "compile.vjp_build_us": "up",
+    "compile.fwd_trace_us": "up",
     "compile.jit_build_us": "up",
 }
 

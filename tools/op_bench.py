@@ -132,6 +132,40 @@ def _suite():
     return ops
 
 
+def _taped_backward_us(fn, targs, reps=10, warmup=3):
+    """Median forward+backward latency through the taped (requires-grad)
+    dispatch — the path the aval-keyed VJP cache amortizes. None for ops
+    without a differentiable float input (or whose output can't reduce
+    to a scalar loss)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+
+    leafs = []
+    any_diff = False
+    for t in targs:
+        diff = jnp.issubdtype(t._data.dtype, jnp.inexact)
+        any_diff = any_diff or diff
+        leafs.append(paddle.to_tensor(np.asarray(t._data),
+                                      stop_gradient=not diff))
+    if not any_diff:
+        return None
+
+    def run():
+        out = fn(*leafs)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        out.sum().backward()
+        for leaf in leafs:
+            leaf.clear_grad()
+        return out
+
+    try:
+        return _median_us(run, reps=reps, warmup=warmup)
+    except Exception:
+        return None
+
+
 def run_bench():
     import jax
     import jax.numpy as jnp
@@ -151,8 +185,11 @@ def run_bench():
 
         jit_fn = jax.jit(jit_wrap)
         jit_us = _median_us(lambda: jit_fn(*arrays))
+        taped_us = _taped_backward_us(fn, targs)
         results[name] = {"eager_us": round(eager_us, 1),
-                         "jit_us": round(jit_us, 1)}
+                         "jit_us": round(jit_us, 1),
+                         "taped_backward_us": (None if taped_us is None
+                                               else round(taped_us, 1))}
 
     # ---- dispatch overhead decomposition (phi/README.md §1.2) ----
     # baseline = a pre-compiled jax program call: the true floor for one
@@ -197,6 +234,9 @@ def run_bench():
     hr = stats.vjp_cache_hit_rate()
     if hr is not None:
         telemetry["vjp_cache_hit_rate"] = round(hr, 4)
+    fhr = stats.fwd_cache_hit_rate()
+    if fhr is not None:
+        telemetry["fwd_cache_hit_rate"] = round(fhr, 4)
     return {
         "backend": jax.default_backend(),
         "device": getattr(jax.devices()[0], "device_kind", "cpu"),
@@ -232,11 +272,14 @@ def compare(prev_path: str, cur_path: str, tol: float = 0.10) -> int:
         p = prev["ops"].get(name)
         if not p:
             continue
-        for k in ("eager_us", "jit_us"):
+        for k in ("eager_us", "jit_us", "taped_backward_us"):
+            pv, cv = p.get(k), c.get(k)
+            if pv is None or cv is None:  # column absent in older rounds
+                continue
             # guard tiny-latency noise with a 5us floor
-            if c[k] > max(p[k] * (1 + tol), p[k] + 5.0):
-                bad.append(f"{name}.{k}: {p[k]} -> {c[k]} us "
-                           f"(+{100 * (c[k] / p[k] - 1):.0f}%)")
+            if cv > max(pv * (1 + tol), pv + 5.0):
+                bad.append(f"{name}.{k}: {pv} -> {cv} us "
+                           f"(+{100 * (cv / pv - 1):.0f}%)")
     if bad:
         print("op_bench REGRESSIONS (>10%):")
         for line in bad:
