@@ -208,8 +208,21 @@ class FusedMultiTransformer(Layer):
             return (x @ w.astype(x.dtype)) * scale.astype(x.dtype)
         return x @ w
 
+    @staticmethod
+    def _mm_a8w8(x, w_q, scale):
+        """A8W8 matmul: per-token dynamic activation quant into an
+        int8 x int8 dot with int32 accumulation, dequantized once by
+        ``act_scale (x) weight_scale`` (the reference's
+        fused_multi_transformer_int8 quantize/GEMM/dequant round).
+        Returns f32 — call sites cast back to the compute dtype."""
+        from ...quantization.dynamic import (dynamic_act_quant,
+                                             int8_dot_dequant)
+
+        xq, xs = dynamic_act_quant(x)
+        return int8_dot_dequant(xq, xs, w_q, scale)
+
     def _layer_body(self, w, h, positions, kv_write, attend, cos_t,
-                    sin_t, linear=None):
+                    sin_t, linear=None, a8w8=False):
         """One pre-LN transformer layer over hidden ``h`` (any leading
         dims). Compute dtype FOLLOWS h (bf16 weights + bf16 h → pure
         bf16 MXU dots; LN statistics promote to fp32 internally and are
@@ -220,10 +233,16 @@ class FusedMultiTransformer(Layer):
         streaming kernel over UNSLICED stacked weights."""
         eps = self.epsilon
         if linear is None:
-            def linear(x, kind):
-                return self._mm(x, w[f"{kind}_weight"],
-                                w.get(f"{kind}_scale")) \
-                    + w[f"{kind}_bias"]
+            if a8w8:
+                def linear(x, kind):
+                    return self._mm_a8w8(x, w[f"{kind}_weight"],
+                                         w[f"{kind}_scale"]) \
+                        + w[f"{kind}_bias"]
+            else:
+                def linear(x, kind):
+                    return self._mm(x, w[f"{kind}_weight"],
+                                    w.get(f"{kind}_scale")) \
+                        + w[f"{kind}_bias"]
         hn = self._ln(h, w["ln1_scale"], w["ln1_bias"], eps) \
             .astype(h.dtype)
         proj = linear(hn, "qkv")
@@ -245,6 +264,13 @@ class FusedMultiTransformer(Layer):
         return h, ck, cv
 
     @staticmethod
+    def _weights_dtype(weights):
+        """Matmul-stack dtype for either weight form (stacked dict or
+        list of per-layer dicts)."""
+        w = weights[0] if isinstance(weights, (list, tuple)) else weights
+        return w["qkv_weight"].dtype
+
+    @staticmethod
     def _pool_data(side):
         """Raw page array of a cache side (quantized sides are
         (int8_rows, f32_scale_plane) tuples)."""
@@ -256,7 +282,8 @@ class FusedMultiTransformer(Layer):
     def _pool_page_size(self, cache: PagedKV) -> int:
         return self._pool_data(cache.k).shape[2]
 
-    def prefill_raw(self, weights, x, cache, block_tables, cos_t, sin_t):
+    def prefill_raw(self, weights, x, cache, block_tables, cos_t, sin_t,
+                    a8w8=False):
         """Prompt pass: x [b, s, d] → (hidden [b, s, d], filled cache).
 
         Causal dense attention (flash-fusable by XLA/Pallas); each
@@ -265,7 +292,12 @@ class FusedMultiTransformer(Layer):
         parity path) with no KV writes. Ragged batches are NOT masked
         here — pad prompts to a common length (dense attention over
         padding is causal-safe for the suffix tokens actually decoded).
+        ``a8w8``: run the four matmuls with per-token dynamic int8
+        activations against the int8 weight stack (``_mm_a8w8``).
         """
+        if a8w8 and self._weights_dtype(weights) != jnp.int8:
+            raise ValueError("a8w8 prefill needs an int8 weight stack "
+                             "(quantize_weight_only_int8 first)")
         b, s, d = x.shape
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
         group = self.num_heads // self.num_kv_heads
@@ -280,7 +312,7 @@ class FusedMultiTransformer(Layer):
             def body(h, w):
                 h, _, _ = self._layer_body(
                     w, h, positions, lambda k, v: (None, None), attend,
-                    cos_t, sin_t)
+                    cos_t, sin_t, a8w8=a8w8)
                 return h, None
 
             h, _ = jax.lax.scan(body, x, weights)
@@ -296,7 +328,7 @@ class FusedMultiTransformer(Layer):
             h, ck, cv = self._layer_body(
                 w, h, positions,
                 lambda k, v: write_prefill_kv_pages(ck, cv, k, v, tbl),
-                attend, cos_t, sin_t)
+                attend, cos_t, sin_t, a8w8=a8w8)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(
@@ -316,7 +348,7 @@ class FusedMultiTransformer(Layer):
                 for l in range(self.num_layers)]
 
     def decode_raw(self, weights, x, cache: PagedKV, block_tables,
-                   seq_lens, cos_t, sin_t):
+                   seq_lens, cos_t, sin_t, a8w8=False):
         """One decode step: x [b, d] token embeddings, seq_lens [b] =
         tokens already cached (the new token's position). Returns
         (hidden [b, d], cache').
@@ -327,7 +359,14 @@ class FusedMultiTransformer(Layer):
         experimental, measured slower end-to-end; see that method's
         docstring). Either way the pool is carried through the loop and
         only scatter-written/gather-read — never copied.
+
+        ``a8w8``: activations dynamically quantized per token into the
+        int8 x int8 streamed matmuls (stream_linear act_quant path) —
+        requires the int8 weight stack.
         """
+        if a8w8 and self._weights_dtype(weights) != jnp.int8:
+            raise ValueError("a8w8 decode needs an int8 weight stack "
+                             "(quantize_weight_only_int8 first)")
         npages = self._pages_per_layer(cache)
         lens1 = (seq_lens + 1).astype(jnp.int32)
         # token-level pool ownership (the stream kernels' mask) is
@@ -396,28 +435,39 @@ class FusedMultiTransformer(Layer):
                     attend_paged(tbl, base), cos_t, sin_t,
                     linear=linear)
 
+        from ...nn.functional.stream_linear import stream_linear
+
         if isinstance(weights, (list, tuple)):
             h, ck, cv = x, cache.k, cache.v
             for l, w in enumerate(weights):
+                linear = None
+                if a8w8:
+                    def linear(xx, kind, _w=w):
+                        return stream_linear(
+                            xx, _w[f"{kind}_weight"],
+                            bias=_w[f"{kind}_bias"],
+                            scale=_w[f"{kind}_scale"],
+                            act_quant=True, out_dtype=xx.dtype)
                 h, ck, cv = run_layer(w, h, ck, cv, block_tables,
-                                      l * npages, None)
+                                      l * npages, linear)
             return h, PagedKV(ck, cv)
 
         # matmul weights stay STACKED: the weight-streaming kernel reads
         # layer l's block directly via a prefetched index, so the loop
         # never materializes a per-layer [K, N] slice (a dynamic-slice
         # operand to the kernel's custom call would copy ~100MB/layer)
-        from ...nn.functional.stream_linear import stream_linear
 
         # dtype-aware auto (r5 1.3B b32 end-to-end): bf16 weights run
         # FASTER through XLA's sliced dots (2916 vs 2749 tok/s — the
         # ~96 kernel dispatches/step eat the DMA gains), int8 weights
         # run faster through the streaming kernel whose dequant fuses
-        # into the block DMA (3398 vs 3231)
+        # into the block DMA (3398 vs 3231). A8W8 always streams: the
+        # act-quant path's int8 x int8 dot lives in the same kernel
+        # (off-TPU it degrades to the identical-math XLA int32 dot).
         lin_flag = flag("decode_linear")
         is_int8 = weights["qkv_weight"].dtype == jnp.int8
-        use_stream_lin = x.shape[0] % 8 == 0 and (
-            lin_flag == "stream" or (lin_flag == "auto" and is_int8))
+        use_stream_lin = a8w8 or (x.shape[0] % 8 == 0 and (
+            lin_flag == "stream" or (lin_flag == "auto" and is_int8)))
         small = {n: a for n, a in weights.items()
                  if not n.startswith(("qkv_", "out_", "ffn1_", "ffn2_"))}
 
@@ -433,7 +483,7 @@ class FusedMultiTransformer(Layer):
                         xx, weights[f"{kind}_weight"], layer=l,
                         bias=weights[f"{kind}_bias"],
                         scale=weights.get(f"{kind}_scale"),
-                        out_dtype=xx.dtype)
+                        act_quant=a8w8, out_dtype=xx.dtype)
             h, ck, cv = run_layer(w, h, ck, cv, block_tables,
                                   l * npages, linear)
             return h, ck, cv

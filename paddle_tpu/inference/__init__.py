@@ -19,14 +19,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
-from .engine import (ContinuousBatchingEngine, FusedCausalLM,
-                     GenerationEngine, GenRequest)
+from .engine import (DEFAULT_DECODE_CHUNK, ContinuousBatchingEngine,
+                     FusedCausalLM, GenerationEngine, GenRequest)
 from .kv_cache import BlockKVCacheManager
 
 __all__ = [
     "Config", "create_predictor", "Predictor", "PredictorTensor",
     "FusedCausalLM", "GenerationEngine", "BlockKVCacheManager",
-    "ContinuousBatchingEngine", "GenRequest",
+    "ContinuousBatchingEngine", "GenRequest", "DEFAULT_DECODE_CHUNK",
 ]
 
 

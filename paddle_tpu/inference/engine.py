@@ -25,7 +25,22 @@ from ..profiler import stats as _stats
 from .kv_cache import BlockKVCacheManager
 
 __all__ = ["FusedCausalLM", "GenerationEngine",
-           "ContinuousBatchingEngine", "GenRequest"]
+           "ContinuousBatchingEngine", "GenRequest",
+           "DEFAULT_DECODE_CHUNK"]
+
+#: auto-picked decode scan-chunk: 128 measured best on the 1.3B bench
+#: geometry (chunk 64 -> 128: +7% tok/s, bench_profile.json r5 — one
+#: scan program covers the whole generation, so chunk-boundary pool
+#: relayout + the per-chunk host sync amortize). Callers pass an
+#: explicit ``decode_chunk`` to override (small chunks keep
+#: continuous-batching admit latency low on interactive traffic).
+DEFAULT_DECODE_CHUNK = 128
+
+
+def _resolve_decode_chunk(decode_chunk) -> int:
+    if decode_chunk is None:
+        return DEFAULT_DECODE_CHUNK
+    return max(int(decode_chunk), 1)
 
 
 def _round_pool_pages(n: int, page_size: int) -> int:
@@ -103,19 +118,20 @@ class GenerationEngine:
 
     def __init__(self, model: FusedCausalLM, page_size: int = 16,
                  max_length: int = 1024, num_pages: Optional[int] = None,
-                 decode_chunk: int = 8, kv_dtype=None):
+                 decode_chunk: Optional[int] = None, kv_dtype=None,
+                 quant: Optional[str] = None):
         self.model = model
         st = model.stack
         self.max_length = max_length
         self.page_size = page_size
-        self.decode_chunk = max(int(decode_chunk), 1)
+        self.decode_chunk = _resolve_decode_chunk(decode_chunk)
         self._cos, self._sin = rope_table(st.max_position, st.head_dim,
                                           st.rope_theta)
-        self._init_serving_state(kv_dtype)
+        self._init_serving_state(kv_dtype, quant)
         self._num_pages = num_pages
         self._mgr = None
 
-    def _init_serving_state(self, kv_dtype):
+    def _init_serving_state(self, kv_dtype, quant=None):
         """Serving dtype discipline + compiled-program holders (shared
         with ContinuousBatchingEngine): the COMPUTE dtype follows the
         stack weights (cast them bf16 for the bandwidth-bound serving
@@ -123,12 +139,29 @@ class GenerationEngine:
         quantized → compute bf16), the KV pool follows kv_dtype
         (default: same as compute), and the lm head is a PRE-TRANSPOSED
         [d, vocab] copy in compute dtype with fp32 accumulation in the
-        logits dot."""
-        wd = self.model.stack.qkv_weight._data.dtype
+        logits dot.
+
+        ``quant``: None | "int8" (weight-only) | "a8w8" (weight-only
+        int8 PLUS per-token dynamic int8 activations into int8 x int8
+        matmuls). Both quantize the model's stack IN PLACE when it is
+        not already int8."""
+        if quant not in (None, "int8", "a8w8"):
+            raise ValueError(
+                f"quant={quant!r}: expected None, 'int8' or 'a8w8'")
+        st = self.model.stack
+        if quant is not None and \
+                st.qkv_weight._data.dtype != jnp.int8:
+            st.quantize_weight_only_int8()
+        self._a8w8 = quant == "a8w8"
+        wd = st.qkv_weight._data.dtype
         self._cdtype = jnp.bfloat16 if wd == jnp.int8 else wd
         self._kv_dtype = kv_dtype or self._cdtype
         self._head_t = jnp.array(self.model.embed._data.T) \
             .astype(self._cdtype)
+        # roofline rung names: A8W8 programs report under their own
+        # ``decode.a8w8``/``prefill.a8w8`` keys so the serving modes'
+        # achieved-bandwidth rows never mix (bench.py picks these up)
+        self._decode_tag = "decode.a8w8" if self._a8w8 else "decode"
         # one jitted prefill; decode programs are per-chunk-size (k=1
         # is the single-token step); cache operands are donated. Both
         # dispatch through the explicit-AOT wrapper so each program's
@@ -136,7 +169,8 @@ class GenerationEngine:
         # weight+KV traffic) feeds the roofline telemetry
         # (profiler/roofline.py) instead of a hand-derived byte count.
         self._prefill = _roofline.AotProgram(
-            "prefill", jax.jit(self._prefill_fn, donate_argnums=(7, 8)))
+            "prefill.a8w8" if self._a8w8 else "prefill",
+            jax.jit(self._prefill_fn, donate_argnums=(7, 8)))
         self._decode_k_jit = {}
 
     def _get_decode_k(self, k: int, sample_cfg=None):
@@ -148,11 +182,21 @@ class GenerationEngine:
             import functools
 
             self._decode_k_jit[key] = _roofline.AotProgram(
-                f"decode[k={k}]",
+                f"{self._decode_tag}[k={k}]",
                 jax.jit(functools.partial(self._decode_k_fn, k=k,
                                           sample_cfg=sample_cfg),
                         donate_argnums=(7, 8)))
         return self._decode_k_jit[key]
+
+    def _count_a8w8(self, steps: int):
+        """Python-side ``quant.*`` accounting for executed A8W8 work
+        (inside the traced programs the quant ops run once per compile,
+        so the dispatch layer counts per EXECUTED step: 4 matmuls per
+        layer per step, each preceded by one dynamic act-quant)."""
+        if self._a8w8:
+            n = 4 * self.model.stack.num_layers * steps
+            _stats.inc("quant.act_quant_calls", n)
+            _stats.inc("quant.a8w8_matmuls", n)
 
     # ---------- pure programs ----------
 
@@ -183,7 +227,7 @@ class GenerationEngine:
         x = embed[ids].astype(self._cdtype)
         h, cache = st.prefill_raw(
             weights, x, PagedKV(cache_k, cache_v), tables,
-            self._cos, self._sin)
+            self._cos, self._sin, a8w8=self._a8w8)
         hl = h[jnp.arange(h.shape[0]), seq_lens - 1]
         logits = self._logits(hl, head_t, lnf_s, lnf_b)
         return logits, cache.k, cache.v
@@ -259,7 +303,7 @@ class GenerationEngine:
             x = embed[tok].astype(self._cdtype)
             h, cache = st.decode_raw(
                 weights, x, PagedKV(ck, cv), tables, lens,
-                self._cos, self._sin)
+                self._cos, self._sin, a8w8=self._a8w8)
             logits = self._logits(h, head_t, lnf_s, lnf_b)
             nxt = self._pick_token(logits, jax.random.fold_in(key, i),
                                    cfg)
@@ -353,6 +397,7 @@ class GenerationEngine:
                         self.model.lnf_bias._data)
 
         _stats.inc("inference.prefills")
+        self._count_a8w8(1)
         logits, ck, cv = self._prefill(
             weights, embed, self._head_t, lnf_s, lnf_b, jnp.asarray(ids),
             jnp.asarray(lens), cache.k, cache.v, tables)
@@ -393,6 +438,7 @@ class GenerationEngine:
             tables = self._grow_tables(range(b), lens + emitted, k,
                                        pages_per_seq)
             _stats.inc("inference.decode_steps", k)
+            self._count_a8w8(k)
             _stats.set_gauge("inference.kv_pages_in_use",
                              self._mgr.num_pages - self._mgr.free_pages)
             import time as _time
@@ -406,7 +452,7 @@ class GenerationEngine:
             toks_np = np.asarray(toks)
             # honest wall time: the np.asarray fetch synced the chunk,
             # so this roofline reflects executed work, not dispatch
-            _roofline.analyze(f"decode[k={k}]",
+            _roofline.analyze(f"{self._decode_tag}[k={k}]",
                               _time.perf_counter() - t0)
             for j in range(k):
                 col = toks_np[:, j].astype(ids.dtype)
@@ -466,22 +512,24 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model: FusedCausalLM, max_batch: int = 4,
                  page_size: int = 16, max_length: int = 1024,
-                 num_pages: Optional[int] = None, decode_chunk: int = 8,
-                 prompt_bucket: int = 16, kv_dtype=None):
+                 num_pages: Optional[int] = None,
+                 decode_chunk: Optional[int] = None,
+                 prompt_bucket: int = 16, kv_dtype=None,
+                 quant: Optional[str] = None):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_length = int(max_length)
         self.page_size = int(page_size)
-        self.decode_chunk = max(int(decode_chunk), 1)
+        self.decode_chunk = _resolve_decode_chunk(decode_chunk)
         self.prompt_bucket = max(int(prompt_bucket), 1)
-        st = model.stack
-        self._pages_per_seq = -(-self.max_length // self.page_size)
         self._gen = GenerationEngine.__new__(GenerationEngine)  # share
         self._gen.model = model
         self._gen.max_length = self.max_length
         self._gen.page_size = self.page_size
         self._gen.decode_chunk = self.decode_chunk
-        self._gen._init_serving_state(kv_dtype)
+        self._gen._init_serving_state(kv_dtype, quant)
+        st = model.stack
+        self._pages_per_seq = -(-self.max_length // self.page_size)
         requested = (num_pages or self.max_batch * self._pages_per_seq) + 1
         self._mgr = BlockKVCacheManager(
             st.num_layers, st.num_kv_heads, st.head_dim, self.page_size,
@@ -541,6 +589,7 @@ class ContinuousBatchingEngine:
             [("slot", i) for i in range(self.max_batch)],
             self._pages_per_seq, allow_missing=True)
         _stats.inc("serving.decode_steps", k)
+        self._gen._count_a8w8(k)
         _stats.set_gauge("serving.kv_pages_in_use",
                          self._mgr.num_pages - self._mgr.free_pages)
         _stats.set_gauge("serving.active_slots", len(active))
@@ -559,7 +608,8 @@ class ContinuousBatchingEngine:
             self._ck, self._cv, tables)
         toks_np = np.asarray(toks)
         # synced by the fetch above — an honest per-chunk roofline
-        _roofline.analyze(f"decode[k={k}]", _time.perf_counter() - t0)
+        _roofline.analyze(f"{self._gen._decode_tag}[k={k}]",
+                          _time.perf_counter() - t0)
 
         done_now = []
         for i in active:
@@ -610,6 +660,7 @@ class ContinuousBatchingEngine:
             self.waiting.pop(0)
             self._slots[i] = req
             _stats.inc("serving.admitted")
+            self._gen._count_a8w8(1)
             L = len(req.prompt)
             self._mgr.allocate(("slot", i), L)
             tables = self._mgr.block_tables([("slot", i)],

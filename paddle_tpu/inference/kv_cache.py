@@ -34,6 +34,14 @@ class BlockKVCacheManager:
         self.head_dim = head_dim
         self.page_size = page_size
         self.num_pages = num_pages
+        # dtype: pool element type ("bfloat16"/"float32" strings are
+        # normalized; "int8"/jnp.int8 selects the QUANTIZED cache-KV
+        # mode below). Orthogonal to the engines' weight quantization —
+        # quant="int8"/"a8w8" changes the matmul path, not the pool, so
+        # any (quant, kv_dtype) pair composes (the bench's best rung is
+        # int8 weights + int8 KV at b64).
+        if isinstance(dtype, str) and dtype != "int8":
+            dtype = jnp.dtype(dtype)
         self.dtype = dtype
         # reserve_scratch: page 0 is never handed out, so block-table
         # padding entries (0) and idle continuous-batching slots can

@@ -19,6 +19,18 @@ dynamic-slice operand to a custom call would copy the whole layer).
 Reference comparator: the fused weight-only GEMV/GEMM serving kernels
 (paddle/phi/kernels/fusion/gpu/fused_weight_only_linear_pass &
 masked_multihead_attention's surrounding fused_multi_transformer step).
+
+A8W8 mode (``act_quant=True``): activations are dynamically quantized
+per token (absmax -> int8 + fp32 scale, quantization/dynamic.py) ahead
+of the GEMM, the kernel computes the [M, K] x [K, bn] dot int8 x int8
+with **int32 MXU accumulation**, and the accumulator is dequantized
+ONCE with ``act_scale (x) per-output-channel weight_scale`` (bias added
+post-dequant). This removes the int8->bf16 weight convert from the
+streamed read AND keeps the skinny matmul's math on the int8 MXU —
+the missing half of the reference's full-int8 serving matmuls
+(fused_multi_transformer_int8_op.cu quantize/dequant rounds around its
+int8 GEMMs). Off-TPU / ragged shapes fall back to the same math via
+``lax.dot_general(..., preferred_element_type=int32)``.
 """
 from __future__ import annotations
 
@@ -33,6 +45,10 @@ __all__ = ["stream_linear"]
 
 _TARGET_BLOCK_BYTES = 4 << 20
 
+#: int8 VMEM tiles are (32, 128) — the quantized-activation block is
+#: padded up to this sublane multiple before entering the kernel
+_INT8_SUBLANES = 32
+
 
 def _pick_bn(K: int, N: int, itemsize: int) -> int:
     """Largest 128-multiple divisor of N whose [K, bn] block is a few
@@ -45,13 +61,129 @@ def _pick_bn(K: int, N: int, itemsize: int) -> int:
     return best
 
 
+def _apply_activation(acc, activation):
+    if activation == "gelu":
+        return jax.nn.gelu(acc)
+    if activation == "relu":
+        return jax.nn.relu(acc)
+    return acc
+
+
+def _stream_linear_a8w8(x_q, x_scale, w3, s3, b3, layer, activation,
+                        out_dtype, interpret=None):
+    """int8-activation streaming kernel: x_q [M, K] int8 (+ per-token
+    scales [M] f32) against stacked int8 weights w3 [L, K, N] with
+    per-output-channel dequant scales s3 [L, 1, N] (b3 [L, 1, N] bias
+    or None). One [M, K] x [K, bn] int8 MXU dot per weight block,
+    int32 accumulator dequantized in-kernel by
+    ``x_scale[:, None] * s3`` — the weight stream stays int8 end to
+    end. Runs in Pallas interpret mode off-TPU so CPU CI pins the
+    kernel's numerics (tests/test_stream_linear_a8w8.py)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, K = x_q.shape
+    N = w3.shape[-1]
+    bn = _pick_bn(K, N, 1)
+    if interpret is None:
+        interpret = not _on_tpu()
+    # pad the (tiny) activation block up to the int8 sublane tile
+    Mp = -(-M // _INT8_SUBLANES) * _INT8_SUBLANES
+    if Mp != M:
+        x_q = jnp.pad(x_q, ((0, Mp - M), (0, 0)))
+        x_scale = jnp.pad(x_scale, (0, Mp - M))
+    xs2 = x_scale.reshape(Mp, 1).astype(jnp.float32)
+    has_bias = b3 is not None
+    nb = N // bn
+    lidx = jnp.reshape(jnp.asarray(0 if layer is None else layer,
+                                   jnp.int32), (1,))
+
+    def kernel(l_ref, x_ref, xs_ref, w_ref, s_ref, *rest):
+        del l_ref
+        b_ref = rest[0] if has_bias else None
+        o_ref = rest[-1]
+        acc = jax.lax.dot_general(
+            x_ref[...], w_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)          # [Mp, bn] int32
+        acc = acc.astype(jnp.float32) * xs_ref[...] \
+            * s_ref[0].astype(jnp.float32)
+        if b_ref is not None:
+            acc = acc + b_ref[0].astype(jnp.float32)
+        acc = _apply_activation(acc, activation)
+        o_ref[...] = acc.astype(o_ref.dtype)
+
+    in_specs = [
+        pl.BlockSpec((Mp, K), lambda j, l: (0, 0)),
+        pl.BlockSpec((Mp, 1), lambda j, l: (0, 0)),
+        pl.BlockSpec((1, K, bn), lambda j, l: (l[0], 0, j)),
+        pl.BlockSpec((1, 1, bn), lambda j, l: (l[0], 0, j)),
+    ]
+    operands = [x_q, xs2, w3, s3]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, bn),
+                                     lambda j, l: (l[0], 0, j)))
+        operands.append(b3)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((Mp, bn), lambda j, l: (0, j)),
+        scratch_shapes=[])
+    with _enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((Mp, N), out_dtype),
+            compiler_params=_pltpu_compiler_params(pltpu)(
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=interpret,
+        )(lidx, *operands)
+    return out[:M] if Mp != M else out
+
+
+def _stream_linear_act_quant(x, w, layer, bias, scale, activation,
+                             out_dtype, *, stacked):
+    """A8W8 dispatch: dynamic per-token act quant, then the streaming
+    int8 x int8 kernel on TPU (clean geometry) or the XLA
+    ``preferred_element_type=int32`` dot everywhere else — identical
+    math, so CPU serving tests exercise the same numerics the chip
+    runs."""
+    from ...quantization.dynamic import dynamic_act_quant
+
+    K = x.shape[1]
+    N = w.shape[-1]
+    x_q, x_s = dynamic_act_quant(x)
+    if _on_tpu() and _pick_bn(K, N, 1) and K % 128 == 0:
+        w3 = w if stacked else w[None]
+        s3 = (scale if stacked else scale[None]) \
+            .reshape(w3.shape[0], 1, N).astype(jnp.float32)
+        b3 = None
+        if bias is not None:
+            b3 = (bias if stacked else bias[None]) \
+                .reshape(w3.shape[0], 1, N).astype(jnp.float32)
+        return _stream_linear_a8w8(x_q, x_s, w3, s3, b3, layer,
+                                   activation, out_dtype)
+    from ...quantization.dynamic import int8_dot_dequant
+
+    wl = w[layer] if stacked else w
+    out = int8_dot_dequant(
+        x_q, x_s, wl, (scale[layer] if stacked else scale),
+        bias=(bias[layer] if stacked else bias)
+        if bias is not None else None)
+    return _apply_activation(out, activation).astype(out_dtype)
+
+
 def stream_linear(x, w, layer=None, bias=None, scale=None,
-                  activation=None, out_dtype=None):
+                  activation=None, out_dtype=None, act_quant=False):
     """x [M, K] @ w[(L,) K, N] (+ bias) with streamed weights.
 
     layer: traced int32 index when w/bias/scale are layer-stacked.
     scale: int8 weight-only per-output-channel dequant scales [(L,) N].
     activation: None | 'gelu' | 'relu', fused on the f32 accumulator.
+    act_quant: A8W8 — dynamically quantize x per token (absmax int8 +
+    f32 scale) and run the GEMM int8 x int8 with int32 accumulation;
+    requires int8 ``w`` with per-output-channel ``scale``.
     Returns [M, N] in out_dtype (default: x.dtype).
     """
     from jax.experimental import pallas as pl
@@ -61,6 +193,14 @@ def stream_linear(x, w, layer=None, bias=None, scale=None,
     stacked = w.ndim == 3
     N = w.shape[-1]
     out_dtype = out_dtype or x.dtype
+    if act_quant:
+        if w.dtype != jnp.int8 or scale is None:
+            raise ValueError(
+                "stream_linear(act_quant=True) needs int8 weights with "
+                "per-output-channel scales (quantize_weight_only_int8)")
+        return _stream_linear_act_quant(
+            x, w, layer, bias, scale, activation, out_dtype,
+            stacked=stacked)
     bn = _pick_bn(K, N, w.dtype.itemsize)
     if bn == 0 or M % 8 != 0 or K % 128 != 0 or not _on_tpu():
         # fallback: plain XLA dot (CPU tests, odd shapes)
@@ -73,10 +213,7 @@ def stream_linear(x, w, layer=None, bias=None, scale=None,
             out = out * (scale[layer] if stacked else scale)
         if bias is not None:
             out = out + (bias[layer] if stacked else bias)
-        if activation == "gelu":
-            out = jax.nn.gelu(out)
-        elif activation == "relu":
-            out = jax.nn.relu(out)
+        out = _apply_activation(out, activation)
         return out.astype(out_dtype)
 
     nb = N // bn

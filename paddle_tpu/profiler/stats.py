@@ -37,6 +37,10 @@ Conventions for the built-in instrumentation (all optional reading):
 - ``jit.{trace,cache_hit}``    to_static program-cache outcomes
 - ``autograd.{sweeps,nodes}``  run_backward sweeps and executed nodes
 - ``inference.*`` / ``serving.*``  pool sizes, decode steps
+- ``quant.{act_quant_calls,a8w8_matmuls}``  executed dynamic
+  activation-quant ops / int8 x int8 serving matmuls (A8W8 decode,
+  QuantedLinear(a8w8=True)) — counted at the dispatch layer, since
+  inside a traced program the quant body runs once per compile
 - ``dist.<op>.{calls,bytes}``  collective op counts and payload bytes
 - ``roofline.*``               achieved FLOP/s / bytes/s / MFU / BW
   utilization vs device peaks (profiler/roofline.py)
@@ -66,7 +70,8 @@ __all__ = [
 #: starts with one of these
 CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
-    "inference.", "serving.", "dist.", "roofline.", "hbm.", "t.",
+    "inference.", "serving.", "quant.", "dist.", "roofline.", "hbm.",
+    "t.",
 )
 
 _ENABLED = True

@@ -19,6 +19,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 
+from .dynamic import dynamic_act_quant, int8_dot_dequant
 from .factory import (ClassWithArguments, ObserverFactory, QuanterFactory,
                       instantiate, observer, quanter)
 
@@ -27,7 +28,7 @@ __all__ = [
     "MovingAverageObserver", "QuantedLinear", "FakeQuant", "quant_dequant",
     "BaseObserver", "BaseQuanter", "QuanterFactory", "ObserverFactory",
     "quanter", "observer", "FakeQuanterWithAbsMaxObserver",
-    "post_training_quantize",
+    "post_training_quantize", "dynamic_act_quant", "int8_dot_dequant",
 ]
 
 
@@ -248,12 +249,20 @@ class _ObservedLinear(Layer):
 
 
 class QuantedLinear(Layer):
-    """Deployed weight-only-int8 Linear: int8 weights + fp scale,
-    dequantized into the matmul (reference: the int8 path of
-    quantization-converted Linear; TPU-idiomatic weight-only form)."""
+    """Deployed int8 Linear: int8 weights + fp scale. Two execution
+    modes (reference: the int8 path of quantization-converted Linear):
+
+    - weight-only (default): int8 weights dequantized into the matmul —
+      HBM traffic halves, MXU math stays float (TPU-idiomatic form);
+    - ``a8w8=True``: activations dynamically quantized per token
+      (``dynamic_act_quant``) into an int8 x int8 matmul with int32
+      accumulation and one accumulator dequant — the deployment shape
+      of the reference's fused_multi_transformer_int8 serving matmuls.
+    """
 
     def __init__(self, float_linear, wt_scale: float,
-                 act_scale: Optional[float] = None, bits: int = 8):
+                 act_scale: Optional[float] = None, bits: int = 8,
+                 a8w8: bool = False):
         super().__init__()
         w = float_linear.weight._data
         qmax = 2 ** (bits - 1) - 1
@@ -263,9 +272,24 @@ class QuantedLinear(Layer):
         self.act_scale = act_scale
         self.bias = float_linear.bias
         self.bits = bits
+        self.a8w8 = bool(a8w8)
 
     def forward(self, x):
         xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        if self.a8w8:
+            from ..profiler import stats as _stats
+
+            from .dynamic import dynamic_act_quant, int8_dot_dequant
+
+            _stats.inc("quant.act_quant_calls")
+            _stats.inc("quant.a8w8_matmuls")
+            xq, xs = dynamic_act_quant(xd)
+            out = int8_dot_dequant(
+                xq, xs, self.w_int,
+                jnp.asarray(self.wt_scale, jnp.float32),
+                bias=None if self.bias is None else self.bias._data,
+                out_dtype=xd.dtype)
+            return Tensor(out)
         w = self.w_int.astype(xd.dtype) * jnp.asarray(self.wt_scale,
                                                       xd.dtype)
         out = xd @ w
@@ -299,14 +323,17 @@ class PTQ:
                 self.quantize(child, qual)
         return model
 
-    def convert(self, model: Layer) -> Layer:
+    def convert(self, model: Layer, a8w8: bool = False) -> Layer:
+        """Deploy observed layers as QuantedLinear. ``a8w8=True`` emits
+        dynamic-activation int8 x int8 layers instead of weight-only
+        (the static ``act_obs`` scale is still recorded for audits)."""
         for name, child in list(model.named_children()):
             if isinstance(child, _ObservedLinear):
                 model.add_sublayer(name, QuantedLinear(
                     child.inner, child.wt_obs.scale(),
-                    child.act_obs.scale()))
+                    child.act_obs.scale(), a8w8=a8w8))
             else:
-                self.convert(child)
+                self.convert(child, a8w8=a8w8)
         return model
 
 

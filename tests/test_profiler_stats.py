@@ -309,6 +309,11 @@ class TestNamingConventions:
         memory.sample()
         roofline.record_program("roofline.lint", flops=1.0,
                                 bytes_accessed=1.0)
+        # the A8W8 serving counters (engine dispatch layer +
+        # QuantedLinear(a8w8=True)) live in their own namespace
+        assert "quant." in stats.CONVENTION_PREFIXES
+        stats.inc("quant.act_quant_calls")
+        stats.inc("quant.a8w8_matmuls")
 
         names = (list(stats._COUNTERS) + list(stats._GAUGES)
                  + list(stats._HISTOGRAMS))

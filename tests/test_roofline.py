@@ -293,6 +293,30 @@ class TestBenchGate:
         bad, _ = bench_gate.gate(prev, cold)
         assert any("vjp_cache_hit_rate" in b for b in bad)
 
+    def test_root_scalar_serving_rungs_gate(self):
+        """decode_*_tokens_per_sec / *_pct_of_hbm_roofline live at the
+        bench JSON root (no telemetry block) — the gate must still
+        catch a throughput collapse there, direction 'down'."""
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import bench_gate
+        finally:
+            sys.path.pop(0)
+        prev = {"decode_a8w8_tokens_per_sec": 5000.0,
+                "decode_a8w8_pct_of_hbm_roofline": 52.0}
+        ok = {"decode_a8w8_tokens_per_sec": 5100.0,
+              "decode_a8w8_pct_of_hbm_roofline": 53.0}
+        bad_doc = {"decode_a8w8_tokens_per_sec": 3000.0,
+                   "decode_a8w8_pct_of_hbm_roofline": 30.0}
+        bad, n = bench_gate.gate(prev, ok)
+        assert n >= 2 and bad == []
+        bad, _ = bench_gate.gate(prev, bad_doc)
+        assert any("decode_a8w8_tokens_per_sec" in b for b in bad)
+        assert any("decode_a8w8_pct_of_hbm_roofline" in b for b in bad)
+        # a FASTER run must not trip the 'down' gate
+        bad, _ = bench_gate.gate(bad_doc, prev)
+        assert bad == []
+
     def test_cli_round_trip(self, tmp_path):
         sys.path.insert(0, os.path.join(_REPO, "tools"))
         try:

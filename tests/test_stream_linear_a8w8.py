@@ -1,0 +1,277 @@
+"""A8W8 stream_linear parity: the int8-activation streaming kernel (and
+its XLA fallback) vs an fp32 reference, on CPU.
+
+Like tests/test_paged_backends.py's stream-kernel tests, the Pallas
+kernel runs in interpret mode off-TPU so CPU CI pins the exact numerics
+the chip compiles. The fp32 reference is ``x @ dequant(w) + bias`` —
+the only error the A8W8 path may add over it is the per-token dynamic
+activation quantization, bounded elementwise by
+
+    |out - ref| <= 0.5 * act_scale(row) * sum_k |w_dequant[k, n]|
+
+(round-to-nearest symmetric int8; see quantization/dynamic.py), which
+is the tolerance every assertion below derives — not a magic atol.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.functional.stream_linear import (_stream_linear_a8w8,
+                                                    stream_linear)
+from paddle_tpu.quantization.dynamic import (dynamic_act_quant,
+                                             int8_dot_dequant)
+
+
+def _quantize_weights(rng, L, K, N):
+    """Weight-only int8 per-output-channel quantization (the engine's
+    quantize_weight_only_int8 layout): wq [L, K, N] int8, s [L, N]."""
+    w = rng.randn(L, K, N).astype(np.float32)
+    s = np.maximum(np.abs(w).max(axis=1) / 127.0, 1e-8)
+    wq = np.clip(np.round(w / s[:, None, :]), -127, 127).astype(np.int8)
+    return wq, s
+
+
+def _dynamic_quant_bound(x, w_deq):
+    """Documented elementwise error bound of the dynamic act quant:
+    0.5 * act_scale per element through the K-long dot columns."""
+    x_s = np.maximum(np.abs(np.asarray(x, np.float32)).max(-1) / 127.0,
+                     1e-8)                               # [M]
+    col_abs = np.abs(w_deq).sum(axis=0)                  # [N]
+    return 0.5 * x_s[:, None] * col_abs[None, :] + 1e-2
+
+
+class TestDynamicActQuant:
+    def test_roundtrip_error_bound_and_range(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 64).astype(np.float32) * 10.0
+        q, s = dynamic_act_quant(jnp.asarray(x))
+        qn, sn = np.asarray(q), np.asarray(s)
+        assert qn.dtype == np.int8
+        assert qn.min() >= -127 and qn.max() <= 127
+        np.testing.assert_allclose(
+            sn, np.abs(x).max(-1) / 127.0, rtol=1e-6)
+        err = np.abs(qn.astype(np.float32) * sn[:, None] - x)
+        assert (err <= 0.5 * sn[:, None] + 1e-6).all()
+
+    def test_zero_row_is_finite(self):
+        """absmax-0 row: the eps floor must give q=0 with a finite
+        scale, and the matmul output must be exactly 0 for that row."""
+        x = np.zeros((4, 32), np.float32)
+        x[1] = 1.0
+        q, s = dynamic_act_quant(jnp.asarray(x))
+        assert np.isfinite(np.asarray(s)).all()
+        assert (np.asarray(q)[0] == 0).all()
+        w = jnp.ones((32, 8), jnp.int8)
+        out = int8_dot_dequant(q, s, w, jnp.full((8,), 0.01))
+        assert (np.asarray(out)[0] == 0).all()
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_saturation_worst_case(self):
+        """A row holding one huge outlier + tiny values: the small
+        values collapse toward 0 (the documented accuracy caveat of
+        per-token quant) but the bound still holds and nothing clips
+        past +-127."""
+        rng = np.random.RandomState(3)
+        x = rng.randn(8, 128).astype(np.float32) * 0.01
+        x[:, 0] = 1000.0  # absmax -> scale ~7.87, small values -> 0
+        q, s = dynamic_act_quant(jnp.asarray(x))
+        qn = np.asarray(q)
+        assert qn[:, 0].max() <= 127 and (np.abs(qn) <= 127).all()
+        wq, ws = _quantize_weights(rng, 1, 128, 256)
+        w_deq = wq[0].astype(np.float32) * ws[0]
+        out = int8_dot_dequant(q, s, jnp.asarray(wq[0]),
+                               jnp.asarray(ws[0]))
+        ref = x @ w_deq
+        bound = _dynamic_quant_bound(x, w_deq)
+        assert (np.abs(np.asarray(out) - ref) <= bound).all()
+
+
+class TestKernelParity:
+    """Interpret-mode Pallas kernel vs fp32 reference + vs the XLA
+    int8 fallback (identical quantized math -> near-bitwise)."""
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("with_bias", [True, False])
+    def test_kernel_matches_fp32_reference(self, dtype, with_bias):
+        rng = np.random.RandomState(1)
+        M, K, N, L = 8, 256, 384, 2
+        wq, ws = _quantize_weights(rng, L, K, N)
+        bias = rng.randn(L, N).astype(np.float32) if with_bias else None
+        x = jnp.asarray(rng.randn(M, K).astype(np.float32)) \
+            .astype(dtype)
+        xq, xs = dynamic_act_quant(x)
+        for layer in range(L):
+            out = _stream_linear_a8w8(
+                xq, xs, jnp.asarray(wq),
+                jnp.asarray(ws).reshape(L, 1, N),
+                None if bias is None
+                else jnp.asarray(bias).reshape(L, 1, N),
+                jnp.asarray(layer, jnp.int32), None, jnp.float32,
+                interpret=True)
+            w_deq = wq[layer].astype(np.float32) * ws[layer]
+            ref = np.asarray(x, np.float32) @ w_deq
+            if bias is not None:
+                ref = ref + bias[layer]
+            bound = _dynamic_quant_bound(np.asarray(x, np.float32),
+                                         w_deq)
+            assert (np.abs(np.asarray(out) - ref) <= bound).all(), \
+                f"layer {layer} exceeded the dynamic-quant bound"
+
+    def test_kernel_matches_xla_fallback_bitwise_scale(self):
+        """Kernel and XLA int8 fallback share the quantized operands:
+        outputs must agree to float32 rounding, for every M the engine
+        emits (incl. the sublane-padded M=8 and unpadded M=32)."""
+        rng = np.random.RandomState(2)
+        K, N, L = 128, 256, 1
+        wq, ws = _quantize_weights(rng, L, K, N)
+        bias = rng.randn(L, N).astype(np.float32)
+        for M in (8, 32):
+            x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+            xq, xs = dynamic_act_quant(x)
+            out_k = _stream_linear_a8w8(
+                xq, xs, jnp.asarray(wq),
+                jnp.asarray(ws).reshape(L, 1, N),
+                jnp.asarray(bias).reshape(L, 1, N), None, None,
+                jnp.float32, interpret=True)
+            out_x = int8_dot_dequant(xq, xs, jnp.asarray(wq[0]),
+                                     jnp.asarray(ws[0]),
+                                     bias=jnp.asarray(bias[0]))
+            np.testing.assert_allclose(np.asarray(out_k),
+                                       np.asarray(out_x),
+                                       rtol=1e-5, atol=1e-4)
+
+    def test_activation_fusion(self):
+        rng = np.random.RandomState(4)
+        K, N = 128, 128
+        wq, ws = _quantize_weights(rng, 1, K, N)
+        x = jnp.asarray(rng.randn(8, K).astype(np.float32))
+        xq, xs = dynamic_act_quant(x)
+        for act, f in (("relu", lambda a: np.maximum(a, 0)),
+                       ("gelu", lambda a: np.asarray(
+                           jax.nn.gelu(jnp.asarray(a))))):
+            out = _stream_linear_a8w8(
+                xq, xs, jnp.asarray(wq),
+                jnp.asarray(ws).reshape(1, 1, N), None, None, act,
+                jnp.float32, interpret=True)
+            base = int8_dot_dequant(xq, xs, jnp.asarray(wq[0]),
+                                    jnp.asarray(ws[0]))
+            np.testing.assert_allclose(np.asarray(out),
+                                       f(np.asarray(base)),
+                                       rtol=1e-5, atol=1e-4)
+
+
+class TestPublicPathA8W8:
+    """The public stream_linear(act_quant=True) — the exact call the
+    decode loop emits — across stacked/unstacked and ragged K/N (the
+    shapes that must take the XLA int8 fallback)."""
+
+    @pytest.mark.parametrize("K,N", [(96, 80), (130, 257), (128, 128)])
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_ragged_and_aligned_shapes(self, K, N, dtype):
+        rng = np.random.RandomState(5)
+        wq, ws = _quantize_weights(rng, 1, K, N)
+        bias = rng.randn(N).astype(np.float32)
+        x = jnp.asarray(rng.randn(6, K).astype(np.float32)) \
+            .astype(dtype)
+        out = stream_linear(x, jnp.asarray(wq[0]),
+                            bias=jnp.asarray(bias),
+                            scale=jnp.asarray(ws[0]), act_quant=True,
+                            out_dtype=jnp.float32)
+        w_deq = wq[0].astype(np.float32) * ws[0]
+        ref = np.asarray(x, np.float32) @ w_deq + bias
+        bound = _dynamic_quant_bound(np.asarray(x, np.float32), w_deq)
+        assert out.dtype == jnp.float32
+        assert (np.abs(np.asarray(out) - ref) <= bound).all()
+
+    def test_stacked_traced_layer_index(self):
+        """Layer-stacked weights with a TRACED index under jit — the
+        decode loop's form."""
+        rng = np.random.RandomState(6)
+        L, K, N = 3, 128, 128
+        wq, ws = _quantize_weights(rng, L, K, N)
+        bias = rng.randn(L, N).astype(np.float32)
+        x = jnp.asarray(rng.randn(8, K).astype(np.float32))
+
+        @jax.jit
+        def f(l, x):
+            return stream_linear(x, jnp.asarray(wq), layer=l,
+                                 bias=jnp.asarray(bias),
+                                 scale=jnp.asarray(ws), act_quant=True,
+                                 out_dtype=jnp.float32)
+
+        for l in range(L):
+            out = f(jnp.asarray(l, jnp.int32), x)
+            w_deq = wq[l].astype(np.float32) * ws[l]
+            ref = np.asarray(x) @ w_deq + bias[l]
+            bound = _dynamic_quant_bound(np.asarray(x), w_deq)
+            assert (np.abs(np.asarray(out) - ref) <= bound).all()
+
+    def test_act_quant_requires_int8_weights_and_scales(self):
+        x = jnp.ones((4, 16))
+        w_f = jnp.ones((16, 8))
+        w_q = jnp.ones((16, 8), jnp.int8)
+        with pytest.raises(ValueError, match="int8 weights"):
+            stream_linear(x, w_f, act_quant=True)
+        with pytest.raises(ValueError, match="scales"):
+            stream_linear(x, w_q, act_quant=True)
+
+    def test_decode_raw_rejects_float_stack(self):
+        from paddle_tpu.incubate.nn.fused_transformer import (
+            FusedMultiTransformer, PagedKV, rope_table)
+
+        paddle.seed(0)
+        st = FusedMultiTransformer(32, 4, 64, 1, max_position=64)
+        cos, sin = rope_table(64, st.head_dim)
+        cache = PagedKV(jnp.zeros((4, 4, 4, 8)), jnp.zeros((4, 4, 4, 8)))
+        with pytest.raises(ValueError, match="int8 weight stack"):
+            st.decode_raw(st._stack(), jnp.ones((2, 32)), cache,
+                          jnp.zeros((2, 2), jnp.int32),
+                          jnp.zeros((2,), jnp.int32), cos, sin,
+                          a8w8=True)
+
+
+class TestQuantedLinearA8W8:
+    def test_forward_matches_bound_and_counts(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.profiler import stats
+        from paddle_tpu.quantization import QuantedLinear
+
+        paddle.seed(1)
+        lin = nn.Linear(32, 16)
+        w = lin.weight._data
+        wt_scale = float(jnp.abs(w).max() / 127.0)
+        q = QuantedLinear(lin, wt_scale, a8w8=True)
+        x = np.random.RandomState(7).randn(4, 32).astype(np.float32)
+        before = stats.counter("quant.a8w8_matmuls").value
+        out = q(paddle.to_tensor(x)).numpy()
+        assert stats.counter("quant.a8w8_matmuls").value == before + 1
+        w_deq = np.asarray(q.w_int, np.float32) * wt_scale
+        ref = x @ w_deq + np.asarray(lin.bias._data)
+        bound = _dynamic_quant_bound(x, w_deq)
+        assert (np.abs(out - ref) <= bound).all()
+
+    def test_ptq_convert_a8w8(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import PTQ, QuantedLinear
+
+        paddle.seed(2)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        net = PTQ().quantize(Net())
+        x = paddle.to_tensor(
+            np.random.RandomState(8).randn(2, 8).astype(np.float32))
+        net(x)  # calibrate
+        net = PTQ().convert(net, a8w8=True)
+        assert isinstance(net.fc, QuantedLinear) and net.fc.a8w8
+        out = net(x).numpy()
+        assert np.isfinite(out).all()

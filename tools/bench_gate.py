@@ -20,7 +20,9 @@ Exits nonzero when any gated metric regressed by more than ``--tol``
 Telemetry blocks are discovered anywhere in the JSON under keys named
 ``telemetry`` / ``*_telemetry`` (bench.py nests one per rung;
 op_bench.py keeps one at top level) and same-named blocks are compared
-pairwise.
+pairwise. The document ROOT is additionally treated as a block so the
+serving rungs' top-level scalars (``decode_a8w8_tokens_per_sec``,
+``decode_*_pct_of_hbm_roofline``, ...) gate too.
 """
 from __future__ import annotations
 
@@ -56,6 +58,16 @@ DEFAULT_METRICS: Dict[str, str] = {
     "compile.vjp_build_us": "up",
     "compile.fwd_trace_us": "up",
     "compile.jit_build_us": "up",
+    # serving decode rungs: top-level scalars of the bench JSON (the
+    # gate compares the document root as its own block) — throughput
+    # and %-of-roofline regress DOWN
+    "decode_tokens_per_sec": "down",
+    "decode_pct_of_hbm_roofline": "down",
+    "decode_int8_tokens_per_sec": "down",
+    "decode_int8_pct_of_hbm_roofline": "down",
+    "decode_a8w8_tokens_per_sec": "down",
+    "decode_a8w8_pct_of_hbm_roofline": "down",
+    "decode_int8kv_b64_tokens_per_sec": "down",
 }
 
 #: absolute-change floors so tiny counts/latencies don't trip the
@@ -78,6 +90,24 @@ def extract_telemetry(doc: dict, prefix: str = "") -> Dict[str, dict]:
                 out[path] = v
             else:
                 out.update(extract_telemetry(v, path))
+    return out
+
+
+def _scalar_blocks(doc: dict, metrics: Dict[str, str],
+                   prefix: str = "") -> Dict[str, dict]:
+    """Dicts anywhere in the JSON that carry a gated metric as a direct
+    scalar key (bench.py's serving rungs live at the document root, or
+    under a ``parsed`` wrapper in archived BENCH_r*.json files)."""
+    out: Dict[str, dict] = {}
+    if not isinstance(doc, dict):
+        return out
+    if any(isinstance(doc.get(m), (int, float)) for m in metrics):
+        out[prefix or "<root>"] = doc
+    for k, v in doc.items():
+        if isinstance(v, dict) and k != "telemetry" \
+                and not k.endswith("_telemetry"):
+            out.update(_scalar_blocks(
+                v, metrics, f"{prefix}.{k}" if prefix else k))
     return out
 
 
@@ -115,6 +145,13 @@ def gate(prev_doc: dict, cur_doc: dict,
     metrics = metrics or DEFAULT_METRICS
     prev_blocks = extract_telemetry(prev_doc)
     cur_blocks = extract_telemetry(cur_doc)
+    # scalar rung metrics (decode_*_tokens_per_sec, *_pct_of_hbm_
+    # roofline) live OUTSIDE telemetry blocks — gate the dicts that
+    # carry them too, so a throughput collapse fails as loudly
+    for name, blk in _scalar_blocks(prev_doc, metrics).items():
+        prev_blocks.setdefault(name, blk)
+    for name, blk in _scalar_blocks(cur_doc, metrics).items():
+        cur_blocks.setdefault(name, blk)
     bad: List[str] = []
     compared = 0
     for path in sorted(set(prev_blocks) & set(cur_blocks)):

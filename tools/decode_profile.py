@@ -59,7 +59,8 @@ def time_chunk(fn, args, steps=3):
     return (time.perf_counter() - t0) / steps
 
 
-def mode_full(cache_dtype="float32", attn="pallas", bf16_embed=False):
+def mode_full(cache_dtype="float32", attn="pallas", bf16_embed=False,
+              quant=None):
     """Current engine path end-to-end (greedy, chunk=64)."""
     import jax.numpy as jnp
 
@@ -68,7 +69,7 @@ def mode_full(cache_dtype="float32", attn="pallas", bf16_embed=False):
     model = build(bf16_embed=bf16_embed)
     eng = GenerationEngine(model, page_size=PAGE,
                            max_length=PROMPT + CHUNK + 2,
-                           decode_chunk=CHUNK)
+                           decode_chunk=CHUNK, quant=quant)
     if attn == "xla":
         import paddle_tpu as _p
 
@@ -522,9 +523,10 @@ def mode_xla_paged_attn(batch=32, dtype="bfloat16"):
 def mode_engine_full(batch=32, backend=None, quant=None, kv=None):
     """Current engine end-to-end at the given batch (bf16 stack; the
     engine derives bf16 compute + bf16 KV from the weight dtype).
-    backend forces FLAGS_paged_attention_backend; quant='int8' applies
-    weight-only int8 to the stack (the bench's int8 rung); kv='int8'
-    additionally quantizes the KV cache (cache-KV int8 mode)."""
+    backend forces FLAGS_paged_attention_backend; quant='int8' runs
+    weight-only int8 (the bench's int8 rung), quant='a8w8' the full
+    dynamic-activation int8 x int8 matmul path; kv='int8' additionally
+    quantizes the KV cache (cache-KV int8 mode)."""
     import paddle_tpu as paddle
 
     if backend:
@@ -537,22 +539,12 @@ def mode_engine_full(batch=32, backend=None, quant=None, kv=None):
             kw.setdefault("kv_dtype", "int8")
             orig_ginit(self, *a, **kw)
         _GE.__init__ = ginit
-    if quant == "int8":
-        orig_build = globals()["build"]
-
-        def build_q(*a, **kw):
-            model = orig_build(*a, **kw)
-            model.stack.quantize_weight_only_int8()
-            return model
-        globals()["build"] = build_q
     global BATCH
     old, BATCH = BATCH, batch
     try:
-        return mode_full()
+        return mode_full(quant=quant)
     finally:
         BATCH = old
-        if quant == "int8":
-            globals()["build"] = orig_build
 
 
 def mode_stream_attn(batch=32, dtype="bfloat16"):
@@ -712,6 +704,15 @@ MODES = {
         lambda: mode_engine_full(64, quant="int8", kv="int8"),
     "engine_int8_stream_b32":
         lambda: mode_engine_full(32, backend="stream", quant="int8"),
+    # A8W8 ablation rows: dynamic-act int8 x int8 matmuls vs the
+    # weight-only rungs above (same geometry — the delta IS the
+    # activation-dequant round the a8w8 kernel removes)
+    "engine_a8w8_b32": lambda: mode_engine_full(32, quant="a8w8"),
+    "engine_a8w8_b64": lambda: mode_engine_full(64, quant="a8w8"),
+    "engine_a8w8kv8_b32":
+        lambda: mode_engine_full(32, quant="a8w8", kv="int8"),
+    "engine_a8w8kv8_b64":
+        lambda: mode_engine_full(64, quant="a8w8", kv="int8"),
     "engine_int8_noattn_b32":
         lambda: mode_engine_knockout(32, "attn", quant="int8"),
     "engine_int8_nohead_b32":

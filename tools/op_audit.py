@@ -122,7 +122,8 @@ COVERED_BY = {
     "weight_only_linear": "quantization.QuantedLinear (weight-only int8)",
     "weight_quantize": "quantization.PTQ.convert",
     "weight_dequantize": "QuantedLinear dequant-into-matmul",
-    "llm_int8_linear": "quantization.QuantedLinear",
+    "llm_int8_linear": "quantization.QuantedLinear (weight-only; a8w8=True runs per-token dynamic-act int8 x int8 with int32 accumulation) + the serving A8W8 stream_linear act-quant path (nn/functional/stream_linear.py — SURVEY Missing #2 closed)",
+    "fused_multi_transformer_int8_xpu": "the A8W8 decode path: quant=\"a8w8\" engines run dynamic-act int8 x int8 streamed matmuls (stream_linear) through the fused stack — the int8 serving semantics of fused_multi_transformer_int8_op.cu on the single XLA backend",
     "block_multihead_attention_": "nn/functional/paged_attention.py + ContinuousBatchingEngine",
     "masked_multihead_attention_": "FusedMultiTransformer.decode_raw",
     "fused_bias_act": "XLA fuses bias+activation (incubate fused_linear covers the API)",
