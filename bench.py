@@ -435,6 +435,25 @@ def _run_secondary(kind):
                           "decode_a8w8_pct_of_hbm_roofline": pct,
                           "decode_a8w8_roofline": cost_rl,
                           "decode_a8w8_telemetry": _telemetry()}))
+    elif kind == "--decode-bf16-grouped":
+        # GROUPED bf16 weight-stream decode (FLAGS_decode_grouped on +
+        # cross-layer prefetch): the fused O+LN2+FFN tail kernel plus
+        # in-tail next-layer QKV — ONE streamed call per layer.
+        # TPU targets for the next chip run (ISSUE r6 / VERDICT r5 #1):
+        #   - >= 50% of the weight-bandwidth roofline (vs 35% r5)
+        #   - >= ~5,000 tok/s at b32 bf16 (vs 3,490 r5)
+        #   - weights_only_grouped ablation <= 5 ms/step (vs 10.9 ms
+        #     against the 2.9 ms weight-read floor)
+        # gated by tools/bench_gate.py (direction "down").
+        import paddle_tpu as _p
+
+        _p.set_flags({"decode_grouped": "on", "decode_prefetch": True})
+        tps, pct, cost_rl = run_decode_bench()
+        print(json.dumps(
+            {"decode_bf16_grouped_tokens_per_sec": round(tps, 1),
+             "decode_bf16_grouped_pct_of_hbm_roofline": pct,
+             "decode_bf16_grouped_roofline": cost_rl,
+             "decode_bf16_grouped_telemetry": _telemetry()}))
     elif kind == "--decode-int8kv":
         # best-throughput serving config: int8 weights + int8 KV cache
         # (cache-KV quant pays once KV traffic rivals the weight
@@ -466,7 +485,8 @@ def main():
         _run_one(sys.argv[sys.argv.index("--config") + 1])
         return
     for kind in ("--decode", "--decode-int8", "--decode-a8w8",
-                 "--decode-int8kv", "--bert", "--s2048"):
+                 "--decode-bf16-grouped", "--decode-int8kv", "--bert",
+                 "--s2048"):
         if kind in sys.argv:
             _run_secondary(kind)
             return
@@ -506,7 +526,8 @@ def main():
         # secondary rungs each get a FRESH process (and a fresh chip —
         # the training rung's buffers die with its process)
         for kind in ("--s2048", "--decode", "--decode-int8",
-                     "--decode-a8w8", "--decode-int8kv", "--bert"):
+                     "--decode-a8w8", "--decode-bf16-grouped",
+                     "--decode-int8kv", "--bert"):
             # s2048's flash-attention bwd compile alone can take ~25min
             # cold (measured r5); the run itself is seconds
             extra, err = _sub([kind], 2400 if kind == "--s2048" else 1500)

@@ -62,6 +62,11 @@ from . import profiler  # noqa: E402
 from . import incubate  # noqa: E402
 from . import sparse  # noqa: E402
 from . import device  # noqa: E402
+
+# persistent XLA compilation cache (FLAGS_compile_cache_dir / env
+# PADDLE_TPU_COMPILE_CACHE_DIR): applied once at import, before any
+# program compiles
+device.setup_compile_cache()
 from . import framework  # noqa: E402
 from .framework.io import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402

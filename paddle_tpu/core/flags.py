@@ -77,10 +77,32 @@ define_flag("paged_attention_backend", "auto",
             "(r4 per-sequence page-DMA Pallas kernel, opt-in) | pallas "
             "(stock jax kernel via a layout transpose)")
 define_flag("decode_linear", "auto",
-            "decode matmul path: auto/xla (XLA dots over loop-sliced "
-            "stacked weights — measured fastest end-to-end, r5) | "
-            "stream (opt-in Pallas weight-streaming kernel, "
-            "nn/functional/stream_linear.py)")
+            "UNGROUPED decode matmul path (used when decode_grouped "
+            "is off): auto (stream for int8 weights, XLA dots over "
+            "loop-sliced stacked weights for bf16 — the r5 "
+            "measurement) | xla | stream (force the Pallas "
+            "weight-streaming kernel, nn/functional/stream_linear.py)")
+define_flag("decode_grouped", "auto",
+            "grouped decode weight streaming (fused O+LN2+FFN layer "
+            "tail + QKV, <=2 streamed matmul calls per layer — "
+            "nn/functional/stream_linear.py stream_layer_tail): auto "
+            "(grouped for bf16/f32/weight-only-int8 stacks; A8W8 "
+            "keeps the ungrouped int8 x int8 act-quant kernel) | on | "
+            "off")
+define_flag("decode_prefetch", True,
+            "cross-layer prefetch inside the grouped decode tail: "
+            "layer l+1's LN1+QKV projection runs as the tail kernel's "
+            "final grid phase, overlapping its weight DMA with layer "
+            "l's FFN compute; off = a separate streamed QKV call per "
+            "layer (2 streamed calls/layer instead of 1)")
+define_flag("compile_cache_dir",
+            os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR", ""),
+            "persistent XLA compilation-cache directory (also settable "
+            "via env PADDLE_TPU_COMPILE_CACHE_DIR): applied to "
+            "jax_compilation_cache_dir at import by "
+            "device.setup_compile_cache(), so recompiles of unchanged "
+            "programs (e.g. the 25-min s2048 flash-attention backward) "
+            "are served from disk across processes")
 define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
 define_flag("eager_fwd_cache", True,
             "no-grad eager dispatch through the signature-keyed "

@@ -20,10 +20,45 @@ __all__ = [
     "set_device", "get_device", "device_count", "current_place",
     "synchronize", "memory_stats", "memory_allocated",
     "max_memory_allocated", "memory_reserved", "max_memory_reserved",
-    "reset_peak_memory_stats", "empty_cache",
+    "reset_peak_memory_stats", "empty_cache", "setup_compile_cache",
     "Place", "CPUPlace", "TPUPlace", "is_compiled_with_tpu",
     "is_compiled_with_cuda", "is_compiled_with_xpu", "cuda", "tpu",
 ]
+
+
+def setup_compile_cache(path=None):
+    """Wire the persistent XLA compilation cache.
+
+    ``path`` (or ``FLAGS_compile_cache_dir`` / env
+    ``PADDLE_TPU_COMPILE_CACHE_DIR`` when omitted) becomes jax's
+    ``jax_compilation_cache_dir``: compiled executables are written to
+    disk and re-loaded by later processes, so a warm run skips the
+    multi-minute XLA compiles the cold run paid (the s2048 rung's
+    flash-attention backward alone measured ~25 min cold, r5).
+    Called automatically at ``import paddle_tpu``; call again after
+    ``set_flags({"FLAGS_compile_cache_dir": ...})`` to re-point it.
+    Returns the applied path, or None when no path is configured.
+    The ``compile.persistent_cache`` gauge records whether a cache dir
+    is active, so bench telemetry shows which regime — cold or
+    cache-warm — a compile-seconds histogram was measured under."""
+    from ..core.flags import flag
+    from ..profiler import stats as _stats
+
+    path = path or flag("compile_cache_dir")
+    if not path:
+        _stats.set_gauge("compile.persistent_cache", 0)
+        return None
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    # cache even fast-compiling programs: the decode/prefill serving
+    # programs are individually cheap but numerous, and CI correctness
+    # runs recompile them every process
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+    except AttributeError:  # older jax: flag absent — defaults apply
+        pass
+    _stats.set_gauge("compile.persistent_cache", 1)
+    return str(path)
 
 
 def _resolve(device=None) -> jax.Device:

@@ -31,6 +31,24 @@ from ...ops.dispatch import as_tensor_args, eager_apply
 __all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate", "ExpertFFN"]
 
 
+def _count_dropped(drop):
+    """Surface capacity-overflow drops on the EAGER path: bump the
+    ``moe.dropped_tokens`` stats counter with this forward's dropped
+    token->expert assignment count. The count is data-dependent (it
+    comes off the device), so it is only fetched while the registry is
+    enabled; inside a fully jit-compiled step the counter is not
+    updated (the traced body runs once per compile) — silent-drop
+    debugging is an eager/profiling activity."""
+    from ...profiler import stats as _stats
+
+    if not _stats.is_enabled():
+        return
+    arr = drop._data if isinstance(drop, Tensor) else drop
+    if isinstance(arr, jax.core.Tracer):
+        return  # under trace (TrainStep/jit): no per-execution count
+    _stats.inc("moe.dropped_tokens", int(float(np.asarray(arr))))
+
+
 class BaseGate(Layer):
     def __init__(self, d_model: int, num_experts: int, top_k: int):
         super().__init__()
@@ -183,7 +201,7 @@ class MoELayer(Layer):
             def body(x_loc, wg_, w1_loc, b1_loc, w2_loc, b2_loc):
                 xt = x_loc.reshape(-1, d)
                 probs = jax.nn.softmax(xt @ wg_, -1)
-                combine, dispatch, aux = _gshard_dispatch(
+                combine, dispatch, aux, drop = _gshard_dispatch(
                     probs, E, K, capacity)
                 exp_in = jnp.einsum("tec,td->ecd", dispatch, xt)
                 # [E, c, d] -> [E/ep, ep*c, d]: rows for MY experts from
@@ -196,17 +214,29 @@ class MoELayer(Layer):
                 back = jax.lax.all_to_all(out, axis, split_axis=1,
                                           concat_axis=0, tiled=True)
                 y = jnp.einsum("tec,ecd->td", combine, back)
-                return y.reshape(x_loc.shape), jax.lax.pmean(aux, axis)
+                return (y.reshape(x_loc.shape),
+                        jax.lax.pmean(aux, axis),
+                        jax.lax.psum(drop, axis))
 
-            return shard_map(
+            y, aux, drop = shard_map(
                 body, mesh=jmesh,
                 in_specs=(x_spec, P(), w_spec, w_spec, w_spec, w_spec),
-                out_specs=(x_spec, P()))(xa, wg, w1, b1, w2, b2)
+                out_specs=(x_spec, P(), P()))(xa, wg, w1, b1, w2, b2)
+            # zero-weight edge tying aux into the differentiated
+            # output: when a whole-step AD (TrainStep) never consumes
+            # aux, shard_map's transpose would otherwise receive a
+            # symbolic-Zero cotangent for it and psum can't transpose
+            # that (drop is int32 — non-differentiable by dtype — so
+            # it needs no edge); XLA folds the multiply away
+            y = y + (jnp.zeros((), y.dtype) * aux.astype(y.dtype))
+            return y, aux, drop
 
         tensors = as_tensor_args(x, self.gate.weight, st.w1, st.b1,
                                  st.w2, st.b2)
-        out, aux = eager_apply("moe_layer_ep", raw, tensors, n_outputs=2)
+        out, aux, drop = eager_apply("moe_layer_ep", raw, tensors,
+                                     n_outputs=3)
         self.aux_loss = aux * aux_w if aux_w else aux
+        _count_dropped(drop)
         return out
 
     def forward(self, x):
@@ -231,7 +261,7 @@ class MoELayer(Layer):
                 xt = xa.reshape(tokens, d)
                 logits = xt @ wg                               # [T, E]
                 probs = jax.nn.softmax(logits, -1)
-                combine, dispatch, aux = _gshard_dispatch(
+                combine, dispatch, aux, drop = _gshard_dispatch(
                     probs, E, K, capacity)
                 # dispatch: [T, E, C] → expert inputs [E, C, d]
                 exp_in = jnp.einsum("tec,td->ecd", dispatch, xt)
@@ -239,10 +269,12 @@ class MoELayer(Layer):
                 h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
                 exp_out = h @ w2 + b2                          # [E, C, d]
                 out = jnp.einsum("tec,ecd->td", combine, exp_out)
-                return out.reshape(xa.shape), aux
+                return out.reshape(xa.shape), aux, drop
 
-            out, aux = eager_apply("moe_layer", raw, tensors, n_outputs=2)
+            out, aux, drop = eager_apply("moe_layer", raw, tensors,
+                                         n_outputs=3)
             self.aux_loss = aux * aux_w if aux_w else aux
+            _count_dropped(drop)
             return out
 
         # generic per-expert path (heterogeneous experts); gate grads flow
@@ -252,13 +284,15 @@ class MoELayer(Layer):
         def raw_dispatch(xa, wg):
             logits = xa @ wg
             probs = jax.nn.softmax(logits, -1)
-            combine, dispatch, aux = _gshard_dispatch(probs, E, K, capacity)
+            combine, dispatch, aux, drop = _gshard_dispatch(
+                probs, E, K, capacity)
             exp_in = jnp.einsum("tec,td->ecd", dispatch, xa)
-            return exp_in, combine, aux
+            return exp_in, combine, aux, drop
 
-        exp_in_all, combine_t, aux = eager_apply(
+        exp_in_all, combine_t, aux, drop = eager_apply(
             "moe_dispatch", raw_dispatch,
-            as_tensor_args(xt, self.gate.weight), n_outputs=3)
+            as_tensor_args(xt, self.gate.weight), n_outputs=4)
+        _count_dropped(drop)
         outs = []
         for e, expert in enumerate(self.experts):
             outs.append(expert(exp_in_all[e]))
@@ -275,7 +309,14 @@ class MoELayer(Layer):
 
 def _gshard_dispatch(probs, E, K, capacity):
     """GShard top-K dispatch with capacity (pure jnp; differentiable
-    through the combine weights)."""
+    through the combine weights).
+
+    Returns (combine, dispatch, aux, dropped): ``dropped`` (int32
+    scalar) is the number of token->expert assignments discarded by
+    the capacity bound this batch, counted exactly per top-k pass —
+    the eager MoELayer forward surfaces it as the
+    ``moe.dropped_tokens`` stats counter so capacity-overflow drops
+    are observable instead of silent."""
     T = probs.shape[0]
     topk_val, topk_idx = jax.lax.top_k(probs, K)              # [T, K]
     # normalize selected probabilities
@@ -294,6 +335,7 @@ def _gshard_dispatch(probs, E, K, capacity):
     # two tokens silently share a slot (the exact corruption the `base`
     # fix prevents)
     base = jnp.zeros((E,), jnp.float32)
+    dropped = jnp.zeros((), jnp.int32)
     for k in range(K):
         idx = topk_idx[:, k]                                  # [T]
         onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # [T, E]
@@ -302,6 +344,7 @@ def _gshard_dispatch(probs, E, K, capacity):
                     + base[None, :]) * onehot                 # [T, E]
         pos = jnp.sum(pos_in_e, axis=-1).astype(jnp.int32)    # [T]
         keep = pos < capacity
+        dropped = dropped + (T - jnp.sum(keep.astype(jnp.int32)))
         pos_cap = jnp.clip(pos, 0, capacity - 1)
         cap_onehot = jax.nn.one_hot(pos_cap, capacity,
                                     dtype=probs.dtype)        # [T, C]
@@ -317,4 +360,7 @@ def _gshard_dispatch(probs, E, K, capacity):
     ce = jnp.mean(
         jax.nn.one_hot(topk_idx[:, 0], E, dtype=probs.dtype), axis=0)
     aux = jnp.sum(me * ce) * E
-    return combine, dispatch, aux
+    # int32 on purpose: exact under AMP (a bf16 dispatch.sum() rounds
+    # past 256), and non-differentiable by dtype so the ep path's
+    # shard_map psum never sees a symbolic-zero cotangent for it
+    return combine, dispatch, aux, dropped

@@ -360,6 +360,16 @@ class FusedMultiTransformer(Layer):
         docstring). Either way the pool is carried through the loop and
         only scatter-written/gather-read — never copied.
 
+        GROUPED streaming (``FLAGS_decode_grouped``, default auto):
+        the four per-layer matmuls issue as at most TWO streamed calls
+        — one QKV stream and one fused O+LN2+FFN tail
+        (``stream_layer_tail``) — and with ``FLAGS_decode_prefetch``
+        the tail's last grid phase computes layer l+1's LN1+QKV so its
+        weight DMA overlaps layer l's FFN compute: ONE fused streamed
+        call per layer in steady state. ``auto`` groups bf16/f32/
+        weight-only-int8 stacks; A8W8 keeps the ungrouped int8 x int8
+        act-quant kernel (grouped would forgo its int8 MXU math).
+
         ``a8w8``: activations dynamically quantized per token into the
         int8 x int8 streamed matmuls (stream_linear act_quant path) —
         requires the int8 weight stack.
@@ -377,65 +387,168 @@ class FusedMultiTransformer(Layer):
             _on_tpu, build_pool_ownership,
             paged_decode_attention_inplace_q)
 
-        if isinstance(cache.k, tuple):
+        quantized_kv = isinstance(cache.k, tuple)
+        fused_stream = False
+        if quantized_kv:
             # int8 cache-KV mode: always the fused quantized kernel
             # (interpret off-TPU); the pools never touch a non-Pallas op
             ownership = build_pool_ownership(
                 block_tables, seq_lens.astype(jnp.int32), npages,
                 self._pool_page_size(cache))
-
-            def run_layer_q(w, h, kk, vv, tbl, base, linear=None):
-                def attend(q, k, v, _ck, _cv):
-                    att, kq2, ks2, vq2, vs2 = \
-                        paged_decode_attention_inplace_q(
-                            q, k, v, kk[0], kk[1], vv[0], vv[1],
-                            seq_lens, tbl, pool_base=base,
-                            pool_pages=npages, ownership=ownership)
-                    return att, (kq2, ks2), (vq2, vs2)
-                return self._layer_body(w, h, seq_lens, None, attend,
-                                        cos_t, sin_t, linear=linear)
-            run_layer = run_layer_q
-            fused_stream = False
         else:
             backend = flag("paged_attention_backend")
             fused_stream = (backend in ("auto", "stream") and _on_tpu()
                             and self.head_dim % 128 == 0)
-        if fused_stream:
-            # fused append+attend kernel masks with seq_lens (current
-            # token joins from the operands)
-            ownership = build_pool_ownership(
-                block_tables, seq_lens.astype(jnp.int32), npages,
-                cache.k.shape[2])
+            if fused_stream:
+                # fused append+attend kernel masks with seq_lens
+                # (current token joins from the operands)
+                ownership = build_pool_ownership(
+                    block_tables, seq_lens.astype(jnp.int32), npages,
+                    cache.k.shape[2])
+            else:
+                ownership = build_pool_ownership(
+                    block_tables, lens1, npages, cache.k.shape[2])
 
-            def run_layer(w, h, ck, cv, tbl, base, linear=None):
-                def attend(q, k, v, _ck, _cv):
-                    return paged_decode_attention_inplace(
-                        q, k, v, ck, cv, seq_lens, tbl,
-                        pool_base=base, pool_pages=npages,
-                        ownership=ownership)
-                return self._layer_body(w, h, seq_lens, None, attend,
-                                        cos_t, sin_t, linear=linear)
-        elif not isinstance(cache.k, tuple):
-            ownership = build_pool_ownership(block_tables, lens1,
-                                             npages, cache.k.shape[2])
+        def attend_fn(q, k, v, ck, cv, tbl, base):
+            """One decode-attention step for the active backend:
+            returns (att, ck', cv') with the new token's K/V in the
+            pool — the shared core of the ungrouped _layer_body path
+            and the grouped carried-QKV loop."""
+            if quantized_kv:
+                att, kq2, ks2, vq2, vs2 = \
+                    paged_decode_attention_inplace_q(
+                        q, k, v, ck[0], ck[1], cv[0], cv[1],
+                        seq_lens, tbl, pool_base=base,
+                        pool_pages=npages, ownership=ownership)
+                return att, (kq2, ks2), (vq2, vs2)
+            if fused_stream:
+                return paged_decode_attention_inplace(
+                    q, k, v, ck, cv, seq_lens, tbl,
+                    pool_base=base, pool_pages=npages,
+                    ownership=ownership)
+            ck, cv = write_kv_pages(ck, cv, k, v, seq_lens, tbl + base)
+            att = paged_attention(q, ck, cv, lens1, tbl,
+                                  pool_base=base, pool_pages=npages,
+                                  ownership=ownership)
+            return att, ck, cv
 
-            def attend_paged(tbl, base):
-                def attend(q, k, v, ck, cv):
-                    return paged_attention(q, ck, cv, lens1, tbl,
-                                           pool_base=base,
-                                           pool_pages=npages,
-                                           ownership=ownership)
-                return attend
+        def run_layer(w, h, ck, cv, tbl, base, linear=None):
+            def attend(q, k, v, _ck, _cv):
+                return attend_fn(q, k, v, ck, cv, tbl, base)
+            return self._layer_body(w, h, seq_lens, None, attend,
+                                    cos_t, sin_t, linear=linear)
 
-            def run_layer(w, h, ck, cv, tbl, base, linear=None):
-                return self._layer_body(
-                    w, h, seq_lens,
-                    lambda k, v: write_kv_pages(ck, cv, k, v, seq_lens,
-                                                tbl + base),
-                    attend_paged(tbl, base), cos_t, sin_t,
-                    linear=linear)
+        from ...nn.functional.stream_linear import (stream_layer_tail,
+                                                    stream_linear)
 
-        from ...nn.functional.stream_linear import stream_linear
+        g_flag = flag("decode_grouped")
+        use_grouped = g_flag == "on" or (g_flag == "auto" and not a8w8)
+        prefetch = bool(flag("decode_prefetch"))
+        d_att = self.num_heads * self.head_dim
+
+        def split_rope(qkv, h):
+            return _split_rope(qkv.astype(h.dtype), seq_lens,
+                               self.num_heads, self.num_kv_heads,
+                               self.head_dim, cos_t, sin_t)
+
+        if use_grouped and isinstance(weights, (list, tuple)):
+            # unstacked grouped loop: per-layer dicts, python-unrolled
+            def qkv_call(wl, hh):
+                hn = self._ln(hh, wl["ln1_scale"], wl["ln1_bias"],
+                              self.epsilon).astype(hh.dtype)
+                return stream_linear(hn, wl["qkv_weight"],
+                                     bias=wl["qkv_bias"],
+                                     scale=wl.get("qkv_scale"),
+                                     out_dtype=hh.dtype)
+
+            h, ck, cv = x, cache.k, cache.v
+            qkv = qkv_call(weights[0], h)
+            for l, w in enumerate(weights):
+                q, k, v = split_rope(qkv, h)
+                att, ck, cv = attend_fn(q, k, v, ck, cv, block_tables,
+                                        l * npages)
+                att = att.reshape(*h.shape[:-1], d_att).astype(h.dtype)
+                nxt = weights[l + 1] \
+                    if (prefetch and l + 1 < len(weights)) else None
+                res = stream_layer_tail(
+                    att, h, w["out_weight"], w["ffn1_weight"],
+                    w["ffn2_weight"], bo=w["out_bias"],
+                    b1=w["ffn1_bias"], b2=w["ffn2_bias"],
+                    ln2_scale=w["ln2_scale"], ln2_bias=w["ln2_bias"],
+                    epsilon=self.epsilon, activation=self.activation,
+                    so=w.get("out_scale"), s1=w.get("ffn1_scale"),
+                    s2=w.get("ffn2_scale"),
+                    next_qkv=None if nxt is None else dict(
+                        w=nxt["qkv_weight"], b=nxt["qkv_bias"],
+                        s=nxt.get("qkv_scale"),
+                        ln_s=nxt["ln1_scale"], ln_b=nxt["ln1_bias"]),
+                    out_dtype=h.dtype)
+                if nxt is None:
+                    h = res
+                    if l + 1 < len(weights):
+                        qkv = qkv_call(weights[l + 1], h)
+                else:
+                    h, qkv = res
+            return h, PagedKV(ck, cv)
+
+        if use_grouped:
+            # stacked grouped loop: QKV carried through the fori_loop,
+            # layer l+1's projection computed by layer l's tail kernel
+            L = self.num_layers
+
+            def qkv_at(l, hh):
+                ln_s = jax.lax.dynamic_index_in_dim(
+                    weights["ln1_scale"], l, 0, False)
+                ln_b = jax.lax.dynamic_index_in_dim(
+                    weights["ln1_bias"], l, 0, False)
+                hn = self._ln(hh, ln_s, ln_b, self.epsilon) \
+                    .astype(hh.dtype)
+                return stream_linear(hn, weights["qkv_weight"],
+                                     layer=l, bias=weights["qkv_bias"],
+                                     scale=weights.get("qkv_scale"),
+                                     out_dtype=hh.dtype)
+
+            def tail(att, h, l):
+                nq = None
+                if prefetch:
+                    nq = dict(w=weights["qkv_weight"],
+                              b=weights["qkv_bias"],
+                              s=weights.get("qkv_scale"),
+                              ln_s=weights["ln1_scale"],
+                              ln_b=weights["ln1_bias"],
+                              layer=jnp.minimum(l + 1, L - 1))
+                return stream_layer_tail(
+                    att, h, weights["out_weight"],
+                    weights["ffn1_weight"], weights["ffn2_weight"],
+                    layer=l, bo=weights["out_bias"],
+                    b1=weights["ffn1_bias"], b2=weights["ffn2_bias"],
+                    ln2_scale=weights["ln2_scale"],
+                    ln2_bias=weights["ln2_bias"],
+                    epsilon=self.epsilon, activation=self.activation,
+                    so=weights.get("out_scale"),
+                    s1=weights.get("ffn1_scale"),
+                    s2=weights.get("ffn2_scale"),
+                    next_qkv=nq, out_dtype=h.dtype)
+
+            def body(l, carry):
+                h, qkv, ck, cv = carry
+                q, k, v = split_rope(qkv, h)
+                att, ck, cv = attend_fn(q, k, v, ck, cv, block_tables,
+                                        l * npages)
+                att = att.reshape(*h.shape[:-1], d_att).astype(h.dtype)
+                if prefetch:
+                    # steady state: ONE fused streamed call per layer
+                    # (the last layer's prefetched QKV is discarded)
+                    h, qkv = tail(att, h, l)
+                else:
+                    h = tail(att, h, l)
+                    qkv = qkv_at(jnp.minimum(l + 1, L - 1), h)
+                return h, qkv, ck, cv
+
+            qkv0 = qkv_at(0, x)
+            h, _q, nk, nv = jax.lax.fori_loop(
+                0, L, body, (x, qkv0, cache.k, cache.v))
+            return h, PagedKV(nk, nv)
 
         if isinstance(weights, (list, tuple)):
             h, ck, cv = x, cache.k, cache.v

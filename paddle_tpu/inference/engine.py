@@ -159,9 +159,24 @@ class GenerationEngine:
         self._head_t = jnp.array(self.model.embed._data.T) \
             .astype(self._cdtype)
         # roofline rung names: A8W8 programs report under their own
-        # ``decode.a8w8``/``prefill.a8w8`` keys so the serving modes'
-        # achieved-bandwidth rows never mix (bench.py picks these up)
-        self._decode_tag = "decode.a8w8" if self._a8w8 else "decode"
+        # ``decode.a8w8``/``prefill.a8w8`` keys, and the grouped
+        # weight-stream path (FLAGS_decode_grouped, the r6 default for
+        # non-a8w8 stacks) under ``decode.<dtype>_grouped`` — so the
+        # serving modes' achieved-bandwidth rows never mix (bench.py
+        # picks these up; the flag is read once at engine init, matching
+        # when the decode programs trace)
+        from ..core.flags import flag as _flag
+
+        g = _flag("decode_grouped")
+        self._grouped = g == "on" or (g == "auto" and not self._a8w8)
+        if self._a8w8:
+            self._decode_tag = "decode.a8w8"
+        elif self._grouped:
+            wname = ("int8" if wd == jnp.int8 else
+                     "bf16" if self._cdtype == jnp.bfloat16 else "f32")
+            self._decode_tag = f"decode.{wname}_grouped"
+        else:
+            self._decode_tag = "decode"
         # one jitted prefill; decode programs are per-chunk-size (k=1
         # is the single-token step); cache operands are donated. Both
         # dispatch through the explicit-AOT wrapper so each program's

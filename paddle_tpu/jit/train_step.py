@@ -222,6 +222,19 @@ class TrainStep:
         opt = self.optimizer
         trainable = [self._params[i] for i in self._trainable_idx]
 
+        # first call = trace + XLA compile (+ run): record its wall
+        # seconds so bench telemetry carries cold-vs-warm compile time
+        # — with FLAGS_compile_cache_dir set (persistent cache, see
+        # device.setup_compile_cache) a warm process's first call drops
+        # to executable-load time, and the histogram shows it
+        first = not getattr(self, "_first_call_done", False)
+        if first:
+            import time as _time
+
+            from ..profiler import stats as _stats
+
+            t0 = _time.perf_counter()
+
         try:
             loss, new_params, new_sts, new_bufs = self._compiled(
                 *self._build_args(inputs, labels))
@@ -232,6 +245,11 @@ class TrainStep:
                     f"TrainStep[{type(self.model).__name__}]", e):
                 raise
 
+        if first:
+            self._first_call_done = True
+            self.first_call_seconds = _time.perf_counter() - t0
+            _stats.observe("compile.train_step_first_call_s",
+                           self.first_call_seconds)
         for p, a in zip(self._params, new_params):
             p._rebind(a)
         for p, st in zip(trainable, new_sts):

@@ -41,6 +41,9 @@ Conventions for the built-in instrumentation (all optional reading):
   activation-quant ops / int8 x int8 serving matmuls (A8W8 decode,
   QuantedLinear(a8w8=True)) — counted at the dispatch layer, since
   inside a traced program the quant body runs once per compile
+- ``moe.dropped_tokens``       token->expert assignments discarded by
+  the MoE capacity bound (incubate/moe/moe_layer.py _gshard_dispatch)
+  — counted on the eager forward path only (data-dependent)
 - ``dist.<op>.{calls,bytes}``  collective op counts and payload bytes
 - ``roofline.*``               achieved FLOP/s / bytes/s / MFU / BW
   utilization vs device peaks (profiler/roofline.py)
@@ -70,8 +73,8 @@ __all__ = [
 #: starts with one of these
 CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
-    "inference.", "serving.", "quant.", "dist.", "roofline.", "hbm.",
-    "t.",
+    "inference.", "serving.", "quant.", "moe.", "dist.", "roofline.",
+    "hbm.", "t.",
 )
 
 _ENABLED = True

@@ -20,6 +20,13 @@ reference.
 Consumers: ``nn/functional/stream_linear.py`` (the int8-activation
 streamed GEMM), ``incubate/nn/fused_transformer.py`` (prefill A8W8
 matmuls), and ``QuantedLinear(a8w8=True)`` (the PTQ deployment target).
+
+Grouped-decode interaction (r6): the GROUPED weight-stream path
+(``stream_layer_tail``) accepts the same int8 stacks + scales but runs
+its GEMMs via in-kernel weight dequant (weight-only math) — the int8
+weight STREAM (the bound resource) is preserved while the act-quant
+int8 x int8 MXU form stays exclusive to the ungrouped kernel, which is
+why ``FLAGS_decode_grouped=auto`` keeps A8W8 ungrouped.
 """
 from __future__ import annotations
 

@@ -1,0 +1,77 @@
+"""Persistent XLA compilation cache wiring + cold-vs-warm compile
+telemetry (ISSUE r6 satellite, first step toward the 25-min s2048
+compile).
+
+- ``FLAGS_compile_cache_dir`` (env ``PADDLE_TPU_COMPILE_CACHE_DIR``)
+  -> ``device.setup_compile_cache()`` -> jax_compilation_cache_dir,
+  with the ``compile.persistent_cache`` gauge recording the regime.
+- ``TrainStep`` records its first call's wall seconds (trace + XLA
+  compile + run) in the ``compile.train_step_first_call_s`` histogram,
+  which bench.py embeds in its telemetry block — so a cache-warm
+  round's compile-second drop is visible across BENCH_r*.json files.
+"""
+import numpy as np
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import stats
+
+
+class TestCompileCacheFlag:
+    def test_setup_applies_flag_dir_and_gauge(self, tmp_path):
+        old = paddle.get_flags("compile_cache_dir")["compile_cache_dir"]
+        try:
+            paddle.set_flags({"FLAGS_compile_cache_dir":
+                              str(tmp_path)})
+            applied = paddle.device.setup_compile_cache()
+            assert applied == str(tmp_path)
+            assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+            assert stats.gauge("compile.persistent_cache").value == 1
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+            paddle.set_flags({"FLAGS_compile_cache_dir": old})
+            stats.set_gauge("compile.persistent_cache",
+                            1 if old else 0)
+
+    def test_no_dir_is_a_noop(self):
+        old = paddle.get_flags("compile_cache_dir")["compile_cache_dir"]
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            paddle.set_flags({"FLAGS_compile_cache_dir": ""})
+            assert paddle.device.setup_compile_cache() is None
+            assert jax.config.jax_compilation_cache_dir == prev
+            assert stats.gauge("compile.persistent_cache").value == 0
+        finally:
+            paddle.set_flags({"FLAGS_compile_cache_dir": old})
+
+    def test_explicit_path_wins_over_flag(self, tmp_path):
+        try:
+            applied = paddle.device.setup_compile_cache(
+                str(tmp_path / "explicit"))
+            assert applied == str(tmp_path / "explicit")
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+            stats.set_gauge("compile.persistent_cache", 0)
+
+
+class TestTrainStepCompileSeconds:
+    def test_first_call_observed_once(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        model = nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        step = paddle.jit.TrainStep(
+            model, lambda o, y: ((o - y) ** 2).mean(), opt)
+        h = stats.histogram("compile.train_step_first_call_s")
+        before = h.count
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        step([x], [y])
+        assert h.count == before + 1
+        assert step.first_call_seconds > 0
+        first = step.first_call_seconds
+        step([x], [y])  # warm call: no second observation
+        assert h.count == before + 1
+        assert step.first_call_seconds == first

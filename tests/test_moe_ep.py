@@ -55,7 +55,9 @@ class TestGShardDispatch:
         x = jnp.asarray(rng.randn(T, Dx).astype(np.float32))
         wg = jnp.asarray(rng.randn(Dx, Ex).astype(np.float32) * 0.3)
         probs = jax.nn.softmax(x @ wg, -1)
-        combine, dispatch, _ = _gshard_dispatch(probs, Ex, K, T * K)
+        combine, dispatch, _, dropped = _gshard_dispatch(
+            probs, Ex, K, T * K)
+        assert float(dropped) == 0.0  # ample capacity: nothing dropped
         out = jnp.einsum("tec,ecd->td", combine,
                          jnp.einsum("tec,td->ecd", dispatch, x))
         np.testing.assert_allclose(np.asarray(out), np.asarray(x),
@@ -135,3 +137,41 @@ class TestMoEExpertParallel:
         x = paddle.to_tensor(np.ones((8, 4, D), np.float32))
         with pytest.raises(ValueError, match="divisible"):
             moe(x)
+
+
+class TestDroppedTokensObservability:
+    """moe.dropped_tokens: capacity-overflow drops become a stats
+    counter on the eager forward (ISSUE r6 satellite — slice of
+    VERDICT weak #6's silent-drop problem)."""
+
+    def test_stacked_path_counts_drops(self):
+        from paddle_tpu.profiler import stats
+
+        paddle.seed(0)
+        # capacity_factor 0.05 -> capacity 1 slot/expert: with T*K=64
+        # assignments into 4 experts, >= 60 must drop
+        moe = MoELayer(d_model=D, num_experts=E, gate="gshard",
+                       d_hidden=32, capacity_factor=0.05)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 4, D).astype(np.float32))
+        before = stats.counter("moe.dropped_tokens").value
+        moe(x)
+        got = stats.counter("moe.dropped_tokens").value - before
+        assert got >= 32 * 2 - E * 1  # T*K minus total capacity slots
+
+    def test_ample_capacity_counts_zero(self):
+        from paddle_tpu.profiler import stats
+
+        paddle.seed(1)
+        moe = MoELayer(d_model=D, num_experts=E, gate="gshard",
+                       d_hidden=32, capacity_factor=8.0)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(8, 4, D).astype(np.float32))
+        before = stats.counter("moe.dropped_tokens").value
+        moe(x)
+        assert stats.counter("moe.dropped_tokens").value == before
+
+    def test_counter_uses_convention_prefix(self):
+        from paddle_tpu.profiler import stats
+
+        assert any(p == "moe." for p in stats.CONVENTION_PREFIXES)

@@ -67,6 +67,11 @@ DEFAULT_METRICS: Dict[str, str] = {
     "decode_int8_pct_of_hbm_roofline": "down",
     "decode_a8w8_tokens_per_sec": "down",
     "decode_a8w8_pct_of_hbm_roofline": "down",
+    # grouped bf16 weight-stream decode (r6 tentpole rung): both the
+    # throughput and its %-of-weight-roofline must not collapse — the
+    # roofline % is the honest one (it normalizes out batch/geometry)
+    "decode_bf16_grouped_tokens_per_sec": "down",
+    "decode_bf16_grouped_pct_of_hbm_roofline": "down",
     "decode_int8kv_b64_tokens_per_sec": "down",
 }
 

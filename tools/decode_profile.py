@@ -132,6 +132,86 @@ def mode_weights_only():
     return BATCH * CHUNK / sec
 
 
+def mode_weights_only_grouped(prefetch=True):
+    """GROUPED transformer matmuls only (no attention/cache/logits):
+    the r6 fused O+LN2+FFN tail kernel (+ in-tail next-layer QKV when
+    ``prefetch``) against mode_weights_only's per-projection floor —
+    the delta is the per-call dispatch/ramp-up cost the grouping
+    removes. The "attention output" is the QKV projection's leading D
+    columns, exactly like mode_weights_only."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.stream_linear import (
+        stream_layer_tail, stream_linear)
+
+    model = build()
+    st = model.stack
+    w = st._stack()
+    eps, act = st.epsilon, st.activation
+
+    def qkv_at(weights, l, h):
+        ln_s = jax.lax.dynamic_index_in_dim(weights["ln1_scale"], l,
+                                            0, False)
+        ln_b = jax.lax.dynamic_index_in_dim(weights["ln1_bias"], l,
+                                            0, False)
+        hn = ((h - jnp.mean(h, -1, keepdims=True)) * ln_s + ln_b) \
+            .astype(h.dtype)
+        return stream_linear(hn, weights["qkv_weight"], layer=l,
+                             bias=weights["qkv_bias"], out_dtype=h.dtype)
+
+    def chunk(weights, x):
+        def tok_step(carry, _):
+            h = carry
+
+            def body(l, hq):
+                h, qkv = hq
+                att = qkv[:, :D]
+                nq = None
+                if prefetch:
+                    nq = dict(w=weights["qkv_weight"],
+                              b=weights["qkv_bias"],
+                              ln_s=weights["ln1_scale"],
+                              ln_b=weights["ln1_bias"],
+                              layer=jnp.minimum(l + 1, L - 1))
+                res = stream_layer_tail(
+                    att, h, weights["out_weight"],
+                    weights["ffn1_weight"], weights["ffn2_weight"],
+                    layer=l, bo=weights["out_bias"],
+                    b1=weights["ffn1_bias"], b2=weights["ffn2_bias"],
+                    ln2_scale=weights["ln2_scale"],
+                    ln2_bias=weights["ln2_bias"], epsilon=eps,
+                    activation=act, next_qkv=nq, out_dtype=h.dtype)
+                if prefetch:
+                    h, qkv = res
+                else:
+                    h = res
+                    qkv = qkv_at(weights, jnp.minimum(l + 1, L - 1), h)
+                return h, qkv
+
+            qkv0 = qkv_at(weights, 0, h)
+            h, _ = jax.lax.fori_loop(0, L, body, (h, qkv0))
+            return h, h[:, 0]
+        h, outs = jax.lax.scan(tok_step, x, jnp.arange(CHUNK))
+        return outs
+
+    fn = jax.jit(chunk)
+    x = jnp.ones((BATCH, D), jnp.bfloat16)
+    sec = time_chunk(fn, (w, x))
+    return BATCH * CHUNK / sec
+
+
+def mode_engine_grouped(batch=32, grouped="on", prefetch=True,
+                        quant=None):
+    """Engine end-to-end with the grouped weight-stream path forced
+    on/off (grouped-vs-ungrouped and prefetch on/off ablations)."""
+    import paddle_tpu as paddle
+
+    paddle.set_flags({"decode_grouped": grouped,
+                      "decode_prefetch": prefetch})
+    return mode_engine_full(batch, quant=quant)
+
+
 def mode_head_only(bf16=False):
     """Logits head (h @ embed.T) + argmax, 64 steps."""
     import jax
@@ -713,6 +793,21 @@ MODES = {
         lambda: mode_engine_full(32, quant="a8w8", kv="int8"),
     "engine_a8w8kv8_b64":
         lambda: mode_engine_full(64, quant="a8w8", kv="int8"),
+    # grouped weight-stream rows (r6): kernel floor, grouped-vs-
+    # ungrouped engine delta, and the cross-layer-prefetch knockout
+    "weights_only_grouped": mode_weights_only_grouped,
+    "weights_only_grouped_b32":
+        lambda: _with_batch(32, mode_weights_only_grouped),
+    "weights_only_grouped_noprefetch_b32":
+        lambda: _with_batch(32,
+                            lambda: mode_weights_only_grouped(False)),
+    "engine_grouped_b32": lambda: mode_engine_grouped(32),
+    "engine_ungrouped_b32":
+        lambda: mode_engine_grouped(32, grouped="off"),
+    "prefetch_on": lambda: mode_engine_grouped(32, prefetch=True),
+    "prefetch_off": lambda: mode_engine_grouped(32, prefetch=False),
+    "engine_grouped_int8_b32":
+        lambda: mode_engine_grouped(32, quant="int8"),
     "engine_int8_noattn_b32":
         lambda: mode_engine_knockout(32, "attn", quant="int8"),
     "engine_int8_nohead_b32":
