@@ -1,0 +1,104 @@
+"""paddle_tpu.analysis — static analysis for TPU kernels and traced
+code, runnable entirely on CPU.
+
+Chip time is the scarcest resource in this repo (a cold s2048 compile
+alone is ~25 min); this package proves on CPU the properties that
+otherwise only fail on hardware:
+
+- **Pass 1 — kernel geometry** (:mod:`.geometry` over :mod:`.audit` /
+  :mod:`.sites`): every ``pallas_call`` launch spec is shim-recorded
+  from an ``eval_shape`` dry-trace and validated — VMEM footprint vs
+  the declared limit and the per-generation budget table
+  (:mod:`paddle_tpu.device.vmem`), dtype tile alignment, grid
+  divisibility, index-map bounds at grid edges, and no magic
+  ``vmem_limit_bytes`` literals.
+- **Pass 2 — use-after-donate** (:mod:`.donation`): a
+  ``FLAGS_check_donation`` poison mode that makes CPU runs fail exactly
+  where TPU donation would read freed HBM, plus a static audit of the
+  registry's donation contracts.
+- **Pass 3 — trace purity** (:mod:`.purity`): AST lint of traced code
+  for concretization hazards (``bool/int/float``/``if`` on tracers,
+  ``np.*`` on tracers, host time/RNG, python-state mutation in loop
+  bodies), with an inline waiver syntax
+  (``# tpu-lint: ok(<rule>) -- <reason>``).
+
+Front-end: ``tools/tpu_lint.py`` (``--json`` for CI); the tier-1 test
+``tests/test_tpu_lint.py`` asserts the repo is clean.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from .audit import PallasCallRecord, record_pallas_calls  # noqa: F401
+from .base import Finding, apply_waivers, parse_waivers  # noqa: F401
+from .donation import (  # noqa: F401
+    UseAfterDonateError, assert_not_poisoned, audit_donation_registry,
+    clear_poisoned, is_poisoned, poison, poisoned_count,
+)
+from .flags_lint import env_var_for, run_flags_pass  # noqa: F401
+from .geometry import (  # noqa: F401
+    analyze_record, scan_magic_vmem_literals, tile_padded_bytes,
+    vmem_footprint,
+)
+from .purity import run_purity_pass  # noqa: F401
+from .sites import KERNEL_SITES, trace_all_sites, trace_site  # noqa: F401
+
+__all__ = [
+    "Finding", "PallasCallRecord", "record_pallas_calls",
+    "UseAfterDonateError", "poison", "is_poisoned", "assert_not_poisoned",
+    "poisoned_count", "clear_poisoned",
+    "analyze_record", "vmem_footprint", "tile_padded_bytes",
+    "scan_magic_vmem_literals", "audit_donation_registry",
+    "run_geometry_pass", "run_donation_pass", "run_purity_pass",
+    "run_flags_pass", "run_all_passes", "unwaivered",
+    "KERNEL_SITES", "trace_site", "trace_all_sites", "env_var_for",
+]
+
+
+def _pkg_root() -> str:
+    """The paddle_tpu/ package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_geometry_pass(generation: Optional[str] = None) -> List[Finding]:
+    """Dry-trace every kernel site, analyze each recorded launch spec,
+    and scan the tree for magic VMEM literals."""
+    pkg = _pkg_root()
+    findings: List[Finding] = []
+    for name, records in trace_all_sites().items():
+        for rec in records:
+            for f in analyze_record(rec, generation=generation):
+                f.site = f"{name} ({rec.kernel_name})"
+                findings.append(f)
+    src_findings = scan_magic_vmem_literals(pkg)
+    waivers = {}
+    for f in src_findings:
+        if f.path and f.path not in waivers:
+            path = os.path.join(os.path.dirname(pkg), f.path)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    waivers[f.path] = parse_waivers(fh.read())
+            except OSError:
+                pass
+    apply_waivers(src_findings, waivers)
+    return findings + src_findings
+
+
+def run_donation_pass() -> List[Finding]:
+    return audit_donation_registry(_pkg_root())
+
+
+def run_all_passes(generation: Optional[str] = None
+                   ) -> Dict[str, List[Finding]]:
+    """All four checks; keys: geometry / donation / purity / flags."""
+    return {
+        "geometry": run_geometry_pass(generation=generation),
+        "donation": run_donation_pass(),
+        "purity": run_purity_pass(_pkg_root()),
+        "flags": run_flags_pass(),
+    }
+
+
+def unwaivered(findings: List[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.waived]

@@ -1,0 +1,83 @@
+"""Shared plumbing for the static-analysis passes: the ``Finding``
+record every pass emits and the inline-waiver syntax that documents
+intentional exceptions.
+
+Waiver syntax (on the flagged line, or the line immediately above)::
+
+    x = risky_thing()  # tpu-lint: ok(P-HOST-RNG) -- reseeded per trace
+
+The rule id must match the finding's rule and a non-empty reason is
+required — a bare ``ok(...)`` does not waive. True positives get fixed;
+waivers exist so the intentional exceptions are documented in-line and
+survive review.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Finding", "parse_waivers", "apply_waivers"]
+
+#: ``# tpu-lint: ok(RULE) <sep> reason`` — separator is any dash/em-dash
+#: or colon; the reason must be non-empty
+_WAIVER_RE = re.compile(
+    r"#\s*tpu-lint:\s*ok\(\s*(?P<rule>[A-Za-z0-9_.-]+)\s*\)\s*"
+    r"(?:[-—–:]+\s*)?(?P<reason>\S.*)?$")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analysis finding, anchored (when source-level) to a line."""
+
+    rule: str                      # e.g. "G-TILE", "P-TRACER-IF"
+    message: str
+    path: Optional[str] = None     # repo-relative when source-anchored
+    line: Optional[int] = None
+    site: Optional[str] = None     # kernel/op the finding is about
+    waived: bool = False
+    waive_reason: Optional[str] = None
+
+    def location(self) -> str:
+        if self.path and self.line:
+            return f"{self.path}:{self.line}"
+        return self.path or self.site or "<repo>"
+
+    def render(self) -> str:
+        tag = " [waived: %s]" % self.waive_reason if self.waived else ""
+        where = self.location()
+        at = f" @ {self.site}" if self.site and self.site != where else ""
+        return f"{self.rule} {where}{at}: {self.message}{tag}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def parse_waivers(source: str) -> Dict[int, Tuple[str, str]]:
+    """line number (1-based) -> (rule, reason) for every waiver comment
+    in ``source``. Waivers with an empty reason are ignored (and the
+    lint itself flags them, see purity.check_waiver_hygiene)."""
+    out: Dict[int, Tuple[str, str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _WAIVER_RE.search(text)
+        if m and m.group("reason"):
+            out[i] = (m.group("rule"), m.group("reason").strip())
+    return out
+
+
+def apply_waivers(findings: List[Finding],
+                  waivers_by_path: Dict[str, Dict[int, Tuple[str, str]]],
+                  ) -> List[Finding]:
+    """Mark findings waived when a matching-rule waiver sits on the
+    flagged line or the line above it. Returns ``findings``."""
+    for f in findings:
+        if f.path is None or f.line is None:
+            continue
+        waivers = waivers_by_path.get(f.path, {})
+        for ln in (f.line, f.line - 1):
+            w = waivers.get(ln)
+            if w and w[0] == f.rule:
+                f.waived = True
+                f.waive_reason = w[1]
+                break
+    return findings

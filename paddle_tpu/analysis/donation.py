@@ -1,0 +1,220 @@
+"""Pass 2 — use-after-donate detector.
+
+Two halves:
+
+**Runtime poison mode** (``FLAGS_check_donation``): the compiled-forward
+fast path donates in-place op buffers (ops/dispatch.py). On TPU a
+donated buffer is genuinely dead — any alias that slipped past the
+``_donation_safe`` refcount guard reads freed HBM. CPU jaxlib ignores
+donation, so such a bug is INVISIBLE in CI. With the flag on, dispatch
+registers every donated buffer here after the call; every subsequent
+dispatch (and ``Tensor.numpy()``) asserts none of its inputs is a
+poisoned buffer and raises :class:`UseAfterDonateError` with the
+donating op — so CPU tests reproduce the TPU failure mode
+deterministically instead of silently passing.
+
+The registry holds ids + weakrefs only (jax arrays are immutable; we
+cannot scribble on the buffer itself), and entries self-purge when the
+donated array object dies — id() reuse can never poison a fresh array.
+
+**Static registry audit** (``audit_donation_registry``): proves the op
+registry's donation metadata is consistent with the dispatch layer —
+every ``OpDef.donates`` is the in-place contract ``(0,)`` with
+``inplace_of`` naming a registered base op and an ``inplace`` tag; every
+function that dispatches through ``inplace_apply`` (donating slot 0 at
+runtime) is registered with that contract; and the donation path in
+ops/dispatch.py still filters through ``_donation_safe``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import weakref
+from typing import Any, Dict, List, Optional
+
+from .base import Finding
+
+__all__ = ["UseAfterDonateError", "poison", "is_poisoned",
+           "assert_not_poisoned", "poisoned_count", "clear_poisoned",
+           "audit_donation_registry"]
+
+
+class UseAfterDonateError(RuntimeError):
+    """A live Tensor read a buffer that was donated to a compiled op."""
+
+
+#: id(array) -> (weakref, donating op name). Weakref callbacks purge the
+#: entry when the donated object dies, so a recycled id() is never
+#: mistaken for the poisoned buffer.
+_POISONED: Dict[int, Any] = {}
+
+
+def poison(arr, op_name: str) -> None:
+    """Mark ``arr``'s buffer as donated (dead) by ``op_name``."""
+    key = id(arr)
+
+    def _purge(ref, _key=key):
+        ent = _POISONED.get(_key)
+        if ent is not None and ent[0] is ref:
+            _POISONED.pop(_key, None)
+
+    try:
+        _POISONED[key] = (weakref.ref(arr, _purge), op_name)
+    except TypeError:  # non-weakref-able array impl: id-only (no purge)
+        _POISONED[key] = (None, op_name)
+
+
+def is_poisoned(arr) -> Optional[str]:
+    """The donating op's name when ``arr`` is a poisoned buffer."""
+    ent = _POISONED.get(id(arr))
+    if ent is None:
+        return None
+    ref, op = ent
+    if ref is not None and ref() is not arr:
+        return None
+    return op
+
+
+def assert_not_poisoned(arrays, reader: str) -> None:
+    """Raise when any of ``arrays`` was donated. ``reader`` names the
+    consuming operation for the error message."""
+    if not _POISONED:
+        return
+    for a in arrays:
+        op = is_poisoned(a)
+        if op is not None:
+            raise UseAfterDonateError(
+                f"{reader} read a buffer that `{op}` donated to its "
+                "compiled executable — on TPU this is freed HBM. An "
+                "alias escaped the _donation_safe refcount guard (or "
+                "the guard was bypassed); hold a copy instead of an "
+                "alias, or file the op's donation contract as a bug.")
+
+
+def poisoned_count() -> int:
+    return len(_POISONED)
+
+
+def clear_poisoned() -> None:
+    _POISONED.clear()
+
+
+# ----------------------------------------------------------------- audit
+
+def _inplace_apply_call_sites(pkg_root: str) -> List[dict]:
+    """AST scan: every function def that calls ``inplace_apply`` —
+    those donate their slot-0 buffer at runtime."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(pkg_root))
+            if rel.replace(os.sep, "/").endswith("ops/dispatch.py"):
+                continue  # the definition itself
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.FunctionDef):
+                    continue
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Name)
+                            and sub.func.id == "inplace_apply"):
+                        out.append({"fn": node.name, "path": rel,
+                                    "line": sub.lineno})
+                        break
+    return out
+
+
+def _dispatch_guard_ok(pkg_root: str) -> bool:
+    """Does ops/dispatch.py still filter donate_idx through
+    ``_donation_safe`` before building the donated executable?"""
+    path = os.path.join(pkg_root, "ops", "dispatch.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return False
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.FunctionDef)
+                and node.name == "_forward_fast_path"):
+            return any(isinstance(s, ast.Call)
+                       and isinstance(s.func, ast.Name)
+                       and s.func.id == "_donation_safe"
+                       for s in ast.walk(node))
+    return False
+
+
+def audit_donation_registry(pkg_root: Optional[str] = None
+                            ) -> List[Finding]:
+    """Static consistency audit of the registry's donation metadata."""
+    from ..ops.registry import all_ops
+
+    if pkg_root is None:
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    findings: List[Finding] = []
+    ops = all_ops()
+
+    for name, d in sorted(ops.items()):
+        if d.donates:
+            if d.donates != (0,):
+                findings.append(Finding(
+                    rule="D-SLOT", site=name,
+                    message=f"donates={d.donates}: inplace_apply only "
+                            "donates slot 0 — other slots are never "
+                            "rebound and would alias freed buffers"))
+            if not d.inplace_of:
+                findings.append(Finding(
+                    rule="D-ORPHAN", site=name,
+                    message="declares donates but no inplace_of — the "
+                            "donated slot has no rebind contract"))
+            if "inplace" not in d.tags:
+                findings.append(Finding(
+                    rule="D-TAG", site=name,
+                    message="donating op missing the 'inplace' tag"))
+        if d.inplace_of:
+            if not d.donates:
+                findings.append(Finding(
+                    rule="D-NODONATE", site=name,
+                    message=f"inplace_of={d.inplace_of!r} without a "
+                            "donates contract — the fast path will "
+                            "double-buffer this in-place op forever"))
+            if d.inplace_of not in ops:
+                findings.append(Finding(
+                    rule="D-DANGLING", site=name,
+                    message=f"inplace_of={d.inplace_of!r} is not a "
+                            "registered op — the registry is supposed "
+                            "to be the single source of truth"))
+
+    # runtime donation sites must be declared in the registry
+    by_fn_name = {}
+    for name, d in ops.items():
+        by_fn_name.setdefault(getattr(d.fn, "__name__", name), name)
+    for site in _inplace_apply_call_sites(pkg_root):
+        fn = site["fn"]
+        # the contract may live under the def's name, its `*_` alias,
+        # or any registry entry whose fn is this def (increment_)
+        cands = [fn, fn + "_", by_fn_name.get(fn)]
+        covered = any(c in ops and ops[c].donates for c in cands if c)
+        if not covered:
+            findings.append(Finding(
+                rule="D-UNDECLARED", path=site["path"], line=site["line"],
+                site=fn,
+                message=(f"`{fn}` dispatches through inplace_apply "
+                         "(donates slot 0 at runtime) but its OpDef "
+                         "declares no donation contract")))
+
+    if not _dispatch_guard_ok(pkg_root):
+        findings.append(Finding(
+            rule="D-GUARD", path="paddle_tpu/ops/dispatch.py",
+            message="_forward_fast_path no longer filters donate_idx "
+                    "through _donation_safe — aliased buffers would be "
+                    "donated"))
+    return findings

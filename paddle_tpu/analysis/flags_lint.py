@@ -1,0 +1,70 @@
+"""Flags/env/README parity check.
+
+Every ``FLAGS_*`` defined in ``core/flags.py`` is settable by env var
+two ways — ``FLAGS_<name>`` (reference parity) and ``PADDLE_TPU_<NAME>``
+(the deployment convention PR 5's compile-cache flag established) — and
+must carry a row in the README flags table so operators can discover
+it. This pass asserts the parity holds for the whole registry:
+
+- ``F-README``: flag missing from the README flags table (the row must
+  mention both the ``FLAGS_<name>`` and ``PADDLE_TPU_<NAME>`` forms);
+- ``F-ENV``: ``define_flag`` no longer honors the generic
+  ``PADDLE_TPU_*`` override (source-level check on core/flags.py).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .base import Finding
+
+__all__ = ["env_var_for", "run_flags_pass"]
+
+
+def env_var_for(flag_name: str) -> str:
+    """The ``PADDLE_TPU_*`` env override for a flag name (delegates to
+    core.flags so the convention has one definition)."""
+    from ..core.flags import env_var_for as _impl
+
+    return _impl(flag_name)
+
+
+def run_flags_pass(repo_root: Optional[str] = None) -> List[Finding]:
+    from ..core.flags import _FLAGS
+
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    findings: List[Finding] = []
+
+    readme = os.path.join(repo_root, "README.md")
+    try:
+        with open(readme, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        text = ""
+        findings.append(Finding(rule="F-README", path="README.md",
+                                message="README.md not found"))
+
+    for name in sorted(_FLAGS):
+        full, env = f"FLAGS_{name}", env_var_for(name)
+        missing = [s for s in (full, env) if s not in text]
+        if missing:
+            findings.append(Finding(
+                rule="F-README", path="README.md", site=full,
+                message=(f"flag `{full}` has no conventions row naming "
+                         f"{' and '.join(missing)} — add it to the "
+                         "README flags table")))
+
+    flags_py = os.path.join(repo_root, "paddle_tpu", "core", "flags.py")
+    try:
+        with open(flags_py, encoding="utf-8") as f:
+            src = f.read()
+    except OSError:
+        src = ""
+    if "PADDLE_TPU_" not in src:
+        findings.append(Finding(
+            rule="F-ENV", path="paddle_tpu/core/flags.py",
+            message="define_flag no longer reads the generic "
+                    "PADDLE_TPU_<NAME> env override"))
+    return findings

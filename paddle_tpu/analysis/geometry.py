@@ -1,0 +1,356 @@
+"""Pass 1 — kernel geometry analyzer.
+
+Consumes :class:`~paddle_tpu.analysis.audit.PallasCallRecord`s (shim-
+captured launch specs) and proves, on CPU, the properties that
+otherwise only fail on a real chip:
+
+- **VMEM footprint** (``G-VMEM`` / ``G-BUDGET``): per-grid-step bytes =
+  tile-padded block bytes for every blocked operand/output (x2 when its
+  index map varies across the grid — Pallas double-buffers streamed
+  blocks) + VMEM scratch. Checked against the kernel's declared
+  ``vmem_limit_bytes`` (or Mosaic's 16 MiB scoped default when
+  undeclared) and against the per-generation physical budget table in
+  ``paddle_tpu.device.vmem``.
+- **Tile alignment** (``G-TILE``): each of a block's last two dims must
+  be 1, the full array dim, or a multiple of the dtype tile —
+  (8, 128) f32/int32, (16, 128) bf16, (32, 128) int8.
+- **Grid divisibility** (``G-DIV``): every blocked dim must divide its
+  array dim exactly (Mosaic's edge-padding is where silent garbage
+  reads come from in hand-rolled index maps).
+- **Index-map bounds** (``G-BOUNDS``): index maps are evaluated at the
+  grid edges with concrete indices; a block whose start exceeds the
+  array is flagged. Dims whose index depends on scalar-prefetch values
+  (traced layer ids, page tables) are skipped — they are dynamic by
+  design and reported as such.
+- **Magic VMEM literals** (``G-MAGIC``, source-level): any
+  ``vmem_limit_bytes=<numeric literal>`` in the tree must instead come
+  from ``device.vmem.KERNEL_VMEM_LIMIT_BYTES`` so the cap and the
+  budget table can never drift apart.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .audit import BlockSpecInfo, PallasCallRecord
+from .base import Finding
+
+__all__ = ["SUBLANES", "LANE", "tile_padded_bytes", "index_map_profile",
+           "vmem_footprint", "analyze_record", "scan_magic_vmem_literals",
+           "FootprintItem", "FootprintReport"]
+
+LANE = 128
+
+#: minimum VMEM tile (sublane count) per dtype itemsize — the
+#: (8, 128) f32 / (16, 128) bf16 / (32, 128) int8 table
+SUBLANES = {8: 8, 4: 8, 2: 16, 1: 32}
+
+_DTYPE_SIZE_CACHE: Dict[str, int] = {}
+
+
+def _itemsize(dtype: str) -> int:
+    n = _DTYPE_SIZE_CACHE.get(dtype)
+    if n is None:
+        n = _DTYPE_SIZE_CACHE[dtype] = int(np.dtype(
+            dtype.replace("bfloat16", "uint16")).itemsize)
+    return n
+
+
+def tile_padded_bytes(shape: Sequence[int], dtype: str) -> int:
+    """Bytes one block of ``shape``/``dtype`` occupies in VMEM, with the
+    last two dims padded up to the dtype's (sublane, lane) tile."""
+    shape = tuple(int(d) for d in shape)
+    size = _itemsize(dtype)
+    sub = SUBLANES.get(size, 8)
+    if not shape:
+        return size
+    if len(shape) == 1:
+        return size * (-(-shape[0] // LANE) * LANE)
+    lead = 1
+    for d in shape[:-2]:
+        lead *= d
+    s2 = -(-shape[-2] // sub) * sub
+    s1 = -(-shape[-1] // LANE) * LANE
+    return size * lead * s2 * s1
+
+
+def _scalar_args(record: PallasCallRecord, fill: int) -> List[np.ndarray]:
+    """Concrete stand-ins for the scalar-prefetch operands the index
+    maps index into (``l[0]`` etc.)."""
+    out = []
+    for aval in record.scalar_operands():
+        shape = aval[0] if aval else (1,)
+        out.append(np.full(shape, fill, dtype=np.int32))
+    return out
+
+
+def _grid_points(grid: Tuple[int, ...], cap: int = 512):
+    """All grid points when the grid is small, otherwise the corners
+    plus per-axis edge sweeps (the places index maps go out of bounds)."""
+    if not grid:
+        return [()]
+    total = 1
+    for g in grid:
+        total *= max(g, 1)
+    if total <= cap:
+        import itertools
+
+        return list(itertools.product(*(range(max(g, 1)) for g in grid)))
+    points = set()
+    corners = [(0, max(g - 1, 0)) for g in grid]
+    import itertools
+
+    points.update(itertools.product(*corners))
+    for ax, g in enumerate(grid):
+        base = [0] * len(grid)
+        for v in range(max(g, 1)):
+            p = list(base)
+            p[ax] = v
+            points.add(tuple(p))
+            if len(points) >= cap:
+                break
+    return sorted(points)
+
+
+def index_map_profile(record: PallasCallRecord, spec: BlockSpecInfo):
+    """Evaluate a BlockSpec's index map over the grid.
+
+    Returns ``(varies, dynamic_dims, points)`` where ``varies`` is True
+    when the block index changes across the grid (the operand is
+    streamed — double-buffered), ``dynamic_dims`` is the set of block
+    dims whose index depends on scalar-prefetch VALUES (bounds cannot be
+    proven statically), and ``points`` maps each evaluated grid point to
+    its block index tuple (with scalar refs zeroed). Returns
+    ``(True, None, None)`` when the map cannot be evaluated — the
+    analyzer then assumes the conservative streamed case.
+    """
+    if spec.index_map is None:
+        return False, set(), {}
+    zeros = _scalar_args(record, 0)
+    ones = _scalar_args(record, 1)
+
+    def run(point, scalars):
+        out = spec.index_map(*point, *scalars)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return tuple(int(v) for v in out)
+
+    try:
+        pts = _grid_points(record.grid)
+        seen = {}
+        dynamic = set()
+        for p in pts:
+            z = run(p, zeros)
+            seen[p] = z
+            if ones:
+                o = run(p, ones)
+                dynamic.update(i for i, (a, b) in enumerate(zip(z, o))
+                               if a != b)
+        varies = len(set(seen.values())) > 1
+        return varies, dynamic, seen
+    except Exception:
+        return True, None, None
+
+
+@dataclasses.dataclass
+class FootprintItem:
+    name: str                    # "in[3]", "out[0]", "scratch[2]"
+    block_shape: Tuple[int, ...]
+    dtype: str
+    bytes: int                   # tile-padded, x2 when double-buffered
+    buffers: int                 # 1 resident / 2 streamed
+    streamed: bool
+
+
+@dataclasses.dataclass
+class FootprintReport:
+    items: List[FootprintItem]
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(i.bytes for i in self.items)
+
+
+def vmem_footprint(record: PallasCallRecord) -> FootprintReport:
+    """Per-grid-step VMEM footprint of a recorded launch spec."""
+    items: List[FootprintItem] = []
+
+    def add(name, spec: BlockSpecInfo, aval):
+        if spec is None or not spec.is_blocked:
+            return  # memory_space=ANY stays in HBM (manual DMA)
+        dtype = (aval[1] if aval else None) or "float32"
+        varies, _, _ = index_map_profile(record, spec)
+        n = 2 if varies else 1
+        per = tile_padded_bytes(spec.block_shape, dtype)
+        items.append(FootprintItem(
+            name=name, block_shape=tuple(spec.block_shape), dtype=dtype,
+            bytes=per * n, buffers=n, streamed=varies))
+
+    for i, (spec, aval) in enumerate(record.blocked_operands()):
+        add(f"in[{i}]", spec, aval)
+    outs = record.out_shapes + [None] * (
+        len(record.out_specs) - len(record.out_shapes))
+    for i, spec in enumerate(record.out_specs):
+        add(f"out[{i}]", spec, outs[i] if i < len(outs) else None)
+    for i, sc in enumerate(record.scratch):
+        if sc.memory_space != "vmem":
+            continue  # semaphores/SMEM are not VMEM tiles
+        items.append(FootprintItem(
+            name=f"scratch[{i}]", block_shape=sc.shape, dtype=sc.dtype,
+            bytes=tile_padded_bytes(sc.shape, sc.dtype), buffers=1,
+            streamed=False))
+    return FootprintReport(items)
+
+
+def _check_tile(record, name, spec: BlockSpecInfo, aval, findings):
+    if not spec.is_blocked:
+        return
+    dtype = (aval[1] if aval else None) or "float32"
+    sub = SUBLANES.get(_itemsize(dtype), 8)
+    shape = spec.block_shape
+    full = aval[0] if aval else None
+    for pos, need in ((-1, LANE), (-2, sub)):
+        if len(shape) < -pos:
+            continue
+        d = shape[pos]
+        full_d = full[pos] if full and len(full) >= -pos else None
+        if d == 1 or d % need == 0 or (full_d is not None and d == full_d):
+            continue
+        findings.append(Finding(
+            rule="G-TILE", site=record.site, path=record.path,
+            line=record.line,
+            message=(f"{name} block {shape} dim {pos} = {d} is not a "
+                     f"multiple of the {dtype} tile ({sub}, {LANE}) nor "
+                     "the full array dim")))
+
+
+def _check_div_bounds(record, name, spec: BlockSpecInfo, aval, findings):
+    if not spec.is_blocked or aval is None:
+        return
+    shape, arr = spec.block_shape, aval[0]
+    if len(shape) != len(arr):
+        findings.append(Finding(
+            rule="G-RANK", site=record.site, path=record.path,
+            line=record.line,
+            message=f"{name} block rank {len(shape)} != operand rank "
+                    f"{len(arr)} (shape {arr})"))
+        return
+    for i, (b, a) in enumerate(zip(shape, arr)):
+        if b and a % b:
+            findings.append(Finding(
+                rule="G-DIV", site=record.site, path=record.path,
+                line=record.line,
+                message=(f"{name} dim {i}: array {a} not divisible by "
+                         f"block {b} — the edge block reads Mosaic pad "
+                         "garbage")))
+    varies, dynamic, points = index_map_profile(record, spec)
+    if points is None or dynamic is None:
+        return  # un-evaluable map: dynamic by construction
+    for point, idx in points.items():
+        if len(idx) != len(shape):
+            findings.append(Finding(
+                rule="G-RANK", site=record.site, path=record.path,
+                line=record.line,
+                message=f"{name} index map returns {len(idx)} indices "
+                        f"for a rank-{len(shape)} block"))
+            return
+        for i, (bi, b, a) in enumerate(zip(idx, shape, arr)):
+            if i in dynamic or not b:
+                continue
+            if bi * b + b > a or bi < 0:
+                findings.append(Finding(
+                    rule="G-BOUNDS", site=record.site, path=record.path,
+                    line=record.line,
+                    message=(f"{name} dim {i}: block index {bi} at grid "
+                             f"point {point} maps to "
+                             f"[{bi * b}, {bi * b + b}) outside array "
+                             f"dim {a}")))
+                return  # one bound finding per operand is enough
+
+
+def analyze_record(record: PallasCallRecord,
+                   generation: Optional[str] = None) -> List[Finding]:
+    """Run every geometry check on one recorded launch spec."""
+    from ..device import vmem as dv
+
+    findings: List[Finding] = []
+    pairs = [(f"in[{i}]", s, a)
+             for i, (s, a) in enumerate(record.blocked_operands())]
+    outs = record.out_shapes + [None] * (
+        len(record.out_specs) - len(record.out_shapes))
+    pairs += [(f"out[{i}]", s, outs[i] if i < len(outs) else None)
+              for i, s in enumerate(record.out_specs)]
+    for name, spec, aval in pairs:
+        if spec is None:
+            continue
+        _check_tile(record, name, spec, aval, findings)
+        _check_div_bounds(record, name, spec, aval, findings)
+
+    fp = vmem_footprint(record)
+    limit = record.vmem_limit_bytes
+    declared = limit is not None
+    if limit is None:
+        limit = dv.MOSAIC_DEFAULT_VMEM_LIMIT_BYTES
+    if fp.total_bytes > limit:
+        findings.append(Finding(
+            rule="G-VMEM", site=record.site, path=record.path,
+            line=record.line,
+            message=(f"footprint {fp.total_bytes / dv.MiB:.1f} MiB exceeds "
+                     + (f"declared vmem_limit_bytes {limit / dv.MiB:.1f} MiB"
+                        if declared else
+                        f"Mosaic's {limit / dv.MiB:.0f} MiB scoped default "
+                        "(declare vmem_limit_bytes)"))))
+    budget = dv.vmem_budget_bytes(generation)
+    if max(fp.total_bytes, limit if declared else 0) > budget:
+        findings.append(Finding(
+            rule="G-BUDGET", site=record.site, path=record.path,
+            line=record.line,
+            message=(f"declared limit/footprint "
+                     f"{max(fp.total_bytes, limit) / dv.MiB:.1f} MiB exceeds "
+                     f"the {generation or dv.detect_generation()} physical "
+                     f"VMEM budget {budget / dv.MiB:.0f} MiB")))
+    return findings
+
+
+# ----------------------------------------------------------------- source
+def scan_magic_vmem_literals(root: str) -> List[Finding]:
+    """``G-MAGIC``: flag every ``vmem_limit_bytes=<numeric literal>`` in
+    the tree — the cap must come from device.vmem so it can't drift from
+    the budget table."""
+
+    def is_const_num(node) -> bool:
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, (int, float))
+        if isinstance(node, ast.BinOp):
+            return is_const_num(node.left) and is_const_num(node.right)
+        return False
+
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(root))
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "vmem_limit_bytes" and is_const_num(kw.value):
+                        findings.append(Finding(
+                            rule="G-MAGIC", path=rel, line=kw.value.lineno,
+                            message=("vmem_limit_bytes is a magic numeric "
+                                     "literal; use device.vmem."
+                                     "KERNEL_VMEM_LIMIT_BYTES")))
+    return findings

@@ -1,0 +1,404 @@
+"""Pass 3 — trace-purity lint.
+
+An AST pass over the package flagging concretization hazards inside
+TRACED code — the class of bug that works in eager/CPU runs and then
+explodes (or silently bakes stale state) the first time the same code
+is traced for the chip.
+
+What counts as traced code (the contexts the pass scans):
+
+- Pallas kernel bodies (functions passed to ``pl.pallas_call``) and
+  ``@pl.when(...)`` sub-bodies — kind ``kernel`` / ``when``;
+- control-flow bodies handed to ``lax.fori_loop`` / ``while_loop`` /
+  ``scan`` / ``cond`` / ``switch`` — kind ``loop``;
+- functions wrapped by ``jax.jit`` — kind ``jit``.
+
+Rules (waivable in-line with ``# tpu-lint: ok(<rule>) -- <reason>``):
+
+- ``P-TRACER-IF``: python ``if``/``while``/ternary on a traced
+  parameter — concretizes the tracer (``is None`` identity checks are
+  exempt: they never read the value).
+- ``P-CONCRETIZE``: ``bool()/int()/float()`` applied to a traced
+  parameter.
+- ``P-NP-TRACER``: ``np.*`` applied to a traced parameter — silently
+  falls back to host numpy via ``__array__`` (a device sync + constant
+  bake) or fails to trace.
+- ``P-HOST-TIME`` / ``P-HOST-RNG``: ``time.*`` / python ``random.*`` /
+  ``np.random.*`` inside traced code — evaluated ONCE at trace time,
+  then frozen into every execution.
+- ``P-STATE-MUT``: python-state mutation inside ``fori_loop`` / ``scan``
+  / ``cond`` / ``while_loop`` bodies (``global``/``nonlocal``, attribute
+  stores or ``.append()``-family calls on closed-over objects) — the
+  body runs once at trace time, so the mutation happens once, not per
+  iteration. Stores through Pallas Refs (params of an enclosing kernel)
+  are device stores and exempt.
+- ``P-WAIVER``: a ``tpu-lint: ok(...)`` comment with no reason — a
+  waiver must document WHY.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import Finding, apply_waivers, parse_waivers
+
+__all__ = ["run_purity_file", "run_purity_pass"]
+
+#: call-wrapper name -> traced-context kind
+_WRAPPERS = {
+    "pallas_call": "kernel",
+    "fori_loop": "loop",
+    "while_loop": "loop",
+    "scan": "loop",
+    "cond": "loop",
+    "switch": "loop",
+    "jit": "jit",
+}
+
+_MUTATORS = {"append", "extend", "insert", "update", "add", "pop",
+             "setdefault", "remove", "clear", "discard"}
+
+#: a waiver-looking comment; ``ok(<`` is documentation of the syntax
+#: itself (placeholder brackets), not a waiver attempt
+_BARE_WAIVER_RE = re.compile(r"#\s*tpu-lint:\s*ok\b(?!\(<)")
+
+
+def _attr_tail(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _attr_chain(node) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+def _params_of(fn) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in
+             list(getattr(a, "posonlyargs", [])) + list(a.args)
+             + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+#: attribute reads that are static under trace (aval metadata)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+
+
+def _names_in(node) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _hazard_names(node) -> Set[str]:
+    """Names in ``node`` whose VALUE would be read under trace —
+    excludes structural accesses that stay python-static: ``len(x)``,
+    ``isinstance(x, ...)``, and ``x.shape``/``.ndim``/``.dtype``/etc."""
+    out: Set[str] = set()
+
+    def walk(n):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id in ("len", "isinstance", "type"):
+            return
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        for child in ast.iter_child_nodes(n):
+            walk(child)
+
+    walk(node)
+    return out
+
+
+def _is_none_identity(test) -> bool:
+    """``x is None`` / ``x is not None`` (possibly under BoolOp/not):
+    identity checks never concretize a tracer."""
+    if isinstance(test, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in test.ops)
+    if isinstance(test, ast.BoolOp):
+        return all(_is_none_identity(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_none_identity(test.operand)
+    return False
+
+
+class _FileLint:
+    def __init__(self, rel_path: str, tree: ast.AST):
+        self.rel = rel_path
+        self.tree = tree
+        self.findings: List[Finding] = []
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        # name -> FunctionDef nodes (for resolving fn names passed to
+        # wrappers; local names, so collisions are harmless)
+        self.defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                self.defs.setdefault(node.name, []).append(node)
+
+    # ---------------------------------------------------- traced contexts
+    def traced_contexts(self) -> List[Tuple[ast.AST, str]]:
+        out: List[Tuple[ast.AST, str]] = []
+        seen: Set[ast.AST] = set()
+
+        def mark(fn_node, kind):
+            if fn_node is not None and fn_node not in seen:
+                seen.add(fn_node)
+                out.append((fn_node, kind))
+
+        def ancestors(n):
+            out = []
+            cur = self.parents.get(n)
+            while cur is not None:
+                out.append(cur)
+                cur = self.parents.get(cur)
+            return out
+
+        def resolve(arg):
+            if isinstance(arg, ast.Lambda):
+                return arg
+            if isinstance(arg, ast.Name):
+                cands = self.defs.get(arg.id)
+                if not cands:
+                    return None
+                if len(cands) == 1:
+                    return cands[0]
+                # several same-named defs (every kernel is `kernel`,
+                # every loop body `body`): pick the one whose enclosing
+                # scope is the nearest ancestor of this call site
+                chain = ancestors(arg)
+                best, best_depth = cands[-1], -1
+                for c in cands:
+                    parent = self.parents.get(c)
+                    if parent in chain:
+                        depth = len(chain) - chain.index(parent)
+                        if depth > best_depth:
+                            best, best_depth = c, depth
+                return best
+            if (isinstance(arg, ast.Call)
+                    and _attr_tail(arg.func) == "partial" and arg.args):
+                return resolve(arg.args[0])
+            return None
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                kind = _WRAPPERS.get(_attr_tail(node.func) or "")
+                if kind:
+                    for arg in node.args:
+                        fn = resolve(arg)
+                        if fn is not None:
+                            mark(fn, kind)
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    tail = _attr_tail(dec.func if isinstance(dec, ast.Call)
+                                      else dec)
+                    if tail == "when":
+                        mark(node, "when")
+                    elif tail == "jit":
+                        mark(node, "jit")
+        return out
+
+    # -------------------------------------------------------------- rules
+    def _flag(self, rule, node, msg):
+        self.findings.append(Finding(
+            rule=rule, path=self.rel, line=getattr(node, "lineno", 0),
+            message=msg))
+
+    def _enclosing_param_names(self, node) -> Set[str]:
+        """Params of every enclosing FunctionDef/Lambda (Pallas Refs
+        closed over by pl.when/loop bodies live here)."""
+        names: Set[str] = set()
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.Lambda)):
+                names |= _params_of(cur)
+            cur = self.parents.get(cur)
+        return names
+
+    def lint_context(self, fn, kind: str) -> None:
+        params = _params_of(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+
+        local_stores: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            local_stores.add(sub.id)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        local_stores.add(sub.id)
+
+        enclosing_params = self._enclosing_param_names(fn)
+
+        for stmt in body:
+            for node in ast.walk(stmt):
+                # --- tracer concretization ---------------------------
+                if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    test = node.test
+                    hit = _hazard_names(test) & params
+                    if not _is_none_identity(test) and hit:
+                        which = {ast.If: "if", ast.While: "while",
+                                 ast.IfExp: "conditional expression"}[
+                                     type(node)]
+                        self._flag(
+                            "P-TRACER-IF", node,
+                            f"python {which} on traced value(s) "
+                            f"{sorted(hit)} inside "
+                            f"a {kind} body — concretizes the tracer; "
+                            "use lax.cond/select or pl.when")
+                if isinstance(node, ast.Call):
+                    tail = _attr_tail(node.func)
+                    chain = _attr_chain(node.func)
+                    arg_names: Set[str] = set()
+                    for a in list(node.args) + [kw.value
+                                                for kw in node.keywords]:
+                        arg_names |= _hazard_names(a)
+                    if (isinstance(node.func, ast.Name)
+                            and node.func.id in ("bool", "int", "float")
+                            and arg_names & params):
+                        self._flag(
+                            "P-CONCRETIZE", node,
+                            f"{node.func.id}() on traced value(s) "
+                            f"{sorted(arg_names & params)} inside a "
+                            f"{kind} body — forces a device sync / "
+                            "trace error")
+                    if (chain and chain[0] in ("np", "numpy")
+                            and chain[1:2] != ["random"]
+                            and arg_names & params):
+                        self._flag(
+                            "P-NP-TRACER", node,
+                            f"np.{'.'.join(chain[1:])} applied to traced "
+                            f"value(s) {sorted(arg_names & params)} — "
+                            "host numpy bakes a constant (or fails) "
+                            "under trace; use jnp")
+                    if chain and chain[0] == "time":
+                        self._flag(
+                            "P-HOST-TIME", node,
+                            f"time.{'.'.join(chain[1:])}() inside a "
+                            f"{kind} body runs ONCE at trace time")
+                    if chain and (chain[0] == "random"
+                                  or chain[:2] == ["np", "random"]
+                                  or chain[:2] == ["numpy", "random"]):
+                        self._flag(
+                            "P-HOST-RNG", node,
+                            f"host RNG {'.'.join(chain)} inside a {kind} "
+                            "body is frozen at trace time; use jax.random "
+                            "with a threaded key")
+                # --- python-state mutation in loop bodies ------------
+                if kind == "loop":
+                    self._lint_state_mut(node, params, local_stores,
+                                         enclosing_params)
+
+    def _lint_state_mut(self, node, params, local_stores,
+                        enclosing_params) -> None:
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            self._flag(
+                "P-STATE-MUT", node,
+                f"{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                f" {', '.join(node.names)} inside a traced loop body — "
+                "the body runs once at trace time, not per iteration")
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                base = t
+                kinds = []
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    kinds.append(type(base))
+                    base = base.value
+                if not kinds or not isinstance(base, ast.Name):
+                    continue
+                name = base.id
+                if name in params or name in local_stores:
+                    continue
+                if ast.Subscript in kinds and name in enclosing_params:
+                    continue  # Pallas Ref store through a kernel param
+                self._flag(
+                    "P-STATE-MUT", node,
+                    f"store into closed-over `{name}` inside a traced "
+                    "loop body happens once at trace time — carry it "
+                    "through the loop state instead")
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            if (node.func.attr in _MUTATORS
+                    and isinstance(node.func.value, ast.Name)):
+                name = node.func.value.id
+                if (name not in params and name not in local_stores
+                        and name not in enclosing_params):
+                    self._flag(
+                        "P-STATE-MUT", node,
+                        f"`{name}.{node.func.attr}(...)` mutates "
+                        "closed-over python state inside a traced loop "
+                        "body — runs once at trace time")
+
+    def run(self) -> List[Finding]:
+        for fn, kind in self.traced_contexts():
+            self.lint_context(fn, kind)
+        return self.findings
+
+
+def _waiver_hygiene(rel: str, source: str) -> List[Finding]:
+    good = parse_waivers(source)
+    out = []
+    for i, line in enumerate(source.splitlines(), start=1):
+        if _BARE_WAIVER_RE.search(line) and i not in good:
+            out.append(Finding(
+                rule="P-WAIVER", path=rel, line=i,
+                message="waiver without a rule id + reason: use "
+                        "`# tpu-lint: ok(<rule>) -- <reason>`"))
+    return out
+
+
+def run_purity_file(path: str, rel: Optional[str] = None) -> List[Finding]:
+    rel = rel or path
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="P-SYNTAX", path=rel, line=e.lineno or 0,
+                        message=f"unparsable: {e.msg}")]
+    findings = _FileLint(rel, tree).run()
+    findings += _waiver_hygiene(rel, source)
+    apply_waivers(findings, {rel: parse_waivers(source)})
+    return findings
+
+
+def run_purity_pass(pkg_root: Optional[str] = None) -> List[Finding]:
+    """Lint every .py under the package root (default: paddle_tpu/)."""
+    if pkg_root is None:
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    findings: List[Finding] = []
+    base = os.path.dirname(pkg_root)
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                findings += run_purity_file(
+                    path, os.path.relpath(path, base))
+    return findings

@@ -1,0 +1,357 @@
+"""The repo's Pallas kernel inventory + the interpret-free dry-trace
+driver that exercises every site through the audit shim.
+
+Each :class:`KernelSite` names one ``pallas_call`` site, builds a
+representative serving-shaped launch (as ShapeDtypeStructs — no real
+arrays), and dry-traces it with ``jax.eval_shape`` under
+``record_pallas_calls``. Abstract evaluation captures the full launch
+spec without lowering to Mosaic, so this runs on CPU in milliseconds
+per kernel, with the exact BlockSpecs/grid/scratch the chip would get.
+
+``expected_vmem`` is an INDEPENDENT hand-written block list per site
+(kept in sync with the kernel by eye, not by code): the tier-1
+regression test asserts the analyzer's footprint over the shim-recorded
+spec equals this closed form, so either the analyzer drifting or a
+kernel's geometry changing silently fails CI until both are
+re-reconciled.
+
+The TPU-only routing gates (``_on_tpu``) are monkeypatched for the
+duration of a dry-trace so the Pallas path is taken off-chip; x64 is
+disabled around each trace to mirror the on-TPU tracing regime (the
+stock flash kernel's index maps require it).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Callable, List, Optional
+
+from .audit import PallasCallRecord, record_pallas_calls
+from .geometry import tile_padded_bytes as _B
+
+__all__ = ["KernelSite", "KERNEL_SITES", "trace_site", "trace_all_sites"]
+
+
+@dataclasses.dataclass
+class KernelSite:
+    name: str                 # "stream_linear.bf16", ...
+    module: str               # module that owns the pallas_call
+    build: Callable           # () -> (fn, args) for jax.eval_shape
+    expected_vmem: Optional[Callable[[], int]]  # closed-form footprint
+    n_calls: int = 1          # pallas_calls the dry-trace must record
+
+
+@contextlib.contextmanager
+def _force_tpu_routing():
+    """Patch the kernel modules' ``_on_tpu`` gates so dry-traces take
+    the Pallas path off-chip, and trace under x64=False (the regime the
+    kernels are written for — see paged_attention._enable_x64)."""
+    import jax
+
+    import paddle_tpu.nn.functional.attention as att
+    import paddle_tpu.nn.functional.stream_linear as sl
+
+    saved = [(sl, "_on_tpu", sl._on_tpu), (att, "_on_tpu", att._on_tpu)]
+    x64 = bool(jax.config.jax_enable_x64)
+    try:
+        for mod, name, _ in saved:
+            setattr(mod, name, lambda: True)
+        jax.config.update("jax_enable_x64", False)
+        yield
+    finally:
+        for mod, name, orig in saved:
+            setattr(mod, name, orig)
+        jax.config.update("jax_enable_x64", x64)
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# --------------------------------------------------------------- builders
+# Representative serving shapes: GPT-1.3B-ish projections (d=2048,
+# dff=8192), a b=8 GQA-free decode batch over a 16-token-page pool with
+# 1024-token stream chunks, and a bert-ish s=512 flash block.
+
+def _build_stream_linear():
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn.functional.stream_linear as sl
+
+    def fn(x, w):
+        return sl.stream_linear(x, w)
+
+    return fn, (_sds((32, 2048), jnp.bfloat16),
+                _sds((2048, 8192), jnp.bfloat16))
+
+
+def _expected_stream_linear():
+    # bn = 2048 (8 MiB bf16 target / K=2048 rows), nb = 4, Mp = 32
+    return (_B((32, 2048), "bfloat16")           # x, resident
+            + 2 * _B((1, 2048, 2048), "bfloat16")  # w stream, dbl-buffered
+            + 2 * _B((32, 2048), "bfloat16"))      # out blocks, streamed
+
+
+def _build_stream_linear_a8w8():
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn.functional.stream_linear as sl
+
+    def fn(x, w, s):
+        return sl.stream_linear(x, w, scale=s, act_quant=True)
+
+    return fn, (_sds((32, 2048), jnp.bfloat16),
+                _sds((2048, 8192), jnp.int8),
+                _sds((8192,), jnp.float32))
+
+
+def _expected_stream_linear_a8w8():
+    # bn = 2048 (4 MiB int8 target), nb = 4, Mp = 32 (int8 sublane tile)
+    return (_B((32, 2048), "int8")                 # x_q, resident
+            + _B((32, 1), "float32")               # per-token scales
+            + 2 * _B((1, 2048, 2048), "int8")      # w stream
+            + 2 * _B((1, 1, 2048), "float32")      # dequant scales
+            + 2 * _B((32, 2048), "bfloat16"))      # out blocks
+
+
+def _build_stream_layer_tail():
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn.functional.stream_linear as sl
+
+    L, d, dff, nq = 4, 2048, 8192, 3 * 2048
+    bf = jnp.bfloat16
+
+    def fn(att, h, wo, w1, w2, bo, b1, b2, ln2s, ln2b, wq, bq, ln1s,
+           ln1b):
+        return sl.stream_layer_tail(
+            att, h, wo, w1, w2, layer=0, bo=bo, b1=b1, b2=b2,
+            ln2_scale=ln2s, ln2_bias=ln2b, epsilon=1e-5,
+            activation="gelu",
+            next_qkv={"w": wq, "b": bq, "ln_s": ln1s, "ln_b": ln1b,
+                      "layer": 1},
+            interpret=True)
+
+    args = (_sds((32, d), bf), _sds((32, d), bf),
+            _sds((L, d, d), bf), _sds((L, d, dff), bf),
+            _sds((L, dff, d), bf),
+            _sds((L, d), bf), _sds((L, dff), bf), _sds((L, d), bf),
+            _sds((L, d), bf), _sds((L, d), bf),
+            _sds((L, d, nq), bf), _sds((L, nq), bf),
+            _sds((L, d), bf), _sds((L, d), bf))
+    return fn, args
+
+
+def _expected_stream_layer_tail():
+    # bn_o = bn_f = bn_q = 512 (2 MiB grouped per-stream target);
+    # grid = nb_o + nb_f + nb_q = 4 + 16 + 12
+    d, dff, nq = 2048, 8192, 3 * 2048
+    bf = "bfloat16"
+    return (
+        _B((32, d), bf) + _B((32, d), bf)          # att, h: resident
+        + 2 * _B((1, d, 512), bf)                  # Wo stream
+        + _B((1, 1, d), bf)                        # bo (whole row)
+        + 2 * _B((1, d, 512), bf)                  # W1 stream
+        + 2 * _B((1, 1, 512), bf)                  # b1 blocks
+        + 2 * _B((1, 512, d), bf)                  # W2 stream
+        + _B((1, 1, d), bf)                        # b2 (whole row)
+        + _B((1, d), bf) * 2                       # ln2 scale+bias
+        + 2 * _B((1, d, 512), bf)                  # Wq prefetch stream
+        + 2 * _B((1, 1, 512), bf)                  # bq blocks
+        + _B((1, d), bf) * 2                       # ln1 scale+bias
+        + _B((32, d), bf)                          # out_h
+        + 2 * _B((32, 512), bf)                    # out_q blocks
+        + _B((32, d), "float32") * 2               # s_h2 + s_acc scratch
+        + _B((32, d), bf))                         # s_hn scratch
+
+
+_POOL = dict(b=8, n_kv=8, d=128, ps=16)
+
+
+def _paged_args(P, pp, dtype_name="bfloat16"):
+    import jax.numpy as jnp
+
+    b, n_kv, d, ps = (_POOL[k] for k in ("b", "n_kv", "d", "ps"))
+    dt = getattr(jnp, dtype_name)
+    return (_sds((b, n_kv, d), dt),
+            _sds((P, n_kv, ps, d), dt),
+            _sds((P, n_kv, ps, d), dt),
+            _sds((b,), jnp.int32),
+            _sds((b, pp), jnp.int32))
+
+
+def _build_fused_paged():
+    from paddle_tpu.nn.functional.paged_attention import _fused_paged
+
+    q, kc, vc, lens, tables = _paged_args(P=64, pp=8)
+
+    def fn(q, kc, vc, lens, tables):
+        return _fused_paged(q, kc, vc, lens, tables)
+
+    return fn, (q, kc, vc, lens, tables)
+
+
+def _expected_fused_paged():
+    b, n_kv, d, ps = (_POOL[k] for k in ("b", "n_kv", "d", "ps"))
+    return (2 * _B((1, n_kv, d), "bfloat16")       # q block per sequence
+            + 2 * _B((1, n_kv, d), "float32")      # out block
+            + 2 * _B((2, n_kv, ps, d), "bfloat16"))  # k_buf + v_buf scratch
+
+
+def _build_stream_paged():
+    from paddle_tpu.nn.functional.paged_attention import _stream_paged
+
+    q, kc, vc, lens, tables = _paged_args(P=128, pp=8)
+
+    def fn(q, kc, vc, lens, tables):
+        return _stream_paged(q, kc, vc, lens, tables, pool_base=0,
+                             pool_pages=128)
+
+    return fn, (q, kc, vc, lens, tables)
+
+
+def _expected_stream_paged():
+    # cp = 64 pages -> C = 1024 tokens/chunk, nchunks = 2, bg = 8
+    b, n_kv, d, ps = (_POOL[k] for k in ("b", "n_kv", "d", "ps"))
+    return (_B((n_kv, b, d), "bfloat16")           # qt, resident
+            + 2 * _B((1, b, 1024), "int32")        # ownership mask chunk
+            + 2 * 2 * _B((64, n_kv, ps, d), "bfloat16")  # k+v chunk streams
+            + _B((n_kv, b, d), "float32")          # out
+            + 2 * _B((n_kv, b), "float32")         # m + l scratch
+            + _B((n_kv, b, d), "float32"))         # acc scratch
+
+
+def _build_decode_inplace():
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.paged_attention import (
+        paged_decode_attention_inplace)
+
+    q, kc, vc, lens, tables = _paged_args(P=128, pp=8)
+    nk = _sds((_POOL["b"], _POOL["n_kv"], _POOL["d"]), jnp.bfloat16)
+
+    def fn(q, nk, nv, kc, vc, lens, tables):
+        return paged_decode_attention_inplace(
+            q, nk, nv, kc, vc, lens, tables, pool_base=0, pool_pages=128)
+
+    return fn, (q, nk, nk, kc, vc, lens, tables)
+
+
+def _expected_decode_inplace():
+    b, n_kv, d, ps = (_POOL[k] for k in ("b", "n_kv", "d", "ps"))
+    bf = "bfloat16"
+    return (_B((n_kv, b, d), bf)                   # qt
+            + 2 * _B((1, b, 1024), "int32")        # ownership mask chunk
+            + 2 * _B((n_kv, b, d), bf)             # nk_t + nv_t operands
+            + 2 * _B((b, n_kv, ps, d), bf)         # nk_w + nv_w page patch
+            + _B((b, 1, ps, 1), "float32")         # slot selector
+            + _B((n_kv, b, d), "float32")          # out
+            + 2 * 2 * _B((64, n_kv, ps, d), bf)    # kb + vb chunk scratch
+            + 2 * _B((b, n_kv, ps, d), bf)         # pgk + pgv page RMW
+            + 2 * _B((n_kv, b), "float32")         # m + l
+            + _B((n_kv, b, d), "float32"))         # acc
+
+
+def _build_decode_inplace_q():
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.paged_attention import (
+        paged_decode_attention_inplace_q)
+
+    b, n_kv, d, ps = (_POOL[k] for k in ("b", "n_kv", "d", "ps"))
+    P = 128
+    q = _sds((b, n_kv, d), jnp.bfloat16)
+    nk = _sds((b, n_kv, d), jnp.bfloat16)
+    pool = _sds((P, n_kv, ps, d), jnp.int8)
+    plane = _sds((n_kv, P * ps), jnp.float32)
+    lens = _sds((b,), jnp.int32)
+    tables = _sds((b, 8), jnp.int32)
+
+    def fn(q, nk, nv, kq, ks, vq, vs, lens, tables):
+        return paged_decode_attention_inplace_q(
+            q, nk, nv, kq, ks, vq, vs, lens, tables, pool_base=0,
+            pool_pages=P)
+
+    return fn, (q, nk, nk, pool, plane, pool, plane, lens, tables)
+
+
+def _expected_decode_inplace_q():
+    # rows_pp = n_kv*ps = 128 int8 rows/page; C = 1024, nchunks = 2
+    b, n_kv, d, ps = (_POOL[k] for k in ("b", "n_kv", "d", "ps"))
+    rp = n_kv * ps
+    return (_B((n_kv, b, d), "int8")               # qq
+            + _B((n_kv, b), "float32")             # qs
+            + 2 * _B((1, b, 1024), "int32")        # ownership mask chunk
+            + 2 * _B((n_kv, b, d), "bfloat16")     # nk_t + nv_t (exact)
+            + 2 * _B((b, rp, d), "int8")           # quantized page patches
+            + _B((b, rp, 1), "float32")            # flat slot selector
+            + 2 * _B((1, 1024), "float32")         # plane patch column sel
+            + 2 * 2 * _B((n_kv, 1024), "float32")  # kval+vval patch values
+            + 2 * 2 * _B((n_kv, 1024), "float32")  # ks+vs plane blocks in
+            + _B((n_kv, b, d), "float32")          # out
+            + 2 * 2 * _B((n_kv, 1024), "float32")  # kso+vso plane blocks out
+            + 2 * _B((2, 64, rp, d), "int8")       # kb + vb chunk scratch
+            + 2 * _B((b, rp, d), "int8")           # pgq + pgv page RMW
+            + 2 * _B((n_kv, b), "float32")         # m + l
+            + _B((n_kv, b, d), "float32"))         # acc
+
+
+def _build_flash():
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn.functional.attention as att
+
+    q = _sds((2, 512, 8, 128), jnp.float32)
+
+    def fn(q, k, v):
+        return att._attention_raw(q, k, v, causal=True)
+
+    return fn, (q, q, q)
+
+
+KERNEL_SITES: List[KernelSite] = [
+    KernelSite("stream_linear.bf16", "nn/functional/stream_linear.py",
+               _build_stream_linear, _expected_stream_linear),
+    KernelSite("stream_linear.a8w8", "nn/functional/stream_linear.py",
+               _build_stream_linear_a8w8, _expected_stream_linear_a8w8),
+    KernelSite("stream_linear.layer_tail",
+               "nn/functional/stream_linear.py",
+               _build_stream_layer_tail, _expected_stream_layer_tail),
+    KernelSite("paged_attention.fused", "nn/functional/paged_attention.py",
+               _build_fused_paged, _expected_fused_paged),
+    KernelSite("paged_attention.stream",
+               "nn/functional/paged_attention.py",
+               _build_stream_paged, _expected_stream_paged),
+    KernelSite("paged_attention.decode_inplace",
+               "nn/functional/paged_attention.py",
+               _build_decode_inplace, _expected_decode_inplace),
+    KernelSite("paged_attention.decode_inplace_q",
+               "nn/functional/paged_attention.py",
+               _build_decode_inplace_q, _expected_decode_inplace_q),
+    # the stock jax flash kernel: geometry-checked but no hand block
+    # list (its internals are jax's, not ours)
+    KernelSite("attention.flash", "nn/functional/attention.py",
+               _build_flash, None),
+]
+
+
+def trace_site(site: KernelSite) -> List[PallasCallRecord]:
+    """Dry-trace one site; returns its recorded launch specs."""
+    import jax
+
+    fn, args = site.build()
+    with _force_tpu_routing(), record_pallas_calls() as records:
+        jax.eval_shape(fn, *args)
+    if len(records) != site.n_calls:
+        raise AssertionError(
+            f"{site.name}: expected {site.n_calls} pallas_call(s), "
+            f"recorded {len(records)} — kernel routing changed; update "
+            "analysis/sites.py")
+    return records
+
+
+def trace_all_sites():
+    """name -> records for the full kernel inventory."""
+    return {site.name: trace_site(site) for site in KERNEL_SITES}

@@ -7,13 +7,20 @@ macro at flags.h:145, settable by env ``FLAGS_*`` or ``paddle.set_flags``).
 We keep the same surface: flags declared once with a default + doc, env
 ``FLAGS_<name>`` overrides the default at first read, and ``set_flags`` /
 ``get_flags`` mutate/inspect at runtime.
+
+Every flag is ALSO settable via ``PADDLE_TPU_<NAME>`` (upper-cased) —
+the deployment convention the PR 5 compile-cache flag established,
+generalized to the whole registry. ``FLAGS_<name>`` wins when both are
+set (reference parity). The README flags table lists both forms per
+flag; ``tools/tpu_lint.py`` (flags pass) asserts the table stays
+complete.
 """
 from __future__ import annotations
 
 import os
 from typing import Any, Dict
 
-__all__ = ["define_flag", "set_flags", "get_flags", "flag"]
+__all__ = ["define_flag", "set_flags", "get_flags", "flag", "env_var_for"]
 
 _FLAGS: Dict[str, dict] = {}
 
@@ -30,10 +37,17 @@ def _coerce(value, proto):
     return value
 
 
+def env_var_for(name: str) -> str:
+    """The deployment-convention env override for a flag name."""
+    return "PADDLE_TPU_" + name.upper()
+
+
 def define_flag(name: str, default: Any, doc: str = "") -> None:
     if name in _FLAGS:
         return
     env = os.environ.get(f"FLAGS_{name}")
+    if env is None:
+        env = os.environ.get(env_var_for(name))
     value = _coerce(env, default) if env is not None else default
     _FLAGS[name] = {"default": default, "value": value, "doc": doc}
 
@@ -95,14 +109,21 @@ define_flag("decode_prefetch", True,
             "final grid phase, overlapping its weight DMA with layer "
             "l's FFN compute; off = a separate streamed QKV call per "
             "layer (2 streamed calls/layer instead of 1)")
-define_flag("compile_cache_dir",
-            os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR", ""),
+define_flag("compile_cache_dir", "",
             "persistent XLA compilation-cache directory (also settable "
             "via env PADDLE_TPU_COMPILE_CACHE_DIR): applied to "
             "jax_compilation_cache_dir at import by "
             "device.setup_compile_cache(), so recompiles of unchanged "
             "programs (e.g. the 25-min s2048 flash-attention backward) "
             "are served from disk across processes")
+define_flag("check_donation", False,
+            "use-after-donate poison mode (paddle_tpu.analysis.donation): "
+            "buffers donated by the compiled-forward fast path are "
+            "registered as poisoned after dispatch, and every subsequent "
+            "dispatch / Tensor.numpy() asserts none of its inputs is one "
+            "— CPU runs then fail exactly where TPU donation would read "
+            "freed HBM, instead of silently passing (CPU jaxlib ignores "
+            "donation)")
 define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
 define_flag("eager_fwd_cache", True,
             "no-grad eager dispatch through the signature-keyed "

@@ -106,6 +106,12 @@ class Tensor:
 
     # ---------------- conversion ----------------
     def numpy(self) -> np.ndarray:
+        from .flags import flag
+
+        if flag("check_donation"):
+            from ..analysis import donation as _don
+
+            _don.assert_not_poisoned([self._data], "Tensor.numpy()")
         return np.asarray(self._data)
 
     def item(self):

@@ -15,6 +15,10 @@ from ..core.place import (  # noqa: F401
     CPUPlace, Place, TPUPlace, current_place, device_count, get_device,
     is_compiled_with_tpu, set_device,
 )
+from . import vmem  # noqa: F401  (per-generation VMEM budget table)
+from .vmem import (  # noqa: F401
+    KERNEL_VMEM_LIMIT_BYTES, VMEM_BUDGET_BYTES, vmem_budget_bytes,
+)
 
 __all__ = [
     "set_device", "get_device", "device_count", "current_place",
@@ -23,6 +27,8 @@ __all__ = [
     "reset_peak_memory_stats", "empty_cache", "setup_compile_cache",
     "Place", "CPUPlace", "TPUPlace", "is_compiled_with_tpu",
     "is_compiled_with_cuda", "is_compiled_with_xpu", "cuda", "tpu",
+    "vmem", "VMEM_BUDGET_BYTES", "KERNEL_VMEM_LIMIT_BYTES",
+    "vmem_budget_bytes",
 ]
 
 

@@ -1,0 +1,100 @@
+"""Per-generation TPU VMEM budgets — the single source of truth for
+kernel VMEM limits.
+
+Every hand-tuned Pallas kernel in the repo caps its scoped-VMEM use via
+``compiler_params(vmem_limit_bytes=...)``. Those caps used to be magic
+``100 * 1024 * 1024`` literals scattered across the kernel modules; the
+geometry pass of ``paddle_tpu.analysis`` flags any such literal
+(rule ``G-MAGIC``) and this module is where the number actually comes
+from: the physical VMEM of the target generation minus a fixed reserve
+for Mosaic's own scratch (spills, semaphores, pipelining bookkeeping).
+
+Physical VMEM per TensorCore by generation (v2-v4 from the public TPU
+system architecture docs; v5e confirmed empirically by the r5 kernel
+bring-up — the repo's streaming kernels run with a 100MB cap on v5e):
+
+    v2 / v3 : 16 MiB
+    v4+     : 128 MiB (v4, v5e, v5p, v6e)
+
+Off-TPU (CPU interpret mode) the budget is irrelevant to execution but
+the analyzer still validates against the DEFAULT serving generation so
+CI catches geometry that would not fit the chip.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "MiB", "VMEM_BUDGET_BYTES", "VMEM_RESERVE_BYTES",
+    "DEFAULT_GENERATION", "KERNEL_VMEM_LIMIT_BYTES",
+    "MOSAIC_DEFAULT_VMEM_LIMIT_BYTES", "vmem_budget_bytes",
+    "detect_generation",
+]
+
+MiB = 1 << 20
+
+#: physical VMEM bytes per TensorCore, by TPU generation
+VMEM_BUDGET_BYTES = {
+    "v2": 16 * MiB,
+    "v3": 16 * MiB,
+    "v4": 128 * MiB,
+    "v5e": 128 * MiB,
+    "v5p": 128 * MiB,
+    "v6e": 128 * MiB,
+}
+
+#: headroom left to the Mosaic compiler for its own scratch — register
+#: spills, DMA semaphores, pipelining bookkeeping — on top of what the
+#: kernel's declared blocks/scratch consume
+VMEM_RESERVE_BYTES = 28 * MiB
+
+#: the serving generation the hand-tuned kernel geometry targets (the
+#: chip every BENCH_r* number was measured on)
+DEFAULT_GENERATION = "v5e"
+
+#: the vmem_limit_bytes every repo Pallas kernel declares: generation
+#: budget minus the Mosaic reserve (= the historical 100 MiB cap, now
+#: derived instead of hard-coded)
+KERNEL_VMEM_LIMIT_BYTES = (
+    VMEM_BUDGET_BYTES[DEFAULT_GENERATION] - VMEM_RESERVE_BYTES)
+
+#: what a pallas_call gets when it declares NO vmem_limit_bytes — the
+#: conservative scoped-VMEM default of the XLA:TPU compiler
+#: (xla_tpu_scoped_vmem_limit_kib = 16384)
+MOSAIC_DEFAULT_VMEM_LIMIT_BYTES = 16 * MiB
+
+#: jax device_kind strings -> generation keys (prefix match, checked
+#: longest-first so "v5 lite" beats "v5")
+_DEVICE_KIND_MAP = (
+    ("tpu v6 lite", "v6e"),
+    ("tpu v6e", "v6e"),
+    ("tpu v5 lite", "v5e"),
+    ("tpu v5e", "v5e"),
+    ("tpu v5p", "v5p"),
+    ("tpu v5", "v5p"),
+    ("tpu v4", "v4"),
+    ("tpu v3", "v3"),
+    ("tpu v2", "v2"),
+)
+
+
+def detect_generation(default: str = DEFAULT_GENERATION) -> str:
+    """TPU generation of the attached accelerator, or ``default`` when
+    running off-TPU (CPU CI analyses against the serving target)."""
+    try:
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return default
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return default
+    for prefix, gen in _DEVICE_KIND_MAP:
+        if kind.startswith(prefix):
+            return gen
+    return default
+
+
+def vmem_budget_bytes(generation: str | None = None) -> int:
+    """Physical VMEM budget for ``generation`` (auto-detected when
+    None). Unknown generations fall back to the conservative 16 MiB."""
+    gen = generation or detect_generation()
+    return VMEM_BUDGET_BYTES.get(gen, 16 * MiB)

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ...ops.dispatch import defun, eager_apply, as_tensor_args, inplace_apply
-from ...ops.registry import register_op
+from ...ops.registry import all_ops, register_op
 
 __all__ = [
     "relu", "relu_", "relu6", "gelu", "sigmoid", "log_sigmoid", "silu",
@@ -184,9 +184,14 @@ def tanh_(x):
 
 # the in-place family is registered with its donation contract so the
 # registry stays the single source of truth for which ops may donate
-# their target buffer on the compiled no-grad fast path
-for _name, _fn, _of in (("relu_", relu_, "relu"), ("tanh_", tanh_, "tanh"),
-                        ("elu_", elu_, "elu"), ("softmax_", softmax_,
-                                                "softmax")):
+# their target buffer on the compiled no-grad fast path; the base ops
+# are registered alongside so every `inplace_of` resolves inside the
+# registry (the tpu_lint donation audit's D-DANGLING rule)
+for _name, _fn, _of, _base in (
+        ("relu_", relu_, "relu", relu), ("tanh_", tanh_, "tanh", tanh),
+        ("elu_", elu_, "elu", elu),
+        ("softmax_", softmax_, "softmax", softmax)):
+    if _of not in all_ops():  # tanh already registered by ops/math.py
+        register_op(_of, _base, tags=("activation",))
     register_op(_name, _fn, inplace_of=_of, donates=(0,),
                 tags=("activation", "inplace"))

@@ -41,6 +41,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ...device.vmem import KERNEL_VMEM_LIMIT_BYTES
+
 __all__ = ["paged_attention", "write_kv_pages", "write_prefill_kv_pages"]
 
 
@@ -268,6 +270,8 @@ def _fused_paged(q, key_cache, value_cache, seq_lens, block_tables):
             kernel,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((b, n_q, d), jnp.float32),
+            compiler_params=_pltpu_compiler_params(pltpu)(
+                vmem_limit_bytes=KERNEL_VMEM_LIMIT_BYTES),
         )(block_tables.reshape(-1).astype(jnp.int32),
           seq_lens.astype(jnp.int32), q, key_cache, value_cache)
     return out.astype(q.dtype)
@@ -472,7 +476,7 @@ def _stream_paged(q, key_cache, value_cache, seq_lens, block_tables,
             # conservative 16MB default scoped-VMEM budget; v5e has
             # 128MB physical
             compiler_params=_pltpu_compiler_params(pltpu)(
-                vmem_limit_bytes=100 * 1024 * 1024),
+                vmem_limit_bytes=KERNEL_VMEM_LIMIT_BYTES),
             interpret=not _on_tpu(),
         )(base_chunk, qt, mask3, key_cache, value_cache)
     out = jnp.transpose(out.reshape(n_kv, b, g, d), (1, 0, 2, 3))
@@ -764,7 +768,7 @@ def paged_decode_attention_inplace(q, new_k, new_v, key_cache,
             # key_cache is arg 8, value_cache arg 9 -> outputs 1, 2
             input_output_aliases={8: 1, 9: 2},
             compiler_params=_pltpu_compiler_params(pltpu)(
-                vmem_limit_bytes=100 * 1024 * 1024),
+                vmem_limit_bytes=KERNEL_VMEM_LIMIT_BYTES),
             interpret=not _on_tpu(),
         )(scalars, qt, mask3, nk_t, nv_t, nk_w, nv_w, slotmask,
           key_cache, value_cache)
@@ -1213,7 +1217,7 @@ def paged_decode_attention_inplace_q(q, new_k, new_v, kq_pool, ks_plane,
             # ks12, vs13, kq14, vq15]
             input_output_aliases={14: 1, 15: 2, 12: 3, 13: 4},
             compiler_params=_pltpu_compiler_params(pltpu)(
-                vmem_limit_bytes=100 * 1024 * 1024),
+                vmem_limit_bytes=KERNEL_VMEM_LIMIT_BYTES),
             interpret=not _on_tpu(),
         )(scalars, qq, qs, mask3, nk_t, nv_t, nkq_w, nvq_w, sel_flat,
           sel_col, kval, vval, ks_plane, vs_plane, kq_flat, vq_flat)

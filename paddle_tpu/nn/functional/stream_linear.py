@@ -67,6 +67,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...device.vmem import KERNEL_VMEM_LIMIT_BYTES
 from .paged_attention import (_enable_x64, _on_tpu,
                               _pltpu_compiler_params)
 
@@ -198,7 +199,7 @@ def _stream_linear_a8w8(x_q, x_scale, w3, s3, b3, layer, activation,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((Mp, N), out_dtype),
             compiler_params=_pltpu_compiler_params(pltpu)(
-                vmem_limit_bytes=100 * 1024 * 1024),
+                vmem_limit_bytes=KERNEL_VMEM_LIMIT_BYTES),
             interpret=interpret,
         )(lidx, *operands)
     return out[:M] if Mp != M else out
@@ -343,7 +344,7 @@ def stream_linear(x, w, layer=None, bias=None, scale=None,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((Mp, N), out_dtype),
             compiler_params=_pltpu_compiler_params(pltpu)(
-                vmem_limit_bytes=100 * 1024 * 1024),
+                vmem_limit_bytes=KERNEL_VMEM_LIMIT_BYTES),
         )(lidx, *operands)
     return out[:M] if Mp != M else out
 
@@ -578,7 +579,7 @@ def _stream_layer_tail_kernel(att, h, wo3, w13, w23, so3, s13, s23,
             grid_spec=grid_spec,
             out_shape=out_shapes,
             compiler_params=_pltpu_compiler_params(pltpu)(
-                vmem_limit_bytes=100 * 1024 * 1024),
+                vmem_limit_bytes=KERNEL_VMEM_LIMIT_BYTES),
             interpret=interpret,
         )(lidx, *operands)
     return outs

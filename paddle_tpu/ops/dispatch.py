@@ -298,7 +298,25 @@ def _donation_safe(arrays, i) -> bool:
     return sys.getrefcount(arrays[i]) <= 3
 
 
-def _forward_fast_path(raw_fn, arrays, static_kwargs, donate_idx):
+def _poison_donated(op_name, arrays, eff_donate):
+    """FLAGS_check_donation: after a donated dispatch the donated input
+    buffers are dead on TPU — register them so any alias that slipped
+    the refcount guard fails its next read loudly (CPU jaxlib ignores
+    donation, so without this the bug is invisible off-chip)."""
+    from ..analysis import donation as _don
+
+    for i in eff_donate:
+        _don.poison(arrays[i], op_name)
+
+
+def _check_poisoned(arrays, reader):
+    from ..analysis import donation as _don
+
+    _don.assert_not_poisoned(arrays, reader)
+
+
+def _forward_fast_path(raw_fn, arrays, static_kwargs, donate_idx,
+                       op_name="<op>"):
     """Try the compiled-forward cache for a no-grad dispatch. Returns
     ``(outs, was_tuple)`` when a compiled executable served the call,
     None to fall back to the plain eager path."""
@@ -331,6 +349,8 @@ def _forward_fast_path(raw_fn, arrays, static_kwargs, donate_idx):
             return None
         _F_HIT.inc()
         _FWD_CACHE.move_to_end(key)
+        if eff_donate and flag("check_donation"):
+            _poison_donated(op_name, arrays, eff_donate)
         return outs, entry.box.get("was_tuple", False)
     if not _FWD_SEEN.admit(key, raw_fn):
         _F_MISS.inc()
@@ -348,6 +368,8 @@ def _forward_fast_path(raw_fn, arrays, static_kwargs, donate_idx):
     _FWD_CACHE[key] = entry
     while len(_FWD_CACHE) > _FWD_CACHE_MAX:
         _FWD_CACHE.popitem(last=False)
+    if eff_donate and flag("check_donation"):
+        _poison_donated(op_name, arrays, eff_donate)
     return outs, entry.box.get("was_tuple", False)
 
 
@@ -434,6 +456,9 @@ def _eager_apply_impl(
     static_kwargs = static_kwargs or {}
     arrays = [t._data for t in tensor_inputs]
 
+    if flag("check_donation"):
+        _check_poisoned(arrays, f"op `{op_name}`")
+
     # AMP O1 autocast (reference: eager_gen.py:515 AMP logic in generated
     # ad_funcs + python/paddle/amp/auto_cast.py lists): white-list ops run in
     # the low-precision dtype, black-list ops in float32.
@@ -454,7 +479,7 @@ def _eager_apply_impl(
 
     if not grad_wanted:
         fast = _forward_fast_path(raw_fn, arrays, static_kwargs,
-                                  donate_idx)
+                                  donate_idx, op_name=op_name)
         if fast is not None:
             outs, was_tuple = fast
         else:

@@ -100,10 +100,18 @@ def unsqueeze(x, axis, name=None):
                        lambda a: jnp.expand_dims(a, tuple(ax)), [x], {})
 
 
-for _n, _f in (("reshape", reshape), ("reshape_", reshape_), ("view", view),
+for _n, _f in (("reshape", reshape), ("view", view),
                ("flatten", flatten), ("squeeze", squeeze),
                ("unsqueeze", unsqueeze)):
     _export(_n, _f, methods=[_n])
+
+# reshape_ dispatches through inplace_apply, so its registration must
+# carry the donation contract (tpu_lint donation audit D-UNDECLARED)
+globals()["reshape_"] = reshape_
+__all__.append("reshape_")
+register_op("reshape_", reshape_, methods=["reshape_"],
+            inplace_of="reshape", donates=(0,),
+            tags=("manipulation", "inplace"))
 
 
 def _transpose_raw(a, perm=()):

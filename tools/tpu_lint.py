@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""tpu_lint — static analysis for the repo's TPU kernels and traced
+code, runnable entirely on CPU.
+
+Runs the three ``paddle_tpu.analysis`` passes (plus the flags/README
+parity check) and reports findings:
+
+  geometry   dry-traces every pallas_call site through the audit shim
+             and validates VMEM footprint vs the declared limit and the
+             per-generation budget (device/vmem.py), tile alignment,
+             grid divisibility, index-map bounds, magic VMEM literals
+  donation   static audit of the op registry's buffer-donation
+             contracts (the runtime poison mode is FLAGS_check_donation)
+  purity     AST lint of traced code for concretization hazards
+  flags      FLAGS_* / PADDLE_TPU_* / README conventions parity
+
+Exit status is nonzero when any UNWAIVERED finding exists. Intentional
+exceptions are documented in-line::
+
+    risky()  # tpu-lint: ok(P-HOST-RNG) -- reseeded per trace
+
+Usage:
+    python tools/tpu_lint.py [--json] [--pass NAME] [--generation GEN]
+
+    --json           machine-readable report on stdout (for CI)
+    --pass NAME      run one pass: geometry|donation|purity|flags
+    --generation GEN validate VMEM against a specific TPU generation
+                     (v2|v3|v4|v5e|v5p|v6e; default: attached chip,
+                     else the v5e serving target)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PASSES = ("geometry", "donation", "purity", "flags")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit a machine-readable JSON report")
+    ap.add_argument("--pass", dest="which", choices=PASSES,
+                    help="run a single pass (default: all)")
+    ap.add_argument("--generation", default=None,
+                    help="TPU generation for the VMEM budget check")
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    from paddle_tpu import analysis
+
+    if args.which == "geometry":
+        results = {"geometry":
+                   analysis.run_geometry_pass(generation=args.generation)}
+    elif args.which == "donation":
+        results = {"donation": analysis.run_donation_pass()}
+    elif args.which == "purity":
+        results = {"purity": analysis.run_purity_pass()}
+    elif args.which == "flags":
+        results = {"flags": analysis.run_flags_pass()}
+    else:
+        results = analysis.run_all_passes(generation=args.generation)
+    elapsed = time.time() - t0
+
+    n_unwaivered = sum(len(analysis.unwaivered(fs))
+                       for fs in results.values())
+    n_waived = sum(sum(1 for f in fs if f.waived)
+                   for fs in results.values())
+
+    if args.as_json:
+        json.dump({
+            "passes": {k: [f.to_dict() for f in fs]
+                       for k, fs in results.items()},
+            "unwaivered": n_unwaivered,
+            "waived": n_waived,
+            "elapsed_s": round(elapsed, 2),
+            "ok": n_unwaivered == 0,
+        }, sys.stdout, indent=2)
+        print()
+    else:
+        for name, fs in results.items():
+            live = analysis.unwaivered(fs)
+            status = "clean" if not live else f"{len(live)} finding(s)"
+            print(f"[{name}] {status}"
+                  + (f" (+{len(fs) - len(live)} waived)"
+                     if len(fs) != len(live) else ""))
+            for f in fs:
+                print("   " + f.render())
+        print(f"tpu_lint: {n_unwaivered} unwaivered finding(s), "
+              f"{n_waived} waived, {elapsed:.1f}s")
+    return 1 if n_unwaivered else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
