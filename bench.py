@@ -481,6 +481,16 @@ def _run_secondary(kind):
 
 
 def main():
+    # tpu_lint preflight (ISSUE 7): never spend chip time on a program
+    # the static analyzer already knows is broken. The parent process
+    # vets once; the per-rung child processes below inherit --no-lint.
+    no_lint = "--no-lint" in sys.argv
+    if no_lint:
+        sys.argv.remove("--no-lint")
+    from paddle_tpu.analysis.preflight import preflight
+
+    preflight("bench", no_lint=no_lint)
+
     if "--config" in sys.argv:
         _run_one(sys.argv[sys.argv.index("--config") + 1])
         return
@@ -509,8 +519,10 @@ def main():
     import subprocess
 
     def _sub(argv, timeout):
+        # children skip the lint preflight: the parent vetted the tree
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)] + argv,
+            [sys.executable, os.path.abspath(__file__), "--no-lint"]
+            + argv,
             capture_output=True, text=True, timeout=timeout)
         lines = [ln for ln in proc.stdout.splitlines()
                  if ln.startswith("{")]

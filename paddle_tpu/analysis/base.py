@@ -14,10 +14,12 @@ survive review.
 from __future__ import annotations
 
 import dataclasses
+import os
 import re
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["Finding", "parse_waivers", "apply_waivers"]
+__all__ = ["Finding", "parse_waivers", "apply_waivers",
+           "waive_from_sources"]
 
 #: ``# tpu-lint: ok(RULE) <sep> reason`` — separator is any dash/em-dash
 #: or colon; the reason must be non-empty
@@ -63,6 +65,26 @@ def parse_waivers(source: str) -> Dict[int, Tuple[str, str]]:
         if m and m.group("reason"):
             out[i] = (m.group("rule"), m.group("reason").strip())
     return out
+
+
+def waive_from_sources(findings: List[Finding],
+                       root: Optional[str] = None) -> List[Finding]:
+    """Apply inline waivers by reading each finding's source file
+    (relative paths resolve against ``root``, absolute paths — e.g.
+    synthetic test modules — as-is). Returns ``findings``."""
+    cache: Dict[str, Dict[int, Tuple[str, str]]] = {}
+    for f in findings:
+        if not f.path or not f.line:
+            continue
+        if f.path not in cache:
+            path = f.path if os.path.isabs(f.path) else \
+                os.path.join(root or os.getcwd(), f.path)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    cache[f.path] = parse_waivers(fh.read())
+            except OSError:
+                cache[f.path] = {}
+    return apply_waivers(findings, cache)
 
 
 def apply_waivers(findings: List[Finding],

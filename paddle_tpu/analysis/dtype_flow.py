@@ -1,0 +1,94 @@
+"""Pass 4 — DTYPE: dtype-promotion lint over whole-program jaxprs.
+
+A bf16 serving path is bandwidth-bound; one matmul that silently
+promotes to f32 doubles its operand traffic AND halves MXU throughput,
+and nothing fails — the program just runs at half speed on the chip.
+This pass walks the closed jaxpr of every registered program site
+(:mod:`.program_sites`) and flags:
+
+- ``X-PROMOTE``: a ``dot_general`` / ``conv_general_dilated`` inside a
+  declared-bf16 program (``ProgramSite.compute_dtype == "bfloat16"``)
+  with a float32/float64 *operand*. Operands are the traffic; an f32
+  operand means a bf16 value got upcast (or a weight never got cast)
+  upstream. bf16xbf16 dots with ``preferred_element_type=f32`` are the
+  INTENDED accumulation idiom and pass — accumulation is free, operand
+  width is not.
+- ``X-F64``: any float64 abstract value in any program — f64 is
+  software-emulated on TPU (and means x64 leaked into a trace).
+
+Findings anchor to the repo source line that built the op (jax
+source_info), so the standard inline waiver syntax applies at the
+offending call.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .base import Finding, waive_from_sources
+from .jaxpr_util import eqn_anchor, repo_root, walk_eqns
+
+__all__ = ["check_dtype_flow", "run_dtype_pass"]
+
+#: the MXU ops whose operand dtype is the traffic/throughput lever
+_DOT_PRIMS = ("dot_general", "conv_general_dilated")
+
+#: operand dtypes that mean "this declared-bf16 dot got promoted"
+_WIDE_FLOATS = ("float32", "float64")
+
+
+def _anchor(eqn, site):
+    path, line = eqn_anchor(eqn)
+    if path is None:
+        path, line = site.path, site.line
+    return path, line
+
+
+def check_dtype_flow(traced) -> List[Finding]:
+    """All DTYPE findings for one :class:`TracedProgram`."""
+    site = traced.site
+    findings: List[Finding] = []
+    declared_bf16 = site.compute_dtype == "bfloat16"
+    seen_f64 = set()
+    for eqn, _ in walk_eqns(traced.closed.jaxpr):
+        if declared_bf16 and eqn.primitive.name in _DOT_PRIMS:
+            bad = sorted({str(v.aval.dtype) for v in eqn.invars
+                          if str(getattr(v.aval, "dtype", ""))
+                          in _WIDE_FLOATS})
+            if bad:
+                path, line = _anchor(eqn, site)
+                findings.append(Finding(
+                    rule="X-PROMOTE", site=site.name, path=path,
+                    line=line,
+                    message=(f"{eqn.primitive.name} with "
+                             f"{'/'.join(bad)} operand(s) inside the "
+                             f"declared-bf16 program `{site.name}` — a "
+                             "silent upcast doubles operand HBM traffic"
+                             "; cast the operand (accumulate via "
+                             "preferred_element_type instead)")))
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if str(getattr(getattr(v, "aval", None), "dtype", "")) \
+                    == "float64":
+                path, line = _anchor(eqn, site)
+                key = (path, line)
+                if key in seen_f64:
+                    continue
+                seen_f64.add(key)
+                findings.append(Finding(
+                    rule="X-F64", site=site.name, path=path, line=line,
+                    message=(f"float64 value in program `{site.name}` "
+                             f"(primitive {eqn.primitive.name}) — f64 "
+                             "is software-emulated on TPU; x64 leaked "
+                             "into the trace")))
+    return findings
+
+
+def run_dtype_pass(traced: Optional[Dict] = None) -> List[Finding]:
+    """DTYPE findings over the whole program inventory."""
+    from .program_sites import trace_all_programs
+
+    if traced is None:
+        traced = trace_all_programs()
+    findings: List[Finding] = []
+    for tp in traced.values():
+        findings += check_dtype_flow(tp)
+    return waive_from_sources(findings, repo_root())

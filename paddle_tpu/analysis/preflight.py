@@ -1,0 +1,74 @@
+"""Preflight gate: no chip time for a program the analyzer already
+knows is broken.
+
+``bench.py`` and the ``tools/{decode,bert,train}_profile.py`` ablation
+drivers call :func:`preflight` before any TPU work: the full tpu_lint
+suite runs on CPU (seconds) and the tool REFUSES to start when any
+unwaivered finding exists — a 25-minute s2048 compile must never be
+spent proving what the linter already knew. Escape hatches: the tool's
+``--no-lint`` flag, or env ``PADDLE_TPU_NO_LINT=1`` (for drivers that
+re-exec themselves per rung, the parent vets once and children skip).
+
+Telemetry: every lint run (preflight or CLI) publishes
+``lint.{findings,waived}`` counters (profiler.stats), so bench
+telemetry blocks record the lint state the numbers were measured under
+and ``tools/bench_gate.py`` can ratchet on them.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List
+
+from .base import Finding
+
+__all__ = ["preflight", "publish_lint_stats"]
+
+
+def publish_lint_stats(results: Dict[str, List[Finding]]) -> None:
+    """Bump ``lint.{findings,waived}`` from one suite run's results."""
+    from ..profiler import stats as _stats
+    from . import unwaivered
+
+    n_live = sum(len(unwaivered(fs)) for fs in results.values())
+    n_waived = sum(sum(1 for f in fs if f.waived)
+                   for fs in results.values())
+    _stats.inc("lint.findings", n_live)
+    _stats.inc("lint.waived", n_waived)
+    # snapshot() drops zero-valued counters (sparse by design), so a
+    # CLEAN run's lint.findings=0 would be invisible in telemetry and
+    # bench_gate could never compare clean-vs-regressed; mirror into
+    # gauges (never value-filtered) so every block records the lint
+    # state its numbers were measured under.
+    _stats.set_gauge("lint.findings", n_live)
+    _stats.set_gauge("lint.waived", n_waived)
+
+
+def preflight(tool: str, no_lint: bool = False) -> None:
+    """Run the full analysis suite; SystemExit(2) on unwaivered
+    findings. ``no_lint=True`` (the tool's ``--no-lint``) or env
+    ``PADDLE_TPU_NO_LINT`` skips."""
+    if no_lint or os.environ.get("PADDLE_TPU_NO_LINT"):
+        return
+    from . import run_all_passes, unwaivered
+
+    print(f"{tool}: tpu_lint preflight...", file=sys.stderr)
+    results = run_all_passes()
+    publish_lint_stats(results)
+    live = [f for fs in results.values() for f in unwaivered(fs)]
+    if not live:
+        n_waived = sum(1 for fs in results.values()
+                       for f in fs if f.waived)
+        print(f"{tool}: preflight clean ({len(results)} passes, "
+              f"0 unwaivered / {n_waived} waived findings)",
+              file=sys.stderr)
+        return
+    print(f"{tool}: REFUSING to start — {len(live)} unwaivered lint "
+          "finding(s); chip time is never spent on a program the "
+          "analyzer knows is broken:", file=sys.stderr)
+    for f in live:
+        print("  " + f.render(), file=sys.stderr)
+    print(f"(fix or waive them — see tools/tpu_lint.py — or rerun "
+          f"with --no-lint / PADDLE_TPU_NO_LINT=1 to override)",
+          file=sys.stderr)
+    raise SystemExit(2)

@@ -23,13 +23,15 @@ CI catches geometry that would not fit the chip.
 from __future__ import annotations
 
 __all__ = [
-    "MiB", "VMEM_BUDGET_BYTES", "VMEM_RESERVE_BYTES",
+    "MiB", "GiB", "VMEM_BUDGET_BYTES", "VMEM_RESERVE_BYTES",
     "DEFAULT_GENERATION", "KERNEL_VMEM_LIMIT_BYTES",
     "MOSAIC_DEFAULT_VMEM_LIMIT_BYTES", "vmem_budget_bytes",
+    "HBM_BUDGET_BYTES", "HBM_RESERVE_BYTES", "hbm_budget_bytes",
     "detect_generation",
 ]
 
 MiB = 1 << 20
+GiB = 1 << 30
 
 #: physical VMEM bytes per TensorCore, by TPU generation
 VMEM_BUDGET_BYTES = {
@@ -40,6 +42,25 @@ VMEM_BUDGET_BYTES = {
     "v5p": 128 * MiB,
     "v6e": 128 * MiB,
 }
+
+#: physical HBM bytes per chip, by TPU generation (public TPU system
+#: architecture docs; the MEMORY pass of ``paddle_tpu.analysis`` checks
+#: a program's static peak-live-bytes bound against this table, so
+#: "this 13B config OOMs on v5e" is a CPU-side lint finding instead of
+#: a burned chip session)
+HBM_BUDGET_BYTES = {
+    "v2": 8 * GiB,
+    "v3": 16 * GiB,
+    "v4": 32 * GiB,
+    "v5e": 16 * GiB,
+    "v5p": 95 * GiB,
+    "v6e": 32 * GiB,
+}
+
+#: HBM held back from the analyzer's budget: the XLA runtime's own
+#: allocations (executables, infeed/outfeed, framework scratch) that a
+#: program's buffer liveness never sees
+HBM_RESERVE_BYTES = 1 * GiB
 
 #: headroom left to the Mosaic compiler for its own scratch — register
 #: spills, DMA semaphores, pipelining bookkeeping — on top of what the
@@ -98,3 +119,12 @@ def vmem_budget_bytes(generation: str | None = None) -> int:
     None). Unknown generations fall back to the conservative 16 MiB."""
     gen = generation or detect_generation()
     return VMEM_BUDGET_BYTES.get(gen, 16 * MiB)
+
+
+def hbm_budget_bytes(generation: str | None = None) -> int:
+    """Usable HBM for ``generation`` (auto-detected when None): the
+    physical capacity minus the runtime reserve. Unknown generations
+    fall back to the conservative v5e 16 GiB."""
+    gen = generation or detect_generation()
+    return (HBM_BUDGET_BYTES.get(gen, HBM_BUDGET_BYTES["v5e"])
+            - HBM_RESERVE_BYTES)

@@ -126,6 +126,8 @@ def _xla_paged(q, key_cache, value_cache, seq_lens, block_tables):
 
     group = n_q // n_kv  # GQA: q heads per kv head
     qh = q.reshape(b, n_kv, group, d)
+    # fp32 scores by design (softmax stability; QK reads are KV-bound)
+    # tpu-lint: ok(X-PROMOTE) -- attention scores fp32 by design
     logits = jnp.einsum("bngd,bpnsd->bngps", qh.astype(jnp.float32),
                         k.astype(jnp.float32)) * (d ** -0.5)
     logits = logits.reshape(b, n_kv, group, max_len)
@@ -135,6 +137,7 @@ def _xla_paged(q, key_cache, value_cache, seq_lens, block_tables):
                        jnp.finfo(jnp.float32).min)
     w = jax.nn.softmax(logits, axis=-1) \
         .reshape(b, n_kv, group, pages_per_seq, page_size)
+    # tpu-lint: ok(X-PROMOTE) -- fp32 PV accumulation pairs with scores
     out = jnp.einsum("bngps,bpnsd->bngd", w, v.astype(jnp.float32))
     return out.reshape(b, n_q, d).astype(q.dtype)
 

@@ -26,6 +26,26 @@ from ...ops.dispatch import as_tensor_args, eager_apply
 __all__ = ["ring_attention", "ring_flash_attention"]
 
 
+def _shard_map():
+    """shard_map across jax versions (jax >= 0.7 promotes it out of
+    experimental; 0.4.x only has the experimental home)."""
+    try:
+        from jax import shard_map as sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def _mark_varying(t, axis_name):
+    """lax.pcast(..., to='varying') where available (newer jax tracks
+    per-axis replication); on jax without pcast the shard_map below runs
+    with check_rep=False, so the marking is a no-op."""
+    pcast = getattr(lax, "pcast", None)
+    if pcast is None:
+        return t
+    return pcast(t, (axis_name,), to="varying")
+
+
 def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
                             scale: float, axis_size: int):
     """Per-device body under shard_map: q,k,v are local seq blocks."""
@@ -80,8 +100,7 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
     m0 = jnp.full((b, h, sq), -jnp.inf, q.dtype)
     # carries become device-varying after step 1 (they depend on
     # axis_index); mark the inits as varying over the ring axis
-    o0, l0, m0 = (lax.pcast(t, (axis_name,), to='varying')
-                  for t in (o0, l0, m0))
+    o0, l0, m0 = (_mark_varying(t, axis_name) for t in (o0, l0, m0))
     (o, l, m, _, _), _ = lax.scan(step, (o0, l0, m0, k, v),
                                   jnp.arange(axis_size))
     l_safe = jnp.maximum(l, 1e-20)
@@ -98,7 +117,7 @@ def ring_attention(q, k, v, mesh=None, seq_axis: str = "sep",
     ``seq_axis`` (or dense, in which case they're sharded here). Output is
     sharded the same way.
     """
-    from jax import shard_map
+    shard_map = _shard_map()
 
     from ...distributed.auto_parallel.placement import (
         ProcessMesh, Replicate, Shard,
@@ -123,8 +142,13 @@ def ring_attention(q, k, v, mesh=None, seq_axis: str = "sep",
     body = functools.partial(_ring_attention_sharded, axis_name=seq_axis,
                              causal=causal, scale=scale,
                              axis_size=axis_size)
+    kwargs = {}
+    if getattr(lax, "pcast", None) is None:
+        # no pcast -> no way to mark the scan carries device-varying, so
+        # replication checking must be off (jax 0.4.x)
+        kwargs["check_rep"] = False
     fn = shard_map(body, mesh=jmesh, in_specs=(pspec, pspec, pspec),
-                   out_specs=pspec)
+                   out_specs=pspec, **kwargs)
     jit_fn = jax.jit(fn)
 
     placements = [Replicate()] * mesh.ndim

@@ -44,6 +44,11 @@ Conventions for the built-in instrumentation (all optional reading):
 - ``moe.dropped_tokens``       token->expert assignments discarded by
   the MoE capacity bound (incubate/moe/moe_layer.py _gshard_dispatch)
   — counted on the eager forward path only (data-dependent)
+- ``lint.{findings,waived}``   tpu_lint results (unwaivered / waived
+  finding counts) published by every suite run — the CLI
+  (tools/tpu_lint.py) and the bench/profiling preflight gate
+  (analysis/preflight.py) — so bench telemetry records the lint state
+  its numbers were measured under and bench_gate can ratchet on it
 - ``dist.<op>.{calls,bytes}``  collective op counts and payload bytes
 - ``roofline.*``               achieved FLOP/s / bytes/s / MFU / BW
   utilization vs device peaks (profiler/roofline.py)
@@ -74,7 +79,7 @@ __all__ = [
 CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
     "inference.", "serving.", "quant.", "moe.", "dist.", "roofline.",
-    "hbm.", "t.",
+    "hbm.", "lint.", "t.",
 )
 
 _ENABLED = True

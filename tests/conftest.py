@@ -8,6 +8,12 @@ full sharding/collective path without TPU hardware.
 
 Must run before jax initializes a backend. The container pins
 JAX_PLATFORMS=axon via sitecustomize, so we override programmatically too.
+
+Shared mesh fixtures (session-scoped — the mesh objects are immutable
+value types): ``virtual_devices`` (the 8 CPU devices), ``mesh8`` /
+``mesh2x4`` (plain ProcessMeshes) and ``fleet_mesh`` (the dp4 x mp2
+hybrid mesh via fleet.init — the setup test_distributed/test_moe_ep and
+the SPMD-pass tests all need).
 """
 import os
 
@@ -29,3 +35,43 @@ def _reseed():
 
     paddle.seed(2024)
     yield
+
+
+@pytest.fixture(scope="session")
+def virtual_devices():
+    """The 8 virtual CPU devices (the SPMD-pass mesh substrate)."""
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, "xla_force_host_platform_device_count not set"
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def mesh8(virtual_devices):
+    import paddle_tpu.distributed as dist
+
+    return dist.ProcessMesh(list(range(8)), dim_names=["x"])
+
+
+@pytest.fixture(scope="session")
+def mesh2x4(virtual_devices):
+    import paddle_tpu.distributed as dist
+
+    return dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                            dim_names=["dp", "mp"])
+
+
+@pytest.fixture(scope="session")
+def fleet_mesh(virtual_devices):
+    """The dp4 x mp2 hybrid mesh, fleet-initialized once per session
+    (drops the per-test fleet.init boilerplate the distributed/MoE
+    tests used to carry)."""
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        **strategy.hybrid_configs,
+        "dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+        "sharding_degree": 1, "sep_degree": 1,
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group().mesh

@@ -13,15 +13,8 @@ import paddle_tpu.distributed as dist
 import paddle_tpu.nn as nn
 
 
-@pytest.fixture
-def mesh2x4():
-    return dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
-                            dim_names=["dp", "mp"])
-
-
-@pytest.fixture
-def mesh8():
-    return dist.ProcessMesh(list(range(8)), dim_names=["x"])
+# mesh8 / mesh2x4 come from the shared session-scoped conftest fixtures
+# (the virtual 8-device CPU mesh the SPMD lint pass also runs on)
 
 
 class TestProcessMesh:

@@ -15,22 +15,13 @@ import jax
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 import paddle_tpu.nn as nn
-from paddle_tpu.distributed import fleet
 from paddle_tpu.incubate.moe import MoELayer
 
 D = 16
 E = 4
 
-
-def _mesh():
-    strategy = fleet.DistributedStrategy()
-    strategy.hybrid_configs = {
-        **strategy.hybrid_configs,
-        "dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
-        "sharding_degree": 1, "sep_degree": 1,
-    }
-    fleet.init(is_collective=True, strategy=strategy)
-    return fleet.get_hybrid_communicate_group().mesh
+# the dp4 x mp2 hybrid mesh comes from the shared session-scoped
+# ``fleet_mesh`` conftest fixture (one fleet.init per session)
 
 
 def _shard_experts(moe, mesh, axis="dp"):
@@ -66,8 +57,8 @@ class TestGShardDispatch:
 
 
 class TestMoEExpertParallel:
-    def test_matches_dense_path_when_capacity_ample(self):
-        mesh = _mesh()
+    def test_matches_dense_path_when_capacity_ample(self, fleet_mesh):
+        mesh = fleet_mesh
         paddle.seed(0)
         # generous capacity so neither the global nor per-shard
         # formulation drops tokens -> identical outputs
@@ -91,8 +82,8 @@ class TestMoEExpertParallel:
         np.testing.assert_allclose(out_ep, out_dense, rtol=2e-4,
                                    atol=2e-5)
 
-    def test_all_to_all_in_hlo_and_grads_flow(self):
-        mesh = _mesh()
+    def test_all_to_all_in_hlo_and_grads_flow(self, fleet_mesh):
+        mesh = fleet_mesh
         paddle.seed(1)
 
         class Net(nn.Layer):
@@ -130,8 +121,8 @@ class TestMoEExpertParallel:
         assert not np.allclose(np.asarray(net.moe.gate.weight._data),
                                gate_before)
 
-    def test_rejects_indivisible_experts(self):
-        mesh = _mesh()
+    def test_rejects_indivisible_experts(self, fleet_mesh):
+        mesh = fleet_mesh
         moe = MoELayer(d_model=D, num_experts=6, gate="gshard",
                        d_hidden=32, ep_mesh=(mesh, "dp"))
         x = paddle.to_tensor(np.ones((8, 4, D), np.float32))

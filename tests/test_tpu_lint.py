@@ -51,8 +51,11 @@ class TestRepoIsClean:
         t0 = time.time()
         results = analysis.run_all_passes()
         elapsed = time.time() - t0
-        assert set(results) == {"geometry", "donation", "purity",
-                                "flags"}
+        # all seven passes + flags: 3 kernel-level (PR 6) + 4
+        # program-level (PR 7)
+        assert set(results) == set(analysis.PASS_NAMES) == {
+            "geometry", "donation", "purity", "flags",
+            "dtype", "sync", "memory", "spmd"}
         for name, findings in results.items():
             live = analysis.unwaivered(findings)
             assert not live, (
@@ -61,18 +64,32 @@ class TestRepoIsClean:
         # acceptance criterion: the full run fits in the CI budget
         assert elapsed < 60, f"tpu_lint took {elapsed:.1f}s (>60s)"
 
-    def test_cli_json_report(self, tmp_path):
+    def test_cli_json_report_and_baseline_ratchet(self, tmp_path):
+        """One CLI run: schema-v2 JSON report (waived findings carry
+        their reasons) + --write-baseline, then the ratchet compare
+        against the fresh baseline passes by construction."""
+        base = tmp_path / "lint_base.json"
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "tpu_lint.py"),
-             "--json"],
+             "--json", "--write-baseline", str(base)],
             capture_output=True, text=True, timeout=300,
             env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         report = json.loads(proc.stdout)
+        assert report["schema_version"] == 2
         assert report["ok"] is True
         assert report["unwaivered"] == 0
-        assert set(report["passes"]) == {"geometry", "donation",
-                                         "purity", "flags"}
+        assert set(report["passes"]) == set(analysis.PASS_NAMES)
+        # audit trail: waived findings listed with reasons
+        for f in report["waived_findings"]:
+            assert f["waived"] and f["waive_reason"]
+        assert report["waived"] == len(report["waived_findings"])
+        # the baseline stub holds per-rule unwaivered counts (clean
+        # tree -> {}) and ratchets in-process
+        doc = json.loads(base.read_text())
+        assert doc["rule_counts"] == report["rule_counts"] == {}
+        assert analysis.ratchet(report["rule_counts"],
+                                doc["rule_counts"]) == []
 
 
 # ---------------------------------------------------------------------

@@ -73,6 +73,13 @@ DEFAULT_METRICS: Dict[str, str] = {
     "decode_bf16_grouped_tokens_per_sec": "down",
     "decode_bf16_grouped_pct_of_hbm_roofline": "down",
     "decode_int8kv_b64_tokens_per_sec": "down",
+    # static-analysis state the numbers were measured under: the
+    # finding count must only go DOWN between rounds, so any growth
+    # regresses (direction "up" = an increase fails the gate); gates
+    # both the lint.findings counter inside telemetry blocks and a
+    # top-level lint_findings scalar
+    "lint.findings": "up",
+    "lint_findings": "up",
 }
 
 #: absolute-change floors so tiny counts/latencies don't trip the
@@ -134,6 +141,11 @@ def _metric_value(block: dict, name: str) -> Optional[float]:
 
 def _regressed(name: str, direction: str, prev: float, cur: float,
                tol: float) -> bool:
+    if name.startswith("lint"):
+        # lint findings must only go down between rounds — ANY growth
+        # regresses, no noise floor (a single new finding is a real
+        # defect, not measurement jitter)
+        return cur > prev if direction == "up" else cur < prev
     floor = _ABS_FLOOR_US if name.endswith("_us") else _ABS_FLOOR_COUNT
     if direction == "up":
         return cur > max(prev * (1 + tol), prev + floor)

@@ -230,7 +230,12 @@ MODES = {
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", required=True, choices=sorted(MODES))
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip the tpu_lint preflight gate")
     args = ap.parse_args()
+    from paddle_tpu.analysis.preflight import preflight
+
+    preflight("bert_profile", no_lint=args.no_lint)
     t0 = time.time()
     if args.mode == "op_table":
         out = run_op_table()

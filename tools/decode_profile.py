@@ -822,7 +822,16 @@ MODES = {
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", required=True, choices=sorted(MODES))
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip the tpu_lint preflight gate")
     args = ap.parse_args()
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.analysis.preflight import preflight
+
+    preflight("decode_profile", no_lint=args.no_lint)
     t0 = time.time()
     tps = MODES[args.mode]()
     out = {"mode": args.mode, "tokens_per_sec": round(tps, 1),

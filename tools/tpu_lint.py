@@ -1,10 +1,11 @@
 #!/usr/bin/env python
-"""tpu_lint — static analysis for the repo's TPU kernels and traced
-code, runnable entirely on CPU.
+"""tpu_lint — static analysis for the repo's TPU kernels and compiled
+programs, runnable entirely on CPU.
 
-Runs the three ``paddle_tpu.analysis`` passes (plus the flags/README
-parity check) and reports findings:
+Seven ``paddle_tpu.analysis`` passes (plus the flags/README parity
+check) report findings:
 
+  kernel-level (PR 6)
   geometry   dry-traces every pallas_call site through the audit shim
              and validates VMEM footprint vs the declared limit and the
              per-generation budget (device/vmem.py), tile alignment,
@@ -14,6 +15,19 @@ parity check) and reports findings:
   purity     AST lint of traced code for concretization hazards
   flags      FLAGS_* / PADDLE_TPU_* / README conventions parity
 
+  program-level (PR 7): whole-jaxpr passes over the registered program
+  sites (jit'd composites, train step, serving prefill/decode)
+  dtype      silent bf16->f32 matmul promotion (X-PROMOTE), f64 leaks
+             (X-F64)
+  sync       host callbacks in hot loops (X-SYNC), recompile-churn
+             statics (X-CHURN)
+  memory     donation-aware liveness walk -> static HBM-peak bound per
+             program vs the per-generation capacity table (M-HBM)
+  spmd       distributed surfaces compiled on a virtual 8-device CPU
+             mesh: undeclared collectives (S-GATHER), asymmetric
+             branch collectives (S-MATCH), unconstrained outputs
+             (S-UNSPEC)
+
 Exit status is nonzero when any UNWAIVERED finding exists. Intentional
 exceptions are documented in-line::
 
@@ -21,12 +35,22 @@ exceptions are documented in-line::
 
 Usage:
     python tools/tpu_lint.py [--json] [--pass NAME] [--generation GEN]
+                             [--baseline FILE] [--write-baseline FILE]
 
-    --json           machine-readable report on stdout (for CI)
-    --pass NAME      run one pass: geometry|donation|purity|flags
-    --generation GEN validate VMEM against a specific TPU generation
+    --json           machine-readable report on stdout (for CI); the
+                     schema carries `schema_version` and every WAIVED
+                     finding with its reason (audit trail)
+    --pass NAME      run one pass (default: all)
+    --generation GEN validate VMEM/HBM against a TPU generation
                      (v2|v3|v4|v5e|v5p|v6e; default: attached chip,
                      else the v5e serving target)
+    --baseline FILE  ratchet mode: compare per-rule unwaivered counts
+                     against a previous --json report (or a
+                     --write-baseline file); exit nonzero only when a
+                     rule's count GREW — CI enforces "no new findings"
+                     without blocking on legacy ones
+    --write-baseline FILE  write the current per-rule counts for later
+                     --baseline runs (implies exit 0)
 """
 from __future__ import annotations
 
@@ -40,50 +64,113 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-PASSES = ("geometry", "donation", "purity", "flags")
+#: --json schema: 1 = PR 6 (four passes); 2 = PR 7 (seven passes +
+#: schema_version + waived_findings + rule_counts)
+SCHEMA_VERSION = 2
+
+
+def _ensure_virtual_mesh():
+    """The SPMD pass needs 8 virtual CPU devices, which XLA only grants
+    at backend init — set the flag before jax is imported (no-op when
+    jax is already up, e.g. embedded callers; the pass then skips)."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _baseline_counts(doc: dict) -> dict:
+    """Per-rule unwaivered counts from a baseline file: either a full
+    --json report (counts recomputed from its findings) or a
+    --write-baseline {"rule_counts": ...} stub."""
+    if "rule_counts" in doc:
+        return {str(k): int(v) for k, v in doc["rule_counts"].items()}
+    counts: dict = {}
+    for fs in doc.get("passes", {}).values():
+        for f in fs:
+            if not f.get("waived"):
+                counts[f["rule"]] = counts.get(f["rule"], 0) + 1
+    return counts
 
 
 def main(argv=None) -> int:
+    _ensure_virtual_mesh()
+    from paddle_tpu import analysis
+
     ap = argparse.ArgumentParser(
         prog="tpu_lint", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit a machine-readable JSON report")
-    ap.add_argument("--pass", dest="which", choices=PASSES,
+    ap.add_argument("--pass", dest="which", choices=analysis.PASS_NAMES,
                     help="run a single pass (default: all)")
     ap.add_argument("--generation", default=None,
-                    help="TPU generation for the VMEM budget check")
+                    help="TPU generation for the VMEM/HBM budget checks")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="ratchet: fail only on rules whose unwaivered "
+                         "count grew vs this report")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="write current per-rule counts for --baseline")
     args = ap.parse_args(argv)
 
     t0 = time.time()
-    from paddle_tpu import analysis
-
-    if args.which == "geometry":
-        results = {"geometry":
-                   analysis.run_geometry_pass(generation=args.generation)}
-    elif args.which == "donation":
-        results = {"donation": analysis.run_donation_pass()}
-    elif args.which == "purity":
-        results = {"purity": analysis.run_purity_pass()}
-    elif args.which == "flags":
-        results = {"flags": analysis.run_flags_pass()}
+    runners = {
+        "geometry": lambda: analysis.run_geometry_pass(
+            generation=args.generation),
+        "donation": analysis.run_donation_pass,
+        "purity": analysis.run_purity_pass,
+        "flags": analysis.run_flags_pass,
+        "dtype": analysis.run_dtype_pass,
+        "sync": analysis.run_sync_pass,
+        "memory": lambda: analysis.run_memory_pass(
+            generation=args.generation),
+        "spmd": analysis.run_spmd_pass,
+    }
+    if args.which:
+        results = {args.which: runners[args.which]()}
     else:
         results = analysis.run_all_passes(generation=args.generation)
     elapsed = time.time() - t0
+
+    from paddle_tpu.analysis.preflight import publish_lint_stats
+
+    publish_lint_stats(results)
 
     n_unwaivered = sum(len(analysis.unwaivered(fs))
                        for fs in results.values())
     n_waived = sum(sum(1 for f in fs if f.waived)
                    for fs in results.values())
+    counts = analysis.rule_counts(results)
+
+    ratchet_bad = None
+    if args.baseline:
+        with open(args.baseline, encoding="utf-8") as f:
+            ratchet_bad = analysis.ratchet(counts,
+                                           _baseline_counts(json.load(f)))
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump({"schema_version": SCHEMA_VERSION,
+                       "rule_counts": counts}, f, indent=2)
+            f.write("\n")
 
     if args.as_json:
         json.dump({
+            "schema_version": SCHEMA_VERSION,
             "passes": {k: [f.to_dict() for f in fs]
                        for k, fs in results.items()},
+            # audit trail: every waived finding with its reason, flat
+            "waived_findings": [f.to_dict()
+                                for fs in results.values()
+                                for f in fs if f.waived],
+            "rule_counts": counts,
             "unwaivered": n_unwaivered,
             "waived": n_waived,
             "elapsed_s": round(elapsed, 2),
-            "ok": n_unwaivered == 0,
+            "ok": (not ratchet_bad if ratchet_bad is not None
+                   else n_unwaivered == 0),
+            "ratchet": ratchet_bad,
         }, sys.stdout, indent=2)
         print()
     else:
@@ -97,6 +184,16 @@ def main(argv=None) -> int:
                 print("   " + f.render())
         print(f"tpu_lint: {n_unwaivered} unwaivered finding(s), "
               f"{n_waived} waived, {elapsed:.1f}s")
+        if ratchet_bad is not None:
+            if ratchet_bad:
+                print("ratchet REGRESSIONS vs baseline:")
+                for line in ratchet_bad:
+                    print("  " + line)
+            else:
+                print("ratchet: no new findings vs baseline")
+
+    if ratchet_bad is not None:
+        return 1 if ratchet_bad else 0
     return 1 if n_unwaivered else 0
 
 

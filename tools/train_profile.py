@@ -50,7 +50,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", required=True,
                     choices=["full", "noln", "nogelu"])
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip the tpu_lint preflight gate")
     args = ap.parse_args()
+    from paddle_tpu.analysis.preflight import preflight
+
+    preflight("train_profile", no_lint=args.no_lint)
     t0 = time.time()
     tps, mfu, roofline = run(args.mode)
     # roofline: XLA cost-model MFU/bandwidth for the compiled step
