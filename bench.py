@@ -462,6 +462,36 @@ def _run_secondary(kind):
                                           kv_dtype="int8")
         print(json.dumps(
             {"decode_int8kv_b64_tokens_per_sec": round(tps, 1)}))
+    elif kind == "--serve":
+        # serving-frontend SLO rung: Poisson-load TTFT/TPOT/throughput
+        # through paddle_tpu.serving (tools/serve_bench.py owns the
+        # load generator; gated by bench_gate — ttft regresses UP,
+        # tokens/s DOWN). CPU runs the tiny default geometry; on a
+        # chip the 1.3B serving shape at a saturating rate.
+        import os
+        import subprocess
+
+        import jax
+
+        tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "serve_bench.py")
+        argv = [sys.executable, tool, "--no-lint", "--seed", "0",
+                "--streams", "8"]
+        if jax.default_backend() == "tpu":
+            argv += ["--d-model", "2048", "--layers", "24", "--heads",
+                     "16", "--vocab", "51200", "--bf16",
+                     "--prompt-mix", "128,512,1024",
+                     "--prefill-chunk", "256", "--max-new", "64",
+                     "--page-size", "16", "--rate", "64"]
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=1200)
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"serve_bench rc={proc.returncode}: "
+                f"{proc.stderr[-300:]}")
+        print(lines[-1])
     elif kind == "--bert":
         tps, mfu, roofline = run_bert_bench()
         print(json.dumps({"bert_train_tokens_per_sec": round(tps, 1),
@@ -495,8 +525,8 @@ def main():
         _run_one(sys.argv[sys.argv.index("--config") + 1])
         return
     for kind in ("--decode", "--decode-int8", "--decode-a8w8",
-                 "--decode-bf16-grouped", "--decode-int8kv", "--bert",
-                 "--s2048"):
+                 "--decode-bf16-grouped", "--decode-int8kv", "--serve",
+                 "--bert", "--s2048"):
         if kind in sys.argv:
             _run_secondary(kind)
             return
@@ -539,7 +569,7 @@ def main():
         # the training rung's buffers die with its process)
         for kind in ("--s2048", "--decode", "--decode-int8",
                      "--decode-a8w8", "--decode-bf16-grouped",
-                     "--decode-int8kv", "--bert"):
+                     "--decode-int8kv", "--serve", "--bert"):
             # s2048's flash-attention bwd compile alone can take ~25min
             # cold (measured r5); the run itself is seconds
             extra, err = _sub([kind], 2400 if kind == "--s2048" else 1500)

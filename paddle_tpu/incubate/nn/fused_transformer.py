@@ -335,6 +335,87 @@ class FusedMultiTransformer(Layer):
             0, self.num_layers, body, (x, cache.k, cache.v))
         return h, PagedKV(nk, nv)
 
+    def prefill_chunk_raw(self, weights, x, cache, block_tables, start,
+                          chunk_lens, cos_t, sin_t, a8w8=False):
+        """CHUNKED prompt pass: x [b, c, d] embeds tokens at positions
+        ``start[b] .. start[b]+c-1`` of sequences whose earlier tokens
+        (previous chunks, or a shared prefix mapped by the prefix
+        cache) are ALREADY in the paged pool. Queries attend to the
+        cached pages plus causally within the chunk, so a long prompt
+        prefills in fixed-size chunks interleaved with decode steps
+        (the serving scheduler's stall bound) instead of one monolithic
+        program that blocks the decode batch.
+
+        ``start``/``chunk_lens``: [b] int32 traced arrays — position
+        offset and VALID row count (rows ``>= chunk_lens[b]`` are
+        right-padding; their KV writes go to the scratch page and their
+        hidden rows are garbage the caller discards). Returns
+        (hidden [b, c, d], cache').
+        """
+        if a8w8 and self._weights_dtype(weights) != jnp.int8:
+            raise ValueError("a8w8 prefill needs an int8 weight stack "
+                             "(quantize_weight_only_int8 first)")
+        from ...nn.functional.paged_attention import (
+            gather_kv_pages, write_prefill_kv_pages)
+
+        b, c, _d = x.shape
+        start = start.astype(jnp.int32)
+        chunk_lens = chunk_lens.astype(jnp.int32)
+        positions = start[:, None] \
+            + jnp.arange(c, dtype=jnp.int32)[None, :]      # [b, c]
+        n_kv = self.num_kv_heads
+        group = self.num_heads // n_kv
+        hd = self.head_dim
+        npages = self._pages_per_layer(cache)
+        scale = hd ** -0.5
+
+        def body(l, carry):
+            h, ck, cv = carry
+            w = {n: jax.lax.dynamic_index_in_dim(a, l, 0, False)
+                 for n, a in weights.items()}
+            tbl = block_tables + l * npages
+
+            def kv_write(k, v):
+                return write_prefill_kv_pages(
+                    ck, cv, k, v, tbl, start=start,
+                    valid_lens=chunk_lens)
+
+            def attend(q, k, v, nck, ncv):
+                # gather the sequence's whole cached span (the chunk's
+                # own KV was just written) token-major and mask
+                # causally: key position <= query position covers both
+                # the prefix pages and the in-chunk triangle
+                kg = gather_kv_pages(nck, tbl)
+                vg = gather_kv_pages(ncv, tbl)
+                S = kg.shape[1]
+                qh = q.reshape(b, c, n_kv, group, hd)
+                # tpu-lint: ok(X-PROMOTE) -- attention scores fp32 by
+                # design (softmax stability; QK reads are KV-bound)
+                logits = jnp.einsum(
+                    "btngd,bsnd->bngts",
+                    qh.astype(jnp.float32) * scale,
+                    kg.astype(jnp.float32))
+                mask = jnp.arange(S, dtype=jnp.int32)[None, None, :] \
+                    <= positions[:, :, None]               # [b, t, s]
+                logits = jnp.where(mask[:, None, None], logits,
+                                   jnp.finfo(jnp.float32).min)
+                wts = jax.nn.softmax(logits, axis=-1)
+                # tpu-lint: ok(X-PROMOTE) -- fp32 PV accumulation
+                # pairs with scores
+                out = jnp.einsum("bngts,bsnd->btngd", wts,
+                                 vg.astype(jnp.float32))
+                return out.reshape(b, c, n_kv * group, hd) \
+                    .astype(q.dtype)
+
+            h, ck, cv = self._layer_body(
+                w, h, positions, kv_write, attend, cos_t, sin_t,
+                a8w8=a8w8)
+            return h, ck, cv
+
+        h, nk, nv = jax.lax.fori_loop(
+            0, self.num_layers, body, (x, cache.k, cache.v))
+        return h, PagedKV(nk, nv)
+
     def unstack_weights(self, weights=None):
         """Per-layer weight dicts for the UNROLLED decode path
         (experimental). Measured on the 1.3B b32 decode (r4): the

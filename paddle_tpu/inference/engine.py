@@ -10,6 +10,7 @@ device program per token with zero host round-trips in the stack.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Optional
 
 import jax
@@ -489,16 +490,23 @@ class GenerationEngine:
 class GenRequest:
     """One serving request (continuous batching unit)."""
 
-    _next_id = [0]
+    # id allocation must be thread-safe: the serving frontend
+    # (paddle_tpu/serving) submits from arbitrary threads. next() on a
+    # shared itertools.count is atomic under CPython (single bytecode
+    # dispatch into C) — no lock, no duplicate ids.
+    _next_id = itertools.count()
 
     def __init__(self, prompt, max_new_tokens=32, eos_token_id=None):
-        self.id = GenRequest._next_id[0]
-        GenRequest._next_id[0] += 1
+        self.id = next(GenRequest._next_id)
         self.prompt = np.asarray(prompt).reshape(-1).astype(np.int32)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
         self.generated: list = []
         self.done = False
+        # times the admission loop passed this request over for a later
+        # one that fit (skip-ahead head-of-line fix; bounded by the
+        # engine's starvation_bound)
+        self._admit_skips = 0
 
     @property
     def output(self):
@@ -530,13 +538,20 @@ class ContinuousBatchingEngine:
                  num_pages: Optional[int] = None,
                  decode_chunk: Optional[int] = None,
                  prompt_bucket: int = 16, kv_dtype=None,
-                 quant: Optional[str] = None):
+                 quant: Optional[str] = None, admit_window: int = 8,
+                 starvation_bound: int = 16):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_length = int(max_length)
         self.page_size = int(page_size)
         self.decode_chunk = _resolve_decode_chunk(decode_chunk)
         self.prompt_bucket = max(int(prompt_bucket), 1)
+        # admission skip-ahead: when the queue head's pages don't fit,
+        # up to admit_window later requests are tried instead of
+        # head-of-line blocking; a head skipped starvation_bound times
+        # pins the queue until it fits (bounded unfairness)
+        self.admit_window = max(int(admit_window), 1)
+        self.starvation_bound = max(int(starvation_bound), 1)
         self._gen = GenerationEngine.__new__(GenerationEngine)  # share
         self._gen.model = model
         self._gen.max_length = self.max_length
@@ -629,16 +644,26 @@ class ContinuousBatchingEngine:
         done_now = []
         for i in active:
             req = self._slots[i]
+            cb = getattr(req, "on_token", None)
+            consumed = 0
             for j in range(k):
                 if req.done:
                     break
                 t = int(toks_np[i, j])
                 req.generated.append(t)
+                consumed += 1
+                if cb is not None:
+                    cb(req, t)
                 if (req.eos_token_id is not None
                         and t == req.eos_token_id) or \
                         len(req.generated) >= req.max_new_tokens:
                     req.done = True
             if req.done:
+                # tokens the chunk decoded PAST req.done are executed-
+                # but-discarded device work: the decode_chunk tuning
+                # signal (big chunks amortize dispatch, small chunks
+                # waste less tail work on eos/max_new finishes)
+                _stats.inc("serving.wasted_decode_tokens", k - consumed)
                 self._release(i)
                 done_now.append(req)
             else:
@@ -661,41 +686,86 @@ class ContinuousBatchingEngine:
         self._lens[i] = 0
         self._last_tok[i] = 0
 
+    def _slot_free(self, i: int) -> bool:
+        """Is slot i available for admission? (The serving scheduler
+        also parks chunk-prefilling requests on slots.)"""
+        return self._slots[i] is None
+
+    def _can_admit(self, req) -> bool:
+        """Do the pool's free pages cover this request's prompt (+1
+        decode token)? Overridden by the serving frontend to account
+        for prefix-cache hits and to evict cold cached prefixes."""
+        return self._mgr.pages_needed(len(req.prompt) + 1) \
+            <= self._mgr.free_pages
+
+    def _pick_waiting(self):
+        """Next admissible waiting request, with BOUNDED SKIP-AHEAD:
+        when the head's pages don't fit, up to ``admit_window`` later
+        requests are tried (small requests flow past a parked big one
+        instead of head-of-line blocking behind it). Each pass-over
+        bumps the skipped requests' ``_admit_skips`` and the
+        ``serving.admission_skips`` counter; once the head has been
+        skipped ``starvation_bound`` times the window collapses to the
+        head alone, so it admits next no matter what fits behind it."""
+        if not self.waiting:
+            return None
+        head = self.waiting[0]
+        window = 1 if head._admit_skips >= self.starvation_bound \
+            else min(len(self.waiting), self.admit_window)
+        for j in range(window):
+            req = self.waiting[j]
+            if self._can_admit(req):
+                if j > 0:
+                    for skipped in self.waiting[:j]:
+                        skipped._admit_skips += 1
+                    _stats.inc("serving.admission_skips", j)
+                return self.waiting.pop(j)
+        return None
+
     def _admit(self):
-        """Move waiting requests into free slots: prefill each prompt
-        into the shared page pool (bucketed lengths bound recompiles)."""
-        m = self.model
+        """Move admissible waiting requests into free slots (skip-ahead
+        selection via ``_pick_waiting``); prefill each prompt into the
+        shared page pool (bucketed lengths bound recompiles)."""
         for i in range(self.max_batch):
-            if not self.waiting or self._slots[i] is not None:
+            if not self.waiting or not self._slot_free(i):
                 continue
-            req = self.waiting[0]
-            need = self._mgr.pages_needed(len(req.prompt) + 1)
-            if need > self._mgr.free_pages:
-                break  # pool full — admit later when pages free up
-            self.waiting.pop(0)
-            self._slots[i] = req
-            _stats.inc("serving.admitted")
-            self._gen._count_a8w8(1)
-            L = len(req.prompt)
-            self._mgr.allocate(("slot", i), L)
-            tables = self._mgr.block_tables([("slot", i)],
-                                            self._pages_per_seq)
-            # bucket the padded prompt length to bound compile count
-            bs = self.prompt_bucket
-            s_pad = -(-L // bs) * bs
-            ids = np.zeros((1, s_pad), np.int32)
-            ids[0, :L] = req.prompt
-            logits, self._ck, self._cv = self._gen._prefill(
-                m.stack._stack(), m.embed._data, self._gen._head_t,
-                m.lnf_scale._data, m.lnf_bias._data, jnp.asarray(ids),
-                jnp.asarray([L], jnp.int32), self._ck, self._cv, tables)
-            t = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
-            req.generated.append(t)
-            if (req.eos_token_id is not None and t == req.eos_token_id) \
-                    or req.max_new_tokens <= 1:
-                req.done = True
-                self._release(i)
-                self.finished.append(req)
-                continue
-            self._lens[i] = L + 1
-            self._last_tok[i] = t
+            req = self._pick_waiting()
+            if req is None:
+                break  # nothing in the window fits — retry next step
+            self._admit_into(req, i)
+
+    def _admit_into(self, req, i: int):
+        """Prefill ``req``'s whole prompt and start it decoding in slot
+        ``i``. (The serving frontend overrides this with chunked
+        prefill: the prompt fills in fixed-size chunks interleaved with
+        decode steps instead of one monolithic program.)"""
+        m = self.model
+        self._slots[i] = req
+        _stats.inc("serving.admitted")
+        self._gen._count_a8w8(1)
+        L = len(req.prompt)
+        self._mgr.allocate(("slot", i), L)
+        tables = self._mgr.block_tables([("slot", i)],
+                                        self._pages_per_seq)
+        # bucket the padded prompt length to bound compile count
+        bs = self.prompt_bucket
+        s_pad = -(-L // bs) * bs
+        ids = np.zeros((1, s_pad), np.int32)
+        ids[0, :L] = req.prompt
+        logits, self._ck, self._cv = self._gen._prefill(
+            m.stack._stack(), m.embed._data, self._gen._head_t,
+            m.lnf_scale._data, m.lnf_bias._data, jnp.asarray(ids),
+            jnp.asarray([L], jnp.int32), self._ck, self._cv, tables)
+        t = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+        req.generated.append(t)
+        cb = getattr(req, "on_token", None)
+        if cb is not None:
+            cb(req, t)
+        if (req.eos_token_id is not None and t == req.eos_token_id) \
+                or req.max_new_tokens <= 1:
+            req.done = True
+            self._release(i)
+            self.finished.append(req)
+            return
+        self._lens[i] = L + 1
+        self._last_tok[i] = t

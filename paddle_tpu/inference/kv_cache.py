@@ -13,7 +13,7 @@ different lengths share one pool with no copies.
 """
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -24,7 +24,18 @@ __all__ = ["BlockKVCacheManager"]
 
 
 class BlockKVCacheManager:
-    """Owns the page pool + free list; builds per-batch block tables."""
+    """Owns the page pool + free list; builds per-batch block tables.
+
+    Pages are REFCOUNTED: ``allocate``/``grow`` hand out pages at
+    refcount 1, ``share`` maps existing pages into another sequence at
+    +1 (the prefix/KV-reuse path — requests sharing a system prompt map
+    the prefix's pages instead of re-prefilling them), and ``free``
+    only returns a page to the free list once its last reference drops.
+    Shared pages are copy-on-write in the page-table sense: only FULL,
+    immutable prefix pages are ever shared (serving/prefix_cache.py),
+    and a sharer's decode writes land in its privately owned tail
+    pages, so no data copy is ever needed.
+    """
 
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
                  page_size: int = 16, num_pages: int = 512,
@@ -49,6 +60,7 @@ class BlockKVCacheManager:
         self._free: List[int] = list(
             range(1 if reserve_scratch else 0, num_pages))
         self._owned: dict = {}
+        self._refs: Dict[int, int] = {}
 
     def fresh_cache(self) -> PagedKV:
         # layer-FOLDED page-major pool (see PagedKV): layer l's logical
@@ -82,6 +94,8 @@ class BlockKVCacheManager:
                 f"KV pool exhausted: need {n} pages, "
                 f"{len(self._free)} free (of {self.num_pages})")
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
         self._owned.setdefault(seq_id, []).extend(pages)
         return pages
 
@@ -94,11 +108,56 @@ class BlockKVCacheManager:
                 f"KV pool exhausted growing seq {seq_id}: need "
                 f"{n_pages} pages, {len(self._free)} free")
         pages = [self._free.pop() for _ in range(n_pages)]
+        for p in pages:
+            self._refs[p] = 1
         self._owned.setdefault(seq_id, []).extend(pages)
         return pages
 
     def free(self, seq_id) -> None:
-        self._free.extend(self._owned.pop(seq_id, []))
+        self.release_pages(self._owned.pop(seq_id, []))
+
+    # ---------- refcounting (prefix/KV reuse) ----------
+
+    def retain(self, pages: Sequence[int]) -> None:
+        """+1 on live pages (prefix-cache registration keeps prompt
+        pages alive past their original request's free)."""
+        for p in pages:
+            if p not in self._refs:
+                raise KeyError(f"retain of non-live page {p}")
+            self._refs[p] += 1
+
+    def release_pages(self, pages: Sequence[int]) -> None:
+        """-1 each; a page returns to the free list when its LAST
+        reference drops (shared prefix pages survive a sharer's free)."""
+        for p in pages:
+            rc = self._refs.get(p, 0)
+            if rc <= 0:
+                raise KeyError(f"release of non-live page {p}")
+            if rc == 1:
+                del self._refs[p]
+                self._free.append(p)
+            else:
+                self._refs[p] = rc - 1
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def share(self, seq_id, pages: Sequence[int]) -> None:
+        """Map already-live pages into ``seq_id``'s page list at +1 ref
+        — the prefix-reuse admission path. Call BEFORE allocating the
+        sequence's own tail pages: block tables are ordered, and the
+        shared pages cover the leading positions."""
+        self.retain(pages)
+        self._owned.setdefault(seq_id, []).extend(pages)
+
+    def rekey(self, old_seq_id, new_seq_id) -> None:
+        """Move a sequence's page list to a new key (the serving
+        scheduler parks chunk-prefilling sequences under a side key so
+        the decode batch's slot tables never see half-filled pages)."""
+        if new_seq_id in self._owned:
+            raise KeyError(f"rekey target {new_seq_id!r} already owned")
+        if old_seq_id in self._owned:
+            self._owned[new_seq_id] = self._owned.pop(old_seq_id)
 
     def block_tables(self, seq_ids, pages_per_seq: int = None,
                      allow_missing: bool = False):

@@ -857,10 +857,17 @@ def write_kv_pages(key_cache, value_cache, new_k, new_v, positions,
     return key_cache, value_cache
 
 
-def write_prefill_kv_pages(key_cache, value_cache, k, v, block_tables):
-    """Write a whole prompt's K/V ([batch, seq, n_kv, d]) into pages.
+def write_prefill_kv_pages(key_cache, value_cache, k, v, block_tables,
+                           start=None, valid_lens=None):
+    """Write a prompt chunk's K/V ([batch, seq, n_kv, d]) into pages.
 
-    Assumes the prompt starts at position 0 (fresh sequences).
+    ``start`` (optional [batch] int32): per-sequence position offset —
+    the chunked-prefill path writes chunk c's tokens at positions
+    ``start .. start+seq-1`` (default: position 0, fresh sequences).
+    ``valid_lens`` (optional [batch] int32): rows ``>= valid_lens[b]``
+    are PADDING — their writes are routed to page 0 (the reserved
+    scratch page) so a right-padded final chunk never clobbers live
+    pages past the table's real coverage.
     ``key_cache``/``value_cache`` may be quantized (int8 rows, f32
     scale plane) tuples — rows are then int8-quantized per (token,
     head) on the way in (the cache-KV int8 serving mode).
@@ -868,9 +875,24 @@ def write_prefill_kv_pages(key_cache, value_cache, k, v, block_tables):
     b, s, n_kv, d = k.shape
     quant = isinstance(key_cache, tuple)
     page_size = (key_cache[0] if quant else key_cache).shape[2]
-    pos = jnp.arange(s)
-    page_ids = block_tables[:, pos // page_size]      # [b, s]
-    slots = jnp.broadcast_to(pos % page_size, (b, s))  # [b, s]
+    if start is None:
+        pos = jnp.arange(s)
+        page_ids = block_tables[:, pos // page_size]      # [b, s]
+        slots = jnp.broadcast_to(pos % page_size, (b, s))  # [b, s]
+    else:
+        pos2 = start.astype(jnp.int32)[:, None] \
+            + jnp.arange(s, dtype=jnp.int32)[None, :]      # [b, s]
+        # clamp the page INDEX into the table width (pad rows may point
+        # past it); the scratch reroute below keeps clamped rows dead
+        pidx = jnp.minimum(pos2 // page_size,
+                           block_tables.shape[1] - 1)
+        page_ids = jnp.take_along_axis(block_tables, pidx, axis=1)
+        slots = pos2 % page_size
+    if valid_lens is not None:
+        valid = jnp.arange(s, dtype=jnp.int32)[None, :] \
+            < valid_lens.astype(jnp.int32)[:, None]        # [b, s]
+        page_ids = jnp.where(valid, page_ids, 0)
+        slots = jnp.where(valid, slots, 0)
     if quant:
         kq_pool, ks_plane = key_cache
         vq_pool, vs_plane = value_cache
@@ -889,6 +911,29 @@ def write_prefill_kv_pages(key_cache, value_cache, k, v, block_tables):
     value_cache = value_cache.at[page_ids, :, slots].set(
         v.astype(value_cache.dtype))
     return key_cache, value_cache
+
+
+def gather_kv_pages(cache_side, block_tables, out_dtype=None):
+    """Gather one cache side's pages into token-major [b, S, n_kv, d]
+    (S = table_width * page_size, token t = page t//ps, slot t%ps) —
+    the chunked-prefill attention's K/V view. Quantized (int8 rows +
+    f32 scale plane) sides are dequantized on the way out; callers mask
+    dead positions by seq_lens/causality, so garbage rows are harmless.
+    ``block_tables`` must hold ABSOLUTE (layer-offset) page ids."""
+    quant = isinstance(cache_side, tuple)
+    pool = cache_side[0] if quant else cache_side
+    b, P = block_tables.shape
+    _, n_kv, ps, d = pool.shape
+    g = pool[block_tables]                       # [b, P, n_kv, ps, d]
+    g = jnp.moveaxis(g, 2, 3).reshape(b, P * ps, n_kv, d)
+    if quant:
+        plane = cache_side[1]                    # [n_kv, pool_tokens]
+        cols = (block_tables[:, :, None] * ps
+                + jnp.arange(ps, dtype=jnp.int32)[None, None, :]) \
+            .reshape(b, P * ps)                  # [b, S]
+        scales = jnp.moveaxis(plane[:, cols], 0, -1)   # [b, S, n_kv]
+        g = g.astype(jnp.float32) * scales[..., None]
+    return g if out_dtype is None else g.astype(out_dtype)
 
 
 def quantize_kv_rows(x):

@@ -36,7 +36,14 @@ Conventions for the built-in instrumentation (all optional reading):
   trace+compile time
 - ``jit.{trace,cache_hit}``    to_static program-cache outcomes
 - ``autograd.{sweeps,nodes}``  run_backward sweeps and executed nodes
-- ``inference.*`` / ``serving.*``  pool sizes, decode steps
+- ``inference.*`` / ``serving.*``  pool sizes, decode steps, admission
+  (``serving.admission_skips`` skip-ahead pass-overs,
+  ``serving.prefix_{hit,miss,pages_saved}`` prefix/KV reuse,
+  ``serving.wasted_decode_tokens`` chunk tail work past req.done)
+- ``serve.*``                  per-request SLO telemetry of the serving
+  frontend (paddle_tpu/serving): ``serve.{ttft_ms,tpot_ms,
+  request_tpot_ms,queue_wait_ms}`` histograms plus
+  ``serve.{submitted,prefill_chunks,prefill_tokens}`` counters
 - ``quant.{act_quant_calls,a8w8_matmuls}``  executed dynamic
   activation-quant ops / int8 x int8 serving matmuls (A8W8 decode,
   QuantedLinear(a8w8=True)) — counted at the dispatch layer, since
@@ -78,8 +85,8 @@ __all__ = [
 #: starts with one of these
 CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
-    "inference.", "serving.", "quant.", "moe.", "dist.", "roofline.",
-    "hbm.", "lint.", "t.",
+    "inference.", "serving.", "serve.", "quant.", "moe.", "dist.",
+    "roofline.", "hbm.", "lint.", "t.",
 )
 
 _ENABLED = True

@@ -1,0 +1,20 @@
+"""paddle_tpu.serving — SLO-aware request-serving frontend.
+
+The production layer between user traffic and the continuous-batching
+engine (ROADMAP item 2): async admission with priorities and bounded
+skip-ahead, CHUNKED PREFILL interleaved with decode (long prompts
+never stall the decode batch), prefix/KV-cache reuse across requests
+sharing a system prompt, and per-request TTFT/TPOT/queue-wait
+telemetry. Driven under Poisson load by ``tools/serve_bench.py``.
+
+The TP (ROADMAP item 1) and EP-MoE (item 4) serving engines plug into
+this scheduler: it only talks to the engine's compiled prefill/decode
+programs and the page manager, both of which shard underneath it.
+"""
+from __future__ import annotations
+
+from .prefix_cache import PrefixCache
+from .request import Request
+from .scheduler import ServingEngine, SLOConfig
+
+__all__ = ["Request", "PrefixCache", "ServingEngine", "SLOConfig"]

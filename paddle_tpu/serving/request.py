@@ -1,0 +1,70 @@
+"""Serving request: the admission unit of the SLO-aware frontend.
+
+Extends the continuous-batching :class:`GenRequest` with what a real
+service needs per request: an arrival timestamp (Poisson load, queue-
+wait accounting), a priority (admission ordering), a streaming token
+callback (tokens reach the caller as they decode, not at drain), and
+the SLO lifecycle marks (admitted / first token / done) the scheduler
+stamps so TTFT/TPOT are measured per request, not per batch.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..inference.engine import GenRequest
+
+__all__ = ["Request"]
+
+
+class Request(GenRequest):
+    """One request through the serving frontend.
+
+    ``priority``: higher admits first (FIFO within a priority level;
+    the admission skip-ahead's starvation bound still applies).
+    ``on_token(req, token)``: called on the scheduler thread for every
+    generated token, including the first one emitted by the final
+    prefill chunk — the streaming surface.
+    ``arrival_time``: ``time.monotonic()`` at construction unless the
+    caller replays recorded traffic with its own timestamps.
+    """
+
+    def __init__(self, prompt, max_new_tokens: int = 32,
+                 eos_token_id=None, priority: int = 0,
+                 on_token: Optional[Callable] = None,
+                 arrival_time: Optional[float] = None):
+        super().__init__(prompt, max_new_tokens, eos_token_id)
+        self.priority = int(priority)
+        self.on_token = on_token
+        self.arrival_time = time.monotonic() if arrival_time is None \
+            else float(arrival_time)
+        # SLO lifecycle marks (monotonic seconds), stamped by the
+        # scheduler: admission, first emitted token, completion
+        self.t_admitted: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+
+    # ---- derived SLO readings (None until the mark exists) ----
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.t_admitted is None:
+            return None
+        return self.t_admitted - self.arrival_time
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Time to first token, from ARRIVAL (queue wait included —
+        the number the user experiences, not the scheduler's)."""
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.arrival_time
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean time per output token after the first."""
+        if self.t_done is None or self.t_first_token is None \
+                or len(self.generated) < 2:
+            return None
+        return (self.t_done - self.t_first_token) \
+            / (len(self.generated) - 1)

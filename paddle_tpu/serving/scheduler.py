@@ -1,0 +1,415 @@
+"""SLO-aware serving frontend over the continuous-batching engine.
+
+The kernels under this (PR 4–5 fused weight-stream decode) are fast;
+what turns them into a SERVICE is the layer here (ROADMAP item 2): an
+async admission queue feeding a scheduler that interleaves CHUNKED
+PREFILL with grouped decode — a 4k-token prompt fills the paged pool
+in fixed-size chunks BETWEEN decode chunks, so admitting it never
+stalls the decode batch for its whole prompt length — plus prefix/KV
+reuse (serving/prefix_cache.py) so requests sharing a system prompt
+map the prefix's pages instead of recomputing them.
+
+Scheduling policy (``SLOConfig``): admission uses the engine's bounded
+skip-ahead (head-of-line fix) ordered by request priority; the
+prefill-vs-decode interleave is a weighted cycle derived from the
+TTFT-vs-TPOT weights — ``ttft_weight : tpot_weight`` of 2:1 runs up to
+two prefill chunks per decode chunk (new requests reach their first
+token sooner), 1:2 the reverse (active streams keep their inter-token
+gap tight). With any decode-ready request present, at most
+``prefill_burst`` consecutive prefill chunks ever run, so an active
+request's inter-token stall is BOUNDED by
+``prefill_burst * prefill_chunk + decode_chunk`` tokens of device work
+— the tier-1 stall-bound test pins this.
+
+Telemetry (the PR 1–2 stats/roofline stack): per-request
+``serve.{ttft_ms,tpot_ms,queue_wait_ms}`` histograms,
+``serving.prefix_{hit,miss,pages_saved}`` + chunk counters, and every
+scheduler phase reports under its own roofline rung —
+``serve.prefill[c=N]`` per chunk size (honest post-sync timing) next
+to the engine's ``decode.*[k=N]`` rungs.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..incubate.nn.fused_transformer import PagedKV
+from ..inference.engine import ContinuousBatchingEngine, FusedCausalLM
+from ..profiler import roofline as _roofline
+from ..profiler import stats as _stats
+from .prefix_cache import PrefixCache
+from .request import Request
+
+__all__ = ["SLOConfig", "ServingEngine"]
+
+
+class SLOConfig:
+    """Scheduler knobs (see module docstring for the policy).
+
+    ``ttft_weight`` / ``tpot_weight``: relative urgency of prefill
+    (time-to-first-token) vs decode (time-per-output-token) work; the
+    integer interleave cycle is derived from their ratio.
+    ``prefill_chunk``: tokens per chunked-prefill program (the stall
+    bound's unit; one compiled program serves every chunk of this size).
+    ``admit_window`` / ``starvation_bound``: admission skip-ahead reach
+    and its fairness bound (inference/engine.py ``_pick_waiting``).
+    ``prefix_cache``: enable prefix/KV reuse; ``prefix_cache_pages``
+    caps the registered pages (None = pool-pressure eviction only).
+    """
+
+    def __init__(self, ttft_weight: float = 1.0,
+                 tpot_weight: float = 1.0, prefill_chunk: int = 256,
+                 admit_window: int = 8, starvation_bound: int = 16,
+                 prefix_cache: bool = True,
+                 prefix_cache_pages: Optional[int] = None):
+        if ttft_weight <= 0 or tpot_weight <= 0:
+            raise ValueError("SLO weights must be positive")
+        self.ttft_weight = float(ttft_weight)
+        self.tpot_weight = float(tpot_weight)
+        self.prefill_chunk = max(int(prefill_chunk), 1)
+        self.admit_window = max(int(admit_window), 1)
+        self.starvation_bound = max(int(starvation_bound), 1)
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix_cache_pages = prefix_cache_pages
+        r = self.ttft_weight / self.tpot_weight
+        #: consecutive prefill chunks allowed while decoders wait /
+        #: decode chunks between prefill opportunities — the weighted
+        #: interleave cycle (1:1 → strict alternation)
+        self.prefill_burst = max(1, int(round(r)))
+        self.decode_burst = max(1, int(round(1.0 / r)))
+
+
+class _Prefill:
+    """Progress of one chunk-prefilling request parked on a slot."""
+
+    __slots__ = ("req", "pos")
+
+    def __init__(self, req: Request, pos: int):
+        self.req = req
+        self.pos = pos  # prompt tokens already in the pool
+
+
+class ServingEngine(ContinuousBatchingEngine):
+    """Production-shaped serving frontend (see module docstring).
+
+    Usage::
+
+        eng = ServingEngine(model, max_batch=8,
+                            slo=SLOConfig(prefill_chunk=128))
+        eng.submit([1, 2, 3], max_new_tokens=16,
+                   on_token=lambda r, t: push(t))   # any thread
+        finished = eng.run()        # or step() on the serving thread
+
+    Chunk-prefilling requests park on a slot under a side page-table
+    key (``("prefill", i)``) so the decode batch's slot tables never
+    see their half-filled pages; completion rekeys the pages to
+    ``("slot", i)`` and the request joins the decode batch with its
+    first token already emitted (from the final chunk's logits).
+    """
+
+    def __init__(self, model: FusedCausalLM,
+                 slo: Optional[SLOConfig] = None, **engine_kwargs):
+        slo = slo or SLOConfig()
+        engine_kwargs.setdefault("admit_window", slo.admit_window)
+        engine_kwargs.setdefault("starvation_bound",
+                                 slo.starvation_bound)
+        super().__init__(model, **engine_kwargs)
+        self.slo = slo
+        self.prefix_cache: Optional[PrefixCache] = None
+        if slo.prefix_cache:
+            self.prefix_cache = PrefixCache(
+                self._mgr, self.page_size, slo.prefix_cache_pages)
+        self._prefilling: Dict[int, _Prefill] = {}
+        # async admission: submit() appends here from ANY thread; the
+        # scheduler thread drains into the priority-ordered waiting
+        # list at each step
+        self._inbox: List[Request] = []
+        self._inbox_lock = threading.Lock()
+        self._arrival = itertools.count()
+        self._chunk_jit: dict = {}
+        self._cycle_pos = 0
+        #: scheduler action trace ("prefill"/"decode"), the stall-bound
+        #: test's evidence; cheap (one short str per step)
+        self.action_log: List[str] = []
+
+    # ---------------- public API ----------------
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_token_id=None, priority: int = 0,
+               on_token=None) -> int:
+        """Thread-safe admission (any thread): queue a request, return
+        its id. Tokens stream through ``on_token`` as they decode."""
+        req = Request(prompt, max_new_tokens, eos_token_id,
+                      priority=priority, on_token=on_token)
+        return self.submit_request(req)
+
+    def submit_request(self, req: Request) -> int:
+        if len(req.prompt) + req.max_new_tokens > self.max_length:
+            raise ValueError("request exceeds engine max_length")
+        with self._inbox_lock:
+            self._inbox.append(req)
+        _stats.inc("serve.submitted")
+        return req.id
+
+    @property
+    def num_prefilling(self) -> int:
+        return len(self._prefilling)
+
+    def step(self):
+        """One scheduler action: drain admissions, then run EITHER one
+        prefill chunk or one decode chunk per the SLO interleave.
+        Returns requests finished this step."""
+        self._drain_inbox()
+        self._admit()
+        action = self._pick_action()
+        if action == "prefill":
+            self.action_log.append("prefill")
+            return self._prefill_step()
+        if self.num_active == 0:
+            return []
+        self.action_log.append("decode")
+        before = {i: len(r.generated)
+                  for i, r in enumerate(self._slots) if r is not None}
+        t0 = time.perf_counter()
+        done = super().step()
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        k = self.decode_chunk
+        now = time.monotonic()
+        for i, n0 in before.items():
+            req = self._slots[i]
+            if req is None:  # finished inside the chunk
+                continue
+            emitted = len(req.generated) - n0
+            if emitted > 0:
+                _stats.observe("serve.tpot_ms", dt_ms / k)
+        for req in done:
+            req.t_done = now
+            tpot = getattr(req, "tpot_s", None)
+            if tpot is not None:
+                # whole-lifetime per-token mean (the chunk-level
+                # serve.tpot_ms above is the streaming-gap view)
+                _stats.observe("serve.request_tpot_ms", tpot * 1e3)
+        return done
+
+    def run(self):
+        """Drain: step until every submitted request finishes."""
+        while (self._inbox or self.waiting or self._prefilling
+               or self.num_active):
+            self.step()
+        return self.finished
+
+    # ---------------- admission ----------------
+
+    def _drain_inbox(self):
+        with self._inbox_lock:
+            newly, self._inbox = self._inbox, []
+        for req in newly:
+            req._seq = next(self._arrival)
+            self.waiting.append(req)
+        if newly:
+            # higher priority first, FIFO within a level (stable by
+            # arrival); the skip-ahead window then scans THIS order
+            self.waiting.sort(
+                key=lambda r: (-getattr(r, "priority", 0),
+                               getattr(r, "_seq", r.id)))
+
+    def _slot_free(self, i: int) -> bool:
+        return self._slots[i] is None and i not in self._prefilling
+
+    def _first_chunk_pages(self, req) -> int:
+        """Pages the FIRST prefill chunk needs beyond any prefix hit."""
+        shared = self.prefix_cache.match(req.prompt) \
+            if self.prefix_cache is not None else []
+        covered = len(shared) * self.page_size
+        c = self._chunk_size(len(req.prompt) - covered)
+        need = min(self._mgr.pages_needed(covered + c),
+                   self._pages_per_seq)
+        return need - len(shared)
+
+    def _can_admit(self, req) -> bool:
+        need = self._first_chunk_pages(req)
+        if need > self._mgr.free_pages and self.prefix_cache is not None:
+            # pool pressure: evict cold cached prefixes page by page
+            # (an evicted entry only frees its page if no live sequence
+            # still maps it, so re-check after each drop)
+            while need > self._mgr.free_pages \
+                    and self.prefix_cache.evict(1):
+                pass
+        return need <= self._mgr.free_pages
+
+    def _admit_into(self, req: Request, i: int):
+        """Park ``req`` on slot ``i`` in the chunk-prefill phase: map
+        any cached prefix pages, allocate the first chunk's tail pages,
+        and let ``_prefill_step`` fill the prompt chunk by chunk. No
+        prefill compute happens at admission — admitting a 4k prompt
+        costs a page-table update, not a 4k-token program."""
+        now = time.monotonic()
+        req.t_admitted = now
+        arrival = getattr(req, "arrival_time", now)
+        _stats.observe("serve.queue_wait_ms", (now - arrival) * 1e3)
+        _stats.inc("serving.admitted")
+        self._hook_first_token(req)
+        shared = []
+        if self.prefix_cache is not None:
+            shared = self.prefix_cache.match(req.prompt)
+            if shared:
+                _stats.inc("serving.prefix_hit")
+                _stats.inc("serving.prefix_pages_saved", len(shared))
+            else:
+                _stats.inc("serving.prefix_miss")
+        key = ("prefill", i)
+        if shared:
+            self._mgr.share(key, shared)
+        self._prefilling[i] = _Prefill(
+            req, pos=len(shared) * self.page_size)
+
+    def _hook_first_token(self, req):
+        """Wrap the user's on_token with the TTFT stamp (fires exactly
+        once, on the first emitted token)."""
+        user_cb = getattr(req, "on_token", None)
+
+        def cb(r, t, _u=user_cb):
+            if getattr(r, "t_first_token", None) is None:
+                r.t_first_token = time.monotonic()
+                _stats.observe(
+                    "serve.ttft_ms",
+                    (r.t_first_token
+                     - getattr(r, "arrival_time", r.t_first_token))
+                    * 1e3)
+            if _u is not None:
+                _u(r, t)
+
+        req.on_token = cb
+
+    # ---------------- scheduling ----------------
+
+    def _pick_action(self) -> str:
+        """Prefill vs decode for this step: the weighted interleave
+        cycle, active only under CONTENTION (both phases have work).
+        The cycle restarts whenever contention (re)starts, so while any
+        request is decode-ready at most ``prefill_burst`` consecutive
+        prefill chunks ever run — the stall bound."""
+        if not self._prefilling:
+            self._cycle_pos = 0
+            return "decode"
+        if self.num_active == 0:
+            self._cycle_pos = 0
+            return "prefill"
+        cycle = self.slo.prefill_burst + self.slo.decode_burst
+        pos = self._cycle_pos % cycle
+        self._cycle_pos += 1
+        return "prefill" if pos < self.slo.prefill_burst else "decode"
+
+    def _chunk_size(self, remaining: int) -> int:
+        """Chunk length for ``remaining`` prompt tokens: full chunks
+        while they last, the tail bucket-padded (one compiled program
+        per SIZE — prompt_bucket bounds the tail-program count)."""
+        if remaining >= self.slo.prefill_chunk:
+            return self.slo.prefill_chunk
+        bs = self.prompt_bucket
+        return max(min(-(-remaining // bs) * bs,
+                       self.slo.prefill_chunk), 1)
+
+    def _pick_prefilling(self) -> int:
+        """Most urgent prefilling slot: priority, then admission
+        order (finish what started first — chunks of one prompt don't
+        interleave with another's without cause)."""
+        return min(self._prefilling,
+                   key=lambda i: (
+                       -getattr(self._prefilling[i].req, "priority", 0),
+                       self._prefilling[i].req.t_admitted))
+
+    def _get_chunk_prefill(self, c: int):
+        """One compiled chunk program per chunk SIZE (start/len are
+        traced operands — every chunk of every request shares it)."""
+        if c not in self._chunk_jit:
+            import functools
+
+            import jax
+
+            self._chunk_jit[c] = _roofline.AotProgram(
+                f"serve.prefill[c={c}]",
+                jax.jit(self._chunk_prefill_fn, donate_argnums=(8, 9)))
+        return self._chunk_jit[c]
+
+    def _chunk_prefill_fn(self, weights, embed, head_t, lnf_s, lnf_b,
+                          ids, start, chunk_len, ck, cv, tables):
+        """Compiled chunk program: prefill ``ids`` at positions
+        ``start..`` against the cached prefix + in-chunk causal
+        triangle, returning the last VALID position's logits (used only
+        by the final chunk — one [1, d] @ [d, vocab] head matmul per
+        chunk buys an honest per-chunk device sync)."""
+        g = self._gen
+        st = self.model.stack
+        x = embed[ids].astype(g._cdtype)
+        h, cache = st.prefill_chunk_raw(
+            weights, x, PagedKV(ck, cv), tables, start, chunk_len,
+            g._cos, g._sin, a8w8=g._a8w8)
+        hl = h[jnp.arange(h.shape[0]), chunk_len - 1]
+        logits = g._logits(hl, head_t, lnf_s, lnf_b)
+        return logits, cache.k, cache.v
+
+    def _prefill_step(self):
+        """Run ONE prefill chunk for the most urgent prefilling slot;
+        on prompt completion the request joins the decode batch with
+        its first token emitted. Returns requests finished this step
+        (a one-token request can finish straight out of prefill)."""
+        i = self._pick_prefilling()
+        stt = self._prefilling[i]
+        req = stt.req
+        L = len(req.prompt)
+        c = self._chunk_size(L - stt.pos)
+        n = min(L - stt.pos, c)
+        key = ("prefill", i)
+        need = min(self._mgr.pages_needed(stt.pos + c),
+                   self._pages_per_seq)
+        have = len(self._mgr._owned.get(key, ()))
+        if need > have:
+            self._mgr.grow(key, need - have)
+        tables = self._mgr.block_tables([key], self._pages_per_seq)
+        ids = np.zeros((1, c), np.int32)
+        ids[0, :n] = req.prompt[stt.pos: stt.pos + n]
+        m = self.model
+        self._gen._count_a8w8(1)
+        t0 = time.perf_counter()
+        logits, self._ck, self._cv = self._get_chunk_prefill(c)(
+            m.stack._stack(), m.embed._data, self._gen._head_t,
+            m.lnf_scale._data, m.lnf_bias._data, jnp.asarray(ids),
+            jnp.asarray([stt.pos], jnp.int32),
+            jnp.asarray([n], jnp.int32), self._ck, self._cv, tables)
+        tok = int(np.asarray(
+            self._gen._argmax(jnp.asarray(logits)))[0])
+        # the argmax fetch synced the chunk — honest phase roofline
+        _roofline.analyze(f"serve.prefill[c={c}]",
+                          time.perf_counter() - t0)
+        _stats.inc("serve.prefill_chunks")
+        _stats.inc("serve.prefill_tokens", n)
+        stt.pos += n
+        if stt.pos < L:
+            return []
+        # prompt complete: emit the first token, join the decode batch
+        del self._prefilling[i]
+        self._mgr.rekey(key, ("slot", i))
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(
+                req.prompt, self._mgr._owned[("slot", i)])
+        self._slots[i] = req
+        req.generated.append(tok)
+        cb = getattr(req, "on_token", None)
+        if cb is not None:
+            cb(req, tok)
+        if (req.eos_token_id is not None and tok == req.eos_token_id) \
+                or req.max_new_tokens <= 1:
+            req.done = True
+            req.t_done = time.monotonic()
+            self._release(i)
+            self.finished.append(req)
+            return [req]
+        self._lens[i] = L + 1
+        self._last_tok[i] = tok
+        return []

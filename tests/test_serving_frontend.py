@@ -1,0 +1,446 @@
+"""SLO-aware serving frontend (paddle_tpu.serving): chunked prefill,
+prefix/KV reuse, skip-ahead admission, lifecycle telemetry, serve bench.
+
+Tier-1 acceptance pins (ISSUE 8):
+- chunked prefill BOUNDS decode stall: a 1k-token prompt admitted
+  mid-stream never opens an inter-token gap beyond one prefill chunk
+  plus the decode chunk (``TestChunkedPrefill.test_stall_bound_*``);
+- prefix reuse: two requests sharing a system prompt allocate strictly
+  fewer pool pages than two cold requests, and freeing one never
+  corrupts the other (refcounts — ``TestPrefixReuse``).
+"""
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine, FusedCausalLM
+from paddle_tpu.inference.kv_cache import BlockKVCacheManager
+from paddle_tpu.profiler import stats
+from paddle_tpu.serving import (PrefixCache, Request, ServingEngine,
+                                SLOConfig)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model(seed=7, max_position=256):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=64, embed_dim=32, num_heads=4,
+                         dim_feedforward=64, num_layers=2,
+                         max_position=max_position)
+
+
+def _dense_greedy(model, prompt, n):
+    seq = np.asarray(prompt, np.int64).reshape(1, -1)
+    for _ in range(n):
+        logits = model(paddle.to_tensor(seq)).numpy()
+        nxt = logits[:, -1].argmax(-1)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    return seq[0]
+
+
+class TestChunkedPrefill:
+    def test_long_prompt_chunked_parity(self):
+        """A prompt spanning several prefill chunks (with a ragged
+        tail) must decode exactly like the dense reference — the
+        chunk program attends to cached pages + the in-chunk causal
+        triangle."""
+        model = _model()
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 64, (L,)) for L in (37, 6, 9)]
+        streamed = {}
+        eng = ServingEngine(
+            model, max_batch=3, page_size=4, max_length=128,
+            decode_chunk=2, slo=SLOConfig(prefill_chunk=16))
+        rids = [eng.submit(
+            p, max_new_tokens=6,
+            on_token=lambda r, t: streamed.setdefault(r.id, [])
+            .append(t)) for p in prompts]
+        done = {r.id: r for r in eng.run()}
+        assert sorted(done) == sorted(rids)
+        for rid, p in zip(rids, prompts):
+            ref = _dense_greedy(model, p, 6)
+            np.testing.assert_array_equal(done[rid].output, ref,
+                                          err_msg=f"req {rid}")
+            # streaming callback saw every token, in order
+            assert streamed[rid] == list(done[rid].generated)
+        # lifecycle telemetry stamped per request
+        for r in done.values():
+            assert r.ttft_s is not None and r.ttft_s >= 0
+            assert r.queue_wait_s is not None
+        assert stats.counter("serve.prefill_chunks").value > 0
+
+    def test_stall_bound_1k_prompt_mid_stream(self):
+        """ISSUE 8 acceptance: a 1k-token prompt admitted while a
+        short request decodes must NOT stall it — with the default
+        1:1 SLO weights at most ONE prefill chunk ever runs between
+        that request's decode chunks, so its inter-token gap is
+        bounded by (prefill_chunk + decode_chunk) of device work."""
+        model = _model(max_position=1280)
+        rng = np.random.RandomState(5)
+        short = rng.randint(0, 64, (6,))
+        long_p = rng.randint(0, 64, (1024,))
+        eng = ServingEngine(
+            model, max_batch=2, page_size=8, max_length=1152,
+            decode_chunk=4, slo=SLOConfig(prefill_chunk=128))
+        # A stays decode-active through B's entire 8-chunk prefill
+        # (48 tokens / k=4 = 12 decode chunks > 8 prefill chunks), so
+        # the bound must hold over the WHOLE action log
+        ra = eng.submit(short, max_new_tokens=48)
+        # get the short request decoding first
+        while eng.num_active == 0:
+            eng.step()
+        eng.action_log.clear()
+        rb = eng.submit(long_p, max_new_tokens=4)
+        done = {r.id: r for r in eng.run()}
+        assert set(done) == {ra, rb}
+        # the bound: while A was decode-active, never two consecutive
+        # prefill actions (1024/128 = 8 chunks all interleaved)
+        log = eng.action_log
+        assert log.count("prefill") >= 8, log
+        for i in range(len(log) - 1):
+            if log[i] == "prefill" and i + 1 < len(log):
+                assert log[i + 1] == "decode", (
+                    f"two consecutive prefill chunks at {i}: "
+                    f"{log[max(0, i - 2): i + 3]}")
+        # and both outputs still exact
+        np.testing.assert_array_equal(
+            done[ra].output, _dense_greedy(model, short, 48))
+        np.testing.assert_array_equal(
+            done[rb].output, _dense_greedy(model, long_p, 4))
+
+    def test_ttft_weighted_interleave(self):
+        """ttft_weight 2:1 allows two prefill chunks per decode chunk;
+        the cycle is derived, not hardcoded."""
+        assert SLOConfig(ttft_weight=2, tpot_weight=1) \
+            .prefill_burst == 2
+        assert SLOConfig(ttft_weight=1, tpot_weight=2) \
+            .decode_burst == 2
+        assert SLOConfig().prefill_burst == 1
+        assert SLOConfig().decode_burst == 1
+        with pytest.raises(ValueError):
+            SLOConfig(ttft_weight=0)
+
+
+class TestPrefixReuse:
+    def _engine(self, model, **kw):
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("max_length", 128)
+        kw.setdefault("decode_chunk", 2)
+        kw.setdefault("slo", SLOConfig(prefill_chunk=8))
+        return ServingEngine(model, **kw)
+
+    def test_shared_prefix_allocates_strictly_fewer_pages(self):
+        """ISSUE 8 acceptance: two requests sharing a 16-token system
+        prompt allocate strictly fewer pool pages than two cold
+        requests — exactly 4 pages (the full prefix pages) fewer."""
+        model = _model()
+        rng = np.random.RandomState(11)
+        sysp = rng.randint(0, 64, (16,))
+        tails = [rng.randint(0, 64, (5,)), rng.randint(0, 64, (7,))]
+        prompts = [np.concatenate([sysp, t]) for t in tails]
+
+        def run_pair(prefix_cache):
+            eng = self._engine(_model(), slo=SLOConfig(
+                prefill_chunk=8, prefix_cache=prefix_cache))
+            allocated = []
+            orig_alloc = BlockKVCacheManager.allocate
+            orig_grow = BlockKVCacheManager.grow
+
+            def spy_alloc(mgr, seq_id, n):
+                r = orig_alloc(mgr, seq_id, n)
+                allocated.extend(r)
+                return r
+
+            def spy_grow(mgr, seq_id, n):
+                r = orig_grow(mgr, seq_id, n)
+                allocated.extend(r)
+                return r
+
+            BlockKVCacheManager.allocate = spy_alloc
+            BlockKVCacheManager.grow = spy_grow
+            try:
+                for p in prompts:   # sequential: 2nd hits the cache
+                    eng.submit(p, max_new_tokens=4)
+                    eng.run()
+            finally:
+                BlockKVCacheManager.allocate = orig_alloc
+                BlockKVCacheManager.grow = orig_grow
+            return len(allocated), eng
+
+        before_saved = stats.counter("serving.prefix_pages_saved").value
+        cold_pages, _ = run_pair(prefix_cache=False)
+        warm_pages, eng = run_pair(prefix_cache=True)
+        assert warm_pages < cold_pages
+        # the 16-token prefix = 4 full pages at page_size 4
+        assert cold_pages - warm_pages == 4
+        saved = stats.counter("serving.prefix_pages_saved").value \
+            - before_saved
+        assert saved == 4
+        assert stats.counter("serving.prefix_hit").value >= 1
+        # outputs unaffected by reuse
+        for r, p in zip(eng.finished, prompts):
+            np.testing.assert_array_equal(
+                r.output, _dense_greedy(model, p, 4))
+
+    def test_refcount_free_does_not_corrupt_sharer(self):
+        """ISSUE 8 acceptance: with two live sharers of one prefix,
+        freeing the first must not free/corrupt the pages the second
+        still maps (refcount), and its tokens stay exact."""
+        model = _model()
+        rng = np.random.RandomState(13)
+        sysp = rng.randint(0, 64, (16,))
+        pa = np.concatenate([sysp, rng.randint(0, 64, (5,))])
+        pb = np.concatenate([sysp, rng.randint(0, 64, (6,))])
+        pc = np.concatenate([sysp, rng.randint(0, 64, (7,))])
+        eng = self._engine(model, max_batch=2)
+        eng.submit(pa, max_new_tokens=2)
+        eng.run()          # cold run registers pa's 5 full pages
+        assert len(eng.prefix_cache) == 5   # 21 tokens // page 4
+        assert all(eng._mgr.refcount(p) == 1
+                   for p in eng.prefix_cache._entries.values())
+        # the chain B/C share with A is the 4 system-prompt pages
+        shared = eng.prefix_cache.match(pb)
+        assert len(shared) == 4
+
+        # B (short) and C (long) decode concurrently, both sharing
+        rb = eng.submit(pb, max_new_tokens=2)
+        rc = eng.submit(pc, max_new_tokens=12)
+        while not any(r.id == rb for r in eng.finished):
+            eng.step()
+        # B freed its pages; C still maps the prefix: refcount must be
+        # cache(1) + C(1) — B's free took only ITS reference
+        assert any(r is not None and r.id == rc for r in eng._slots) \
+            or rc in [s.req.id for s in eng._prefilling.values()]
+        assert all(eng._mgr.refcount(p) == 2 for p in shared)
+        done = {r.id: r for r in eng.run()}
+        np.testing.assert_array_equal(
+            done[rc].output, _dense_greedy(model, pc, 12))
+        # drained: only the cache's references remain (pa's 5 pages +
+        # B's and C's own full tail page each); pool accounting exact
+        assert len(eng.prefix_cache) == 7
+        cached = list(eng.prefix_cache._entries.values())
+        assert all(eng._mgr.refcount(p) == 1 for p in cached)
+        assert eng._mgr.free_pages == eng._mgr.num_pages - 1 \
+            - len(cached)
+        # eviction returns them and the pool closes the loop
+        eng.prefix_cache.clear()
+        assert eng._mgr.free_pages == eng._mgr.num_pages - 1
+
+    def test_prefix_never_covers_whole_prompt(self):
+        """A prompt that is ENTIRELY full cached pages must still
+        prefill its last token (the first emitted token needs a fresh
+        hidden state): match is capped at (len-1)//page_size pages."""
+        mgr = BlockKVCacheManager(2, 4, 8, page_size=4, num_pages=16,
+                                  reserve_scratch=True)
+        cache = PrefixCache(mgr, page_size=4)
+        prompt = np.arange(16, dtype=np.int32)
+        pages = mgr.allocate("a", 16)
+        cache.insert(prompt, pages)
+        assert len(cache) == 4
+        hit = cache.match(prompt)           # same 16 tokens
+        assert len(hit) == 3                # NOT 4: last page prefills
+        assert hit == pages[:3]
+
+
+class TestKVRefcounting:
+    def test_share_then_free_order_independent(self):
+        mgr = BlockKVCacheManager(2, 4, 8, page_size=4, num_pages=16,
+                                  reserve_scratch=True)
+        a = mgr.allocate("a", 8)            # 2 pages, rc=1
+        mgr.share("b", a)                   # rc=2
+        mgr.allocate("b", 4)                # +1 private page
+        free0 = mgr.free_pages
+        mgr.free("a")                       # shared rc 2->1: not freed
+        assert mgr.free_pages == free0
+        assert all(mgr.refcount(p) == 1 for p in a)
+        mgr.free("b")                       # last ref: all back
+        assert mgr.free_pages == 15
+        assert all(mgr.refcount(p) == 0 for p in a)
+
+    def test_release_guards(self):
+        mgr = BlockKVCacheManager(2, 4, 8, page_size=4, num_pages=16)
+        with pytest.raises(KeyError):
+            mgr.retain([3])                 # never allocated
+        pages = mgr.allocate("a", 4)
+        mgr.free("a")
+        with pytest.raises(KeyError):
+            mgr.release_pages(pages)        # double free
+
+
+class TestAdmission:
+    def _busy_engine(self):
+        """max_batch=3 engine whose pool is mostly eaten by one active
+        long request, so page-hungry admissions don't fit."""
+        model = _model()
+        eng = ContinuousBatchingEngine(
+            model, max_batch=3, page_size=4, max_length=64,
+            decode_chunk=2, num_pages=15)
+        rng = np.random.RandomState(17)
+        eng.submit(rng.randint(0, 64, (40,)), max_new_tokens=20)
+        eng.step()
+        assert eng.num_active == 1
+        return eng, rng
+
+    def test_skip_ahead_fixes_head_of_line(self):
+        """When the head's pages don't fit, a later request that fits
+        admits instead of blocking — with the skip counted."""
+        eng, rng = self._busy_engine()
+        before = stats.counter("serving.admission_skips").value
+        big = eng.submit(rng.randint(0, 64, (24,)), max_new_tokens=4)
+        small = eng.submit(rng.randint(0, 64, (4,)), max_new_tokens=4)
+        eng.step()
+        active_ids = [r.id for r in eng._slots if r is not None]
+        assert small in active_ids, "small request head-of-line blocked"
+        assert big in [r.id for r in eng.waiting]
+        assert stats.counter("serving.admission_skips").value \
+            == before + 1
+        done = {r.id: r for r in eng.run()}     # big admits eventually
+        assert big in done and done[big].done
+
+    def test_starvation_bound_pins_queue(self):
+        """After starvation_bound skips the window collapses to the
+        head: later requests stop flowing past it even if they fit."""
+        eng, rng = self._busy_engine()
+        eng.starvation_bound = 1
+        big = eng.submit(rng.randint(0, 64, (24,)), max_new_tokens=4)
+        s1 = eng.submit(rng.randint(0, 64, (4,)), max_new_tokens=4)
+        s2 = eng.submit(rng.randint(0, 64, (4,)), max_new_tokens=4)
+        eng.step()     # s1 skips past big (big now at the bound)
+        active_ids = [r.id for r in eng._slots if r is not None]
+        assert s1 in active_ids
+        # a slot is free and s2 fits, but big pins the queue now
+        assert eng.num_active == 2
+        assert [r.id for r in eng.waiting] == [big, s2]
+        done = {r.id: r for r in eng.run()}
+        assert len(done) == 4                  # drains completely
+
+    def test_priority_admits_first(self):
+        """Higher-priority requests admit ahead of earlier arrivals."""
+        model = _model()
+        eng = ServingEngine(model, max_batch=1, page_size=4,
+                            max_length=64, decode_chunk=2,
+                            slo=SLOConfig(prefill_chunk=8))
+        rng = np.random.RandomState(19)
+        lo = eng.submit(rng.randint(0, 64, (4,)), max_new_tokens=2)
+        hi = eng.submit(rng.randint(0, 64, (4,)), max_new_tokens=2,
+                        priority=5)
+        eng.step()
+        admitted = [s.req.id for s in eng._prefilling.values()] \
+            + [r.id for r in eng._slots if r is not None]
+        assert admitted == [hi]
+        done = [r.id for r in eng.run()]
+        assert set(done) == {lo, hi}
+
+
+class TestSatellites:
+    def test_genrequest_ids_thread_safe(self):
+        """ISSUE 8 satellite: concurrent construction never duplicates
+        ids (itertools.count, atomic under CPython)."""
+        from paddle_tpu.inference import GenRequest
+
+        ids = []
+        lock = threading.Lock()
+
+        def worker():
+            local = [GenRequest([1], 1).id for _ in range(250)]
+            with lock:
+                ids.extend(local)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(ids) == len(set(ids)) == 2000
+
+    def test_wasted_decode_tokens_counted(self):
+        """Tokens decoded past req.done inside a chunk are counted —
+        the decode_chunk tuning signal."""
+        model = _model()
+        eng = ContinuousBatchingEngine(model, max_batch=1, page_size=4,
+                                       max_length=64, decode_chunk=4)
+        before = stats.counter("serving.wasted_decode_tokens").value
+        eng.submit(np.array([1, 2, 3]), max_new_tokens=2)
+        eng.run()
+        # admission emits token 1; the k=4 chunk consumes 1 more and
+        # discards 3
+        assert stats.counter("serving.wasted_decode_tokens").value \
+            == before + 3
+
+    def test_serve_prefix_registered_in_conventions(self):
+        """ISSUE 8 satellite: serve./serving. are documented metric
+        namespaces (the naming lint in test_profiler_stats covers the
+        live registry)."""
+        assert "serve." in stats.CONVENTION_PREFIXES
+        assert "serving." in stats.CONVENTION_PREFIXES
+
+    def test_request_slo_properties(self):
+        r = Request([1, 2], max_new_tokens=4, priority=2,
+                    arrival_time=100.0)
+        assert r.priority == 2 and r.arrival_time == 100.0
+        assert r.ttft_s is None and r.tpot_s is None
+        r.t_admitted = 100.5
+        r.t_first_token = 101.0
+        assert r.queue_wait_s == pytest.approx(0.5)
+        assert r.ttft_s == pytest.approx(1.0)
+        r.generated = [1, 2, 3]
+        r.t_done = 102.0
+        assert r.tpot_s == pytest.approx(0.5)
+
+
+class TestServeBench:
+    def test_cli_smoke_emits_slo_rungs(self):
+        """ISSUE 8 acceptance: serve_bench runs on CPU and emits the
+        serve_{p50,p99}_ttft_ms + serve_tokens_per_sec rungs with a
+        telemetry block."""
+        import json
+
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "serve_bench.py"),
+             "--streams", "2", "--requests", "4", "--seed", "0",
+             "--prompt-mix", "6,14", "--system-prompt", "8",
+             "--max-new", "4", "--prefill-chunk", "8",
+             "--decode-chunk", "2", "--d-model", "32", "--layers", "1",
+             "--heads", "2", "--vocab", "64", "--rate", "500",
+             "--no-lint"],
+            capture_output=True, text=True, timeout=420,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        doc = json.loads(
+            [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")][-1])
+        for key in ("serve_p50_ttft_ms", "serve_p99_ttft_ms",
+                    "serve_tokens_per_sec"):
+            assert isinstance(doc[key], (int, float)), key
+        assert doc["serve_p50_ttft_ms"] <= doc["serve_p99_ttft_ms"]
+        assert doc["serve_requests"] == 4
+        tele = doc["telemetry"]
+        assert "serve.ttft_ms" in tele["histograms"]
+        assert tele["histograms"]["serve.ttft_ms"]["count"] == 4
+
+    def test_bench_gate_gates_serve_rungs(self):
+        """TTFT regresses UP, tokens/sec DOWN; improvements pass."""
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import bench_gate
+        finally:
+            sys.path.pop(0)
+        prev = {"serve_p50_ttft_ms": 10.0, "serve_p99_ttft_ms": 40.0,
+                "serve_tokens_per_sec": 1000.0}
+        worse_ttft = dict(prev, serve_p99_ttft_ms=80.0)
+        bad, n = bench_gate.gate(prev, worse_ttft)
+        assert n and any("serve_p99_ttft_ms" in ln for ln in bad)
+        worse_tps = dict(prev, serve_tokens_per_sec=500.0)
+        bad, _ = bench_gate.gate(prev, worse_tps)
+        assert any("serve_tokens_per_sec" in ln for ln in bad)
+        better = {"serve_p50_ttft_ms": 5.0, "serve_p99_ttft_ms": 20.0,
+                  "serve_tokens_per_sec": 2000.0}
+        bad, _ = bench_gate.gate(prev, better)
+        assert not bad

@@ -73,6 +73,12 @@ DEFAULT_METRICS: Dict[str, str] = {
     "decode_bf16_grouped_tokens_per_sec": "down",
     "decode_bf16_grouped_pct_of_hbm_roofline": "down",
     "decode_int8kv_b64_tokens_per_sec": "down",
+    # serving-frontend SLO rungs (tools/serve_bench.py): latency
+    # percentiles regress UP, delivered throughput DOWN
+    "serve_p50_ttft_ms": "up",
+    "serve_p99_ttft_ms": "up",
+    "serve_p50_tpot_ms": "up",
+    "serve_tokens_per_sec": "down",
     # static-analysis state the numbers were measured under: the
     # finding count must only go DOWN between rounds, so any growth
     # regresses (direction "up" = an increase fails the gate); gates

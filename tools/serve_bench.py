@@ -1,0 +1,231 @@
+"""Poisson-load serving benchmark: SLO numbers for the serving frontend.
+
+Drives ``paddle_tpu.serving.ServingEngine`` the way traffic does — a
+seeded Poisson arrival process submits N concurrent streams of mixed
+prompt lengths from a background thread while the scheduler loop runs
+— and prints ONE JSON line with the SLO rungs ``tools/bench_gate.py``
+gates (TTFT regresses UP, throughput DOWN):
+
+    python tools/serve_bench.py --streams 8 --seed 0
+
+    {"serve_p50_ttft_ms": ..., "serve_p99_ttft_ms": ...,
+     "serve_tokens_per_sec": ..., ..., "telemetry": {...}}
+
+Defaults are CPU-sized (tiny model) so the rung runs in CI; on a chip
+pass the 1.3B geometry (--d-model 2048 --layers 24 --heads 16
+--vocab 51200) and a rate that saturates it. A warmup pass compiles
+every chunk/decode program first (--no-warmup to include compiles in
+the measured TTFTs — the cold-start view).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _telemetry():
+    """Runtime-telemetry block (the bench.py shape): stats registry
+    snapshot + the per-program roofline table, so the serve rungs
+    carry the serve.{ttft,tpot,queue_wait} histograms and the
+    per-phase ``serve.prefill[c=*]`` / ``decode.*[k=*]`` rows."""
+    from paddle_tpu.profiler import roofline, stats
+
+    snap = stats.snapshot()
+    out = {
+        "counters": {k: v for k, v in snap["counters"].items()
+                     if not k.startswith("op.")},
+        "gauges": snap["gauges"],
+        "histograms": snap["histograms"],
+    }
+    rl = roofline.report()
+    if rl:
+        out["roofline"] = {k: v for k, v in rl.items()
+                           if k.startswith(("serve", "decode",
+                                            "prefill"))}
+    return out
+
+
+def build_engine(args):
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import FusedCausalLM
+    from paddle_tpu.serving import ServingEngine, SLOConfig
+
+    paddle.seed(args.seed)
+    lens = [int(x) for x in args.prompt_mix.split(",")]
+    max_len = max(lens) + args.system_prompt + args.max_new + 1
+    model = FusedCausalLM(
+        vocab_size=args.vocab, embed_dim=args.d_model,
+        num_heads=args.heads, dim_feedforward=4 * args.d_model,
+        num_layers=args.layers, max_position=max_len + 1)
+    if args.bf16:
+        st = model.stack
+        for n in ("qkv_weight", "qkv_bias", "out_weight", "out_bias",
+                  "ffn1_weight", "ffn1_bias", "ffn2_weight",
+                  "ffn2_bias"):
+            p = getattr(st, n)
+            p._rebind(p._data.astype(jnp.bfloat16))
+    slo = SLOConfig(ttft_weight=args.ttft_weight,
+                    tpot_weight=args.tpot_weight,
+                    prefill_chunk=args.prefill_chunk)
+    return ServingEngine(
+        model, max_batch=args.streams, page_size=args.page_size,
+        max_length=max_len, decode_chunk=args.decode_chunk,
+        quant=args.quant, slo=slo), lens
+
+
+def make_requests(args, lens, rng):
+    """(prompt, arrival_gap_s) list: mixed lengths, a shared system
+    prompt on a fraction of requests (the prefix-cache's traffic
+    shape), exponential inter-arrival gaps (Poisson process)."""
+    sys_prompt = rng.randint(0, args.vocab, (args.system_prompt,)) \
+        if args.system_prompt else None
+    reqs = []
+    for i in range(args.requests):
+        L = int(lens[int(rng.randint(len(lens)))])
+        body = rng.randint(0, args.vocab, (L,))
+        if sys_prompt is not None and rng.rand() < args.system_frac:
+            prompt = np.concatenate([sys_prompt, body])
+        else:
+            prompt = body
+        gap = float(rng.exponential(1.0 / args.rate))
+        reqs.append((prompt, gap))
+    return reqs
+
+
+def drive(eng, reqs, max_new):
+    """Submit on a background thread at the Poisson arrival times;
+    run the scheduler loop here until every request finishes."""
+    n = len(reqs)
+    err: list = []
+
+    def submitter():
+        try:
+            t_next = time.monotonic()
+            for prompt, gap in reqs:
+                t_next += gap
+                delay = t_next - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                eng.submit(prompt, max_new_tokens=max_new)
+        except BaseException as e:  # surface on the main thread
+            err.append(e)
+
+    th = threading.Thread(target=submitter, daemon=True)
+    t0 = time.monotonic()
+    th.start()
+    while len(eng.finished) < n:
+        if err:
+            raise err[0]
+        if (eng._inbox or eng.waiting or eng._prefilling
+                or eng.num_active):
+            eng.step()
+        else:
+            time.sleep(0.0005)  # idle: wait for the next arrival
+    th.join()
+    return time.monotonic() - t0
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Poisson-load serving benchmark (SLO rungs)")
+    ap.add_argument("--streams", type=int, default=8,
+                    help="decode slots (max_batch)")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="total requests (default 3*streams)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="Poisson arrival rate, requests/sec")
+    ap.add_argument("--prompt-mix", default="8,32,96",
+                    help="comma list of prompt lengths, sampled "
+                         "uniformly")
+    ap.add_argument("--system-prompt", type=int, default=32,
+                    help="shared system-prompt tokens prepended to a "
+                         "fraction of requests (0 disables)")
+    ap.add_argument("--system-frac", type=float, default=0.5)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--prefill-chunk", type=int, default=64)
+    ap.add_argument("--decode-chunk", type=int, default=8)
+    ap.add_argument("--ttft-weight", type=float, default=1.0)
+    ap.add_argument("--tpot-weight", type=float, default=1.0)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--bf16", action="store_true",
+                    help="cast the stack bf16 (the chip serving dtype)")
+    ap.add_argument("--quant", default=None,
+                    choices=[None, "int8", "a8w8"])
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="measure cold compiles inside the TTFTs")
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip the tpu_lint preflight gate")
+    args = ap.parse_args()
+    if args.requests is None:
+        args.requests = 3 * args.streams
+
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.analysis.preflight import preflight
+
+    preflight("serve_bench", no_lint=args.no_lint)
+
+    from paddle_tpu.profiler import stats
+
+    eng, lens = build_engine(args)
+    rng = np.random.RandomState(args.seed)
+
+    if not args.no_warmup:
+        # compile every chunk/decode program shape OUTSIDE the
+        # measured window (steady-state SLO; --no-warmup for the
+        # cold-start view), then reset telemetry so the measured block
+        # describes only the load run
+        warm = [(np.full((L,), 1, np.int32), 0.0) for L in lens]
+        if args.system_prompt:
+            warm.append((np.full(
+                (args.system_prompt + lens[0],), 1, np.int32), 0.0))
+        drive(eng, warm, args.max_new)
+        eng.finished.clear()
+        eng.action_log.clear()
+        stats.reset()
+
+    reqs = make_requests(args, lens, rng)
+    wall = drive(eng, reqs, args.max_new)
+
+    done = eng.finished
+    ttfts = np.array([r.ttft_s for r in done], np.float64) * 1e3
+    tpots = [r.tpot_s for r in done if r.tpot_s is not None]
+    total_tokens = sum(len(r.generated) for r in done)
+    out = {
+        "serve_p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 3),
+        "serve_p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 3),
+        "serve_tokens_per_sec": round(total_tokens / wall, 1),
+        "serve_p50_tpot_ms": round(
+            float(np.median(tpots)) * 1e3, 3) if tpots else None,
+        "serve_streams": args.streams,
+        "serve_requests": len(done),
+        "serve_rate": args.rate,
+        "serve_prompt_mix": args.prompt_mix,
+        "serve_prefill_chunk": args.prefill_chunk,
+        "serve_decode_chunk": eng.decode_chunk,
+        "serve_prefix_hits": int(
+            stats.counter("serving.prefix_hit").value),
+        "serve_prefix_pages_saved": int(
+            stats.counter("serving.prefix_pages_saved").value),
+        "serve_wall_s": round(wall, 3),
+        "telemetry": _telemetry(),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
