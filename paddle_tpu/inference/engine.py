@@ -608,13 +608,19 @@ class ContinuousBatchingEngine:
         # the fixed block-table width
         for i in active:
             req = self._slots[i]
+            if req is None:
+                continue  # preempted by an earlier slot's grow
             remaining = req.max_new_tokens - len(req.generated)
             need = self._mgr.pages_needed(
                 int(self._lens[i]) + min(k, max(remaining, 0)))
             need = min(need, self._pages_per_seq)
             have = len(self._mgr._owned.get(("slot", i), ()))
-            if need > have:
-                self._mgr.grow(("slot", i), need - have)
+            if need > have and \
+                    not self._grow_decode_slot(i, need - have):
+                continue  # slot preempted (serving override)
+        active = [i for i in active if self._slots[i] is not None]
+        if not active:
+            return []
         tables = self._mgr.block_tables(
             [("slot", i) for i in range(self.max_batch)],
             self._pages_per_seq, allow_missing=True)
@@ -685,6 +691,16 @@ class ContinuousBatchingEngine:
         self._slots[i] = None
         self._lens[i] = 0
         self._last_tok[i] = 0
+
+    def _grow_decode_slot(self, i: int, n_pages: int) -> bool:
+        """Extend slot ``i``'s pages before a decode chunk; False means
+        the slot was vacated instead of grown. The base engine's pool
+        is sized for max_batch full-length sequences, so exhaustion
+        here is a configuration error and raises; the serving frontend
+        overrides this with prefix-cache eviction and, as a last
+        resort, preemption-by-recompute."""
+        self._mgr.grow(("slot", i), n_pages)
+        return True
 
     def _slot_free(self, i: int) -> bool:
         """Is slot i available for admission? (The serving scheduler

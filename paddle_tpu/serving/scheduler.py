@@ -85,13 +85,19 @@ class SLOConfig:
 
 
 class _Prefill:
-    """Progress of one chunk-prefilling request parked on a slot."""
+    """Progress of one chunk-prefilling request parked on a slot.
 
-    __slots__ = ("req", "pos")
+    ``tokens`` is what gets prefilled: the prompt, or — for a request
+    preempted out of a decode slot under pool pressure — the prompt
+    plus everything already generated, so the final chunk's logits
+    yield the NEXT token of the stream (recompute-style resume)."""
 
-    def __init__(self, req: Request, pos: int):
+    __slots__ = ("req", "pos", "tokens")
+
+    def __init__(self, req: Request, pos: int, tokens):
         self.req = req
-        self.pos = pos  # prompt tokens already in the pool
+        self.pos = pos  # tokens already in the pool
+        self.tokens = tokens
 
 
 class ServingEngine(ContinuousBatchingEngine):
@@ -173,20 +179,23 @@ class ServingEngine(ContinuousBatchingEngine):
         if self.num_active == 0:
             return []
         self.action_log.append("decode")
-        before = {i: len(r.generated)
-                  for i, r in enumerate(self._slots) if r is not None}
+        before = [(r, len(r.generated))
+                  for r in self._slots if r is not None]
         t0 = time.perf_counter()
         done = super().step()
         dt_ms = (time.perf_counter() - t0) * 1e3
-        k = self.decode_chunk
         now = time.monotonic()
-        for i, n0 in before.items():
-            req = self._slots[i]
-            if req is None:  # finished inside the chunk
-                continue
+        for req, n0 in before:
             emitted = len(req.generated) - n0
-            if emitted > 0:
-                _stats.observe("serve.tpot_ms", dt_ms / k)
+            if emitted <= 0:
+                continue
+            # the request waited the whole chunk for its tokens, so
+            # its streaming gap is dt_ms/emitted — observed once PER
+            # TOKEN, so a slot that finished mid-chunk neither drops
+            # out of the histogram nor understates its gap
+            gap = dt_ms / emitted
+            for _ in range(emitted):
+                _stats.observe("serve.tpot_ms", gap)
         for req in done:
             req.t_done = now
             tpot = getattr(req, "tpot_s", None)
@@ -212,35 +221,59 @@ class ServingEngine(ContinuousBatchingEngine):
             req._seq = next(self._arrival)
             self.waiting.append(req)
         if newly:
-            # higher priority first, FIFO within a level (stable by
-            # arrival); the skip-ahead window then scans THIS order
-            self.waiting.sort(
-                key=lambda r: (-getattr(r, "priority", 0),
-                               getattr(r, "_seq", r.id)))
+            self._sort_waiting()
+
+    def _sort_waiting(self):
+        # higher priority first, FIFO within a level (stable by
+        # arrival); the skip-ahead window then scans THIS order
+        self.waiting.sort(
+            key=lambda r: (-getattr(r, "priority", 0),
+                           getattr(r, "_seq", r.id)))
 
     def _slot_free(self, i: int) -> bool:
         return self._slots[i] is None and i not in self._prefilling
 
+    @staticmethod
+    def _admit_tokens(req):
+        """What admission will prefill: the prompt, or the recorded
+        prompt+generated resume stream of a preempted request."""
+        toks = getattr(req, "_resume_tokens", None)
+        return req.prompt if toks is None else toks
+
     def _first_chunk_pages(self, req) -> int:
         """Pages the FIRST prefill chunk needs beyond any prefix hit."""
-        shared = self.prefix_cache.match(req.prompt) \
+        toks = self._admit_tokens(req)
+        shared = self.prefix_cache.match(toks) \
             if self.prefix_cache is not None else []
         covered = len(shared) * self.page_size
-        c = self._chunk_size(len(req.prompt) - covered)
+        c = self._chunk_size(len(toks) - covered)
         need = min(self._mgr.pages_needed(covered + c),
                    self._pages_per_seq)
         return need - len(shared)
 
     def _can_admit(self, req) -> bool:
         need = self._first_chunk_pages(req)
-        if need > self._mgr.free_pages and self.prefix_cache is not None:
-            # pool pressure: evict cold cached prefixes page by page
-            # (an evicted entry only frees its page if no live sequence
-            # still maps it, so re-check after each drop)
-            while need > self._mgr.free_pages \
+        # pool pressure: evict cold cached prefixes page by page (an
+        # evicted entry only frees its page if no live sequence still
+        # maps it, so re-check after each drop)
+        while need > self._mgr.free_pages \
+                and self.prefix_cache is not None \
+                and self.prefix_cache.evict(1):
+            # eviction can drop the very pages the match above counted
+            # as covered, so recompute — the admit decision must
+            # reflect the post-eviction cache. match() LRU-touches its
+            # chain, so the matched prefix is the LAST thing evicted.
+            need = self._first_chunk_pages(req)
+        return need <= self._mgr.free_pages
+
+    def _evict_for(self, n_pages: int) -> bool:
+        """Free pool pages for an n_pages grow by dropping cold cached
+        prefixes; True once the free list covers it."""
+        if self.prefix_cache is not None:
+            while n_pages > self._mgr.free_pages \
                     and self.prefix_cache.evict(1):
                 pass
-        return need <= self._mgr.free_pages
+        return n_pages <= self._mgr.free_pages
 
     def _admit_into(self, req: Request, i: int):
         """Park ``req`` on slot ``i`` in the chunk-prefill phase: map
@@ -249,14 +282,21 @@ class ServingEngine(ContinuousBatchingEngine):
         prefill compute happens at admission — admitting a 4k prompt
         costs a page-table update, not a 4k-token program."""
         now = time.monotonic()
-        req.t_admitted = now
-        arrival = getattr(req, "arrival_time", now)
-        _stats.observe("serve.queue_wait_ms", (now - arrival) * 1e3)
-        _stats.inc("serving.admitted")
-        self._hook_first_token(req)
+        if req.t_admitted is None:
+            # first admission only — a preempted/requeued request
+            # keeps its original marks (queue-wait and TTFT measure
+            # the user-visible wait, and the on_token wrapper is
+            # already installed)
+            req.t_admitted = now
+            arrival = getattr(req, "arrival_time", now)
+            _stats.observe("serve.queue_wait_ms",
+                           (now - arrival) * 1e3)
+            _stats.inc("serving.admitted")
+            self._hook_first_token(req)
+        toks = self._admit_tokens(req)
         shared = []
         if self.prefix_cache is not None:
-            shared = self.prefix_cache.match(req.prompt)
+            shared = self.prefix_cache.match(toks)
             if shared:
                 _stats.inc("serving.prefix_hit")
                 _stats.inc("serving.prefix_pages_saved", len(shared))
@@ -266,7 +306,7 @@ class ServingEngine(ContinuousBatchingEngine):
         if shared:
             self._mgr.share(key, shared)
         self._prefilling[i] = _Prefill(
-            req, pos=len(shared) * self.page_size)
+            req, pos=len(shared) * self.page_size, tokens=toks)
 
     def _hook_first_token(self, req):
         """Wrap the user's on_token with the TTFT stamp (fires exactly
@@ -315,14 +355,17 @@ class ServingEngine(ContinuousBatchingEngine):
         return max(min(-(-remaining // bs) * bs,
                        self.slo.prefill_chunk), 1)
 
+    def _urgency(self, req):
+        """Sort key: most urgent first (priority, then admission order
+        — finish what started first)."""
+        return (-getattr(req, "priority", 0), req.t_admitted)
+
     def _pick_prefilling(self) -> int:
         """Most urgent prefilling slot: priority, then admission
         order (finish what started first — chunks of one prompt don't
         interleave with another's without cause)."""
         return min(self._prefilling,
-                   key=lambda i: (
-                       -getattr(self._prefilling[i].req, "priority", 0),
-                       self._prefilling[i].req.t_admitted))
+                   key=lambda i: self._urgency(self._prefilling[i].req))
 
     def _get_chunk_prefill(self, c: int):
         """One compiled chunk program per chunk SIZE (start/len are
@@ -362,18 +405,48 @@ class ServingEngine(ContinuousBatchingEngine):
         i = self._pick_prefilling()
         stt = self._prefilling[i]
         req = stt.req
-        L = len(req.prompt)
+        toks = stt.tokens
+        L = len(toks)
         c = self._chunk_size(L - stt.pos)
         n = min(L - stt.pos, c)
         key = ("prefill", i)
         need = min(self._mgr.pages_needed(stt.pos + c),
                    self._pages_per_seq)
         have = len(self._mgr._owned.get(key, ()))
+        if need > have and not self._evict_for(need - have):
+            # pool exhausted even after dropping every cold cached
+            # prefix (admission only reserved the FIRST chunk's pages,
+            # so later chunks can outgrow the pool under load)
+            if self.num_active > 0:
+                # decoders hold the pages and free them as they
+                # finish — defer this chunk, the interleave cycle
+                # keeps decode draining meanwhile
+                _stats.inc("serving.prefill_stalls")
+                return []
+            # no decoders to wait for: requeue LESS-urgent prefilling
+            # requests (never this one — ``i`` is the most urgent, and
+            # sacrificing it would livelock: it re-admits first and
+            # starves the survivor all over again) until this chunk's
+            # pages fit
+            while len(self._prefilling) > 1 \
+                    and not self._evict_for(need - have):
+                victim = max(
+                    (j for j in self._prefilling if j != i),
+                    key=lambda j: self._urgency(
+                        self._prefilling[j].req))
+                self._requeue_prefill(victim)
+            if not self._evict_for(need - have):
+                raise RuntimeError(
+                    f"request {req.id} needs {need} KV pages but the "
+                    f"pool can only ever provide "
+                    f"{self._mgr.free_pages + have} "
+                    f"(num_pages={self._mgr.num_pages}); increase "
+                    f"num_pages or cap prompt/generation length")
         if need > have:
             self._mgr.grow(key, need - have)
         tables = self._mgr.block_tables([key], self._pages_per_seq)
         ids = np.zeros((1, c), np.int32)
-        ids[0, :n] = req.prompt[stt.pos: stt.pos + n]
+        ids[0, :n] = toks[stt.pos: stt.pos + n]
         m = self.model
         self._gen._count_a8w8(1)
         t0 = time.perf_counter()
@@ -392,19 +465,19 @@ class ServingEngine(ContinuousBatchingEngine):
         stt.pos += n
         if stt.pos < L:
             return []
-        # prompt complete: emit the first token, join the decode batch
+        # prompt complete: emit the next token, join the decode batch
         del self._prefilling[i]
         self._mgr.rekey(key, ("slot", i))
         if self.prefix_cache is not None:
             self.prefix_cache.insert(
-                req.prompt, self._mgr._owned[("slot", i)])
+                toks, self._mgr._owned[("slot", i)])
         self._slots[i] = req
         req.generated.append(tok)
         cb = getattr(req, "on_token", None)
         if cb is not None:
             cb(req, tok)
         if (req.eos_token_id is not None and tok == req.eos_token_id) \
-                or req.max_new_tokens <= 1:
+                or len(req.generated) >= req.max_new_tokens:
             req.done = True
             req.t_done = time.monotonic()
             self._release(i)
@@ -413,3 +486,48 @@ class ServingEngine(ContinuousBatchingEngine):
         self._lens[i] = L + 1
         self._last_tok[i] = tok
         return []
+
+    # ---------------- pool-pressure recovery ----------------
+
+    def _requeue_prefill(self, i: int):
+        """Abort slot ``i``'s chunk prefill back to the waiting list,
+        freeing its pages (its _resume_tokens, if any, survive so a
+        preempted request still resumes mid-stream). Progress is kept
+        by the surviving prefilling slots, which can now grow."""
+        stt = self._prefilling.pop(i)
+        self._mgr.free(("prefill", i))
+        _stats.inc("serving.prefill_requeues")
+        self.waiting.append(stt.req)
+        self._sort_waiting()
+        return []
+
+    def _preempt_slot(self, j: int):
+        """Preempt decode slot ``j`` by recomputation (vLLM-style):
+        free its pages and requeue the request with prompt+generated
+        as its resume stream — re-admission chunk-prefills the whole
+        history (usually prefix-cache-hot) and the final chunk emits
+        the NEXT token, so the user-visible stream just continues."""
+        req = self._slots[j]
+        req._resume_tokens = np.concatenate(
+            [req.prompt, np.asarray(req.generated, np.int32)])
+        self._release(j)
+        _stats.inc("serving.preemptions")
+        self.waiting.append(req)
+        self._sort_waiting()
+
+    def _grow_decode_slot(self, i: int, n_pages: int) -> bool:
+        """Serving override of the decode-time grow: under pool
+        pressure evict cold cached prefixes first; if the pool is
+        STILL exhausted, preempt the LEAST-urgent active slot (freeing
+        its pages may also unpin cached prefixes, so re-evict each
+        round) until slot ``i`` fits or is itself the victim."""
+        while not self._evict_for(n_pages):
+            victim = max(
+                (j for j in range(self.max_batch)
+                 if self._slots[j] is not None),
+                key=lambda j: self._urgency(self._slots[j]))
+            self._preempt_slot(victim)
+            if victim == i:
+                return False
+        self._mgr.grow(("slot", i), n_pages)
+        return True

@@ -338,6 +338,109 @@ class TestAdmission:
         assert set(done) == {lo, hi}
 
 
+class TestPoolPressure:
+    """Admission reserves only the FIRST prefill chunk's pages, so
+    later chunk grows and decode-time grows must recover under pool
+    pressure (evict cold cached prefixes; preempt-by-recompute as the
+    last resort) instead of crashing ``run()``."""
+
+    def _engine(self, **kw):
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("max_length", 64)
+        kw.setdefault("decode_chunk", 2)
+        kw.setdefault("num_pages", 15)   # true 16-page pool (1 scratch)
+        kw.setdefault("slo", SLOConfig(prefill_chunk=8))
+        return ServingEngine(_model(), **kw)
+
+    def test_prefill_grow_evicts_cached_prefixes(self):
+        """REVIEW repro: 16-page pool, sequential 40-token prompts.
+        The unbounded prefix cache holds the first prompt's 10 pages;
+        the second request's LATER chunks must evict them instead of
+        dying on 'KV pool exhausted'."""
+        model = _model()
+        eng = self._engine()
+        assert eng._mgr.num_pages == 16
+        rng = np.random.RandomState(23)
+        for p in [rng.randint(0, 64, (40,)) for _ in range(3)]:
+            eng.submit(p, max_new_tokens=4)
+            r = eng.run()[-1]
+            np.testing.assert_array_equal(
+                r.output, _dense_greedy(model, p, 4))
+
+    def test_decode_grow_evicts_cached_prefixes(self):
+        """Decode-time grows (engine step) under pool pressure must
+        also dip into the prefix cache."""
+        model = _model()
+        eng = self._engine()
+        rng = np.random.RandomState(27)
+        eng.submit(rng.randint(0, 64, (40,)), max_new_tokens=2)
+        eng.run()                       # cache now holds 10 pages
+        cached = len(eng.prefix_cache)
+        assert cached == 10
+        p = rng.randint(0, 64, (8,))    # tiny prefill, long decode
+        eng.submit(p, max_new_tokens=28)
+        r = eng.run()[-1]
+        np.testing.assert_array_equal(
+            r.output, _dense_greedy(model, p, 28))
+        assert len(eng.prefix_cache) < cached   # eviction happened
+
+    def test_decode_pressure_preempts_and_resumes_exact(self):
+        """Three concurrent decoders whose combined growth exceeds the
+        pool: least-urgent slots are preempted by recomputation and
+        resumed, with every stream exact and every token delivered
+        once, in order. Three slots also pin the grow loop's skip of a
+        slot preempted by an EARLIER slot's grow in the same step."""
+        model = _model()
+        before = stats.counter("serving.preemptions").value
+        eng = self._engine(max_batch=3)
+        rng = np.random.RandomState(29)
+        prompts = [rng.randint(0, 64, (16,)) for _ in range(3)]
+        streamed = {}
+        rids = [eng.submit(
+            p, max_new_tokens=24,
+            on_token=lambda r, t: streamed.setdefault(r.id, [])
+            .append(t)) for p in prompts]
+        done = {r.id: r for r in eng.run()}
+        assert sorted(done) == sorted(rids)
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(
+                done[rid].output, _dense_greedy(model, p, 24))
+            assert streamed[rid] == list(done[rid].generated)
+        assert stats.counter("serving.preemptions").value > before
+
+    def test_admit_eviction_recomputes_first_chunk_need(self):
+        """REVIEW: _can_admit's eviction loop can evict the very chain
+        its page count treated as covered; the admit decision must
+        reflect the post-eviction cache, or the first chunk's grow
+        exceeds the free list."""
+        eng = self._engine(prompt_bucket=4)
+        rng = np.random.RandomState(31)
+        prompt = rng.randint(0, 64, (13,))
+        # LRU-coldest entry: a page a live sequence still maps, so
+        # evicting it frees nothing and the loop digs into the chain
+        pinned = eng._mgr.allocate("live", 4)
+        eng.prefix_cache.insert(np.arange(4), pinned)
+        own = eng._mgr.allocate("tmp", 12)
+        eng.prefix_cache.insert(prompt[:12], own)
+        eng._mgr.free("tmp")            # the chain survives, cache-held
+        eng._mgr.allocate("ballast", 4 * eng._mgr.free_pages)
+        req = Request(prompt, max_new_tokens=4)
+        admitted = eng._can_admit(req)
+        if admitted:   # the admit promise must be honest post-eviction
+            assert eng._first_chunk_pages(req) <= eng._mgr.free_pages
+
+    def test_oversized_request_raises_informative(self):
+        """A request whose pages can NEVER fit the pool (even with the
+        cache drained and every peer gone) raises a sizing error
+        rather than spinning or crashing obscurely."""
+        eng = self._engine()
+        rng = np.random.RandomState(37)
+        eng.submit(rng.randint(0, 64, (56,)), max_new_tokens=8)
+        with pytest.raises(RuntimeError, match="num_pages"):
+            eng.run()
+
+
 class TestSatellites:
     def test_genrequest_ids_thread_safe(self):
         """ISSUE 8 satellite: concurrent construction never duplicates
@@ -372,6 +475,26 @@ class TestSatellites:
         # discards 3
         assert stats.counter("serving.wasted_decode_tokens").value \
             == before + 3
+
+    def test_tpot_observed_per_token(self):
+        """REVIEW: serve.tpot_ms weights per TOKEN and a slot that
+        finishes mid-chunk still contributes — every decoded token is
+        exactly one histogram observation."""
+        model = _model()
+        eng = ServingEngine(model, max_batch=2, page_size=4,
+                            max_length=64, decode_chunk=4,
+                            slo=SLOConfig(prefill_chunk=8))
+        h = stats.histogram("serve.tpot_ms")
+        before = h.count
+        rng = np.random.RandomState(41)
+        # max_new 6 with k=4: chunks emit 4 then 2 mid-chunk tokens
+        eng.submit(rng.randint(0, 64, (6,)), max_new_tokens=6)
+        # max_new 2: a single mid-chunk token, previously unobserved
+        eng.submit(rng.randint(0, 64, (6,)), max_new_tokens=2)
+        eng.run()
+        # each request's first token comes from prefill; every decoded
+        # token after it is one observation: (6-1) + (2-1)
+        assert h.count - before == 6
 
     def test_serve_prefix_registered_in_conventions(self):
         """ISSUE 8 satellite: serve./serving. are documented metric
