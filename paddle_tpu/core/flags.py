@@ -124,6 +124,24 @@ define_flag("check_donation", False,
             "— CPU runs then fail exactly where TPU donation would read "
             "freed HBM, instead of silently passing (CPU jaxlib ignores "
             "donation)")
+define_flag("serve_journal", True,
+            "request-lifecycle flight recorder for the serving "
+            "frontend (serving/journal.py): every lifecycle "
+            "transition (submit/queued/admitted/prefill_chunk/"
+            "first_token/decode/preempt/requeue/stall/evict_trigger/"
+            "finish/error) lands in a bounded in-memory ring, dumped "
+            "as a JSONL artifact on any run() exception; off = the "
+            "scheduler holds no recorder and every hook is a single "
+            "attribute test (zero journal allocations)")
+define_flag("serve_journal_events", 4096,
+            "flight-recorder ring capacity in events; older events "
+            "are overwritten once the ring wraps (the journal.dropped "
+            "gauge counts them)")
+define_flag("serve_journal_dir", "",
+            "directory for serving crash-dump artifacts "
+            "(serve_crash_rank<r>_pid<pid>.jsonl, written by "
+            "ServingEngine.run() on any raise; read back with "
+            "tools/serve_top.py); empty = the system temp dir")
 define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
 define_flag("eager_fwd_cache", True,
             "no-grad eager dispatch through the signature-keyed "

@@ -576,6 +576,11 @@ class ContinuousBatchingEngine:
 
         self.waiting: list = []
         self.finished: list = []
+        # serving flight-recorder hook (serving/journal.py): the
+        # serving frontend installs its ring journal here so engine-
+        # level finish events land on the same per-request timeline;
+        # None (the base engine) keeps every hook a no-op
+        self._journal = None
         # slot state
         self._slots: list = [None] * self.max_batch   # GenRequest or None
         self._lens = np.zeros((self.max_batch,), np.int64)
@@ -670,6 +675,7 @@ class ContinuousBatchingEngine:
                 # signal (big chunks amortize dispatch, small chunks
                 # waste less tail work on eos/max_new finishes)
                 _stats.inc("serving.wasted_decode_tokens", k - consumed)
+                self._finish_hook(req, i)
                 self._release(i)
                 done_now.append(req)
             else:
@@ -691,6 +697,16 @@ class ContinuousBatchingEngine:
         self._slots[i] = None
         self._lens[i] = 0
         self._last_tok[i] = 0
+
+    def _finish_hook(self, req, slot: int):
+        """Called once per finished request, BEFORE its pages release.
+        Base engine: journal a finish event when a flight recorder is
+        installed. The serving frontend overrides this with SLO
+        verdicts + lifecycle stamps (serving/scheduler.py)."""
+        j = self._journal
+        if j is not None:
+            j.record("finish", req.id, slot,
+                     {"n_tokens": len(req.generated)})
 
     def _grow_decode_slot(self, i: int, n_pages: int) -> bool:
         """Extend slot ``i``'s pages before a decode chunk; False means
@@ -780,6 +796,7 @@ class ContinuousBatchingEngine:
         if (req.eos_token_id is not None and t == req.eos_token_id) \
                 or req.max_new_tokens <= 1:
             req.done = True
+            self._finish_hook(req, i)
             self._release(i)
             self.finished.append(req)
             return

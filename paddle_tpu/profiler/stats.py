@@ -44,6 +44,15 @@ Conventions for the built-in instrumentation (all optional reading):
   frontend (paddle_tpu/serving): ``serve.{ttft_ms,tpot_ms,
   request_tpot_ms,queue_wait_ms}`` histograms plus
   ``serve.{submitted,prefill_chunks,prefill_tokens}`` counters
+  (``serving.unserved`` stamps requests still waiting when run()
+  exits — the ones queue-wait histograms never saw)
+- ``journal.{events,dropped}`` serving flight-recorder ring gauges
+  (serving/journal.py: events ever recorded / overwritten by wrap)
+- ``slo.*``                    SLO monitor (serving/slo.py):
+  ``slo.goodput`` rolling fraction of finished requests meeting both
+  TTFT and TPOT targets, ``slo.burn_rate`` error-budget burn,
+  ``slo.{finished,ok,ttft_miss,tpot_miss}`` counters and
+  ``slo.{queue_depth,slot_occupancy}`` load gauges
 - ``quant.{act_quant_calls,a8w8_matmuls}``  executed dynamic
   activation-quant ops / int8 x int8 serving matmuls (A8W8 decode,
   QuantedLinear(a8w8=True)) — counted at the dispatch layer, since
@@ -70,6 +79,8 @@ the bench gate (tools/bench_gate.py) can rely on stable names.
 """
 from __future__ import annotations
 
+import math
+import random
 import threading
 import time
 from typing import Dict, Optional
@@ -85,8 +96,8 @@ __all__ = [
 #: starts with one of these
 CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
-    "inference.", "serving.", "serve.", "quant.", "moe.", "dist.",
-    "roofline.", "hbm.", "lint.", "t.",
+    "inference.", "serving.", "serve.", "journal.", "slo.", "quant.",
+    "moe.", "dist.", "roofline.", "hbm.", "lint.", "t.",
 )
 
 _ENABLED = True
@@ -170,16 +181,31 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming distribution summary (count/total/min/max + powers-of-2
-    buckets) — enough to tell a retrace storm (many large observations)
-    from steady cache hits without storing samples."""
+    """Streaming distribution summary: count/total/min/max, powers-of-2
+    buckets, and a bounded RESERVOIR of raw samples.
+
+    The buckets tell a retrace storm (many large observations) from
+    steady cache hits and stay exported for chrome-trace counters and
+    cross-rank folding (tools/trace_merge.py folds summaries bucket-
+    by-bucket). The reservoir fixes their percentile problem: bucket-
+    midpoint estimates are off by up to 2x for small-count histograms
+    (a 7-request serve bench's p99 TTFT landed on a power-of-2 edge,
+    not a real observation). Up to ``RESERVOIR_SIZE`` samples are kept
+    verbatim — percentiles are EXACT until the 4097th observation —
+    then Vitter's Algorithm R keeps a uniform sample, driven by a
+    per-instance seeded RNG so eviction (and thus every snapshot) is
+    deterministic for a given observation sequence."""
 
     __slots__ = ("name", "count", "total", "min", "max", "_buckets",
-                 "_lock")
+                 "_samples", "_rng", "_lock")
 
     #: bucket upper bounds double from 1; observations are expected in
     #: microseconds for the compile/wall-time histograms
     N_BUCKETS = 32
+    #: reservoir capacity: exact percentiles up to this many samples,
+    #: deterministic uniform sampling beyond (0 disables, falling back
+    #: to the bucket estimator)
+    RESERVOIR_SIZE = 4096
 
     def __init__(self, name: str):
         self.name = name
@@ -188,6 +214,8 @@ class Histogram:
         self.min = None
         self.max = None
         self._buckets = [0] * self.N_BUCKETS
+        self._samples: list = []
+        self._rng = random.Random(0x5EED)
         self._lock = threading.Lock()
 
     def observe(self, v) -> None:
@@ -205,15 +233,32 @@ class Histogram:
                 edge *= 2.0
                 b += 1
             self._buckets[b] += 1
+            if len(self._samples) < self.RESERVOIR_SIZE:
+                self._samples.append(v)
+            else:
+                # Algorithm R: the i-th observation (count = i+1)
+                # replaces a uniformly random reservoir slot with
+                # probability RESERVOIR_SIZE / count
+                j = self._rng.randrange(self.count)
+                if j < self.RESERVOIR_SIZE:
+                    self._samples[j] = v
 
     @property
     def avg(self) -> float:
         return self.total / self.count if self.count else 0.0
 
-    def _percentile_locked(self, q: float):
+    @staticmethod
+    def _quantile_sorted(s, q: float):
+        """Empirical q-quantile of a sorted sample (the ceil(qN)-th
+        order statistic — an OBSERVED value, never an interpolation)."""
+        idx = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+        return round(s[idx], 3)
+
+    def _bucket_percentile_locked(self, q: float):
         """Bucket-derived percentile estimate (linear interpolation
         within the winning power-of-2 bucket, clamped to the exact
-        min/max). Callers hold self._lock."""
+        min/max) — the pre-reservoir fallback, only reached when
+        RESERVOIR_SIZE is 0. Callers hold self._lock."""
         if not self.count:
             return None
         target = q * self.count
@@ -231,9 +276,17 @@ class Histogram:
                 return round(min(max(est, lo_clamp), hi_clamp), 3)
         return self.max
 
+    def _percentile_locked(self, q: float):
+        if not self.count:
+            return None
+        if self._samples:
+            return self._quantile_sorted(sorted(self._samples), q)
+        return self._bucket_percentile_locked(q)
+
     def percentile(self, q: float):
-        """Estimated q-quantile (q in [0, 1]) from the power-of-2
-        buckets; None before any observation."""
+        """q-quantile (q in [0, 1]): exact while the reservoir covers
+        every observation, reservoir-sampled beyond; None before any
+        observation."""
         with self._lock:
             return self._percentile_locked(q)
 
@@ -244,15 +297,22 @@ class Histogram:
             # and can be re-folded across ranks (tools/trace_merge.py)
             buckets = [[(1.0 if b == 0 else 2.0 ** b), n]
                        for b, n in enumerate(self._buckets) if n]
+            if self._samples:
+                s = sorted(self._samples)
+                p50, p90, p99 = (self._quantile_sorted(s, q)
+                                 for q in (0.50, 0.90, 0.99))
+            else:
+                p50, p90, p99 = (self._bucket_percentile_locked(q)
+                                 for q in (0.50, 0.90, 0.99))
             return {
                 "count": self.count,
                 "total": round(self.total, 3),
                 "avg": round(self.avg, 3),
                 "min": self.min,
                 "max": self.max,
-                "p50": self._percentile_locked(0.50),
-                "p90": self._percentile_locked(0.90),
-                "p99": self._percentile_locked(0.99),
+                "p50": p50,
+                "p90": p90,
+                "p99": p99,
                 "buckets": buckets,
             }
 
@@ -263,6 +323,8 @@ class Histogram:
             self.min = None
             self.max = None
             self._buckets = [0] * self.N_BUCKETS
+            self._samples = []
+            self._rng = random.Random(0x5EED)
 
 
 def counter(name: str) -> Counter:

@@ -7,14 +7,25 @@ never stall the decode batch), prefix/KV-cache reuse across requests
 sharing a system prompt, and per-request TTFT/TPOT/queue-wait
 telemetry. Driven under Poisson load by ``tools/serve_bench.py``.
 
+Observability (PR 9): a request-lifecycle FLIGHT RECORDER
+(``journal.py`` — bounded ring journal, ``FLAGS_serve_journal``,
+crash-dump-on-exception in ``ServingEngine.run``), an SLO goodput
+monitor (``slo.py`` — per-request verdicts, rolling ``slo.goodput`` +
+burn rate), and exporters: journal → chrome trace (one lane per
+request, rank-stamped for ``tools/trace_merge.py``) and the
+``tools/serve_top.py`` live/offline dashboard.
+
 The TP (ROADMAP item 1) and EP-MoE (item 4) serving engines plug into
 this scheduler: it only talks to the engine's compiled prefill/decode
 programs and the page manager, both of which shard underneath it.
 """
 from __future__ import annotations
 
+from .journal import FlightRecorder
 from .prefix_cache import PrefixCache
 from .request import Request
 from .scheduler import ServingEngine, SLOConfig
+from .slo import SLOMonitor
 
-__all__ = ["Request", "PrefixCache", "ServingEngine", "SLOConfig"]
+__all__ = ["Request", "PrefixCache", "ServingEngine", "SLOConfig",
+           "FlightRecorder", "SLOMonitor"]

@@ -51,12 +51,16 @@ class PrefixCache:
     """Hash-chain lookup from prompt prefixes to live pool pages."""
 
     def __init__(self, mgr, page_size: int,
-                 capacity_pages: Optional[int] = None):
+                 capacity_pages: Optional[int] = None, journal=None):
         self._mgr = mgr
         self.page_size = int(page_size)
         #: max registered pages (None = bounded only by pool pressure
         #: via ``evict``); exceeding it LRU-evicts before insert
         self.capacity_pages = capacity_pages
+        #: serving flight recorder (serving/journal.py) or None —
+        #: evictions are the pool-pressure signal a post-mortem needs
+        #: next to the preempt/requeue events they interleave with
+        self._journal = journal
         self._entries: "OrderedDict[bytes, int]" = OrderedDict()
 
     def __len__(self) -> int:
@@ -118,6 +122,9 @@ class PrefixCache:
             _key, page = self._entries.popitem(last=False)
             self._mgr.release_pages([page])
             dropped += 1
+        if dropped and self._journal is not None:
+            self._journal.record("evict_trigger", -1, -1,
+                                 {"pages": dropped})
         return dropped
 
     def clear(self) -> int:

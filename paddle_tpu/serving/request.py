@@ -43,6 +43,12 @@ class Request(GenRequest):
         self.t_admitted: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
+        # pool-pressure lifecycle counts (scheduler-stamped) + the
+        # SLO verdict (serving/slo.py, stamped at finish) — the
+        # per-request JSONL serve_bench emits reads these directly
+        self.n_preempts = 0
+        self.n_requeues = 0
+        self.slo_ok: Optional[bool] = None
 
     # ---- derived SLO readings (None until the mark exists) ----
 

@@ -27,6 +27,16 @@ Telemetry (the PR 1–2 stats/roofline stack): per-request
 scheduler phase reports under its own roofline rung —
 ``serve.prefill[c=N]`` per chunk size (honest post-sync timing) next
 to the engine's ``decode.*[k=N]`` rungs.
+
+Observability (PR 9): every lifecycle transition additionally lands in
+the FLIGHT RECORDER (``serving/journal.py``, ``FLAGS_serve_journal``)
+— a bounded ring journal from which one request's whole life is
+reconstructable post-mortem — and every finish feeds the SLO monitor
+(``serving/slo.py``: per-request TTFT/TPOT verdicts, rolling
+``slo.goodput``, burn rate). ``run()`` dumps the journal tail + a
+stats snapshot + every still-unserved request to a JSONL crash
+artifact on any raise (``crash_dump``), so a production stack trace
+always arrives with the request timelines that led to it.
 """
 from __future__ import annotations
 
@@ -38,12 +48,15 @@ from typing import Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.flags import flag as _flag
 from ..incubate.nn.fused_transformer import PagedKV
 from ..inference.engine import ContinuousBatchingEngine, FusedCausalLM
 from ..profiler import roofline as _roofline
 from ..profiler import stats as _stats
+from .journal import FlightRecorder
 from .prefix_cache import PrefixCache
 from .request import Request
+from .slo import SLOMonitor
 
 __all__ = ["SLOConfig", "ServingEngine"]
 
@@ -60,13 +73,21 @@ class SLOConfig:
     and its fairness bound (inference/engine.py ``_pick_waiting``).
     ``prefix_cache``: enable prefix/KV reuse; ``prefix_cache_pages``
     caps the registered pages (None = pool-pressure eviction only).
+    ``ttft_target_ms`` / ``tpot_target_ms``: per-request SLO targets
+    the monitor (serving/slo.py) judges verdicts against (None
+    disables that leg); ``goodput_objective`` + ``slo_window`` shape
+    the rolling ``slo.goodput`` gauge and its burn rate.
     """
 
     def __init__(self, ttft_weight: float = 1.0,
                  tpot_weight: float = 1.0, prefill_chunk: int = 256,
                  admit_window: int = 8, starvation_bound: int = 16,
                  prefix_cache: bool = True,
-                 prefix_cache_pages: Optional[int] = None):
+                 prefix_cache_pages: Optional[int] = None,
+                 ttft_target_ms: Optional[float] = 1000.0,
+                 tpot_target_ms: Optional[float] = 100.0,
+                 goodput_objective: float = 0.99,
+                 slo_window: int = 256):
         if ttft_weight <= 0 or tpot_weight <= 0:
             raise ValueError("SLO weights must be positive")
         self.ttft_weight = float(ttft_weight)
@@ -76,6 +97,14 @@ class SLOConfig:
         self.starvation_bound = max(int(starvation_bound), 1)
         self.prefix_cache = bool(prefix_cache)
         self.prefix_cache_pages = prefix_cache_pages
+        self.ttft_target_ms = None if ttft_target_ms is None \
+            else float(ttft_target_ms)
+        self.tpot_target_ms = None if tpot_target_ms is None \
+            else float(tpot_target_ms)
+        if not 0.0 < float(goodput_objective) < 1.0:
+            raise ValueError("goodput_objective must be in (0, 1)")
+        self.goodput_objective = float(goodput_objective)
+        self.slo_window = max(int(slo_window), 1)
         r = self.ttft_weight / self.tpot_weight
         #: consecutive prefill chunks allowed while decoders wait /
         #: decode chunks between prefill opportunities — the weighted
@@ -126,10 +155,24 @@ class ServingEngine(ContinuousBatchingEngine):
                                  slo.starvation_bound)
         super().__init__(model, **engine_kwargs)
         self.slo = slo
+        # flight recorder (FLAGS_serve_journal): None when disabled,
+        # so every hot-path hook is a single attribute test — no
+        # event tuples or extra dicts are ever allocated
+        self.journal: Optional[FlightRecorder] = None
+        if _flag("serve_journal"):
+            self.journal = FlightRecorder(
+                int(_flag("serve_journal_events")))
+        self._journal = self.journal  # base-engine finish hook
+        self.slo_monitor = SLOMonitor(
+            ttft_target_ms=slo.ttft_target_ms,
+            tpot_target_ms=slo.tpot_target_ms,
+            objective=slo.goodput_objective, window=slo.slo_window)
+        self.last_crash_dump: Optional[str] = None
         self.prefix_cache: Optional[PrefixCache] = None
         if slo.prefix_cache:
             self.prefix_cache = PrefixCache(
-                self._mgr, self.page_size, slo.prefix_cache_pages)
+                self._mgr, self.page_size, slo.prefix_cache_pages,
+                journal=self.journal)
         self._prefilling: Dict[int, _Prefill] = {}
         # async admission: submit() appends here from ANY thread; the
         # scheduler thread drains into the priority-ordered waiting
@@ -159,6 +202,11 @@ class ServingEngine(ContinuousBatchingEngine):
             raise ValueError("request exceeds engine max_length")
         with self._inbox_lock:
             self._inbox.append(req)
+        jr = self.journal
+        if jr is not None:
+            jr.record("submit", req.id, -1,
+                      {"prompt_len": int(len(req.prompt)),
+                       "max_new": int(req.max_new_tokens)})
         _stats.inc("serve.submitted")
         return req.id
 
@@ -172,6 +220,9 @@ class ServingEngine(ContinuousBatchingEngine):
         Returns requests finished this step."""
         self._drain_inbox()
         self._admit()
+        self.slo_monitor.update_gauges(
+            len(self.waiting) + len(self._inbox), self.num_active,
+            len(self._prefilling), self.max_batch)
         action = self._pick_action()
         if action == "prefill":
             self.action_log.append("prefill")
@@ -184,7 +235,6 @@ class ServingEngine(ContinuousBatchingEngine):
         t0 = time.perf_counter()
         done = super().step()
         dt_ms = (time.perf_counter() - t0) * 1e3
-        now = time.monotonic()
         for req, n0 in before:
             emitted = len(req.generated) - n0
             if emitted <= 0:
@@ -196,21 +246,118 @@ class ServingEngine(ContinuousBatchingEngine):
             gap = dt_ms / emitted
             for _ in range(emitted):
                 _stats.observe("serve.tpot_ms", gap)
-        for req in done:
-            req.t_done = now
-            tpot = getattr(req, "tpot_s", None)
-            if tpot is not None:
-                # whole-lifetime per-token mean (the chunk-level
-                # serve.tpot_ms above is the streaming-gap view)
-                _stats.observe("serve.request_tpot_ms", tpot * 1e3)
         return done
 
+    def _finish_hook(self, req, slot: int):
+        """Serving finish path (called from the engine the moment a
+        request completes, before its pages release): stamp t_done,
+        observe the lifetime per-token mean, judge the SLO verdict,
+        and journal a verdict-rich finish event."""
+        req.t_done = time.monotonic()
+        tpot = getattr(req, "tpot_s", None)
+        if tpot is not None:
+            # whole-lifetime per-token mean (the chunk-level
+            # serve.tpot_ms is the streaming-gap view)
+            _stats.observe("serve.request_tpot_ms", tpot * 1e3)
+        v = self.slo_monitor.observe_finish(req)
+        jr = self.journal
+        if jr is not None:
+            jr.record("finish", req.id, slot,
+                      {"n_tokens": len(req.generated),
+                       "ttft_ms": v["ttft_ms"],
+                       "tpot_ms": v["tpot_ms"],
+                       "slo_ok": v["slo_ok"]})
+
     def run(self):
-        """Drain: step until every submitted request finishes."""
-        while (self._inbox or self.waiting or self._prefilling
-               or self.num_active):
-            self.step()
+        """Drain: step until every submitted request finishes.
+
+        Crash-dump-on-exception: any raise journals an ``error``
+        event and writes the flight-recorder tail + stats snapshot +
+        every still-in-flight request to a JSONL artifact
+        (``crash_dump``) before propagating. On every exit the
+        ``serving.unserved`` counter stamps requests that never
+        reached admission (their queue wait is otherwise invisible —
+        ``serve.queue_wait_ms`` only observes admitted requests)."""
+        try:
+            while (self._inbox or self.waiting or self._prefilling
+                   or self.num_active):
+                self.step()
+        except BaseException as e:
+            jr = self.journal
+            if jr is not None:
+                jr.record("error", -1, -1,
+                          {"error": type(e).__name__})
+            self.crash_dump(error=e)
+            raise
+        finally:
+            unserved = len(self._inbox) + len(self.waiting)
+            if unserved:
+                _stats.inc("serving.unserved", unserved)
+            if self.journal is not None:
+                self.journal.publish_gauges()
         return self.finished
+
+    def crash_dump(self, error=None, path: Optional[str] = None) -> str:
+        """Post-mortem JSONL artifact: every surviving journal event
+        (``type=event`` lines), the full ``stats.snapshot()``
+        (``type=stats``), and a ``type=crash`` header naming the error
+        and every request still in flight — inbox/waiting requests
+        (the unserved ones), prefilling slots with their chunk
+        position, and active decode slots. Written under
+        ``FLAGS_serve_journal_dir`` (default: the system temp dir) as
+        ``serve_crash_rank<r>_pid<pid>.jsonl``; read it back with
+        ``tools/serve_top.py``."""
+        import json
+        import os
+        import sys
+        import tempfile
+
+        if path is None:
+            d = str(_flag("serve_journal_dir")) or tempfile.gettempdir()
+            os.makedirs(d, exist_ok=True)
+            try:
+                import jax
+
+                rank = int(jax.process_index())
+            except Exception:
+                rank = 0
+            path = os.path.join(
+                d, f"serve_crash_rank{rank}_pid{os.getpid()}.jsonl")
+        unserved = []
+        with self._inbox_lock:
+            inbox = list(self._inbox)
+        for req in inbox:
+            unserved.append({"rid": req.id, "state": "inbox",
+                             "prompt_len": int(len(req.prompt))})
+        for req in self.waiting:
+            unserved.append({"rid": req.id, "state": "waiting",
+                             "prompt_len": int(len(req.prompt))})
+        for i, stt in sorted(self._prefilling.items()):
+            unserved.append({"rid": stt.req.id, "state": "prefilling",
+                             "slot": i, "pos": int(stt.pos),
+                             "prompt_len": int(len(stt.tokens))})
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                unserved.append({"rid": req.id, "state": "decoding",
+                                 "slot": i,
+                                 "n_tokens": len(req.generated)})
+        events = self.journal.events() if self.journal is not None \
+            else []
+        with open(path, "w") as f:
+            for ev in events:
+                f.write(json.dumps({"type": "event", **ev}) + "\n")
+            f.write(json.dumps({"type": "stats",
+                                "stats": _stats.snapshot()}) + "\n")
+            f.write(json.dumps({
+                "type": "crash",
+                "error": repr(error) if error is not None else None,
+                "unserved": unserved,
+                "dropped_events": (self.journal.dropped
+                                   if self.journal is not None
+                                   else 0)}) + "\n")
+        self.last_crash_dump = path
+        print(f"serve: crash dump -> {path}", file=sys.stderr)
+        return path
 
     # ---------------- admission ----------------
 
@@ -221,6 +368,10 @@ class ServingEngine(ContinuousBatchingEngine):
             req._seq = next(self._arrival)
             self.waiting.append(req)
         if newly:
+            jr = self.journal
+            if jr is not None:
+                for req in newly:
+                    jr.record("queued", req.id, -1, None)
             self._sort_waiting()
 
     def _sort_waiting(self):
@@ -302,6 +453,12 @@ class ServingEngine(ContinuousBatchingEngine):
                 _stats.inc("serving.prefix_pages_saved", len(shared))
             else:
                 _stats.inc("serving.prefix_miss")
+        jr = self.journal
+        if jr is not None:
+            jr.record("admitted", req.id, i,
+                      {"prefix_pages": len(shared),
+                       "resume": getattr(req, "_resume_tokens", None)
+                       is not None})
         key = ("prefill", i)
         if shared:
             self._mgr.share(key, shared)
@@ -316,11 +473,14 @@ class ServingEngine(ContinuousBatchingEngine):
         def cb(r, t, _u=user_cb):
             if getattr(r, "t_first_token", None) is None:
                 r.t_first_token = time.monotonic()
-                _stats.observe(
-                    "serve.ttft_ms",
-                    (r.t_first_token
-                     - getattr(r, "arrival_time", r.t_first_token))
-                    * 1e3)
+                ttft_ms = (r.t_first_token
+                           - getattr(r, "arrival_time",
+                                     r.t_first_token)) * 1e3
+                _stats.observe("serve.ttft_ms", ttft_ms)
+                jr = self.journal
+                if jr is not None:
+                    jr.record("first_token", r.id, -1,
+                              {"ttft_ms": round(ttft_ms, 3)})
             if _u is not None:
                 _u(r, t)
 
@@ -422,6 +582,10 @@ class ServingEngine(ContinuousBatchingEngine):
                 # finish — defer this chunk, the interleave cycle
                 # keeps decode draining meanwhile
                 _stats.inc("serving.prefill_stalls")
+                jr = self._journal
+                if jr is not None:
+                    jr.record("stall", req.id, i,
+                              {"need": need - have})
                 return []
             # no decoders to wait for: requeue LESS-urgent prefilling
             # requests (never this one — ``i`` is the most urgent, and
@@ -463,6 +627,10 @@ class ServingEngine(ContinuousBatchingEngine):
         _stats.inc("serve.prefill_chunks")
         _stats.inc("serve.prefill_tokens", n)
         stt.pos += n
+        jr = self._journal
+        if jr is not None:
+            jr.record("prefill_chunk", req.id, i,
+                      {"c": c, "pos": stt.pos, "n": n})
         if stt.pos < L:
             return []
         # prompt complete: emit the next token, join the decode batch
@@ -479,10 +647,12 @@ class ServingEngine(ContinuousBatchingEngine):
         if (req.eos_token_id is not None and tok == req.eos_token_id) \
                 or len(req.generated) >= req.max_new_tokens:
             req.done = True
-            req.t_done = time.monotonic()
+            self._finish_hook(req, i)
             self._release(i)
             self.finished.append(req)
             return [req]
+        if jr is not None:
+            jr.record("decode", req.id, i, None)
         self._lens[i] = L + 1
         self._last_tok[i] = tok
         return []
@@ -497,8 +667,15 @@ class ServingEngine(ContinuousBatchingEngine):
         stt = self._prefilling.pop(i)
         self._mgr.free(("prefill", i))
         _stats.inc("serving.prefill_requeues")
-        self.waiting.append(stt.req)
+        req = stt.req
+        req.n_requeues = getattr(req, "n_requeues", 0) + 1
+        jr = self.journal
+        if jr is not None:
+            jr.record("requeue", req.id, i, {"pos": int(stt.pos)})
+        self.waiting.append(req)
         self._sort_waiting()
+        if jr is not None:
+            jr.record("queued", req.id, -1, None)
         return []
 
     def _preempt_slot(self, j: int):
@@ -512,8 +689,15 @@ class ServingEngine(ContinuousBatchingEngine):
             [req.prompt, np.asarray(req.generated, np.int32)])
         self._release(j)
         _stats.inc("serving.preemptions")
+        req.n_preempts = getattr(req, "n_preempts", 0) + 1
+        jr = self.journal
+        if jr is not None:
+            jr.record("preempt", req.id, j,
+                      {"n_generated": len(req.generated)})
         self.waiting.append(req)
         self._sort_waiting()
+        if jr is not None:
+            jr.record("queued", req.id, -1, None)
 
     def _grow_decode_slot(self, i: int, n_pages: int) -> bool:
         """Serving override of the decode-time grow: under pool

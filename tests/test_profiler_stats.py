@@ -128,6 +128,47 @@ class TestMetricSemantics:
         s = h.summary()
         assert s["p50"] == s["p90"] == s["p99"] == 5.0
 
+    def test_small_count_percentiles_exact(self):
+        """ISSUE 9 satellite: the reservoir makes small-count
+        percentiles EXACT observed values, not power-of-2 bucket
+        midpoints (a 7-request serve bench's p99 TTFT used to land on
+        a bucket edge, off by ~2x)."""
+        h = stats.histogram("t.exact")
+        for v in (5.0, 6.0, 7.0, 8.0, 9.0, 10.0, 200.0):
+            h.observe(v)
+        s = h.summary()
+        assert s["p50"] == 8.0          # the 4th of 7 observations
+        assert s["p90"] == 200.0        # ceil(0.9*7)=7th
+        assert s["p99"] == 200.0        # an OBSERVED value, not ~181
+        assert h.percentile(0.5) == 8.0
+
+    def test_reservoir_bounded_and_deterministic(self):
+        """Beyond RESERVOIR_SIZE observations the sample set stays
+        bounded and the seeded eviction makes two identical
+        observation sequences summarize identically."""
+        cap = stats.Histogram.RESERVOIR_SIZE
+        n = cap + 1000
+
+        def feed(name):
+            h = stats.histogram(name)
+            for i in range(n):
+                h.observe(float(i % 97))
+            return h
+
+        ha, hb = feed("t.resa"), feed("t.resb")
+        assert len(ha._samples) == cap
+        assert ha.count == n            # count/buckets stay exact
+        sa, sb = ha.summary(), hb.summary()
+        assert sa["p50"] == sb["p50"]
+        assert sa["p90"] == sb["p90"]
+        assert sa["p99"] == sb["p99"]
+        # the uniform sample keeps percentiles near truth (exact
+        # percentiles of i % 97 are 48/87/95 at p50/p90/p99)
+        assert abs(sa["p50"] - 48.0) <= 5.0
+        assert sa["buckets"] == sb["buckets"]   # bucket export intact
+        ha._reset()
+        assert ha._samples == [] and ha.count == 0
+
     def test_snapshot_meta_stamps_rank(self):
         snap = stats.snapshot()
         assert snap["meta"]["process_index"] == 0

@@ -1,0 +1,439 @@
+"""Serving observability (ISSUE 9): request-lifecycle flight recorder,
+SLO goodput monitor, crash-dump forensics, and live introspection.
+
+Tier-1 acceptance pins:
+- event-order invariant for a preempted request: its journal lane
+  reads admitted → … → preempt → queued → admitted → … → finish, and
+  ``tools/serve_top.py`` renders that full timeline from the journal;
+- crash-dump-on-exception: an injected ``step()`` raise leaves a JSONL
+  artifact carrying the event tail + ``stats.snapshot()`` + every
+  still-unserved request (and bumps ``serving.unserved``);
+- goodput arithmetic: ``slo.goodput``/burn-rate match hand-computed
+  verdicts;
+- disabled-journal overhead: with ``FLAGS_serve_journal`` off the
+  scheduler holds no recorder and ``FlightRecorder.record`` is never
+  called from ``step()``;
+- chrome-trace export round-trips through ``tools/trace_merge.py``
+  with rank-stamped request lanes.
+"""
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.inference import FusedCausalLM
+from paddle_tpu.profiler import stats
+from paddle_tpu.serving import (FlightRecorder, Request, ServingEngine,
+                                SLOConfig, SLOMonitor)
+from paddle_tpu.serving import journal as journal_mod
+from paddle_tpu.serving.journal import chrome_trace, load_jsonl
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _journal_flags():
+    """Every test starts from the default flag state and restores it."""
+    set_flags({"serve_journal": True, "serve_journal_events": 4096,
+               "serve_journal_dir": ""})
+    yield
+    set_flags({"serve_journal": True, "serve_journal_events": 4096,
+               "serve_journal_dir": ""})
+
+
+def _model(seed=7, max_position=256):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=64, embed_dim=32, num_heads=4,
+                         dim_feedforward=64, num_layers=2,
+                         max_position=max_position)
+
+
+def _tools(name):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.fixture(scope="module")
+def pressure_serve():
+    """The PR 8 pool-pressure repro (16-page pool, three concurrent
+    24-token decoders) with the journal on: guarantees preemptions,
+    so one run feeds the event-order, serve_top, and chrome-trace
+    tests."""
+    set_flags({"serve_journal": True})
+    eng = ServingEngine(_model(), max_batch=3, page_size=4,
+                        max_length=64, decode_chunk=2, num_pages=15,
+                        slo=SLOConfig(prefill_chunk=8))
+    rng = np.random.RandomState(29)
+    prompts = [rng.randint(0, 64, (16,)) for _ in range(3)]
+    rids = [eng.submit(p, max_new_tokens=24) for p in prompts]
+    done = eng.run()
+    assert sorted(r.id for r in done) == sorted(rids)
+    return eng, rids, done
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_drop_accounting(self):
+        j = FlightRecorder(capacity=8)
+        for i in range(20):
+            j.record("submit", rid=i)
+        evs = j.events()
+        assert len(evs) == 8
+        assert [e["rid"] for e in evs] == list(range(12, 20))
+        assert [e["seq"] for e in evs] == list(range(12, 20))
+        assert j.recorded == 20 and j.dropped == 12
+        assert j.tail(3) == evs[-3:]
+
+    def test_extra_fields_flatten_into_events(self):
+        j = FlightRecorder()
+        j.record("admitted", rid=3, slot=1, extra={"prefix_pages": 4})
+        (e,) = j.events()
+        assert e["ev"] == "admitted" and e["rid"] == 3
+        assert e["slot"] == 1 and e["prefix_pages"] == 4
+        assert j.events(rid=99) == []
+
+    def test_clear_restarts_sequence(self):
+        j = FlightRecorder(capacity=4)
+        j.record("submit", rid=0)
+        j.clear()
+        assert j.events() == [] and j.recorded == 0
+        j.record("submit", rid=1)
+        assert j.events()[0]["seq"] == 0
+
+    def test_dump_and_load_jsonl(self, tmp_path):
+        j = FlightRecorder()
+        j.record("submit", rid=0, extra={"prompt_len": 5})
+        j.record("finish", rid=0, slot=2, extra={"n_tokens": 3})
+        p = j.dump_jsonl(str(tmp_path / "j.jsonl"))
+        events, extras = load_jsonl(p)
+        assert [e["ev"] for e in events] == ["submit", "finish"]
+        assert events[1]["n_tokens"] == 3 and extras == {}
+
+
+class TestLifecycleEvents:
+    def test_single_request_canonical_order(self):
+        """A plain request's lane reads submit → queued → admitted →
+        prefill_chunk+ → first_token → decode → finish, with the
+        schema fields (prefix_pages, chunk c/pos, ttft, verdict)."""
+        eng = ServingEngine(_model(), max_batch=2, page_size=4,
+                            max_length=64, decode_chunk=2,
+                            slo=SLOConfig(prefill_chunk=8))
+        rng = np.random.RandomState(3)
+        rid = eng.submit(rng.randint(0, 64, (12,)), max_new_tokens=4)
+        eng.run()
+        evs = eng.journal.events(rid)
+        names = [e["ev"] for e in evs]
+        assert names[:3] == ["submit", "queued", "admitted"]
+        chunks = [e for e in evs if e["ev"] == "prefill_chunk"]
+        assert len(chunks) == 2                 # 12 tokens / chunk 8
+        assert chunks[0]["c"] == 8 and chunks[0]["pos"] == 8
+        assert chunks[1]["pos"] == 12
+        assert names[-1] == "finish"
+        i_ft = names.index("first_token")
+        assert names[i_ft + 1] == "decode"
+        assert evs[2]["prefix_pages"] == 0
+        assert evs[i_ft]["ttft_ms"] >= 0
+        fin = evs[-1]
+        assert fin["n_tokens"] == 4 and "slo_ok" in fin
+        # monotonic timestamps down the lane
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts)
+
+    def test_preempt_resume_event_order(self, pressure_serve):
+        """ISSUE 9 acceptance: the preempted request's lane carries
+        its WHOLE life — admitted → … → preempt → queued →
+        admitted(resume) → … → finish."""
+        eng, rids, done = pressure_serve
+        preempts = [e for e in eng.journal.events()
+                    if e["ev"] == "preempt"]
+        assert preempts, "pool-pressure repro produced no preemption"
+        rid = preempts[0]["rid"]
+        names = [e["ev"] for e in eng.journal.events(rid)]
+        i_pre = names.index("preempt")
+        assert "admitted" in names[:i_pre]
+        assert names[i_pre + 1] == "queued"
+        assert "admitted" in names[i_pre + 2:]
+        assert names[-1] == "finish"
+        # the re-admission is marked as a resume
+        readmits = [e for e in eng.journal.events(rid)
+                    if e["ev"] == "admitted"]
+        assert readmits[-1]["resume"] is True
+        assert readmits[0]["resume"] is False
+        # and the request-level pressure counters agree
+        req = {r.id: r for r in done}[rid]
+        assert req.n_preempts >= 1
+        # outputs stayed exact through it all (PR 8 guarantee)
+        assert len(req.generated) == 24
+
+
+class TestCrashDump:
+    def test_injected_exception_dumps_artifact(self, tmp_path):
+        """Any step() raise leaves a JSONL artifact: journal tail +
+        stats snapshot + every in-flight request, and bumps the
+        serving.unserved counter for the never-admitted ones."""
+        set_flags({"serve_journal_dir": str(tmp_path)})
+        eng = ServingEngine(_model(), max_batch=1, page_size=4,
+                            max_length=64, decode_chunk=2,
+                            slo=SLOConfig(prefill_chunk=8))
+        rng = np.random.RandomState(5)
+        eng.submit(rng.randint(0, 64, (8,)), max_new_tokens=4)
+        eng.submit(rng.randint(0, 64, (8,)), max_new_tokens=4)
+        eng.step()                       # admit the first request
+
+        def boom(self):
+            raise RuntimeError("injected step failure")
+
+        eng._pick_action = types.MethodType(boom, eng)
+        before = stats.counter("serving.unserved").value
+        with pytest.raises(RuntimeError, match="injected"):
+            eng.run()
+        path = eng.last_crash_dump
+        assert path is not None and os.path.dirname(path) == \
+            str(tmp_path)
+        events, extras = load_jsonl(path)
+        names = [e["ev"] for e in events]
+        assert "submit" in names and names[-1] == "error"
+        snap = extras["stats"]["stats"]
+        assert "counters" in snap and "meta" in snap
+        crash = extras["crash"]
+        assert "injected step failure" in crash["error"]
+        states = {u["state"] for u in crash["unserved"]}
+        # one request still waiting (unserved), one in flight on the
+        # slot (prefilling or decoding, depending on chunk progress)
+        assert "waiting" in states
+        assert len(crash["unserved"]) == 2
+        assert stats.counter("serving.unserved").value == before + 1
+
+    def test_dump_without_journal_still_carries_state(self, tmp_path):
+        """FLAGS_serve_journal=0: the crash artifact still records the
+        snapshot + unserved requests (just no events)."""
+        set_flags({"serve_journal": False,
+                   "serve_journal_dir": str(tmp_path)})
+        eng = ServingEngine(_model(), max_batch=1, page_size=4,
+                            max_length=64, decode_chunk=2,
+                            slo=SLOConfig(prefill_chunk=8))
+        rng = np.random.RandomState(9)
+        eng.submit(rng.randint(0, 64, (6,)), max_new_tokens=2)
+        path = eng.crash_dump(error=ValueError("manual"))
+        events, extras = load_jsonl(path)
+        assert events == []
+        assert extras["crash"]["unserved"][0]["state"] == "inbox"
+        assert "stats" in extras
+
+
+class TestDisabledJournal:
+    def test_flag_off_means_no_recorder_and_zero_record_calls(
+            self, monkeypatch):
+        """ISSUE 9 satellite: with the flag off the engine holds NO
+        recorder — step() performs zero journal allocations or calls
+        (record is patched to explode if anything slips through) —
+        while the SLO monitor keeps judging verdicts."""
+        set_flags({"serve_journal": False})
+        eng = ServingEngine(_model(), max_batch=2, page_size=4,
+                            max_length=64, decode_chunk=2,
+                            slo=SLOConfig(prefill_chunk=8))
+        assert eng.journal is None and eng._journal is None
+        assert eng.prefix_cache._journal is None
+
+        def boom(self, *a, **k):  # pragma: no cover - must not fire
+            raise AssertionError("journal recorded while disabled")
+
+        monkeypatch.setattr(journal_mod.FlightRecorder, "record", boom)
+        rng = np.random.RandomState(11)
+        rid = eng.submit(rng.randint(0, 64, (12,)), max_new_tokens=4)
+        done = eng.run()
+        assert [r.id for r in done] == [rid]
+        # verdict/goodput accounting is journal-independent
+        assert done[0].slo_ok is not None
+        assert eng.slo_monitor.goodput is not None
+
+
+class TestSLOMonitor:
+    @staticmethod
+    def _req(ttft_ms=None, tpot_ms=None, n_tokens=8):
+        """Request with synthetic lifecycle marks yielding exactly the
+        given readings (arrival at t=0)."""
+        r = Request([1, 2, 3], max_new_tokens=n_tokens,
+                    arrival_time=0.0)
+        if ttft_ms is not None:
+            r.t_first_token = ttft_ms / 1e3
+        r.generated = list(range(n_tokens))
+        if tpot_ms is not None and ttft_ms is not None:
+            r.t_done = r.t_first_token \
+                + (n_tokens - 1) * tpot_ms / 1e3
+        return r
+
+    def test_goodput_arithmetic_vs_hand_computed(self):
+        mon = SLOMonitor(ttft_target_ms=100.0, tpot_target_ms=10.0,
+                         objective=0.9, window=16)
+        # 3 ok, 1 ttft miss, 1 tpot miss -> goodput 3/5
+        for ttft, tpot in ((50, 5), (99, 9.9), (100, 10)):
+            v = mon.observe_finish(self._req(ttft, tpot))
+            assert v["slo_ok"] is True
+        v = mon.observe_finish(self._req(250, 5))
+        assert v["ttft_ok"] is False and v["tpot_ok"] is True
+        v = mon.observe_finish(self._req(50, 25))
+        assert v["ttft_ok"] is True and v["tpot_ok"] is False
+        assert mon.goodput == pytest.approx(0.6)
+        # burn rate: miss rate 0.4 over a 0.1 error budget = 4x
+        assert mon.burn_rate == pytest.approx(4.0)
+        assert stats.gauge("slo.goodput").value == pytest.approx(0.6)
+        assert stats.gauge("slo.burn_rate").value == pytest.approx(4.0)
+        assert stats.counter("slo.ttft_miss").value >= 1
+        assert stats.counter("slo.tpot_miss").value >= 1
+
+    def test_rolling_window(self):
+        mon = SLOMonitor(ttft_target_ms=100.0, tpot_target_ms=None,
+                         window=2)
+        mon.observe_finish(self._req(50, None))     # ok
+        mon.observe_finish(self._req(500, None))    # miss
+        mon.observe_finish(self._req(50, None))     # ok
+        # window of 2: [miss, ok]
+        assert mon.goodput == pytest.approx(0.5)
+
+    def test_single_token_request_passes_tpot_vacuously(self):
+        mon = SLOMonitor(ttft_target_ms=100.0, tpot_target_ms=0.001)
+        r = self._req(ttft_ms=50, tpot_ms=None, n_tokens=1)
+        v = mon.observe_finish(r)
+        assert v["tpot_ms"] is None and v["tpot_ok"] is True
+        assert v["slo_ok"] is True and r.slo_ok is True
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            SLOMonitor(objective=1.0)
+        with pytest.raises(ValueError):
+            SLOConfig(goodput_objective=0.0)
+
+    def test_config_carries_targets(self):
+        slo = SLOConfig(ttft_target_ms=123.0, tpot_target_ms=None,
+                        goodput_objective=0.95, slo_window=7)
+        assert slo.ttft_target_ms == 123.0
+        assert slo.tpot_target_ms is None
+        assert slo.goodput_objective == 0.95 and slo.slo_window == 7
+
+
+class TestChromeTraceExport:
+    def test_one_lane_per_request_with_phases(self, pressure_serve):
+        eng, rids, _ = pressure_serve
+        tr = chrome_trace(eng.journal.events(), process_index=3)
+        assert tr["metadata"]["process_index"] == 3
+        evs = tr["traceEvents"]
+        assert all(e["pid"] == 3 for e in evs)
+        lanes = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        for rid in rids:
+            assert f"req {rid}" in lanes
+        spans = {e["name"] for e in evs if e["ph"] == "X"}
+        assert {"queued", "prefill", "decode"} <= spans
+        # the preemption is an instant mark on the request's own lane
+        marks = [e for e in evs if e["ph"] == "i"
+                 and e["name"] == "preempt"]
+        assert marks and all(m["tid"] == m["args"]["rid"] + 1
+                             for m in marks)
+
+    def test_round_trips_through_trace_merge(self, pressure_serve,
+                                             tmp_path):
+        """ISSUE 9 acceptance: rank-stamped journal traces fold into
+        one multi-rank timeline exactly like profiler traces."""
+        eng, _, _ = pressure_serve
+        events = eng.journal.events()
+        paths = []
+        for r in (0, 1):
+            p = str(tmp_path / f"trace_rank{r}.json")
+            with open(p, "w") as f:
+                json.dump(chrome_trace(events, process_index=r), f)
+            paths.append(p)
+        trace_merge = _tools("trace_merge")
+        merged = trace_merge.merge_traces(paths)
+        assert merged["metadata"]["ranks"] == [0, 1]
+        pids = {e["pid"] for e in merged["traceEvents"]
+                if e.get("ph") == "X"}
+        assert pids == {0, 1}
+
+
+class TestServeTop:
+    def test_offline_cli_smoke(self, pressure_serve, tmp_path):
+        """ISSUE 9 acceptance: serve_top renders the preempted
+        request's full timeline from a journal file (offline mode is
+        stdlib-only, so the subprocess is fast)."""
+        eng, _, _ = pressure_serve
+        jpath = str(tmp_path / "journal.jsonl")
+        eng.journal.dump_jsonl(jpath)
+        rid = [e for e in eng.journal.events()
+               if e["ev"] == "preempt"][0]["rid"]
+        out_trace = str(tmp_path / "trace.json")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "serve_top.py"), jpath,
+             "--top", "3", "--export-trace", out_trace],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "goodput" in proc.stdout
+        assert "preempt" in proc.stdout
+        assert os.path.exists(out_trace)
+        # --req renders one full timeline
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "serve_top.py"), jpath,
+             "--req", str(rid)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        for ev in ("admitted", "preempt", "queued", "finish"):
+            assert ev in proc.stdout, ev
+
+    def test_summarize_counts_and_verdicts(self, pressure_serve):
+        eng, rids, done = pressure_serve
+        serve_top = _tools("serve_top")
+        s = serve_top.summarize(eng.journal.events())
+        assert s["finished"] == len(rids)
+        assert s["preemptions"] >= 1
+        assert s["goodput"] is not None
+        # verdicts come from the journal's finish events (stamped by
+        # the monitor), matching the requests' own verdicts
+        expect = sum(1 for r in done if r.slo_ok) / len(done)
+        assert s["goodput"] == pytest.approx(expect)
+
+    def test_render_engine_live(self, pressure_serve):
+        eng, rids, _ = pressure_serve
+        serve_top = _tools("serve_top")
+        out = serve_top.render_engine(eng, top=2)
+        assert "serve_top" in out and "goodput" in out
+        assert f"/{eng.max_batch}" in out    # live slot occupancy
+
+
+class TestBenchGateGoodput:
+    def test_goodput_gates_down(self):
+        bench_gate = _tools("bench_gate")
+        prev = {"serve_goodput": 0.99,
+                "telemetry": {"gauges": {"slo.goodput": 0.99}}}
+        worse = {"serve_goodput": 0.50,
+                 "telemetry": {"gauges": {"slo.goodput": 0.50}}}
+        bad, n = bench_gate.gate(prev, worse)
+        assert n >= 2
+        assert any("serve_goodput" in ln for ln in bad)
+        assert any("slo.goodput" in ln for ln in bad)
+        better = {"serve_goodput": 1.0,
+                  "telemetry": {"gauges": {"slo.goodput": 1.0}}}
+        bad, _ = bench_gate.gate(prev, better)
+        assert not bad
+
+
+class TestConventions:
+    def test_journal_and_slo_prefixes_registered(self):
+        """ISSUE 9 satellite: journal./slo. are documented namespaces
+        so the PR 2 naming lint covers the new metrics."""
+        assert "journal." in stats.CONVENTION_PREFIXES
+        assert "slo." in stats.CONVENTION_PREFIXES
+
+    def test_run_publishes_journal_gauges(self, pressure_serve):
+        eng, _, _ = pressure_serve
+        assert stats.gauge("journal.events").value > 0
+        assert stats.gauge("slo.slot_occupancy").value >= 0

@@ -79,6 +79,11 @@ DEFAULT_METRICS: Dict[str, str] = {
     "serve_p99_ttft_ms": "up",
     "serve_p50_tpot_ms": "up",
     "serve_tokens_per_sec": "down",
+    # SLO goodput (fraction of finished requests meeting both the
+    # TTFT and TPOT targets): both the bench's whole-run scalar and
+    # the slo.goodput rolling telemetry gauge regress DOWN
+    "serve_goodput": "down",
+    "slo.goodput": "down",
     # static-analysis state the numbers were measured under: the
     # finding count must only go DOWN between rounds, so any growth
     # regresses (direction "up" = an increase fails the gate); gates

@@ -9,7 +9,15 @@ gates (TTFT regresses UP, throughput DOWN):
     python tools/serve_bench.py --streams 8 --seed 0
 
     {"serve_p50_ttft_ms": ..., "serve_p99_ttft_ms": ...,
-     "serve_tokens_per_sec": ..., ..., "telemetry": {...}}
+     "serve_tokens_per_sec": ..., "serve_goodput": ...,
+     ..., "telemetry": {...}}
+
+``serve_goodput`` is the fraction of finished requests meeting BOTH
+the ``--ttft-target`` and ``--tpot-target`` SLOs (verdicts stamped
+per request by serving/slo.py). ``--requests-out`` writes one JSONL
+row per request (waits/ttft/tpot/preempt counts/verdict) and
+``--journal-out`` dumps the flight recorder for
+``tools/serve_top.py`` forensics.
 
 Defaults are CPU-sized (tiny model) so the rung runs in CI; on a chip
 pass the 1.3B geometry (--d-model 2048 --layers 24 --heads 16
@@ -73,7 +81,9 @@ def build_engine(args):
             p._rebind(p._data.astype(jnp.bfloat16))
     slo = SLOConfig(ttft_weight=args.ttft_weight,
                     tpot_weight=args.tpot_weight,
-                    prefill_chunk=args.prefill_chunk)
+                    prefill_chunk=args.prefill_chunk,
+                    ttft_target_ms=args.ttft_target,
+                    tpot_target_ms=args.tpot_target)
     return ServingEngine(
         model, max_batch=args.streams, page_size=args.page_size,
         max_length=max_len, decode_chunk=args.decode_chunk,
@@ -154,6 +164,19 @@ def main():
     ap.add_argument("--decode-chunk", type=int, default=8)
     ap.add_argument("--ttft-weight", type=float, default=1.0)
     ap.add_argument("--tpot-weight", type=float, default=1.0)
+    ap.add_argument("--ttft-target", type=float, default=1000.0,
+                    help="SLO TTFT target (ms) for per-request "
+                         "verdicts and serve_goodput")
+    ap.add_argument("--tpot-target", type=float, default=100.0,
+                    help="SLO TPOT target (ms)")
+    ap.add_argument("--requests-out", default=None,
+                    help="write per-request JSONL (id, lens, waits, "
+                         "ttft/tpot, preempt/requeue counts, slo_ok) "
+                         "so offline analysis never re-derives from "
+                         "histograms")
+    ap.add_argument("--journal-out", default=None,
+                    help="dump the flight-recorder journal JSONL "
+                         "(tools/serve_top.py input)")
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--d-model", type=int, default=64)
     ap.add_argument("--layers", type=int, default=2)
@@ -196,21 +219,56 @@ def main():
         drive(eng, warm, args.max_new)
         eng.finished.clear()
         eng.action_log.clear()
+        eng.slo_monitor.reset()
+        if eng.journal is not None:
+            eng.journal.clear()  # the journal describes the load run
         stats.reset()
 
     reqs = make_requests(args, lens, rng)
     wall = drive(eng, reqs, args.max_new)
 
     done = eng.finished
+    if eng.journal is not None:
+        eng.journal.publish_gauges()
     ttfts = np.array([r.ttft_s for r in done], np.float64) * 1e3
     tpots = [r.tpot_s for r in done if r.tpot_s is not None]
     total_tokens = sum(len(r.generated) for r in done)
+    # SLO goodput over the WHOLE run (not the monitor's rolling
+    # window): fraction of finished requests whose stamped verdict
+    # met both targets — bench_gate gates this (direction "down")
+    judged = [r for r in done if getattr(r, "slo_ok", None) is not None]
+    goodput = round(sum(1 for r in judged if r.slo_ok) / len(judged), 4) \
+        if judged else None
+    if args.requests_out:
+        with open(args.requests_out, "w") as f:
+            for r in sorted(done, key=lambda r: r.id):
+                f.write(json.dumps({
+                    "id": r.id,
+                    "prompt_len": int(len(r.prompt)),
+                    "new_tokens": len(r.generated),
+                    "queue_wait_ms": None if r.queue_wait_s is None
+                    else round(r.queue_wait_s * 1e3, 3),
+                    "ttft_ms": None if r.ttft_s is None
+                    else round(r.ttft_s * 1e3, 3),
+                    "tpot_ms": None if r.tpot_s is None
+                    else round(r.tpot_s * 1e3, 3),
+                    "preempts": getattr(r, "n_preempts", 0),
+                    "requeues": getattr(r, "n_requeues", 0),
+                    "slo_ok": getattr(r, "slo_ok", None),
+                }) + "\n")
+    if args.journal_out and eng.journal is not None:
+        eng.journal.dump_jsonl(args.journal_out)
     out = {
         "serve_p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 3),
         "serve_p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 3),
         "serve_tokens_per_sec": round(total_tokens / wall, 1),
         "serve_p50_tpot_ms": round(
             float(np.median(tpots)) * 1e3, 3) if tpots else None,
+        "serve_goodput": goodput,
+        "serve_ttft_target_ms": args.ttft_target,
+        "serve_tpot_target_ms": args.tpot_target,
+        "serve_preemptions": int(
+            stats.counter("serving.preemptions").value),
         "serve_streams": args.streams,
         "serve_requests": len(done),
         "serve_rate": args.rate,

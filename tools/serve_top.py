@@ -1,0 +1,324 @@
+"""serve_top: live/offline text dashboard over the serving journal.
+
+Renders the serving frontend's flight recorder (serving/journal.py)
+as a top-style dashboard — phase occupancy, queue depth, SLO goodput
+and burn rate, pool-pressure counts, and the slowest requests with
+their full event timelines — from a journal/crash JSONL artifact or a
+running engine:
+
+    python tools/serve_top.py serve_journal.jsonl
+    python tools/serve_top.py /tmp/serve_crash_rank0_pid123.jsonl
+    python tools/serve_top.py j.jsonl --req 17          # one timeline
+    python tools/serve_top.py j.jsonl --export-trace t.json --rank 0
+    python tools/serve_top.py j.jsonl --watch 2         # re-render
+
+Offline mode is stdlib-only — ``serving/journal.py`` is loaded
+standalone, so a post-mortem over a crash dump never pays the
+paddle_tpu/jax import. Live mode is the in-process API::
+
+    from tools import serve_top
+    print(serve_top.render_engine(engine))   # any running ServingEngine
+
+Verdicts come from the journal's ``finish`` events when the SLO
+monitor stamped them; ``--ttft-target/--tpot-target`` re-judge
+offline journals that lack them. ``--export-trace`` writes the
+one-lane-per-request chrome trace (rank-stamped: feed several ranks'
+exports through ``tools/trace_merge.py`` for one fleet timeline).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+__all__ = ["summarize", "render", "render_engine", "main"]
+
+
+def _journal_mod():
+    """serving/journal.py loaded standalone (the module is stdlib-only
+    at import time) so offline dashboards skip the jax import."""
+    spec = importlib.util.spec_from_file_location(
+        "_serve_journal", os.path.join(
+            _REPO, "paddle_tpu", "serving", "journal.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def summarize(events: List[dict], ttft_target: Optional[float] = None,
+              tpot_target: Optional[float] = None,
+              objective: float = 0.99) -> dict:
+    """Fold a journal event stream into dashboard state: per-request
+    phase/readings/verdicts plus engine-level pressure counts."""
+    reqs: dict = {}
+    counts = {"preempt": 0, "requeue": 0, "stall": 0, "error": 0}
+    evicted_pages = 0
+    for e in events:
+        ev = e.get("ev")
+        rid = int(e.get("rid", -1))
+        if ev == "evict_trigger":
+            evicted_pages += int(e.get("pages", 0))
+        if ev in counts:
+            counts[ev] += 1
+        if rid < 0:
+            continue
+        r = reqs.setdefault(rid, {
+            "rid": rid, "events": [], "phase": "waiting",
+            "ttft_ms": None, "tpot_ms": None, "slo_ok": None,
+            "preempts": 0, "requeues": 0, "stalls": 0,
+            "prompt_len": None, "n_tokens": None, "chunks": 0})
+        r["events"].append(e)
+        if ev == "submit":
+            r["prompt_len"] = e.get("prompt_len")
+        elif ev == "queued":
+            r["phase"] = "waiting"
+        elif ev == "admitted":
+            r["phase"] = "prefill"
+        elif ev == "prefill_chunk":
+            r["chunks"] += 1
+        elif ev == "first_token":
+            r["ttft_ms"] = e.get("ttft_ms")
+        elif ev == "decode":
+            r["phase"] = "decode"
+        elif ev == "preempt":
+            r["preempts"] += 1
+            r["phase"] = "waiting"
+        elif ev == "requeue":
+            r["requeues"] += 1
+            r["phase"] = "waiting"
+        elif ev == "stall":
+            r["stalls"] += 1
+        elif ev == "finish":
+            r["phase"] = "finished"
+            r["ttft_ms"] = e.get("ttft_ms", r["ttft_ms"])
+            r["tpot_ms"] = e.get("tpot_ms")
+            r["n_tokens"] = e.get("n_tokens")
+            r["slo_ok"] = e.get("slo_ok")
+        elif ev == "error":
+            r["phase"] = "error"
+    # re-judge requests whose journal predates the monitor's verdict
+    # (or judge against CLI-supplied targets)
+    for r in reqs.values():
+        if r["slo_ok"] is None and r["phase"] == "finished" \
+                and (ttft_target is not None or tpot_target is not None):
+            ttft_ok = (r["ttft_ms"] is None or ttft_target is None
+                       or r["ttft_ms"] <= ttft_target)
+            tpot_ok = (r["tpot_ms"] is None or tpot_target is None
+                       or r["tpot_ms"] <= tpot_target)
+            r["slo_ok"] = ttft_ok and tpot_ok
+    finished = [r for r in reqs.values() if r["phase"] == "finished"]
+    judged = [r for r in finished if r["slo_ok"] is not None]
+    ok = [r for r in judged if r["slo_ok"]]
+    goodput = (len(ok) / len(judged)) if judged else None
+    burn = None if goodput is None \
+        else (1.0 - goodput) / max(1.0 - objective, 1e-9)
+    phases = {"waiting": 0, "prefill": 0, "decode": 0, "finished": 0,
+              "error": 0}
+    for r in reqs.values():
+        phases[r["phase"]] = phases.get(r["phase"], 0) + 1
+    return {
+        "events": len(events),
+        "requests": reqs,
+        "queue_depth": phases["waiting"],
+        "prefilling": phases["prefill"],
+        "active": phases["decode"],
+        "finished": phases["finished"],
+        "judged": len(judged),
+        "ok": len(ok),
+        "goodput": goodput,
+        "burn_rate": burn,
+        "objective": objective,
+        "preemptions": counts["preempt"],
+        "requeues": counts["requeue"],
+        "stalls": counts["stall"],
+        "errors": counts["error"],
+        "evicted_pages": evicted_pages,
+        "slots": None,  # live mode fills the real max_batch
+    }
+
+
+def _fmt(v, nd=1, unit=""):
+    return "-" if v is None else f"{v:.{nd}f}{unit}"
+
+
+def _timeline_lines(r: dict) -> List[str]:
+    """One indented line per journal event, offset-relative to the
+    request's first event (the forensic view: every admission,
+    chunk, preemption and requeue of one request's life)."""
+    evs = sorted(r["events"], key=lambda d: d.get("seq", 0))
+    if not evs:
+        return []
+    t0 = float(evs[0]["ts"])
+    lines = []
+    for e in evs:
+        extras = " ".join(
+            f"{k}={v}" for k, v in e.items()
+            if k not in ("seq", "ts", "ev", "rid", "slot"))
+        slot = e.get("slot", -1)
+        slot_s = f" slot={slot}" if isinstance(slot, int) and slot >= 0 \
+            else ""
+        lines.append(f"    +{(float(e['ts']) - t0) * 1e3:9.1f}ms "
+                     f"{e['ev']:<13}{slot_s}"
+                     + (f" {extras}" if extras else ""))
+    return lines
+
+
+def _request_row(r: dict) -> str:
+    verdict = ("SLO ok" if r["slo_ok"] else "SLO MISS") \
+        if r["slo_ok"] is not None else "unjudged"
+    return (f"  req {r['rid']:<5} {r['phase']:<9} "
+            f"ttft {_fmt(r['ttft_ms'], 1, 'ms'):>9}  "
+            f"tpot {_fmt(r['tpot_ms'], 2, 'ms'):>9}  "
+            f"tok {r['n_tokens'] if r['n_tokens'] is not None else '-':>4}  "
+            f"preempts {r['preempts']}  requeues {r['requeues']}  "
+            f"{verdict}")
+
+
+def render(summary: dict, top: int = 5,
+           req: Optional[int] = None) -> str:
+    """Dashboard text. ``req`` narrows to one request's timeline;
+    otherwise the top-k slowest finished requests (by TTFT) get
+    theirs, after the one-line service header rows."""
+    s = summary
+    if req is not None:
+        r = s["requests"].get(req)
+        if r is None:
+            return f"serve_top: no events for req {req}"
+        return "\n".join([_request_row(r)] + _timeline_lines(r))
+    slots = f"/{s['slots']}" if s.get("slots") else ""
+    lines = [
+        f"serve_top — {s['events']} events, "
+        f"{len(s['requests'])} requests",
+        f"phase: waiting {s['queue_depth']}  "
+        f"prefill {s['prefilling']}  decode {s['active']}{slots}  "
+        f"finished {s['finished']}  errors {s['errors']}",
+        f"goodput {_fmt(s['goodput'], 3)} "
+        f"({s['ok']}/{s['judged']} within SLO)   "
+        f"burn_rate {_fmt(s['burn_rate'], 1, 'x')} "
+        f"(objective {s['objective']})",
+        f"pressure: preempts {s['preemptions']}  "
+        f"requeues {s['requeues']}  stalls {s['stalls']}  "
+        f"evicted_pages {s['evicted_pages']}",
+    ]
+    slowest = sorted(
+        (r for r in s["requests"].values()
+         if r["phase"] == "finished" and r["ttft_ms"] is not None),
+        key=lambda r: -r["ttft_ms"])[:max(top, 0)]
+    if slowest:
+        lines.append(f"slowest {len(slowest)} finished requests "
+                     "(by TTFT):")
+        for r in slowest:
+            lines.append(_request_row(r))
+            lines.extend(_timeline_lines(r))
+    unfinished = [r for r in s["requests"].values()
+                  if r["phase"] not in ("finished",)]
+    if unfinished:
+        lines.append(f"in flight ({len(unfinished)}):")
+        for r in sorted(unfinished, key=lambda r: r["rid"])[:top]:
+            lines.append(_request_row(r))
+    return "\n".join(lines)
+
+
+def render_engine(eng, top: int = 5) -> str:
+    """Live dashboard over a RUNNING ServingEngine (in-process): the
+    journal's event-derived view, with the engine's real queue/slot
+    state overriding the event-derived occupancy."""
+    j = getattr(eng, "journal", None)
+    events = j.events() if j is not None else []
+    slo = getattr(eng, "slo", None)
+    s = summarize(
+        events,
+        ttft_target=getattr(slo, "ttft_target_ms", None),
+        tpot_target=getattr(slo, "tpot_target_ms", None),
+        objective=getattr(slo, "goodput_objective", 0.99))
+    s["queue_depth"] = len(eng.waiting) + len(getattr(eng, "_inbox", []))
+    s["active"] = eng.num_active
+    s["prefilling"] = getattr(eng, "num_prefilling", 0)
+    s["slots"] = eng.max_batch
+    mon = getattr(eng, "slo_monitor", None)
+    if mon is not None and mon.goodput is not None:
+        s["goodput"], s["burn_rate"] = mon.goodput, mon.burn_rate
+    head = "" if j is not None else \
+        "serve_top: journal disabled (FLAGS_serve_journal=0) — " \
+        "live gauges only\n"
+    return head + render(s, top=top)
+
+
+def _crash_lines(extras: dict) -> List[str]:
+    crash = extras.get("crash")
+    if not crash:
+        return []
+    unserved = crash.get("unserved") or []
+    lines = [f"crash: {crash.get('error')}   "
+             f"in-flight at dump: {len(unserved)}   "
+             f"dropped_events: {crash.get('dropped_events', 0)}"]
+    for u in unserved:
+        where = u.get("state", "?")
+        extra = " ".join(f"{k}={v}" for k, v in u.items()
+                         if k not in ("rid", "state"))
+        lines.append(f"  req {u.get('rid'):<5} {where:<11} {extra}")
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="text dashboard over a serving journal / crash "
+                    "dump (serving/journal.py JSONL)")
+    ap.add_argument("journal", help="journal or crash-dump JSONL path")
+    ap.add_argument("--top", type=int, default=5,
+                    help="slowest-request timelines to render")
+    ap.add_argument("--req", type=int, default=None,
+                    help="render ONE request's full timeline")
+    ap.add_argument("--ttft-target", type=float, default=None,
+                    help="re-judge verdicts offline: TTFT target (ms)")
+    ap.add_argument("--tpot-target", type=float, default=None,
+                    help="re-judge verdicts offline: TPOT target (ms)")
+    ap.add_argument("--objective", type=float, default=0.99,
+                    help="goodput objective for the burn rate")
+    ap.add_argument("--export-trace", default=None,
+                    help="also write the one-lane-per-request chrome "
+                         "trace here (trace_merge-foldable)")
+    ap.add_argument("--rank", type=int, default=None,
+                    help="process_index stamp for --export-trace "
+                         "(default: the journal's stats stamp, else 0)")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="re-read + re-render every N seconds "
+                         "(0 = render once)")
+    args = ap.parse_args(argv)
+
+    jm = _journal_mod()
+    while True:
+        events, extras = jm.load_jsonl(args.journal)
+        summary = summarize(events, ttft_target=args.ttft_target,
+                            tpot_target=args.tpot_target,
+                            objective=args.objective)
+        out = render(summary, top=args.top, req=args.req)
+        crash = _crash_lines(extras)
+        if crash:
+            out = out + "\n" + "\n".join(crash)
+        if args.watch > 0:
+            print("\033[2J\033[H", end="")
+        print(out)
+        if args.export_trace:
+            rank = args.rank
+            if rank is None:
+                rank = ((extras.get("stats") or {}).get("stats") or {}) \
+                    .get("meta", {}).get("process_index", 0)
+            with open(args.export_trace, "w") as f:
+                json.dump(jm.chrome_trace(events, process_index=rank),
+                          f)
+            print(f"serve_top: chrome trace -> {args.export_trace}")
+            args.export_trace = None  # once per invocation
+        if args.watch <= 0:
+            return 0
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
