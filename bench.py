@@ -232,7 +232,8 @@ def _chip_hbm_bw(device) -> float:
 
 def run_decode_bench(batch=32, prompt=128, new_tokens=129,
                      d_model=2048, n_layers=24, n_heads=16,
-                     decode_chunk=None, quant=None, kv_dtype=None):
+                     decode_chunk=None, quant=None, kv_dtype=None,
+                     mp_degree=None):
     # Flagship-comparable serving rung: the decode model matches the
     # gpt3-1.3b training rung (d2048 L24). Round-4 redesign (each step
     # diagnosed in tools/decode_profile.py + HLO inspection):
@@ -281,7 +282,8 @@ def run_decode_bench(batch=32, prompt=128, new_tokens=129,
     engine = GenerationEngine(model, page_size=16,
                               max_length=prompt + new_tokens,
                               decode_chunk=decode_chunk,
-                              kv_dtype=kv_dtype, quant=quant)
+                              kv_dtype=kv_dtype, quant=quant,
+                              mp_degree=mp_degree)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, VOCAB, (batch, prompt))
     # warmup with the SAME token count: compiles prefill + every chunk-k
@@ -292,10 +294,15 @@ def run_decode_bench(batch=32, prompt=128, new_tokens=129,
     assert out.shape == (batch, prompt + new_tokens)
     tps = batch * new_tokens / dt
     # honest roofline: every decode step must read the full weight
-    # stream (stack + lm head) once from HBM; tokens/step = batch
+    # stream (stack + lm head) once from HBM; tokens/step = batch.
+    # Under TP each chip streams only its 1/mp stack slice (the lm
+    # head stays replicated), so the per-chip weight floor shrinks
+    # accordingly — mp1-throughput preservation is gated on the
+    # EXISTING rungs, this roofline is the per-chip TP bar.
+    mp = mp_degree or 1
     weight_bytes = sum(
         int(np.prod(a.shape)) * a.dtype.itemsize
-        for a in st._stack().values()) + \
+        for a in st._stack().values()) / mp + \
         int(np.prod(engine._head_t.shape)) * engine._head_t.dtype.itemsize
     import jax
 
@@ -454,6 +461,30 @@ def _run_secondary(kind):
              "decode_bf16_grouped_pct_of_hbm_roofline": pct,
              "decode_bf16_grouped_roofline": cost_rl,
              "decode_bf16_grouped_telemetry": _telemetry()}))
+    elif kind == "--decode-tp":
+        # TENSOR-PARALLEL decode rung (ISSUE 10): the mp-sharded
+        # FusedMultiTransformer over every available chip — per-chip
+        # weight streams shrink to 1/mp, two psums per layer ride the
+        # ICI. The roofline denominator is the PER-CHIP weight slice,
+        # so the target stays the same >=50%-of-weight-roofline bar as
+        # the single-chip grouped rung; mp1 throughput preservation is
+        # gated by bench_gate on the existing decode_* rungs, which
+        # this change leaves untouched.
+        import jax
+
+        n = len(jax.devices())
+        if n < 2:
+            print(json.dumps({"decode_tp_skipped":
+                              f"needs >= 2 devices, have {n}"}))
+            return
+        mp = 1 << (n.bit_length() - 1)  # largest power of two <= n
+        tps, pct, cost_rl = run_decode_bench(mp_degree=mp)
+        print(json.dumps(
+            {f"decode_tp{mp}_tokens_per_sec": round(tps, 1),
+             f"decode_tp{mp}_pct_of_hbm_roofline": pct,
+             "decode_tp_mp_degree": mp,
+             "decode_tp_roofline": cost_rl,
+             "decode_tp_telemetry": _telemetry()}))
     elif kind == "--decode-int8kv":
         # best-throughput serving config: int8 weights + int8 KV cache
         # (cache-KV quant pays once KV traffic rivals the weight
@@ -525,8 +556,8 @@ def main():
         _run_one(sys.argv[sys.argv.index("--config") + 1])
         return
     for kind in ("--decode", "--decode-int8", "--decode-a8w8",
-                 "--decode-bf16-grouped", "--decode-int8kv", "--serve",
-                 "--bert", "--s2048"):
+                 "--decode-bf16-grouped", "--decode-tp",
+                 "--decode-int8kv", "--serve", "--bert", "--s2048"):
         if kind in sys.argv:
             _run_secondary(kind)
             return
@@ -569,7 +600,8 @@ def main():
         # the training rung's buffers die with its process)
         for kind in ("--s2048", "--decode", "--decode-int8",
                      "--decode-a8w8", "--decode-bf16-grouped",
-                     "--decode-int8kv", "--serve", "--bert"):
+                     "--decode-tp", "--decode-int8kv", "--serve",
+                     "--bert"):
             # s2048's flash-attention bwd compile alone can take ~25min
             # cold (measured r5); the run itself is seconds
             extra, err = _sub([kind], 2400 if kind == "--s2048" else 1500)

@@ -292,6 +292,82 @@ def _build_moe_ep():
     return fn, (x,)
 
 
+def _tp_serving_setup():
+    """Shared builder state for the TP serving sites: a tiny
+    FusedMultiTransformer, its shard-at-load mp2 stacks, and a
+    kv-head-sharded pool over two of the virtual devices."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..distributed.tp import TPContext, serving_mesh
+    from ..incubate.nn.fused_transformer import (FusedMultiTransformer,
+                                                 rope_table)
+    from ..inference.kv_cache import BlockKVCacheManager
+
+    paddle.seed(0)
+    st = FusedMultiTransformer(32, 4, 64, 2, num_kv_heads=2,
+                               max_position=64)
+    tp = TPContext.create(
+        st.num_heads, st.num_kv_heads, st.head_dim,
+        mesh=serving_mesh(2, devices=jax.devices("cpu")[:2]))
+    w_tp = tp.shard_stack(st._stack())
+    mgr = BlockKVCacheManager(st.num_layers, st.num_kv_heads,
+                              st.head_dim, page_size=4, num_pages=16,
+                              reserve_scratch=True, mp_degree=tp.mp,
+                              mesh=tp.mesh)
+    for i in range(2):
+        mgr.allocate(i, 8)
+    tables = mgr.block_tables(range(2), 4)
+    cache = mgr.fresh_cache()
+    cos, sin = rope_table(64, st.head_dim)
+    lens = jnp.array([6, 6], jnp.int32)
+    return st, tp, w_tp, cache, tables, cos, sin, lens
+
+
+def _build_tp_decode():
+    """The mp2 tensor-parallel decode step: the ONLY collectives the
+    partitioned HLO may carry are the per-layer psum pair (all-reduce
+    after the row-parallel O-proj and FFN2 — the reference's
+    fused_multi_transformer_op.cu:220,529 ring_id points); a gather
+    here means a weight/pool sharding annotation got dropped."""
+    import jax.numpy as jnp
+
+    from ..incubate.nn.fused_transformer import PagedKV
+
+    st, tp, w_tp, cache, tables, cos, sin, lens = _tp_serving_setup()
+    x = jnp.ones((2, st.embed_dim), jnp.float32)
+
+    def fn(w, xb, ck, cv):
+        h, cache2 = st.decode_raw(w, xb, PagedKV(ck, cv), tables,
+                                  lens, cos, sin, tp=tp)
+        return h, cache2.k, cache2.v
+
+    return fn, (w_tp, x, cache.k, cache.v)
+
+
+def _build_tp_prefill_chunk():
+    """The mp2 chunked-prefill program: same psum-only contract as the
+    decode site (the chunk attends to cached pages + its causal
+    triangle entirely shard-locally)."""
+    import jax.numpy as jnp
+
+    from ..incubate.nn.fused_transformer import PagedKV
+
+    st, tp, w_tp, cache, tables, cos, sin, _l = _tp_serving_setup()
+    x = jnp.ones((2, 4, st.embed_dim), jnp.float32)
+    start = jnp.zeros((2,), jnp.int32)
+    clens = jnp.full((2,), 4, jnp.int32)
+
+    def fn(w, xb, ck, cv):
+        h, cache2 = st.prefill_chunk_raw(
+            w, xb, PagedKV(ck, cv), tables, start, clens, cos, sin,
+            tp=tp)
+        return h, cache2.k, cache2.v
+
+    return fn, (w_tp, x, cache.k, cache.v)
+
+
 SPMD_SITES: List[SpmdSite] = [
     SpmdSite("mp.column_row_linear", _build_mp_linear,
              allowed=frozenset({"all-reduce"}),
@@ -300,6 +376,15 @@ SPMD_SITES: List[SpmdSite] = [
              allowed=frozenset({"collective-permute"})),
     SpmdSite("moe.expert_parallel", _build_moe_ep,
              allowed=frozenset({"all-to-all", "all-reduce"})),
+    # tensor-parallel serving (ISSUE 10): the TP decode/prefill
+    # programs declare their per-layer psum pair; shard_map fixes the
+    # output layout via out_specs (S-UNSPEC)
+    SpmdSite("tp.decode", _build_tp_decode,
+             allowed=frozenset({"all-reduce"}),
+             expects_constraint=True),
+    SpmdSite("tp.prefill_chunk", _build_tp_prefill_chunk,
+             allowed=frozenset({"all-reduce"}),
+             expects_constraint=True),
 ]
 
 

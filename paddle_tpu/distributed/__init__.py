@@ -24,6 +24,8 @@ from . import fleet  # noqa: F401
 from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F401
 from ..core.native import TCPStore  # noqa: F401  (native C++ store)
 from .check import CommWatchdog, watchdog  # noqa: F401
+from . import tp  # noqa: F401  (tensor-parallel serving mesh helpers)
+from .tp import TPContext, serving_mesh, split_kv_heads  # noqa: F401
 
 __all__ = [
     "ProcessMesh", "Placement", "Replicate", "Shard", "Partial",
@@ -36,6 +38,7 @@ __all__ = [
     "P2POp", "batch_isend_irecv", "gather", "barrier", "wait",
     "get_backend", "destroy_process_group", "ParallelEnv", "get_rank",
     "get_world_size", "DataParallel", "init_parallel_env", "is_initialized",
+    "TPContext", "serving_mesh", "split_kv_heads",
 ]
 from . import ps  # noqa: F401  (raise-stub surface, SURVEY §7.3)
 from . import rpc  # noqa: F401

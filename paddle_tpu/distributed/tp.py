@@ -1,0 +1,266 @@
+"""Tensor-parallel serving: the ``mp`` mesh axis for the decode stack.
+
+TPU-native equivalent of the reference's multi-rank fused-transformer
+serving (reference: ``fused_multi_transformer_op.cu:220,529`` — one
+``ring_id`` allreduce after each row-parallel matmul — driven by the
+multi-rank engine ``dist_model.cc:172``). Here the sharding is GSPMD
+``shard_map`` over a named ``mp`` axis:
+
+- **column-parallel** QKV and FFN1 (``[K, N/mp]`` shards — attention
+  heads partition naturally with the QKV columns),
+- **row-parallel** O-proj and FFN2 (``[K/mp, N]`` shards) whose partial
+  sums meet in exactly ONE ``psum`` per projection pair — two per
+  layer, the same two allreduce points as the reference; the sequential
+  pre-LN math admits no fewer without changing the model,
+- the **paged KV pool sharded by kv-head** (page tables are host-side
+  ints and stay replicated, so the paged-pool bookkeeping — prefix
+  cache, refcounts, preemption — is untouched by TP).
+
+GQA small-kv fallback: when ``mp`` does not divide ``num_kv_heads`` but
+``num_kv_heads`` divides ``mp``, each kv head is REPLICATED across
+``mp // num_kv_heads`` adjacent shards (each shard stores one kv head
+and computes that head's K/V redundantly); its query heads still
+partition, so weight/KV traffic stays ~1/mp per chip. Any other
+combination is a configuration error and raises early with the exact
+divisibility constraint.
+
+Weights are sharded AT LOAD: ``TPContext.shard_stack`` rearranges the
+stacked host arrays so each shard's block is contiguous (only the QKV
+stack needs a column gather — its q/k/v regions interleave per shard)
+and ``device_put``s them under a ``NamedSharding`` — no chip ever
+materializes the full stack.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+__all__ = ["split_kv_heads", "serving_mesh", "TPContext",
+           "shard_map_fn"]
+
+
+def shard_map_fn():
+    """shard_map across jax versions (jax >= 0.7 promotes it out of
+    experimental; 0.4.x only has the experimental home)."""
+    try:
+        from jax import shard_map as sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def split_kv_heads(num_kv_heads: int, mp: int):
+    """Per-shard kv-head layout for an ``mp``-way tensor-parallel pool.
+
+    Returns ``(kv_heads_per_shard, kv_replication)``:
+
+    - ``num_kv_heads % mp == 0`` → each shard owns a contiguous block of
+      ``num_kv_heads // mp`` heads (``kv_replication == 1``);
+    - ``mp % num_kv_heads == 0`` (GQA small-kv) → each kv head is
+      replicated over ``mp // num_kv_heads`` adjacent shards, one head
+      per shard (shard ``s`` holds head ``s // kv_replication``);
+    - anything else raises with the exact constraint (a silent shape
+      crash deep inside the pool scatter would be undebuggable).
+    """
+    mp = int(mp)
+    num_kv_heads = int(num_kv_heads)
+    if mp <= 1:
+        return num_kv_heads, 1
+    if num_kv_heads % mp == 0:
+        return num_kv_heads // mp, 1
+    if mp % num_kv_heads == 0:
+        return 1, mp // num_kv_heads
+    raise ValueError(
+        f"num_kv_heads={num_kv_heads} is not shardable over "
+        f"mp_degree={mp}: tensor-parallel serving needs "
+        f"num_kv_heads % mp == 0 (kv-head sharding) or "
+        f"mp % num_kv_heads == 0 (kv-head replication, the GQA "
+        f"small-kv fallback); pick an mp degree from the divisors/"
+        f"multiples of {num_kv_heads}")
+
+
+def serving_mesh(mp_degree: int, devices=None, axis: str = "mp"):
+    """A 1-D jax Mesh over the first ``mp_degree`` devices (or the
+    given ones) with the serving ``mp`` axis name."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    mp_degree = int(mp_degree)
+    if len(devices) < mp_degree:
+        raise ValueError(
+            f"mp_degree={mp_degree} needs {mp_degree} devices, "
+            f"have {len(devices)}")
+    return Mesh(np.array(devices[:mp_degree]), (axis,))
+
+
+#: stacked-weight name -> sharding layout kind. ``col3`` shards the
+#: output (last) axis of [L, K, N]; ``row3`` shards the contraction
+#: axis; ``col2`` shards per-output vectors [L, N]; ``rep`` replicates
+#: (LN params and the row-parallel biases/scales, which apply to the
+#: FULL output and are added once, after the psum).
+_STACK_LAYOUT = {
+    "qkv_weight": "col3", "qkv_bias": "col2", "qkv_scale": "col2",
+    "ffn1_weight": "col3", "ffn1_bias": "col2", "ffn1_scale": "col2",
+    "out_weight": "row3", "ffn2_weight": "row3",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TPContext:
+    """Resolved tensor-parallel geometry for one serving engine.
+
+    ``heads_per_shard`` / ``kv_heads_per_shard`` are what the per-shard
+    transformer view computes with; ``kv_replication`` > 1 marks the
+    GQA fallback (shard ``s`` holds kv head ``s // kv_replication``).
+    """
+
+    mesh: Any               # jax.sharding.Mesh with the mp axis
+    axis: str               # mesh axis name ("mp")
+    mp: int
+    num_heads: int          # global query heads
+    num_kv_heads: int       # global kv heads
+    head_dim: int
+    heads_per_shard: int
+    kv_heads_per_shard: int
+    kv_replication: int
+
+    @classmethod
+    def create(cls, num_heads: int, num_kv_heads: int, head_dim: int,
+               mp_degree: Optional[int] = None, mesh=None,
+               axis: str = "mp") -> Optional["TPContext"]:
+        """Resolve engine kwargs into a context (None = single-chip).
+
+        ``mesh`` may be a jax Mesh or anything with ``.jax_mesh()``
+        (e.g. a ProcessMesh); it must carry an ``mp``-named axis. With
+        only ``mp_degree`` given, a 1-D mesh over the first N devices
+        is built.
+        """
+        if mesh is None and (mp_degree is None or int(mp_degree) <= 1):
+            return None
+        if mesh is not None and hasattr(mesh, "jax_mesh"):
+            mesh = mesh.jax_mesh()
+        if mesh is None:
+            mesh = serving_mesh(int(mp_degree), axis=axis)
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"tensor-parallel mesh must carry an {axis!r} axis, "
+                f"got axes {tuple(mesh.axis_names)}")
+        mp = int(mesh.shape[axis])
+        if mp_degree is not None and int(mp_degree) != mp:
+            raise ValueError(
+                f"mp_degree={mp_degree} disagrees with the mesh's "
+                f"{axis!r} extent {mp}")
+        if mp <= 1:
+            return None
+        if num_heads % mp != 0:
+            raise ValueError(
+                f"num_heads={num_heads} must divide evenly over "
+                f"mp_degree={mp} (query heads partition with the QKV "
+                f"columns)")
+        kvs, repl = split_kv_heads(num_kv_heads, mp)
+        return cls(mesh=mesh, axis=axis, mp=mp, num_heads=num_heads,
+                   num_kv_heads=num_kv_heads, head_dim=head_dim,
+                   heads_per_shard=num_heads // mp,
+                   kv_heads_per_shard=kvs, kv_replication=repl)
+
+    # ---------------- specs ----------------
+
+    @property
+    def kv_pool_heads(self) -> int:
+        """GLOBAL kv-head extent of the sharded pool array: the
+        original head count when sharded, ``mp`` (one replicated head
+        per shard) in the GQA fallback."""
+        return self.kv_heads_per_shard * self.mp
+
+    def pspec(self, *parts):
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(*parts)
+
+    def sharding(self, *parts):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self.pspec(*parts))
+
+    def kv_spec(self):
+        """PartitionSpec of a pool side [L*P, kv_heads, page, hd]."""
+        return self.pspec(None, self.axis, None, None)
+
+    def stack_spec(self, name: str):
+        """PartitionSpec for one stacked-weight entry (shard_map
+        in_spec / device placement)."""
+        kind = _STACK_LAYOUT.get(name, "rep")
+        if kind == "col3":
+            return self.pspec(None, None, self.axis)
+        if kind == "row3":
+            return self.pspec(None, self.axis, None)
+        if kind == "col2":
+            return self.pspec(None, self.axis)
+        return self.pspec()
+
+    def replicate(self, arr):
+        """device_put an operand replicated over the mesh (mixing
+        single-device-committed arrays with mesh-sharded ones in one
+        jit call is an error; replicating once at engine init also
+        avoids a per-call host transfer)."""
+        import jax
+
+        return jax.device_put(arr, self.sharding())
+
+    # ---------------- weight rearrangement ----------------
+
+    def qkv_col_index(self):
+        """Column gather index making each shard's QKV block contiguous.
+
+        The stacked QKV output axis is ``[q0..qH-1, k0..k{nkv}-1,
+        v0..]`` (head-major, ``head_dim`` wide each); shard ``s`` needs
+        ``[q of its heads, k of its kv heads, v of its kv heads]``
+        contiguous so a plain even split of the LAST axis is the shard
+        layout. In the GQA fallback the kv columns are DUPLICATED per
+        replica shard, so the rearranged width grows to
+        ``mp * (heads_per_shard + 2) * head_dim``.
+        """
+        import numpy as np
+
+        hd = self.head_dim
+        H, nkv = self.num_heads, self.num_kv_heads
+        Hs, kvs = self.heads_per_shard, self.kv_heads_per_shard
+        within = np.arange(hd)
+        cols = []
+        for s in range(self.mp):
+            qh = np.arange(s * Hs, (s + 1) * Hs)
+            if self.kv_replication == 1:
+                kvh = np.arange(s * kvs, (s + 1) * kvs)
+            else:
+                kvh = np.array([s // self.kv_replication])
+            cols.append((qh[:, None] * hd + within).ravel())
+            cols.append((H * hd) + (kvh[:, None] * hd + within).ravel())
+            cols.append(((H + nkv) * hd)
+                        + (kvh[:, None] * hd + within).ravel())
+        return np.concatenate(cols)
+
+    def shard_stack(self, weights: dict) -> dict:
+        """Per-shard stacked weights, sharded AT LOAD: rearrange on the
+        host (only ``qkv_*`` needs the column gather) and ``device_put``
+        each stack under its NamedSharding — every chip receives only
+        its ``[K, N/mp]`` / ``[K/mp, N]`` slice, never the full stack.
+        """
+        import numpy as np
+
+        import jax
+
+        qkv_idx = None
+        out = {}
+        for name, arr in weights.items():
+            a = np.asarray(arr)
+            if name.startswith("qkv_"):
+                if qkv_idx is None:
+                    qkv_idx = self.qkv_col_index()
+                a = np.take(a, qkv_idx, axis=-1)
+            out[name] = jax.device_put(
+                a, self.sharding(*self.stack_spec(name)))
+        return out

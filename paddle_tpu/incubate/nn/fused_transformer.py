@@ -222,7 +222,7 @@ class FusedMultiTransformer(Layer):
         return int8_dot_dequant(xq, xs, w_q, scale)
 
     def _layer_body(self, w, h, positions, kv_write, attend, cos_t,
-                    sin_t, linear=None, a8w8=False):
+                    sin_t, linear=None, a8w8=False, psum_axis=None):
         """One pre-LN transformer layer over hidden ``h`` (any leading
         dims). Compute dtype FOLLOWS h (bf16 weights + bf16 h → pure
         bf16 MXU dots; LN statistics promote to fp32 internally and are
@@ -230,19 +230,30 @@ class FusedMultiTransformer(Layer):
         append+attend kernel path, where kv_write is skipped.
         ``linear(x, kind)`` computes x @ W_kind + bias (int8 scales
         applied) — the decode loop overrides it with the weight-
-        streaming kernel over UNSLICED stacked weights."""
+        streaming kernel over UNSLICED stacked weights.
+
+        ``psum_axis``: tensor-parallel shard body (inside shard_map) —
+        the row-parallel O-proj and FFN2 partial sums meet in one
+        ``psum`` per projection pair BEFORE the (replicated) bias adds,
+        the two per-layer allreduce points of the reference
+        (fused_multi_transformer_op.cu:220,529). Per-output-channel
+        int8 scales commute with the sum, so dequant stays per-shard."""
         eps = self.epsilon
         if linear is None:
             if a8w8:
-                def linear(x, kind):
+                def raw(x, kind):
                     return self._mm_a8w8(x, w[f"{kind}_weight"],
-                                         w[f"{kind}_scale"]) \
-                        + w[f"{kind}_bias"]
+                                         w[f"{kind}_scale"])
             else:
-                def linear(x, kind):
+                def raw(x, kind):
                     return self._mm(x, w[f"{kind}_weight"],
-                                    w.get(f"{kind}_scale")) \
-                        + w[f"{kind}_bias"]
+                                    w.get(f"{kind}_scale"))
+
+            def linear(x, kind):
+                y = raw(x, kind)
+                if psum_axis is not None and kind in ("out", "ffn2"):
+                    y = jax.lax.psum(y, psum_axis)
+                return y + w[f"{kind}_bias"]
         hn = self._ln(h, w["ln1_scale"], w["ln1_bias"], eps) \
             .astype(h.dtype)
         proj = linear(hn, "qkv")
@@ -282,8 +293,68 @@ class FusedMultiTransformer(Layer):
     def _pool_page_size(self, cache: PagedKV) -> int:
         return self._pool_data(cache.k).shape[2]
 
+    # ---------- tensor parallelism (mp mesh axis) ----------
+
+    def _tp_view(self, tp) -> "FusedMultiTransformer":
+        """Per-shard view for the shard_map body: the same stack config
+        with PER-SHARD head counts (query heads partition with the QKV
+        columns; kv heads shard — or replicate one head per shard in
+        the GQA fallback). No parameters are attached: the raw methods
+        only read config attrs and the weights they are handed."""
+        v = object.__new__(FusedMultiTransformer)
+        for n in ("embed_dim", "head_dim", "dim_feedforward",
+                  "num_layers", "activation", "epsilon", "rope_theta",
+                  "max_position"):
+            object.__setattr__(v, n, getattr(self, n))
+        object.__setattr__(v, "num_heads", tp.heads_per_shard)
+        object.__setattr__(v, "num_kv_heads", tp.kv_heads_per_shard)
+        return v
+
+    def _tp_wrap(self, tp, method: str, weights, x, cache, tables,
+                 rep_args, cos_t, sin_t, a8w8):
+        """shard_map a raw phase over the ``mp`` axis: weights enter
+        pre-sharded (TPContext.shard_stack specs), the KV pool sharded
+        by kv-head, everything else — hidden state, block tables,
+        seq_lens/positions, rope tables — replicated. The body is the
+        SAME raw method on the per-shard view with ``psum_axis`` set,
+        so each column→row projection pair contributes exactly one
+        psum."""
+        from ...distributed.tp import shard_map_fn
+
+        if cache is None:
+            raise ValueError(
+                "tensor-parallel prefill needs a paged cache (the "
+                "dense training/eval path is single-chip)")
+        if isinstance(weights, (list, tuple)):
+            raise ValueError(
+                "tensor-parallel decode takes the stacked weight dict "
+                "(per-layer lists do not carry shard specs)")
+        if isinstance(cache.k, tuple):
+            raise NotImplementedError(
+                "int8 cache-KV is not supported under tensor "
+                "parallelism yet — serve TP with a bf16/f32 pool")
+        view = self._tp_view(tp)
+        rep = tp.pspec()
+        wspecs = {n: tp.stack_spec(n) for n in weights}
+        kv = tp.kv_spec()
+
+        def body(w, xb, ck, cv, tbl, cos, sin, *extras):
+            h, cache2 = getattr(view, method)(
+                w, xb, PagedKV(ck, cv), tbl, *extras, cos, sin,
+                a8w8=a8w8, psum_axis=tp.axis)
+            return h, cache2.k, cache2.v
+
+        fn = shard_map_fn()(
+            body, mesh=tp.mesh,
+            in_specs=(wspecs, rep, kv, kv, rep, rep, rep)
+            + (rep,) * len(rep_args),
+            out_specs=(rep, kv, kv), check_rep=False)
+        h, nk, nv = fn(weights, x, cache.k, cache.v, tables,
+                       cos_t, sin_t, *rep_args)
+        return h, PagedKV(nk, nv)
+
     def prefill_raw(self, weights, x, cache, block_tables, cos_t, sin_t,
-                    a8w8=False):
+                    a8w8=False, tp=None, psum_axis=None):
         """Prompt pass: x [b, s, d] → (hidden [b, s, d], filled cache).
 
         Causal dense attention (flash-fusable by XLA/Pallas); each
@@ -294,10 +365,18 @@ class FusedMultiTransformer(Layer):
         padding is causal-safe for the suffix tokens actually decoded).
         ``a8w8``: run the four matmuls with per-token dynamic int8
         activations against the int8 weight stack (``_mm_a8w8``).
+
+        ``tp``: a distributed.tp.TPContext — shard the whole pass over
+        the ``mp`` mesh axis (weights from TPContext.shard_stack, pool
+        kv-head-sharded). ``psum_axis`` is the internal per-shard form
+        (set by the shard_map wrapper, not callers).
         """
         if a8w8 and self._weights_dtype(weights) != jnp.int8:
             raise ValueError("a8w8 prefill needs an int8 weight stack "
                              "(quantize_weight_only_int8 first)")
+        if tp is not None:
+            return self._tp_wrap(tp, "prefill_raw", weights, x, cache,
+                                 block_tables, (), cos_t, sin_t, a8w8)
         b, s, d = x.shape
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
         group = self.num_heads // self.num_kv_heads
@@ -312,7 +391,7 @@ class FusedMultiTransformer(Layer):
             def body(h, w):
                 h, _, _ = self._layer_body(
                     w, h, positions, lambda k, v: (None, None), attend,
-                    cos_t, sin_t, a8w8=a8w8)
+                    cos_t, sin_t, a8w8=a8w8, psum_axis=psum_axis)
                 return h, None
 
             h, _ = jax.lax.scan(body, x, weights)
@@ -328,7 +407,7 @@ class FusedMultiTransformer(Layer):
             h, ck, cv = self._layer_body(
                 w, h, positions,
                 lambda k, v: write_prefill_kv_pages(ck, cv, k, v, tbl),
-                attend, cos_t, sin_t, a8w8=a8w8)
+                attend, cos_t, sin_t, a8w8=a8w8, psum_axis=psum_axis)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(
@@ -336,7 +415,8 @@ class FusedMultiTransformer(Layer):
         return h, PagedKV(nk, nv)
 
     def prefill_chunk_raw(self, weights, x, cache, block_tables, start,
-                          chunk_lens, cos_t, sin_t, a8w8=False):
+                          chunk_lens, cos_t, sin_t, a8w8=False,
+                          tp=None, psum_axis=None):
         """CHUNKED prompt pass: x [b, c, d] embeds tokens at positions
         ``start[b] .. start[b]+c-1`` of sequences whose earlier tokens
         (previous chunks, or a shared prefix mapped by the prefix
@@ -355,6 +435,11 @@ class FusedMultiTransformer(Layer):
         if a8w8 and self._weights_dtype(weights) != jnp.int8:
             raise ValueError("a8w8 prefill needs an int8 weight stack "
                              "(quantize_weight_only_int8 first)")
+        if tp is not None:
+            return self._tp_wrap(tp, "prefill_chunk_raw", weights, x,
+                                 cache, block_tables,
+                                 (start, chunk_lens), cos_t, sin_t,
+                                 a8w8)
         from ...nn.functional.paged_attention import (
             gather_kv_pages, write_prefill_kv_pages)
 
@@ -409,7 +494,7 @@ class FusedMultiTransformer(Layer):
 
             h, ck, cv = self._layer_body(
                 w, h, positions, kv_write, attend, cos_t, sin_t,
-                a8w8=a8w8)
+                a8w8=a8w8, psum_axis=psum_axis)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(
@@ -429,7 +514,8 @@ class FusedMultiTransformer(Layer):
                 for l in range(self.num_layers)]
 
     def decode_raw(self, weights, x, cache: PagedKV, block_tables,
-                   seq_lens, cos_t, sin_t, a8w8=False):
+                   seq_lens, cos_t, sin_t, a8w8=False, tp=None,
+                   psum_axis=None):
         """One decode step: x [b, d] token embeddings, seq_lens [b] =
         tokens already cached (the new token's position). Returns
         (hidden [b, d], cache').
@@ -454,10 +540,27 @@ class FusedMultiTransformer(Layer):
         ``a8w8``: activations dynamically quantized per token into the
         int8 x int8 streamed matmuls (stream_linear act_quant path) —
         requires the int8 weight stack.
+
+        TENSOR PARALLELISM (``tp``, a distributed.tp.TPContext): the
+        whole step runs under shard_map over the ``mp`` mesh axis —
+        per-shard query/kv heads, a kv-head-sharded pool, and each
+        column→row projection pair meeting in exactly one ``psum``
+        (two per layer: after the row-parallel O-proj and FFN2, the
+        reference's fused_multi_transformer_op.cu:220,529 ring_id
+        allreduce points). The per-shard matmuls go through
+        ``stream_linear`` so every chip streams only its [K, N/mp] /
+        [K/mp, N] weight slice — TP decode keeps the per-chip
+        weight-bandwidth roofline; the fused grouped tail is split at
+        the psum boundaries (a collective cannot live inside one
+        Pallas grid). ``psum_axis`` is the internal per-shard form.
         """
         if a8w8 and self._weights_dtype(weights) != jnp.int8:
             raise ValueError("a8w8 decode needs an int8 weight stack "
                              "(quantize_weight_only_int8 first)")
+        if tp is not None:
+            return self._tp_wrap(tp, "decode_raw", weights, x, cache,
+                                 block_tables, (seq_lens,), cos_t,
+                                 sin_t, a8w8)
         npages = self._pages_per_layer(cache)
         lens1 = (seq_lens + 1).astype(jnp.int32)
         # token-level pool ownership (the stream kernels' mask) is
@@ -531,6 +634,52 @@ class FusedMultiTransformer(Layer):
             return _split_rope(qkv.astype(h.dtype), seq_lens,
                                self.num_heads, self.num_kv_heads,
                                self.head_dim, cos_t, sin_t)
+
+        if psum_axis is not None:
+            # tensor-parallel shard body: four streamed per-shard
+            # matmuls per layer (QKV / O / FFN1 / FFN2 slices), the two
+            # row-parallel ones reduced over mp INSIDE stream_linear
+            # (reduce_axis psums the f32 partial before the replicated
+            # bias + activation — the collective stays fused with the
+            # projection instead of breaking the decode stream). The
+            # fused grouped tail cannot span a psum, so TP grouping
+            # splits at the two collective points.
+            L = self.num_layers
+
+            def small(name, l):
+                return jax.lax.dynamic_index_in_dim(
+                    weights[name], l, 0, False)
+
+            def lin(xx, kind, l, **kw):
+                return stream_linear(
+                    xx, weights[f"{kind}_weight"], layer=l,
+                    scale=weights.get(f"{kind}_scale"),
+                    act_quant=a8w8, out_dtype=xx.dtype, **kw)
+
+            def body(l, carry):
+                h, ck, cv = carry
+                hn = self._ln(h, small("ln1_scale", l),
+                              small("ln1_bias", l),
+                              self.epsilon).astype(h.dtype)
+                qkv = lin(hn, "qkv", l, bias=weights["qkv_bias"])
+                q, k, v = split_rope(qkv, h)
+                att, ck, cv = attend_fn(q, k, v, ck, cv, block_tables,
+                                        l * npages)
+                att = att.reshape(*h.shape[:-1], d_att).astype(h.dtype)
+                h = (h + lin(att, "out", l, bias=weights["out_bias"],
+                             reduce_axis=psum_axis)).astype(h.dtype)
+                hn = self._ln(h, small("ln2_scale", l),
+                              small("ln2_bias", l),
+                              self.epsilon).astype(h.dtype)
+                ff = lin(hn, "ffn1", l, bias=weights["ffn1_bias"],
+                         activation=self.activation)
+                h = (h + lin(ff, "ffn2", l, bias=weights["ffn2_bias"],
+                             reduce_axis=psum_axis)).astype(h.dtype)
+                return h, ck, cv
+
+            h, nk, nv = jax.lax.fori_loop(
+                0, L, body, (x, cache.k, cache.v))
+            return h, PagedKV(nk, nv)
 
         if use_grouped and isinstance(weights, (list, tuple)):
             # unstacked grouped loop: per-layer dicts, python-unrolled

@@ -120,7 +120,8 @@ class GenerationEngine:
     def __init__(self, model: FusedCausalLM, page_size: int = 16,
                  max_length: int = 1024, num_pages: Optional[int] = None,
                  decode_chunk: Optional[int] = None, kv_dtype=None,
-                 quant: Optional[str] = None):
+                 quant: Optional[str] = None, mesh=None,
+                 mp_degree: Optional[int] = None):
         self.model = model
         st = model.stack
         self.max_length = max_length
@@ -128,11 +129,13 @@ class GenerationEngine:
         self.decode_chunk = _resolve_decode_chunk(decode_chunk)
         self._cos, self._sin = rope_table(st.max_position, st.head_dim,
                                           st.rope_theta)
-        self._init_serving_state(kv_dtype, quant)
+        self._init_serving_state(kv_dtype, quant, mesh=mesh,
+                                 mp_degree=mp_degree)
         self._num_pages = num_pages
         self._mgr = None
 
-    def _init_serving_state(self, kv_dtype, quant=None):
+    def _init_serving_state(self, kv_dtype, quant=None, mesh=None,
+                            mp_degree=None):
         """Serving dtype discipline + compiled-program holders (shared
         with ContinuousBatchingEngine): the COMPUTE dtype follows the
         stack weights (cast them bf16 for the bandwidth-bound serving
@@ -145,11 +148,25 @@ class GenerationEngine:
         ``quant``: None | "int8" (weight-only) | "a8w8" (weight-only
         int8 PLUS per-token dynamic int8 activations into int8 x int8
         matmuls). Both quantize the model's stack IN PLACE when it is
-        not already int8."""
+        not already int8.
+
+        ``mesh`` / ``mp_degree``: tensor-parallel serving over an
+        ``mp`` mesh axis (distributed/tp.py). The stacked weights are
+        sharded AT LOAD — column/row slices per chip, the QKV columns
+        rearranged so attention heads partition with them — the KV
+        pool shards by kv-head, and every decode/prefill program runs
+        under shard_map with exactly one psum per column→row
+        projection pair. Rungs report with an ``,mp=N`` suffix and
+        ``dist.mp_degree`` lands in telemetry."""
         if quant not in (None, "int8", "a8w8"):
             raise ValueError(
                 f"quant={quant!r}: expected None, 'int8' or 'a8w8'")
         st = self.model.stack
+        from ..distributed.tp import TPContext
+
+        self._tp = TPContext.create(
+            st.num_heads, st.num_kv_heads, st.head_dim,
+            mp_degree=mp_degree, mesh=mesh)
         if quant is not None and \
                 st.qkv_weight._data.dtype != jnp.int8:
             st.quantize_weight_only_int8()
@@ -159,6 +176,19 @@ class GenerationEngine:
         self._kv_dtype = kv_dtype or self._cdtype
         self._head_t = jnp.array(self.model.embed._data.T) \
             .astype(self._cdtype)
+        if self._tp is not None:
+            # shard-at-load: per-chip column/row weight slices; the
+            # replicated operands (embed, lm head, final LN) are
+            # device_put once so no per-call host transfer (and no
+            # mixing of single-device-committed arrays into the
+            # mesh-sharded programs)
+            tp = self._tp
+            self._tp_weights = tp.shard_stack(st._stack())
+            self._head_t = tp.replicate(self._head_t)
+            self._embed_tp = tp.replicate(self.model.embed._data)
+            self._lnf_tp = (tp.replicate(self.model.lnf_scale._data),
+                            tp.replicate(self.model.lnf_bias._data))
+            _stats.set_gauge("dist.mp_degree", tp.mp)
         # roofline rung names: A8W8 programs report under their own
         # ``decode.a8w8``/``prefill.a8w8`` keys, and the grouped
         # weight-stream path (FLAGS_decode_grouped, the r6 default for
@@ -185,9 +215,37 @@ class GenerationEngine:
         # weight+KV traffic) feeds the roofline telemetry
         # (profiler/roofline.py) instead of a hand-derived byte count.
         self._prefill = _roofline.AotProgram(
-            "prefill.a8w8" if self._a8w8 else "prefill",
+            ("prefill.a8w8" if self._a8w8 else "prefill")
+            + self._mp_suffix(),
             jax.jit(self._prefill_fn, donate_argnums=(7, 8)))
         self._decode_k_jit = {}
+
+    def _mp_suffix(self) -> str:
+        """``[mp=N]`` rung suffix under tensor parallelism (README
+        metric conventions; composes as ``[k=*,mp=N]`` on decode)."""
+        return f"[mp={self._tp.mp}]" if self._tp is not None else ""
+
+    def _decode_rung(self, k: int) -> str:
+        """Roofline rung name of the k-step decode program —
+        ``decode.bf16_grouped[k=8,mp=2]``-shaped under TP."""
+        mp = f",mp={self._tp.mp}" if self._tp is not None else ""
+        return f"{self._decode_tag}[k={k}{mp}]"
+
+    def _weights(self):
+        """The decode/prefill weight-stack operand: the shard-at-load
+        TP stacks when a mesh is configured, the model's plain stacked
+        dict otherwise (fresh dict of the same arrays — cheap)."""
+        return self._tp_weights if self._tp is not None \
+            else self.model.stack._stack()
+
+    def _embed(self):
+        return self._embed_tp if self._tp is not None \
+            else self.model.embed._data
+
+    def _lnf(self):
+        if self._tp is not None:
+            return self._lnf_tp
+        return (self.model.lnf_scale._data, self.model.lnf_bias._data)
 
     def _get_decode_k(self, k: int, sample_cfg=None):
         """One compiled program per (chunk size, greedy-vs-sample,
@@ -198,7 +256,7 @@ class GenerationEngine:
             import functools
 
             self._decode_k_jit[key] = _roofline.AotProgram(
-                f"{self._decode_tag}[k={k}]",
+                self._decode_rung(k),
                 jax.jit(functools.partial(self._decode_k_fn, k=k,
                                           sample_cfg=sample_cfg),
                         donate_argnums=(7, 8)))
@@ -243,7 +301,7 @@ class GenerationEngine:
         x = embed[ids].astype(self._cdtype)
         h, cache = st.prefill_raw(
             weights, x, PagedKV(cache_k, cache_v), tables,
-            self._cos, self._sin, a8w8=self._a8w8)
+            self._cos, self._sin, a8w8=self._a8w8, tp=self._tp)
         hl = h[jnp.arange(h.shape[0]), seq_lens - 1]
         logits = self._logits(hl, head_t, lnf_s, lnf_b)
         return logits, cache.k, cache.v
@@ -319,7 +377,7 @@ class GenerationEngine:
             x = embed[tok].astype(self._cdtype)
             h, cache = st.decode_raw(
                 weights, x, PagedKV(ck, cv), tables, lens,
-                self._cos, self._sin, a8w8=self._a8w8)
+                self._cos, self._sin, a8w8=self._a8w8, tp=self._tp)
             logits = self._logits(h, head_t, lnf_s, lnf_b)
             nxt = self._pick_token(logits, jax.random.fold_in(key, i),
                                    cfg)
@@ -399,7 +457,9 @@ class GenerationEngine:
         self._mgr = BlockKVCacheManager(
             st.num_layers, st.num_kv_heads, st.head_dim, self.page_size,
             num_pages=_round_pool_pages(requested, self.page_size),
-            dtype=self._kv_dtype, reserve_scratch=True)
+            dtype=self._kv_dtype, reserve_scratch=True,
+            mp_degree=self._tp.mp if self._tp else 1,
+            mesh=self._tp.mesh if self._tp else None)
         _stats.set_gauge("inference.pool_pages_requested", requested)
         _stats.set_gauge("inference.pool_pages", self._mgr.num_pages)
         for i in range(b):
@@ -407,10 +467,9 @@ class GenerationEngine:
         tables = self._mgr.block_tables(range(b), pages_per_seq)
         cache = self._mgr.fresh_cache()
 
-        weights = self.model.stack._stack()
-        embed = self.model.embed._data
-        lnf_s, lnf_b = (self.model.lnf_scale._data,
-                        self.model.lnf_bias._data)
+        weights = self._weights()
+        embed = self._embed()
+        lnf_s, lnf_b = self._lnf()
 
         _stats.inc("inference.prefills")
         self._count_a8w8(1)
@@ -457,6 +516,11 @@ class GenerationEngine:
             self._count_a8w8(k)
             _stats.set_gauge("inference.kv_pages_in_use",
                              self._mgr.num_pages - self._mgr.free_pages)
+            if self._tp is not None:
+                # re-stamped per chunk: benches reset the registry
+                # after warmup, and the TP degree must survive into
+                # the measured telemetry block
+                _stats.set_gauge("dist.mp_degree", self._tp.mp)
             import time as _time
 
             t0 = _time.perf_counter()
@@ -468,7 +532,7 @@ class GenerationEngine:
             toks_np = np.asarray(toks)
             # honest wall time: the np.asarray fetch synced the chunk,
             # so this roofline reflects executed work, not dispatch
-            _roofline.analyze(f"{self._decode_tag}[k={k}]",
+            _roofline.analyze(self._decode_rung(k),
                               _time.perf_counter() - t0)
             for j in range(k):
                 col = toks_np[:, j].astype(ids.dtype)
@@ -539,7 +603,8 @@ class ContinuousBatchingEngine:
                  decode_chunk: Optional[int] = None,
                  prompt_bucket: int = 16, kv_dtype=None,
                  quant: Optional[str] = None, admit_window: int = 8,
-                 starvation_bound: int = 16):
+                 starvation_bound: int = 16, mesh=None,
+                 mp_degree: Optional[int] = None):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_length = int(max_length)
@@ -557,14 +622,18 @@ class ContinuousBatchingEngine:
         self._gen.max_length = self.max_length
         self._gen.page_size = self.page_size
         self._gen.decode_chunk = self.decode_chunk
-        self._gen._init_serving_state(kv_dtype, quant)
+        self._gen._init_serving_state(kv_dtype, quant, mesh=mesh,
+                                      mp_degree=mp_degree)
         st = model.stack
         self._pages_per_seq = -(-self.max_length // self.page_size)
         requested = (num_pages or self.max_batch * self._pages_per_seq) + 1
+        tp = self._gen._tp
         self._mgr = BlockKVCacheManager(
             st.num_layers, st.num_kv_heads, st.head_dim, self.page_size,
             num_pages=_round_pool_pages(requested, self.page_size),
-            dtype=self._gen._kv_dtype, reserve_scratch=True)
+            dtype=self._gen._kv_dtype, reserve_scratch=True,
+            mp_degree=tp.mp if tp else 1,
+            mesh=tp.mesh if tp else None)
         _stats.set_gauge("serving.pool_pages_requested", requested)
         _stats.set_gauge("serving.pool_pages", self._mgr.num_pages)
         cache = self._mgr.fresh_cache()
@@ -634,22 +703,25 @@ class ContinuousBatchingEngine:
         _stats.set_gauge("serving.kv_pages_in_use",
                          self._mgr.num_pages - self._mgr.free_pages)
         _stats.set_gauge("serving.active_slots", len(active))
+        if self._gen._tp is not None:
+            # survives post-warmup stats.reset() in the benches
+            _stats.set_gauge("dist.mp_degree", self._gen._tp.mp)
 
-        m = self.model
         cur = np.where([r is not None for r in self._slots],
                        self._lens - 1, 0).astype(np.int64)
         import time as _time
 
+        lnf_s, lnf_b = self._gen._lnf()
         t0 = _time.perf_counter()
         toks, self._ck, self._cv = self._gen._get_decode_k(k)(
-            m.stack._stack(), m.embed._data,
-            self._gen._head_t, m.lnf_scale._data, m.lnf_bias._data,
+            self._gen._weights(), self._gen._embed(),
+            self._gen._head_t, lnf_s, lnf_b,
             jnp.asarray(self._last_tok, jnp.int32),
             jnp.asarray(cur, jnp.int32),
             self._ck, self._cv, tables)
         toks_np = np.asarray(toks)
         # synced by the fetch above — an honest per-chunk roofline
-        _roofline.analyze(f"{self._gen._decode_tag}[k={k}]",
+        _roofline.analyze(self._gen._decode_rung(k),
                           _time.perf_counter() - t0)
 
         done_now = []
@@ -771,7 +843,6 @@ class ContinuousBatchingEngine:
         ``i``. (The serving frontend overrides this with chunked
         prefill: the prompt fills in fixed-size chunks interleaved with
         decode steps instead of one monolithic program.)"""
-        m = self.model
         self._slots[i] = req
         _stats.inc("serving.admitted")
         self._gen._count_a8w8(1)
@@ -784,9 +855,10 @@ class ContinuousBatchingEngine:
         s_pad = -(-L // bs) * bs
         ids = np.zeros((1, s_pad), np.int32)
         ids[0, :L] = req.prompt
+        lnf_s, lnf_b = self._gen._lnf()
         logits, self._ck, self._cv = self._gen._prefill(
-            m.stack._stack(), m.embed._data, self._gen._head_t,
-            m.lnf_scale._data, m.lnf_bias._data, jnp.asarray(ids),
+            self._gen._weights(), self._gen._embed(),
+            self._gen._head_t, lnf_s, lnf_b, jnp.asarray(ids),
             jnp.asarray([L], jnp.int32), self._ck, self._cv, tables)
         t = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
         req.generated.append(t)

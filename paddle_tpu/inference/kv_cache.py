@@ -39,7 +39,8 @@ class BlockKVCacheManager:
 
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
                  page_size: int = 16, num_pages: int = 512,
-                 dtype=jnp.float32, reserve_scratch: bool = False):
+                 dtype=jnp.float32, reserve_scratch: bool = False,
+                 mp_degree: int = 1, mesh=None, mp_axis: str = "mp"):
         self.num_layers = num_layers
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
@@ -54,6 +55,32 @@ class BlockKVCacheManager:
         if isinstance(dtype, str) and dtype != "int8":
             dtype = jnp.dtype(dtype)
         self.dtype = dtype
+        # tensor parallelism (mp_degree > 1): the pool's kv-head axis
+        # shards over the mesh's mp axis — each shard stores only
+        # num_kv_heads // mp heads (or ONE replicated head per shard in
+        # the GQA small-kv fallback, mp % num_kv_heads == 0; any other
+        # combination raises here with the exact divisibility
+        # constraint instead of shape-crashing in the pool scatter).
+        # Page tables are host-side ints and stay replicated, so every
+        # page-level mechanism (prefix sharing, refcounts, preemption)
+        # is TP-oblivious.
+        self.mp_degree = max(int(mp_degree or 1), 1)
+        self.mp_axis = mp_axis
+        self._mesh = mesh
+        if self.mp_degree > 1:
+            from ..distributed.tp import split_kv_heads
+
+            self.kv_heads_per_shard, self.kv_replication = \
+                split_kv_heads(num_kv_heads, self.mp_degree)
+        else:
+            self.kv_heads_per_shard = num_kv_heads
+            self.kv_replication = 1
+        self._pool_heads = self.kv_heads_per_shard * self.mp_degree
+        if self._mesh is not None and \
+                (self.dtype == "int8" or self.dtype == jnp.int8):
+            raise NotImplementedError(
+                "int8 cache-KV is not supported under tensor "
+                "parallelism yet — serve TP with a bf16/f32 pool")
         # reserve_scratch: page 0 is never handed out, so block-table
         # padding entries (0) and idle continuous-batching slots can
         # write/read it without clobbering a live sequence
@@ -70,16 +97,27 @@ class BlockKVCacheManager:
         # per-token-per-head f32 scale PLANES [n_kv, pages*page_size]
         # (lane-major so the decode kernel applies them as logits-column
         # multiplies; see paged_decode_attention_inplace_q)
-        shape = (self.num_layers * self.num_pages, self.num_kv_heads,
+        shape = (self.num_layers * self.num_pages, self._pool_heads,
                  self.page_size, self.head_dim)
         if self.dtype == "int8" or self.dtype == jnp.int8:
-            plane = (self.num_kv_heads,
+            plane = (self._pool_heads,
                      self.num_layers * self.num_pages * self.page_size)
             return PagedKV(
                 (jnp.zeros(shape, jnp.int8),
                  jnp.zeros(plane, jnp.float32)),
                 (jnp.zeros(shape, jnp.int8),
                  jnp.zeros(plane, jnp.float32)))
+        if self._mesh is not None:
+            # kv-head-sharded pool: allocated directly under its
+            # NamedSharding so no chip ever holds the full pool
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(
+                self._mesh, P(None, self.mp_axis, None, None))
+            zero = jax.jit(lambda: jnp.zeros(shape, self.dtype),
+                           out_shardings=sh)
+            return PagedKV(zero(), zero())
         return PagedKV(jnp.zeros(shape, self.dtype),
                        jnp.zeros(shape, self.dtype))
 

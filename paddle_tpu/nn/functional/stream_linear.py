@@ -61,6 +61,15 @@ tail accepts int8/a8w8 weight stacks too, but runs their GEMMs via
 in-kernel dequant (weight-only math): the weight STREAM — the bound
 resource — stays int8, only the MXU math is bf16, so ``auto`` routing
 keeps full A8W8 on the ungrouped act-quant kernel.
+
+Tensor parallelism: under the serving ``mp`` mesh (distributed/tp.py,
+shard_map), every call streams a PER-SHARD slice — column-parallel
+callers pass [K, N/mp] blocks (bias/scale shard along), row-parallel
+callers pass [K/mp, N] with ``reduce_axis="mp"`` so the f32 partial is
+psum'd before the replicated bias/activation (the collective stays
+fused with the projection call). Per chip the streamed bytes are
+exactly 1/mp of the stack, so TP decode keeps its weight-bandwidth
+roofline per chip instead of re-streaming replicated full matrices.
 """
 from __future__ import annotations
 
@@ -238,7 +247,8 @@ def _stream_linear_act_quant(x, w, layer, bias, scale, activation,
 
 
 def stream_linear(x, w, layer=None, bias=None, scale=None,
-                  activation=None, out_dtype=None, act_quant=False):
+                  activation=None, out_dtype=None, act_quant=False,
+                  reduce_axis=None):
     """x [M, K] @ w[(L,) K, N] (+ bias) with streamed weights.
 
     layer: traced int32 index when w/bias/scale are layer-stacked.
@@ -247,6 +257,12 @@ def stream_linear(x, w, layer=None, bias=None, scale=None,
     act_quant: A8W8 — dynamically quantize x per token (absmax int8 +
     f32 scale) and run the GEMM int8 x int8 with int32 accumulation;
     requires int8 ``w`` with per-output-channel ``scale``.
+    reduce_axis: ROW-PARALLEL tensor-parallel form (inside shard_map):
+    ``w`` is this shard's [K/mp, N] slice — the f32 partial product is
+    ``psum``'d over the named mesh axis BEFORE the (replicated) bias
+    add and activation, so the collective stays fused with the
+    projection call (per-output-channel int8 dequant scales commute
+    with the sum and stay per-shard, inside the streamed kernel).
     Returns [M, N] in out_dtype (default: x.dtype).
     """
     from jax.experimental import pallas as pl
@@ -256,6 +272,16 @@ def stream_linear(x, w, layer=None, bias=None, scale=None,
     stacked = w.ndim == 3
     N = w.shape[-1]
     out_dtype = out_dtype or x.dtype
+    if reduce_axis is not None:
+        part = stream_linear(x, w, layer=layer, bias=None, scale=scale,
+                             activation=None, out_dtype=jnp.float32,
+                             act_quant=act_quant)
+        out = jax.lax.psum(part, reduce_axis)
+        if bias is not None:
+            b = bias[0 if layer is None else layer] if stacked else bias
+            out = out + b.astype(jnp.float32)
+        out = _apply_activation(out, activation)
+        return out.astype(out_dtype)
     if act_quant:
         if w.dtype != jnp.int8 or scale is None:
             raise ValueError(

@@ -527,6 +527,13 @@ class ServingEngine(ContinuousBatchingEngine):
         return min(self._prefilling,
                    key=lambda i: self._urgency(self._prefilling[i].req))
 
+    def _chunk_rung(self, c: int) -> str:
+        """Rung name of the c-token chunk program —
+        ``serve.prefill[c=N,mp=M]`` under tensor parallelism."""
+        tp = self._gen._tp
+        mp = f",mp={tp.mp}" if tp is not None else ""
+        return f"serve.prefill[c={c}{mp}]"
+
     def _get_chunk_prefill(self, c: int):
         """One compiled chunk program per chunk SIZE (start/len are
         traced operands — every chunk of every request shares it)."""
@@ -536,7 +543,7 @@ class ServingEngine(ContinuousBatchingEngine):
             import jax
 
             self._chunk_jit[c] = _roofline.AotProgram(
-                f"serve.prefill[c={c}]",
+                self._chunk_rung(c),
                 jax.jit(self._chunk_prefill_fn, donate_argnums=(8, 9)))
         return self._chunk_jit[c]
 
@@ -552,7 +559,7 @@ class ServingEngine(ContinuousBatchingEngine):
         x = embed[ids].astype(g._cdtype)
         h, cache = st.prefill_chunk_raw(
             weights, x, PagedKV(ck, cv), tables, start, chunk_len,
-            g._cos, g._sin, a8w8=g._a8w8)
+            g._cos, g._sin, a8w8=g._a8w8, tp=g._tp)
         hl = h[jnp.arange(h.shape[0]), chunk_len - 1]
         logits = g._logits(hl, head_t, lnf_s, lnf_b)
         return logits, cache.k, cache.v
@@ -611,18 +618,18 @@ class ServingEngine(ContinuousBatchingEngine):
         tables = self._mgr.block_tables([key], self._pages_per_seq)
         ids = np.zeros((1, c), np.int32)
         ids[0, :n] = toks[stt.pos: stt.pos + n]
-        m = self.model
         self._gen._count_a8w8(1)
+        lnf_s, lnf_b = self._gen._lnf()
         t0 = time.perf_counter()
         logits, self._ck, self._cv = self._get_chunk_prefill(c)(
-            m.stack._stack(), m.embed._data, self._gen._head_t,
-            m.lnf_scale._data, m.lnf_bias._data, jnp.asarray(ids),
+            self._gen._weights(), self._gen._embed(),
+            self._gen._head_t, lnf_s, lnf_b, jnp.asarray(ids),
             jnp.asarray([stt.pos], jnp.int32),
             jnp.asarray([n], jnp.int32), self._ck, self._cv, tables)
         tok = int(np.asarray(
             self._gen._argmax(jnp.asarray(logits)))[0])
         # the argmax fetch synced the chunk — honest phase roofline
-        _roofline.analyze(f"serve.prefill[c={c}]",
+        _roofline.analyze(self._chunk_rung(c),
                           time.perf_counter() - t0)
         _stats.inc("serve.prefill_chunks")
         _stats.inc("serve.prefill_tokens", n)

@@ -73,6 +73,17 @@ DEFAULT_METRICS: Dict[str, str] = {
     "decode_bf16_grouped_tokens_per_sec": "down",
     "decode_bf16_grouped_pct_of_hbm_roofline": "down",
     "decode_int8kv_b64_tokens_per_sec": "down",
+    # tensor-parallel serving rungs (ISSUE 10, mp2 canonical): the
+    # mp-sharded decode/serve throughput regresses DOWN like its mp1
+    # siblings — whose unchanged keys above ARE the mp1-throughput-
+    # preserved check (TP must not slow the single-chip path)
+    "decode_tp2_tokens_per_sec": "down",
+    "decode_tp2_pct_of_hbm_roofline": "down",
+    "serve_tp2_tokens_per_sec": "down",
+    "serve_tp2_p50_ttft_ms": "up",
+    "serve_tp2_p99_ttft_ms": "up",
+    "serve_tp2_p50_tpot_ms": "up",
+    "serve_tp2_goodput": "down",
     # serving-frontend SLO rungs (tools/serve_bench.py): latency
     # percentiles regress UP, delivered throughput DOWN
     "serve_p50_ttft_ms": "up",

@@ -212,6 +212,33 @@ def mode_engine_grouped(batch=32, grouped="on", prefetch=True,
     return mode_engine_full(batch, quant=quant)
 
 
+def mode_engine_tp(batch=32, mp=2):
+    """Engine end-to-end TENSOR-PARALLEL over ``mp`` chips (ISSUE 10):
+    per-chip weight streams shrink to 1/mp, two psums per layer ride
+    the ICI — compare against engine_grouped_b32 to read the
+    collective + split-grouping overhead directly. Needs >= mp
+    devices (it is a multi-chip ablation, not an emulation)."""
+    import jax
+
+    if len(jax.devices()) < mp:
+        raise SystemExit(
+            f"engine_tp mp={mp} needs {mp} devices, have "
+            f"{len(jax.devices())} — run on a multi-chip host")
+    from paddle_tpu.inference import GenerationEngine as _GE
+
+    orig_init = _GE.__init__
+
+    def ginit(self, *a, **kw):
+        kw.setdefault("mp_degree", mp)
+        orig_init(self, *a, **kw)
+
+    _GE.__init__ = ginit
+    try:
+        return mode_engine_full(batch)
+    finally:
+        _GE.__init__ = orig_init
+
+
 def mode_head_only(bf16=False):
     """Logits head (h @ embed.T) + argmax, 64 steps."""
     import jax
@@ -808,6 +835,10 @@ MODES = {
     "prefetch_off": lambda: mode_engine_grouped(32, prefetch=False),
     "engine_grouped_int8_b32":
         lambda: mode_engine_grouped(32, quant="int8"),
+    # tensor-parallel ablation (ISSUE 10): mp2-sharded engine vs the
+    # single-chip grouped row — the delta is the per-layer psum pair
+    # plus the tail grouping split at the collective boundaries
+    "engine_grouped_mp2_b32": lambda: mode_engine_tp(32, mp=2),
     "engine_int8_noattn_b32":
         lambda: mode_engine_knockout(32, "attn", quant="int8"),
     "engine_int8_nohead_b32":
