@@ -142,6 +142,46 @@ define_flag("serve_journal_dir", "",
             "(serve_crash_rank<r>_pid<pid>.jsonl, written by "
             "ServingEngine.run() on any raise; read back with "
             "tools/serve_top.py); empty = the system temp dir")
+define_flag("serve_step_retries", 2,
+            "crash-isolated stepping (serving/scheduler.py): retries "
+            "granted to one request's prefill chunk / one decode "
+            "chunk after an exception, each with capped exponential "
+            "backoff, before the OFFENDING request alone errors out "
+            "(state='error') while the serve loop keeps going")
+define_flag("serve_retry_backoff_ms", 5.0,
+            "base backoff between crash-isolated step retries; "
+            "attempt k sleeps min(base * 2^(k-1), "
+            "serve_retry_backoff_cap_ms) through the injectable "
+            "serving clock (serving/faults.py — a ManualClock makes "
+            "backoff a pure time-warp in tests)")
+define_flag("serve_retry_backoff_cap_ms", 500.0,
+            "cap on the exponential step-retry backoff")
+define_flag("serve_watchdog_steps", 256,
+            "progress watchdog: a request whose token progress "
+            "(prefill position / generated count) hasn't moved for "
+            "this many scheduler steps is preempted/requeued once, "
+            "then failed on a second trip — the serve loop never "
+            "hangs behind a wedged slot; 0 disables")
+define_flag("serve_inbox_limit", 4096,
+            "hard bound on the ServingEngine submit inbox; a full "
+            "inbox rejects submit() with the typed ServerOverloaded "
+            "(backpressure to the producer thread); 0 = unbounded")
+define_flag("serve_shed_queue_depth", 0,
+            "overload shedding: queue depth (inbox + waiting) at "
+            "which admission rejects with ServerOverloaded and "
+            "_drain_inbox sheds the sorted queue's overflow tail "
+            "(lowest priority, newest first) into the 'shed' "
+            "terminal state; 0 disables")
+define_flag("serve_shed_burn_rate", 0.0,
+            "overload shedding on service health: reject submits "
+            "with ServerOverloaded while the rolling SLO burn-rate "
+            "gauge (serving/slo.py) exceeds this; 0 disables")
+define_flag("serve_chunk_shrink", True,
+            "graceful degradation under pool pressure: before a "
+            "prefill chunk stalls/requeues for pages, shrink it "
+            "(halving, page/bucket-aligned) until its tail pages fit "
+            "the squeezed pool — tokens keep flowing at reduced "
+            "chunk size instead of the request parking")
 define_flag("use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on the MXU")
 define_flag("eager_fwd_cache", True,
             "no-grad eager dispatch through the signature-keyed "

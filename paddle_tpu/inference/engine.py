@@ -650,6 +650,10 @@ class ContinuousBatchingEngine:
         # level finish events land on the same per-request timeline;
         # None (the base engine) keeps every hook a no-op
         self._journal = None
+        # fault-injection registry (serving/faults.py): the serving
+        # frontend installs its injector here so the ``decode.step``
+        # site fires once per decode chunk; None = one attribute test
+        self._faults = None
         # slot state
         self._slots: list = [None] * self.max_batch   # GenRequest or None
         self._lens = np.zeros((self.max_batch,), np.int64)
@@ -677,6 +681,13 @@ class ContinuousBatchingEngine:
             return []
         k = self.decode_chunk
         active = [i for i, r in enumerate(self._slots) if r is not None]
+        fi = self._faults
+        if fi is not None and active:
+            # the decode.step fault site fires BEFORE the grow loop so
+            # scheduled pool squeezes exhaust the free list the grows
+            # are about to hit — the REAL recovery paths (eviction,
+            # preemption-by-recompute) engage on genuine pool state
+            fi.fire("decode.step")
         # pages grow on demand, clamped to what the request can still
         # emit — a near-max_length prompt must not over-allocate past
         # the fixed block-table width
@@ -723,6 +734,10 @@ class ContinuousBatchingEngine:
         # synced by the fetch above — an honest per-chunk roofline
         _roofline.analyze(self._gen._decode_rung(k),
                           _time.perf_counter() - t0)
+        # overridable token filter: runs BEFORE any request mutates,
+        # so a validation raise (serving corruption detection) leaves
+        # every slot exactly as it was and a chunk re-run is clean
+        toks_np = self._postprocess_tokens(toks_np, active)
 
         done_now = []
         for i in active:
@@ -769,6 +784,13 @@ class ContinuousBatchingEngine:
         self._slots[i] = None
         self._lens[i] = 0
         self._last_tok[i] = 0
+
+    def _postprocess_tokens(self, toks_np, active):
+        """Hook over the decode chunk's fetched token matrix, called
+        before the per-slot append loop. Base engine: identity. The
+        serving frontend overrides it with fault-injection corruption
+        + token-range validation (serving/scheduler.py)."""
+        return toks_np
 
     def _finish_hook(self, req, slot: int):
         """Called once per finished request, BEFORE its pages release.

@@ -88,6 +88,11 @@ class BlockKVCacheManager:
             range(1 if reserve_scratch else 0, num_pages))
         self._owned: dict = {}
         self._refs: Dict[int, int] = {}
+        # fault-injection registry (serving/faults.py) or None — the
+        # ``kv.alloc`` / ``kv.grow`` sites fire BEFORE any free-list
+        # mutation, so an injected raise leaves the pool consistent
+        # and a retry is clean (one attribute test when disabled)
+        self._faults = None
 
     def fresh_cache(self) -> PagedKV:
         # layer-FOLDED page-major pool (see PagedKV): layer l's logical
@@ -127,6 +132,9 @@ class BlockKVCacheManager:
     def allocate(self, seq_id, max_length: int) -> List[int]:
         """Reserve pages covering max_length tokens for one sequence."""
         n = self.pages_needed(max_length)
+        f = self._faults
+        if f is not None:
+            f.fire("kv.alloc")
         if n > len(self._free):
             raise RuntimeError(
                 f"KV pool exhausted: need {n} pages, "
@@ -141,6 +149,9 @@ class BlockKVCacheManager:
         """On-demand paging: extend an existing sequence by n_pages
         (the continuous-batching growth path — the reference's serving
         frontends grow block tables the same way between steps)."""
+        f = self._faults
+        if f is not None:
+            f.fire("kv.grow")
         if n_pages > len(self._free):
             raise RuntimeError(
                 f"KV pool exhausted growing seq {seq_id}: need "

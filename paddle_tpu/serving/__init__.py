@@ -15,12 +15,26 @@ burn rate), and exporters: journal → chrome trace (one lane per
 request, rank-stamped for ``tools/trace_merge.py``) and the
 ``tools/serve_top.py`` live/offline dashboard.
 
+Robustness (ISSUE 11): a deterministic, seeded FAULT-INJECTION
+registry (``faults.py`` — named sites in the serving hot path that
+raise, delay, corrupt-and-detect, or squeeze the page pool on a
+scheduled step) plus the hardening that survives it: per-request
+deadlines, crash-isolated stepping with capped-backoff retries, a
+progress watchdog, and typed overload shedding — all on one
+injectable monotonic clock so every timing behavior tests
+deterministically. ``tools/serve_bench.py --chaos`` pins survivor
+token parity and bounded goodput loss under a seeded fault schedule.
+
 The TP (ROADMAP item 1) and EP-MoE (item 4) serving engines plug into
 this scheduler: it only talks to the engine's compiled prefill/decode
 programs and the page manager, both of which shard underneath it.
 """
 from __future__ import annotations
 
+from .faults import (Clock, DeadlineExceeded, FaultInjector, FaultSpec,
+                     InjectedFault, ManualClock, PoolSizingError,
+                     ServerOverloaded, TokenCorruption, WatchdogTimeout,
+                     set_clock, use_clock)
 from .journal import FlightRecorder
 from .prefix_cache import PrefixCache
 from .request import Request
@@ -28,4 +42,8 @@ from .scheduler import ServingEngine, SLOConfig
 from .slo import SLOMonitor
 
 __all__ = ["Request", "PrefixCache", "ServingEngine", "SLOConfig",
-           "FlightRecorder", "SLOMonitor"]
+           "FlightRecorder", "SLOMonitor",
+           "FaultInjector", "FaultSpec", "Clock", "ManualClock",
+           "set_clock", "use_clock", "InjectedFault", "TokenCorruption",
+           "DeadlineExceeded", "ServerOverloaded", "WatchdogTimeout",
+           "PoolSizingError"]

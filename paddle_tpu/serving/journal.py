@@ -42,17 +42,32 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import time
 from typing import List, Optional
+
+try:  # the serving clock seam (serving/faults.py): journal timestamps
+    # follow the same injectable monotonic clock as every lifecycle
+    # mark, so ManualClock tests see consistent timelines. The
+    # fallback keeps this module loadable STANDALONE (tools/serve_top
+    # imports it by file path, outside the package).
+    from .faults import now as _now
+except ImportError:  # standalone load — real monotonic clock
+    _now = time.monotonic
 
 __all__ = ["FlightRecorder", "LIFECYCLE_EVENTS", "chrome_trace",
            "load_jsonl"]
 
 #: the journal's event vocabulary, in canonical lifecycle order
+#: (ISSUE 11 adds the failure-semantics events: ``fault`` = an
+#: injected-fault fire, ``retry`` = a crash-isolated step backoff,
+#: ``watchdog`` = a no-progress trip, and the terminal
+#: ``deadline_exceeded`` / ``shed``)
 LIFECYCLE_EVENTS = (
     "submit", "queued", "admitted", "prefill_chunk", "first_token",
     "decode", "preempt", "requeue", "stall", "evict_trigger",
-    "finish", "error",
+    "fault", "retry", "watchdog",
+    "finish", "error", "deadline_exceeded", "shed",
 )
 
 
@@ -75,7 +90,7 @@ class FlightRecorder:
         (page counts, chunk position, ttft) or None."""
         i = next(self._ctr)
         self._ring[i % self.capacity] = (
-            i, time.monotonic(), ev, rid, slot, extra)
+            i, _now(), ev, rid, slot, extra)
 
     # ---------------- reading ----------------
 
@@ -119,7 +134,13 @@ class FlightRecorder:
 
     def dump_jsonl(self, path: str) -> str:
         """Write the surviving events as ``{"type": "event", ...}``
-        JSONL lines (the ``tools/serve_top.py`` offline format)."""
+        JSONL lines (the ``tools/serve_top.py`` offline format). The
+        target directory is created if missing — a journal dump is
+        usually the LAST thing a dying serve does, and must not fail
+        on a fresh artifact directory."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
             for d in self.events():
                 f.write(json.dumps({"type": "event", **d}) + "\n")
@@ -162,7 +183,8 @@ def load_jsonl(path: str):
 _PHASE_OF = {"submit": "queued", "queued": "queued",
              "admitted": "prefill", "decode": "decode"}
 #: transitions that CLOSE whatever phase is open
-_CLOSERS = ("preempt", "requeue", "finish", "error")
+_CLOSERS = ("preempt", "requeue", "finish", "error",
+            "deadline_exceeded", "shed")
 
 
 def chrome_trace(events: List[dict], process_index: int = 0) -> dict:

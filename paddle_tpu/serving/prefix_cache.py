@@ -61,6 +61,11 @@ class PrefixCache:
         #: evictions are the pool-pressure signal a post-mortem needs
         #: next to the preempt/requeue events they interleave with
         self._journal = journal
+        #: fault-injection registry (serving/faults.py) or None; the
+        #: ``prefix.insert`` site fires at the TOP of insert, before
+        #: any page ref is taken, so an injected failure never leaks
+        #: a retain
+        self._faults = None
         self._entries: "OrderedDict[bytes, int]" = OrderedDict()
 
     def __len__(self) -> int:
@@ -96,6 +101,9 @@ class PrefixCache:
         holds tokens ``p*ps..(p+1)*ps-1``; the trailing partial page is
         never registered). Already-cached chain segments dedupe to an
         LRU touch. Returns the number of newly registered pages."""
+        f = self._faults
+        if f is not None:
+            f.fire("prefix.insert")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         n_full = min(len(pages), len(prompt) // self.page_size)
         added = 0

@@ -37,6 +37,22 @@ reconstructable post-mortem — and every finish feeds the SLO monitor
 stats snapshot + every still-unserved request to a JSONL crash
 artifact on any raise (``crash_dump``), so a production stack trace
 always arrives with the request timelines that led to it.
+
+Failure semantics (ISSUE 11 — see README "Failure semantics"): one
+request's failure must never take the loop down. Per-request
+``deadline_ms`` aborts a request wherever it sits (queue/prefill/
+decode) and frees its pages; an exception inside one slot's
+prefill/decode chunk retries with capped exponential backoff
+(``FLAGS_serve_step_retries`` / ``FLAGS_serve_retry_backoff_ms``)
+through the injectable serving clock, then errors out ONLY the
+offending request; a progress watchdog
+(``FLAGS_serve_watchdog_steps``) preempts/requeues a request that
+stopped emitting tokens, and kills it on the second trip; admission
+sheds with a typed ``ServerOverloaded`` when the (bounded) inbox,
+queue depth, or SLO burn rate crosses its threshold — after the
+scheduler has already degraded gracefully by shrinking prefill chunks
+under pool pressure. All of it drivable deterministically by the
+seeded fault registry in ``serving/faults.py``.
 """
 from __future__ import annotations
 
@@ -53,6 +69,9 @@ from ..incubate.nn.fused_transformer import PagedKV
 from ..inference.engine import ContinuousBatchingEngine, FusedCausalLM
 from ..profiler import roofline as _roofline
 from ..profiler import stats as _stats
+from . import faults as _faults
+from .faults import (DeadlineExceeded, PoolSizingError, ServerOverloaded,
+                     TokenCorruption, WatchdogTimeout)
 from .journal import FlightRecorder
 from .prefix_cache import PrefixCache
 from .request import Request
@@ -148,7 +167,8 @@ class ServingEngine(ContinuousBatchingEngine):
     """
 
     def __init__(self, model: FusedCausalLM,
-                 slo: Optional[SLOConfig] = None, **engine_kwargs):
+                 slo: Optional[SLOConfig] = None, faults=None,
+                 **engine_kwargs):
         slo = slo or SLOConfig()
         engine_kwargs.setdefault("admit_window", slo.admit_window)
         engine_kwargs.setdefault("starvation_bound",
@@ -185,21 +205,56 @@ class ServingEngine(ContinuousBatchingEngine):
         #: scheduler action trace ("prefill"/"decode"), the stall-bound
         #: test's evidence; cheap (one short str per step)
         self.action_log: List[str] = []
+        # crash-isolation bookkeeping (ISSUE 11): the request/slot a
+        # risky phase is operating on (so its failure can be pinned to
+        # the offending request), and the decode-chunk retry budget
+        # (decode failures aren't attributable to one slot until the
+        # budget is spent)
+        self._admitting = None            # (req, slot) mid-_admit_into
+        self._prefill_active = None       # (req, slot) mid-chunk
+        self._decode_retries = 0
+        # fault injection (serving/faults.py): installed on the engine,
+        # the page manager (kv.alloc/kv.grow sites + squeeze target)
+        # and the prefix cache; None keeps every site one attr test
+        self.faults = None
+        if faults is not None:
+            self.install_faults(faults)
+
+    def install_faults(self, faults) -> None:
+        """Arm a :class:`~paddle_tpu.serving.faults.FaultInjector` on
+        every wired site — the engine itself (``prefill.dispatch``,
+        ``decode.step``, ``journal.dump``), the page manager
+        (``kv.alloc``/``kv.grow`` + the squeeze target) and the prefix
+        cache (``prefix.insert``). Callable after construction so a
+        chaos bench can warm compile caches fault-free first."""
+        self.faults = faults
+        self._faults = faults             # base-engine decode.step site
+        faults.bind(mgr=self._mgr, journal=self.journal)
+        self._mgr._faults = faults
+        if self.prefix_cache is not None:
+            self.prefix_cache._faults = faults
 
     # ---------------- public API ----------------
 
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id=None, priority: int = 0,
-               on_token=None) -> int:
+               on_token=None, deadline_ms: Optional[float] = None) -> int:
         """Thread-safe admission (any thread): queue a request, return
-        its id. Tokens stream through ``on_token`` as they decode."""
+        its id. Tokens stream through ``on_token`` as they decode.
+        ``deadline_ms`` bounds the request's whole life from arrival
+        (see README "Failure semantics"). Raises
+        :class:`ServerOverloaded` — backpressure to the SUBMITTING
+        thread — when the bounded inbox, the queue depth, or the SLO
+        burn rate is past its shed threshold."""
         req = Request(prompt, max_new_tokens, eos_token_id,
-                      priority=priority, on_token=on_token)
+                      priority=priority, on_token=on_token,
+                      deadline_ms=deadline_ms)
         return self.submit_request(req)
 
     def submit_request(self, req: Request) -> int:
         if len(req.prompt) + req.max_new_tokens > self.max_length:
             raise ValueError("request exceeds engine max_length")
+        self._check_overload(req)
         with self._inbox_lock:
             self._inbox.append(req)
         jr = self.journal
@@ -210,30 +265,85 @@ class ServingEngine(ContinuousBatchingEngine):
         _stats.inc("serve.submitted")
         return req.id
 
+    def _check_overload(self, req: Request) -> None:
+        """Admission-time overload shedding (ISSUE 11): reject with a
+        typed ``ServerOverloaded`` when (a) the inbox is at its hard
+        bound (``FLAGS_serve_inbox_limit``; an unbounded producer can
+        no longer grow the waiting list without backpressure), (b) the
+        queue depth (inbox + waiting) crossed
+        ``FLAGS_serve_shed_queue_depth``, or (c) the PR 9 SLO
+        burn-rate gauge crossed ``FLAGS_serve_shed_burn_rate`` (the
+        service is already missing its objective — more load only
+        deepens the miss). 0 disables each threshold."""
+        limit = int(_flag("serve_inbox_limit"))
+        depth_cap = int(_flag("serve_shed_queue_depth"))
+        with self._inbox_lock:
+            inbox = len(self._inbox)
+        reason = None
+        if limit > 0 and inbox >= limit:
+            reason = f"inbox at its bound ({inbox}/{limit})"
+        elif depth_cap > 0 and inbox + len(self.waiting) >= depth_cap:
+            reason = (f"queue depth {inbox + len(self.waiting)} >= "
+                      f"shed threshold {depth_cap}")
+        else:
+            burn_cap = float(_flag("serve_shed_burn_rate"))
+            burn = self.slo_monitor.burn_rate
+            if burn_cap > 0 and burn is not None and burn > burn_cap:
+                reason = (f"SLO burn rate {burn:.2f} > shed "
+                          f"threshold {burn_cap:.2f}")
+        if reason is None:
+            return
+        _stats.inc("serving.shed")
+        jr = self.journal
+        if jr is not None:
+            jr.record("shed", req.id, -1, {"reason": reason})
+        raise ServerOverloaded(
+            f"request {req.id} shed at submit: {reason}")
+
     @property
     def num_prefilling(self) -> int:
         return len(self._prefilling)
 
     def step(self):
-        """One scheduler action: drain admissions, then run EITHER one
-        prefill chunk or one decode chunk per the SLO interleave.
-        Returns requests finished this step."""
+        """One scheduler action: drain admissions (shed-aware), expire
+        deadlines, tick the progress watchdog, then run EITHER one
+        prefill chunk or one decode chunk per the SLO interleave —
+        CRASH-ISOLATED: an exception inside admission or either chunk
+        retries with capped exponential backoff and then errors out
+        only the offending request (``_recover_*``); the loop keeps
+        serving everyone else. Returns requests finished this step."""
         self._drain_inbox()
-        self._admit()
+        self._expire_deadlines()
+        try:
+            self._admit()
+        except Exception as e:
+            self._recover_admit(e)
         self.slo_monitor.update_gauges(
             len(self.waiting) + len(self._inbox), self.num_active,
             len(self._prefilling), self.max_batch)
+        self._watchdog_tick()
         action = self._pick_action()
         if action == "prefill":
             self.action_log.append("prefill")
-            return self._prefill_step()
+            try:
+                out = self._prefill_step()
+            except Exception as e:
+                return self._recover_prefill(e)
+            tgt, self._prefill_active = self._prefill_active, None
+            if tgt is not None:
+                tgt[0].n_retries = 0  # chunk landed — budget restored
+            return out
         if self.num_active == 0:
             return []
         self.action_log.append("decode")
         before = [(r, len(r.generated))
                   for r in self._slots if r is not None]
         t0 = time.perf_counter()
-        done = super().step()
+        try:
+            done = super().step()
+        except Exception as e:
+            return self._recover_decode(e)
+        self._decode_retries = 0
         dt_ms = (time.perf_counter() - t0) * 1e3
         for req, n0 in before:
             emitted = len(req.generated) - n0
@@ -253,7 +363,9 @@ class ServingEngine(ContinuousBatchingEngine):
         request completes, before its pages release): stamp t_done,
         observe the lifetime per-token mean, judge the SLO verdict,
         and journal a verdict-rich finish event."""
-        req.t_done = time.monotonic()
+        req.t_done = _faults.now()
+        if getattr(req, "state", None) is None:
+            req.state = "ok"
         tpot = getattr(req, "tpot_s", None)
         if tpot is not None:
             # whole-lifetime per-token mean (the chunk-level
@@ -267,6 +379,230 @@ class ServingEngine(ContinuousBatchingEngine):
                        "ttft_ms": v["ttft_ms"],
                        "tpot_ms": v["tpot_ms"],
                        "slo_ok": v["slo_ok"]})
+
+    # ---------------- failure semantics (ISSUE 11) ----------------
+
+    _FAIL_COUNTERS = {"deadline_exceeded": "serving.deadline_exceeded",
+                      "shed": "serving.shed",
+                      "error": "serving.request_errors"}
+
+    def _fail_request(self, req: Request, slot: int, state: str,
+                      exc: BaseException):
+        """Terminal failure path: stamp the request's terminal state
+        and error, roll it into the SLO window as a miss, journal the
+        terminal event, and move it to ``finished``. The error
+        surfaces ONLY to this request (``req.error`` / its caller) —
+        never to the serve loop. Callers remove the request from
+        queue/slot structures and free its pages FIRST."""
+        req.done = True
+        req.state = state
+        req.error = exc
+        req.t_done = _faults.now()
+        self.slo_monitor.observe_error(req)
+        _stats.inc(self._FAIL_COUNTERS.get(
+            state, "serving.request_errors"))
+        jr = self.journal
+        if jr is not None:
+            ev = state if state in ("deadline_exceeded", "shed") \
+                else "error"
+            jr.record(ev, req.id, slot,
+                      {"error": type(exc).__name__,
+                       "msg": str(exc)[:200]})
+        self.finished.append(req)
+
+    def _drop_prefill_slot(self, i: int):
+        """Vacate prefill slot ``i`` and free its pages (no requeue —
+        the caller decides the request's fate)."""
+        self._prefilling.pop(i, None)
+        if ("prefill", i) in self._mgr._owned:
+            self._mgr.free(("prefill", i))
+
+    def _expire_deadlines(self):
+        """Abort every request whose ``deadline_ms`` budget elapsed —
+        wherever it sits (waiting list, prefill slot, decode slot) —
+        freeing its pages and surfacing ``DeadlineExceeded`` only to
+        it. Runs once per scheduler step on the injected clock."""
+        now = _faults.now()
+        expired = [r for r in self.waiting if r.past_deadline(now)]
+        for req in expired:
+            self.waiting.remove(req)
+            self._fail_request(req, -1, "deadline_exceeded",
+                               DeadlineExceeded(
+                                   f"request {req.id} exceeded its "
+                                   f"{req.deadline_ms}ms deadline in "
+                                   "queue"))
+        for i in [i for i, s in list(self._prefilling.items())
+                  if s.req.past_deadline(now)]:
+            req = self._prefilling[i].req
+            self._drop_prefill_slot(i)
+            self._fail_request(req, i, "deadline_exceeded",
+                               DeadlineExceeded(
+                                   f"request {req.id} exceeded its "
+                                   f"{req.deadline_ms}ms deadline "
+                                   "during prefill"))
+        for i in range(self.max_batch):
+            req = self._slots[i]
+            if req is not None and req.past_deadline(now):
+                self._release(i)
+                self._fail_request(req, i, "deadline_exceeded",
+                                   DeadlineExceeded(
+                                       f"request {req.id} exceeded "
+                                       f"its {req.deadline_ms}ms "
+                                       "deadline during decode"))
+
+    def _note_retry(self, req, slot: int, exc: BaseException,
+                    phase: str) -> bool:
+        """Crash-isolation retry bookkeeping: True = a retry is still
+        in budget (``FLAGS_serve_step_retries``) and its capped
+        exponential backoff has been slept through the serving clock;
+        False = the budget is spent and the caller must error the
+        request out."""
+        budget = int(_flag("serve_step_retries"))
+        if req.n_retries >= budget:
+            return False
+        req.n_retries += 1
+        _stats.inc("serving.step_retries")
+        delay_ms = min(
+            float(_flag("serve_retry_backoff_ms"))
+            * (2 ** (req.n_retries - 1)),
+            float(_flag("serve_retry_backoff_cap_ms")))
+        jr = self.journal
+        if jr is not None:
+            jr.record("retry", req.id, slot,
+                      {"phase": phase, "attempt": req.n_retries,
+                       "backoff_ms": delay_ms,
+                       "error": type(exc).__name__})
+        _faults.clock().sleep(delay_ms / 1e3)
+        return True
+
+    def _recover_admit(self, e: Exception):
+        """An exception inside admission: roll back the half-admitted
+        request (its prefill-key pages release), then retry-or-fail
+        it. Failures outside any admission (no request attributable)
+        are not isolable and propagate to ``run()``'s crash dump."""
+        if isinstance(e, PoolSizingError):
+            raise e
+        tgt = self._admitting
+        self._admitting = None
+        if tgt is None:
+            raise e
+        req, i = tgt
+        self._drop_prefill_slot(i)
+        if self._note_retry(req, i, e, "admit"):
+            self.waiting.append(req)
+            self._sort_waiting()
+        else:
+            self._fail_request(req, i, "error", e)
+
+    def _recover_prefill(self, e: Exception):
+        """An exception inside one slot's prefill chunk: the offending
+        request is known (``_prefill_active``); retry it in place with
+        backoff, then error out only it. Chunk re-dispatch is clean —
+        nothing host-side mutated before the raise, and re-running the
+        chunk rewrites the same KV pages with identical values."""
+        if isinstance(e, PoolSizingError):
+            raise e
+        tgt = self._prefill_active
+        self._prefill_active = None
+        if tgt is None:
+            raise e
+        req, i = tgt
+        if self._note_retry(req, i, e, "prefill"):
+            return []
+        self._drop_prefill_slot(i)
+        if self._slots[i] is req:   # failed past the decode handoff
+            self._release(i)
+        self._fail_request(req, i, "error", e)
+        return []
+
+    def _recover_decode(self, e: Exception):
+        """An exception inside the decode chunk: not attributable to
+        one slot (the chunk is batched), so retry the whole chunk with
+        backoff; once the budget is spent, sacrifice the LEAST-urgent
+        active slot (bounded degradation — a persistent fault sheds
+        one request per exhausted budget instead of hanging or killing
+        the loop) and keep serving."""
+        if isinstance(e, PoolSizingError):
+            raise e
+        budget = int(_flag("serve_step_retries"))
+        if self._decode_retries < budget:
+            self._decode_retries += 1
+            _stats.inc("serving.step_retries")
+            delay_ms = min(
+                float(_flag("serve_retry_backoff_ms"))
+                * (2 ** (self._decode_retries - 1)),
+                float(_flag("serve_retry_backoff_cap_ms")))
+            jr = self.journal
+            if jr is not None:
+                jr.record("retry", -1, -1,
+                          {"phase": "decode",
+                           "attempt": self._decode_retries,
+                           "backoff_ms": delay_ms,
+                           "error": type(e).__name__})
+            _faults.clock().sleep(delay_ms / 1e3)
+            return []
+        self._decode_retries = 0
+        victims = [j for j in range(self.max_batch)
+                   if self._slots[j] is not None]
+        if not victims:
+            raise e
+        j = max(victims, key=lambda j: self._urgency(self._slots[j]))
+        req = self._slots[j]
+        self._release(j)
+        self._fail_request(req, j, "error", e)
+        return []
+
+    def _watchdog_tick(self):
+        """Progress watchdog: a request whose token progress marker
+        hasn't moved for ``FLAGS_serve_watchdog_steps`` scheduler
+        steps is preempted/requeued (first trip) and failed (second) —
+        the loop never hangs behind a wedged slot. 0 disables."""
+        n = int(_flag("serve_watchdog_steps"))
+        if n <= 0:
+            return
+        for i, stt in list(self._prefilling.items()):
+            self._wd_check(stt.req, ("prefill", stt.pos), i, n, True)
+        for i in range(self.max_batch):
+            req = self._slots[i]
+            if req is not None:
+                self._wd_check(req, ("decode", len(req.generated)),
+                               i, n, False)
+
+    def _wd_check(self, req, mark, slot: int, n: int,
+                  prefilling: bool):
+        if req._wd_mark != mark:
+            req._wd_mark = mark
+            req._wd_steps = 0
+            return
+        req._wd_steps += 1
+        if req._wd_steps < n:
+            return
+        req._wd_steps = 0
+        req._wd_mark = None
+        req._wd_trips += 1
+        jr = self.journal
+        if jr is not None:
+            jr.record("watchdog", req.id, slot,
+                      {"trip": req._wd_trips,
+                       "phase": "prefill" if prefilling else "decode"})
+        if req._wd_trips <= 1:
+            # first trip: give the stack one recovery shot — requeue
+            # (prefill) / preempt-by-recompute (decode); re-admission
+            # is prefix-cache-hot, so a transient wedge costs little
+            _stats.inc("serving.watchdog_preempts")
+            if prefilling:
+                self._requeue_prefill(slot)
+            else:
+                self._preempt_slot(slot)
+            return
+        _stats.inc("serving.watchdog_kills")
+        if prefilling:
+            self._drop_prefill_slot(slot)
+        else:
+            self._release(slot)
+        self._fail_request(req, slot, "error", WatchdogTimeout(
+            f"request {req.id}: no token progress for {n} scheduler "
+            "steps twice (one preempt/requeue already spent)"))
 
     def run(self):
         """Drain: step until every submitted request finishes.
@@ -297,7 +633,8 @@ class ServingEngine(ContinuousBatchingEngine):
                 self.journal.publish_gauges()
         return self.finished
 
-    def crash_dump(self, error=None, path: Optional[str] = None) -> str:
+    def crash_dump(self, error=None,
+                   path: Optional[str] = None) -> Optional[str]:
         """Post-mortem JSONL artifact: every surviving journal event
         (``type=event`` lines), the full ``stats.snapshot()``
         (``type=stats``), and a ``type=crash`` header naming the error
@@ -306,23 +643,44 @@ class ServingEngine(ContinuousBatchingEngine):
         position, and active decode slots. Written under
         ``FLAGS_serve_journal_dir`` (default: the system temp dir) as
         ``serve_crash_rank<r>_pid<pid>.jsonl``; read it back with
-        ``tools/serve_top.py``."""
+        ``tools/serve_top.py``.
+
+        NEVER RAISES (ISSUE 11): this runs inside ``run()``'s error
+        handling, and a failed dump (full disk, bad journal dir, an
+        injected ``journal.dump`` fault) must not mask the original
+        exception. On failure it warns on stderr and returns None."""
+        import sys
+
+        try:
+            return self._crash_dump_impl(error, path)
+        except BaseException as dump_err:  # noqa: BLE001 — by design
+            print(f"serve: crash dump FAILED ({dump_err!r}) — "
+                  "original error preserved", file=sys.stderr)
+            return None
+
+    def _crash_dump_impl(self, error, path: Optional[str]) -> str:
         import json
         import os
         import sys
         import tempfile
 
+        f0 = self.faults
+        if f0 is not None:
+            f0.fire("journal.dump")
         if path is None:
             d = str(_flag("serve_journal_dir")) or tempfile.gettempdir()
-            os.makedirs(d, exist_ok=True)
+            rank = 0
             try:
                 import jax
 
                 rank = int(jax.process_index())
             except Exception:
-                rank = 0
+                pass
             path = os.path.join(
                 d, f"serve_crash_rank{rank}_pid{os.getpid()}.jsonl")
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
         unserved = []
         with self._inbox_lock:
             inbox = list(self._inbox)
@@ -362,6 +720,13 @@ class ServingEngine(ContinuousBatchingEngine):
     # ---------------- admission ----------------
 
     def _drain_inbox(self):
+        """Move submitted requests into the priority-ordered waiting
+        list — SHED-AWARE (ISSUE 11): once the sorted queue is past
+        ``FLAGS_serve_shed_queue_depth``, the overflow tail (lowest
+        priority, newest arrivals) terminates in the ``shed`` state
+        instead of growing the waiting list without bound. The
+        submit-side check already rejects most overload; this is the
+        backstop for racing producers that got past it."""
         with self._inbox_lock:
             newly, self._inbox = self._inbox, []
         for req in newly:
@@ -373,6 +738,15 @@ class ServingEngine(ContinuousBatchingEngine):
                 for req in newly:
                     jr.record("queued", req.id, -1, None)
             self._sort_waiting()
+            cap = int(_flag("serve_shed_queue_depth"))
+            if cap > 0 and len(self.waiting) > cap:
+                overflow = self.waiting[cap:]
+                del self.waiting[cap:]
+                for req in overflow:
+                    self._fail_request(
+                        req, -1, "shed", ServerOverloaded(
+                            f"request {req.id} shed at drain: queue "
+                            f"depth past {cap}"))
 
     def _sort_waiting(self):
         # higher priority first, FIFO within a level (stable by
@@ -432,7 +806,8 @@ class ServingEngine(ContinuousBatchingEngine):
         and let ``_prefill_step`` fill the prompt chunk by chunk. No
         prefill compute happens at admission — admitting a 4k prompt
         costs a page-table update, not a 4k-token program."""
-        now = time.monotonic()
+        self._admitting = (req, i)   # crash-isolation attribution
+        now = _faults.now()
         if req.t_admitted is None:
             # first admission only — a preempted/requeued request
             # keeps its original marks (queue-wait and TTFT measure
@@ -464,6 +839,7 @@ class ServingEngine(ContinuousBatchingEngine):
             self._mgr.share(key, shared)
         self._prefilling[i] = _Prefill(
             req, pos=len(shared) * self.page_size, tokens=toks)
+        self._admitting = None
 
     def _hook_first_token(self, req):
         """Wrap the user's on_token with the TTFT stamp (fires exactly
@@ -472,7 +848,7 @@ class ServingEngine(ContinuousBatchingEngine):
 
         def cb(r, t, _u=user_cb):
             if getattr(r, "t_first_token", None) is None:
-                r.t_first_token = time.monotonic()
+                r.t_first_token = _faults.now()
                 ttft_ms = (r.t_first_token
                            - getattr(r, "arrival_time",
                                      r.t_first_token)) * 1e3
@@ -514,6 +890,47 @@ class ServingEngine(ContinuousBatchingEngine):
         bs = self.prompt_bucket
         return max(min(-(-remaining // bs) * bs,
                        self.slo.prefill_chunk), 1)
+
+    def _chunk_floor(self) -> int:
+        """Smallest chunk graceful degradation may shrink to: one
+        page/bucket of tokens (whichever is smaller — shrunk sizes
+        stay multiples of it, bounding the per-size compile count to
+        the halving chain)."""
+        return max(1, min(self.prompt_bucket, self.page_size))
+
+    def _shrunk_chunk(self, c: int) -> int:
+        """Next smaller chunk size in the degradation chain: half of
+        ``c``, rounded up to the floor's multiple, strictly below
+        ``c``."""
+        floor = self._chunk_floor()
+        nxt = -(-(c // 2) // floor) * floor
+        return max(min(nxt, c - 1), floor)
+
+    def _postprocess_tokens(self, toks_np, active):
+        """Serving override of the decode-chunk token filter (ISSUE
+        11): route the chunk's token matrix through any scheduled
+        ``decode.step`` corruption, then validate the whole ACTIVE
+        block before a single request mutates — a detected corruption
+        raises :class:`TokenCorruption` while the crash-isolated retry
+        is still clean (re-running the chunk rewrites the same KV
+        pages with identical values)."""
+        f = self._faults
+        if f is not None and active:
+            i0 = active[0]
+            cur = int(toks_np[i0, 0])
+            poked = f.corrupt("decode.step", cur)
+            if poked != cur:
+                # np.asarray over a jax array is a read-only view —
+                # corrupt a writable copy (the fault path only)
+                toks_np = np.array(toks_np)
+                toks_np[i0, 0] = poked
+        v = self.model.vocab_size
+        blk = toks_np[active]
+        if blk.size and (int(blk.min()) < 0 or int(blk.max()) >= v):
+            raise TokenCorruption(
+                f"decode chunk produced token(s) outside [0, {v}) "
+                f"for slots {active}")
+        return toks_np
 
     def _urgency(self, req):
         """Sort key: most urgent first (priority, then admission order
@@ -572,6 +989,7 @@ class ServingEngine(ContinuousBatchingEngine):
         i = self._pick_prefilling()
         stt = self._prefilling[i]
         req = stt.req
+        self._prefill_active = (req, i)  # crash-isolation attribution
         toks = stt.tokens
         L = len(toks)
         c = self._chunk_size(L - stt.pos)
@@ -580,6 +998,23 @@ class ServingEngine(ContinuousBatchingEngine):
         need = min(self._mgr.pages_needed(stt.pos + c),
                    self._pages_per_seq)
         have = len(self._mgr._owned.get(key, ()))
+        if need > have and not self._evict_for(need - have):
+            # graceful degradation FIRST (ISSUE 11): shrink this
+            # step's chunk until its tail pages fit the squeezed pool
+            # — smaller chunks keep tokens flowing where the full
+            # chunk would stall, requeue, or shed
+            if _flag("serve_chunk_shrink"):
+                c2 = c
+                while c2 > self._chunk_floor():
+                    c2 = self._shrunk_chunk(c2)
+                    need2 = min(self._mgr.pages_needed(stt.pos + c2),
+                                self._pages_per_seq)
+                    if need2 <= have \
+                            or self._evict_for(need2 - have):
+                        _stats.inc("serving.chunk_shrinks")
+                        c, n = c2, min(L - stt.pos, c2)
+                        need = need2
+                        break
         if need > have and not self._evict_for(need - have):
             # pool exhausted even after dropping every cold cached
             # prefix (admission only reserved the FIRST chunk's pages,
@@ -607,7 +1042,7 @@ class ServingEngine(ContinuousBatchingEngine):
                         self._prefilling[j].req))
                 self._requeue_prefill(victim)
             if not self._evict_for(need - have):
-                raise RuntimeError(
+                raise PoolSizingError(
                     f"request {req.id} needs {need} KV pages but the "
                     f"pool can only ever provide "
                     f"{self._mgr.free_pages + have} "
@@ -615,6 +1050,9 @@ class ServingEngine(ContinuousBatchingEngine):
                     f"num_pages or cap prompt/generation length")
         if need > have:
             self._mgr.grow(key, need - have)
+        fi = self.faults
+        if fi is not None:
+            fi.fire("prefill.dispatch", rid=req.id)
         tables = self._mgr.block_tables([key], self._pages_per_seq)
         ids = np.zeros((1, c), np.int32)
         ids[0, :n] = toks[stt.pos: stt.pos + n]
@@ -628,6 +1066,16 @@ class ServingEngine(ContinuousBatchingEngine):
             jnp.asarray([n], jnp.int32), self._ck, self._cv, tables)
         tok = int(np.asarray(
             self._gen._argmax(jnp.asarray(logits)))[0])
+        if fi is not None:
+            tok = fi.corrupt("prefill.dispatch", tok)
+        if not 0 <= tok < self.model.vocab_size:
+            # corrupt-and-DETECT: the poisoned token never reaches the
+            # request's stream; the raise happens before any host-side
+            # mutation, so the crash-isolated retry re-runs this chunk
+            # cleanly (same KV pages rewritten with identical values)
+            raise TokenCorruption(
+                f"prefill chunk for request {req.id} produced token "
+                f"{tok} outside [0, {self.model.vocab_size})")
         # the argmax fetch synced the chunk — honest phase roofline
         _roofline.analyze(self._chunk_rung(c),
                           time.perf_counter() - t0)
@@ -644,8 +1092,15 @@ class ServingEngine(ContinuousBatchingEngine):
         del self._prefilling[i]
         self._mgr.rekey(key, ("slot", i))
         if self.prefix_cache is not None:
-            self.prefix_cache.insert(
-                toks, self._mgr._owned[("slot", i)])
+            try:
+                self.prefix_cache.insert(
+                    toks, self._mgr._owned[("slot", i)])
+            except Exception:
+                # a prefix-cache registration failure (e.g. an
+                # injected prefix.insert fault) costs future page
+                # reuse, never the request — absorbed here, counted,
+                # and the request proceeds to decode untouched
+                _stats.inc("serving.prefix_insert_errors")
         self._slots[i] = req
         req.generated.append(tok)
         cb = getattr(req, "on_token", None)

@@ -23,7 +23,9 @@ turns the raw TTFT/TPOT readings into service-level accounting:
   refreshed by the scheduler every step — the live dashboard's
   (``tools/serve_top.py``) pressure row.
 
-Counters: ``slo.{finished,ok,ttft_miss,tpot_miss}``.
+Counters: ``slo.{finished,ok,ttft_miss,tpot_miss,errors}`` (errors =
+requests that ended in a failure terminal state — deadline, shed,
+step error — each rolled into the goodput window as a miss).
 """
 from __future__ import annotations
 
@@ -90,6 +92,21 @@ class SLOMonitor:
                 "tpot_ms": None if tpot_ms is None
                 else round(tpot_ms, 3),
                 "ttft_ok": ttft_ok, "tpot_ok": tpot_ok, "slo_ok": ok}
+
+    def observe_error(self, req) -> None:
+        """Roll a FAILED request (deadline/shed/step error, ISSUE 11)
+        into the goodput window as a miss: a request the service
+        dropped is by definition not good throughput, whatever its
+        latencies were before it died. Stamps ``req.slo_ok = False``
+        and publishes the same rolling gauges as a finish."""
+        with self._lock:
+            self._window.append(False)
+            good = sum(self._window) / len(self._window)
+        _stats.inc("slo.finished")
+        _stats.inc("slo.errors")
+        _stats.set_gauge("slo.goodput", round(good, 4))
+        _stats.set_gauge("slo.burn_rate", round(self._burn(good), 3))
+        req.slo_ok = False
 
     # ---------------- rolling views ----------------
 

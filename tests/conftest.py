@@ -29,6 +29,22 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # registered markers (no pytest.ini in this repo): ``slow`` is
+    # excluded from tier-1 (`-m 'not slow'`); ``chaos`` tags the
+    # deterministic fault-injection serving tests
+    # (tests/test_serving_faults.py) — tier-1 RUNS them (they are not
+    # slow), the marker exists so a chip run can select them alone
+    # (`-m chaos`) before trusting a serving deploy
+    config.addinivalue_line(
+        "markers", "slow: long-running composition smoke, excluded "
+                   "from tier-1 (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection serving "
+                   "tests (ISSUE 11) — in tier-1, selectable alone "
+                   "via -m chaos")
+
+
 @pytest.fixture(autouse=True)
 def _reseed():
     import paddle_tpu as paddle

@@ -95,6 +95,16 @@ DEFAULT_METRICS: Dict[str, str] = {
     # the slo.goodput rolling telemetry gauge regress DOWN
     "serve_goodput": "down",
     "slo.goodput": "down",
+    # chaos-hardened serving rungs (tools/serve_bench.py --chaos,
+    # ISSUE 11): survivor token parity is binary and must stay 1.0,
+    # chaos goodput/throughput regress DOWN like their fault-free
+    # siblings, and request errors under the SAME seeded fault
+    # schedule regress UP (more requests dying per injected fault =
+    # the isolation got leakier)
+    "serve_chaos_survivor_parity": "down",
+    "serve_chaos_goodput": "down",
+    "serve_chaos_tokens_per_sec": "down",
+    "serve_chaos_request_errors": "up",
     # static-analysis state the numbers were measured under: the
     # finding count must only go DOWN between rounds, so any growth
     # regresses (direction "up" = an increase fails the gate); gates

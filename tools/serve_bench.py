@@ -24,6 +24,17 @@ pass the 1.3B geometry (--d-model 2048 --layers 24 --heads 16
 --vocab 51200) and a rate that saturates it. A warmup pass compiles
 every chunk/decode program first (--no-warmup to include compiles in
 the measured TTFTs — the cold-start view).
+
+``--chaos`` (ISSUE 11) re-drives the SAME measured workload against a
+fresh engine with a seeded fault schedule installed
+(``serving/faults.py`` — raises, delays, token corruption, and pool
+squeezes across >=5 distinct sites) and pins the robustness
+acceptance: the serve loop never exits, every faulted request lands
+in a terminal ``error``/``deadline_exceeded``/``shed`` state, every
+SURVIVING request's greedy tokens are identical to the fault-free
+run, and goodput stays within a pinned bound of the fault-free run's.
+Emits ``serve_chaos_*`` keys (gated by tools/bench_gate.py) and exits
+nonzero when any pin fails.
 """
 from __future__ import annotations
 
@@ -58,7 +69,7 @@ def _telemetry():
     return out
 
 
-def build_engine(args):
+def build_engine(args, faults=None):
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
@@ -87,7 +98,7 @@ def build_engine(args):
     return ServingEngine(
         model, max_batch=args.streams, page_size=args.page_size,
         max_length=max_len, decode_chunk=args.decode_chunk,
-        quant=args.quant, slo=slo,
+        quant=args.quant, slo=slo, faults=faults,
         mp_degree=args.mp if args.mp and args.mp > 1 else None), lens
 
 
@@ -110,11 +121,18 @@ def make_requests(args, lens, rng):
     return reqs
 
 
-def drive(eng, reqs, max_new):
+def drive(eng, reqs, max_new, deadline_ms=None):
     """Submit on a background thread at the Poisson arrival times;
-    run the scheduler loop here until every request finishes."""
-    n = len(reqs)
+    run the scheduler loop here until every submitted request reaches
+    a TERMINAL state (ok, error, deadline_exceeded, shed-at-drain).
+    Returns ``(wall_s, rids)`` — ``rids[i]`` is submission i's request
+    id, or None when the engine shed it at submit (typed
+    ServerOverloaded backpressure)."""
+    from paddle_tpu.serving import ServerOverloaded
+
     err: list = []
+    rids: list = []
+    done_submitting = threading.Event()
 
     def submitter():
         try:
@@ -124,23 +142,147 @@ def drive(eng, reqs, max_new):
                 delay = t_next - time.monotonic()
                 if delay > 0:
                     time.sleep(delay)
-                eng.submit(prompt, max_new_tokens=max_new)
+                try:
+                    rids.append(eng.submit(prompt,
+                                           max_new_tokens=max_new,
+                                           deadline_ms=deadline_ms))
+                except ServerOverloaded:
+                    rids.append(None)  # backpressure — dropped load
         except BaseException as e:  # surface on the main thread
             err.append(e)
+        finally:
+            done_submitting.set()
 
     th = threading.Thread(target=submitter, daemon=True)
     t0 = time.monotonic()
     th.start()
-    while len(eng.finished) < n:
+    while True:
         if err:
             raise err[0]
+        if done_submitting.is_set() and len(eng.finished) >= sum(
+                1 for r in rids if r is not None):
+            break
         if (eng._inbox or eng.waiting or eng._prefilling
                 or eng.num_active):
             eng.step()
         else:
             time.sleep(0.0005)  # idle: wait for the next arrival
     th.join()
-    return time.monotonic() - t0
+    return time.monotonic() - t0, list(rids)
+
+
+def chaos_injector(seed):
+    """The seeded chaos schedule: >=5 distinct serving-hot-path sites
+    (kv.grow, prefill.dispatch, decode.step, prefix.insert,
+    journal.dump) across every fault kind — raises, a delay, a token
+    corruption (detected, never streamed), a pool squeeze that drives
+    the REAL pool-pressure recovery paths, and an injected dump
+    failure proving a crash dump can't mask an original error."""
+    from paddle_tpu.serving import FaultInjector
+
+    return (FaultInjector(seed=seed)
+            .add("kv.grow", kind="raise", at=2)
+            .add("prefill.dispatch", kind="raise", at=1)
+            .add("prefill.dispatch", kind="delay", every=13, times=2,
+                 delay_ms=2.0)
+            .add("decode.step", kind="raise", at=3)
+            .add("decode.step", kind="corrupt", at=6)
+            .add("decode.step", kind="squeeze", pages=4, at=8)
+            .add("decode.step", kind="release", at=16)
+            .add("prefix.insert", kind="raise", at=1)
+            .add("journal.dump", kind="raise", at=0))
+
+
+def run_chaos(args, reqs, base_rids, base_done, base_goodput):
+    """Re-drive the measured workload against a fresh engine with the
+    seeded fault schedule armed (after a fault-free warmup, so compile
+    time stays out of the SLO comparison). Returns
+    ``(serve_chaos_* dict, ok: bool)``."""
+    from paddle_tpu.profiler import stats
+
+    seed = args.chaos_seed if args.chaos_seed is not None \
+        else args.seed
+    inj = chaos_injector(seed)
+    eng, lens = build_engine(args)
+    if not args.no_warmup:
+        warm = [(np.full((L,), 1, np.int32), 0.0) for L in lens]
+        drive(eng, warm, args.max_new)
+        eng.finished.clear()
+        eng.slo_monitor.reset()
+        if eng.journal is not None:
+            eng.journal.clear()
+    eng.install_faults(inj)
+    t0 = time.monotonic()
+    wall, rids = drive(eng, reqs, args.max_new,
+                       deadline_ms=args.deadline_ms)
+    done_by_id = {r.id: r for r in eng.finished}
+    base_by_id = {r.id: r for r in base_done}
+    # survivor parity: every request the chaos run finished in the
+    # "ok" state must carry exactly the fault-free run's greedy tokens
+    # (keyed by submission index — ids differ between engines)
+    survivors = mismatches = 0
+    failed = {"error": 0, "deadline_exceeded": 0, "shed": 0}
+    for idx, rid in enumerate(rids):
+        if rid is None:
+            failed["shed"] += 1
+            continue
+        req = done_by_id.get(rid)
+        if req is None:
+            continue
+        state = getattr(req, "state", None)
+        if state == "ok":
+            survivors += 1
+            brid = base_rids[idx] if idx < len(base_rids) else None
+            base = base_by_id.get(brid) if brid is not None else None
+            if base is not None and \
+                    list(base.generated) != list(req.generated):
+                mismatches += 1
+        elif state in failed:
+            failed[state] += 1
+        else:
+            failed["error"] += 1
+    n = max(len(rids), 1)
+    judged = [r for r in done_by_id.values()
+              if getattr(r, "slo_ok", None) is not None]
+    goodput = round(sum(1 for r in judged if r.slo_ok)
+                    / len(judged), 4) if judged else None
+    total_tokens = sum(len(r.generated) for r in done_by_id.values())
+    parity = 1.0 if mismatches == 0 and survivors > 0 else 0.0
+    n_failed = sum(failed.values())
+    # pinned goodput bound: losing goodput beyond the failed share
+    # plus slack means the faults degraded SURVIVORS too
+    bound_ok = True
+    if base_goodput is not None and goodput is not None:
+        bound_ok = goodput >= base_goodput - n_failed / n - 0.25
+    # forensic dump with the journal.dump fault armed: must swallow
+    # the injected failure and return None rather than raise
+    dump_survived = 1
+    try:
+        eng.crash_dump(error=None)
+    except BaseException:
+        dump_survived = 0
+    sites = sorted({f["site"] for f in inj.fired})
+    out = {
+        "serve_chaos_seed": seed,
+        "serve_chaos_survivor_parity": parity,
+        "serve_chaos_survivors": survivors,
+        "serve_chaos_request_errors": failed["error"],
+        "serve_chaos_deadline_exceeded": failed["deadline_exceeded"],
+        "serve_chaos_shed": failed["shed"],
+        "serve_chaos_goodput": goodput,
+        "serve_chaos_goodput_bound_ok": int(bound_ok),
+        "serve_chaos_tokens_per_sec": round(total_tokens / wall, 1)
+        if wall > 0 else None,
+        "serve_chaos_faults_injected": len(inj.fired),
+        "serve_chaos_sites_fired": sites,
+        "serve_chaos_step_retries": int(
+            stats.counter("serving.step_retries").value),
+        "serve_chaos_dump_survived": dump_survived,
+        "serve_chaos_wall_s": round(time.monotonic() - t0, 3),
+    }
+    ok = (parity == 1.0 and bound_ok and dump_survived == 1
+          and len(sites) >= 5)
+    return out, ok
 
 
 def main():
@@ -170,6 +312,18 @@ def main():
                          "verdicts and serve_goodput")
     ap.add_argument("--tpot-target", type=float, default=100.0,
                     help="SLO TPOT target (ms)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline from arrival; exceeded "
+                         "-> the request aborts in the "
+                         "deadline_exceeded terminal state")
+    ap.add_argument("--chaos", action="store_true",
+                    help="re-drive the measured workload under a "
+                         "seeded >=5-site fault schedule and pin "
+                         "survivor token parity + bounded goodput "
+                         "loss (serve_chaos_* keys; nonzero exit on "
+                         "a failed pin)")
+    ap.add_argument("--chaos-seed", type=int, default=None,
+                    help="fault-schedule seed (default: --seed)")
     ap.add_argument("--requests-out", default=None,
                     help="write per-request JSONL (id, lens, waits, "
                          "ttft/tpot, preempt/requeue counts, slo_ok) "
@@ -240,12 +394,16 @@ def main():
         stats.reset()
 
     reqs = make_requests(args, lens, rng)
-    wall = drive(eng, reqs, args.max_new)
+    wall, rids = drive(eng, reqs, args.max_new,
+                       deadline_ms=args.deadline_ms)
 
     done = eng.finished
     if eng.journal is not None:
         eng.journal.publish_gauges()
-    ttfts = np.array([r.ttft_s for r in done], np.float64) * 1e3
+    ttfts = np.array([r.ttft_s for r in done
+                      if r.ttft_s is not None], np.float64) * 1e3
+    if ttfts.size == 0:
+        ttfts = np.array([0.0])
     tpots = [r.tpot_s for r in done if r.tpot_s is not None]
     total_tokens = sum(len(r.generated) for r in done)
     # SLO goodput over the WHOLE run (not the monitor's rolling
@@ -270,6 +428,9 @@ def main():
                     "preempts": getattr(r, "n_preempts", 0),
                     "requeues": getattr(r, "n_requeues", 0),
                     "slo_ok": getattr(r, "slo_ok", None),
+                    "state": getattr(r, "state", None),
+                    "error": None if getattr(r, "error", None) is None
+                    else type(r.error).__name__,
                 }) + "\n")
     if args.journal_out and eng.journal is not None:
         eng.journal.dump_jsonl(args.journal_out)
@@ -297,6 +458,11 @@ def main():
         "serve_wall_s": round(wall, 3),
         "telemetry": _telemetry(),
     }
+    chaos_ok = True
+    if args.chaos:
+        chaos_out, chaos_ok = run_chaos(args, reqs, rids, done,
+                                        goodput)
+        out.update(chaos_out)
     if args.mp and args.mp > 1:
         # TP rung keys: serve_tp{N}_* so bench_gate tracks the
         # mp-sharded SLO rungs independently of the mp1 ones (whose
@@ -306,6 +472,11 @@ def main():
                for k, v in out.items()}
         out["serve_mp_degree"] = args.mp
     print(json.dumps(out))
+    if not chaos_ok:
+        print("serve_bench --chaos: robustness pins FAILED "
+              "(survivor parity / goodput bound / dump survival / "
+              "site coverage)", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -57,7 +57,9 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
     """Fold a journal event stream into dashboard state: per-request
     phase/readings/verdicts plus engine-level pressure counts."""
     reqs: dict = {}
-    counts = {"preempt": 0, "requeue": 0, "stall": 0, "error": 0}
+    counts = {"preempt": 0, "requeue": 0, "stall": 0, "error": 0,
+              "deadline_exceeded": 0, "shed": 0, "retry": 0,
+              "watchdog": 0, "fault": 0}
     evicted_pages = 0
     for e in events:
         ev = e.get("ev")
@@ -100,7 +102,9 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
             r["tpot_ms"] = e.get("tpot_ms")
             r["n_tokens"] = e.get("n_tokens")
             r["slo_ok"] = e.get("slo_ok")
-        elif ev == "error":
+        elif ev in ("error", "deadline_exceeded", "shed"):
+            # ISSUE 11 terminal failure states all render as the
+            # error phase; the counts dict keeps them distinguishable
             r["phase"] = "error"
     # re-judge requests whose journal predates the monitor's verdict
     # (or judge against CLI-supplied targets)
@@ -137,7 +141,13 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
         "preemptions": counts["preempt"],
         "requeues": counts["requeue"],
         "stalls": counts["stall"],
-        "errors": counts["error"],
+        "errors": (counts["error"] + counts["deadline_exceeded"]
+                   + counts["shed"]),
+        "deadline_exceeded": counts["deadline_exceeded"],
+        "shed": counts["shed"],
+        "retries": counts["retry"],
+        "watchdog_trips": counts["watchdog"],
+        "faults_injected": counts["fault"],
         "evicted_pages": evicted_pages,
         "slots": None,  # live mode fills the real max_batch
     }
@@ -205,6 +215,11 @@ def render(summary: dict, top: int = 5,
         f"pressure: preempts {s['preemptions']}  "
         f"requeues {s['requeues']}  stalls {s['stalls']}  "
         f"evicted_pages {s['evicted_pages']}",
+        f"faults: injected {s.get('faults_injected', 0)}  "
+        f"retries {s.get('retries', 0)}  "
+        f"watchdog {s.get('watchdog_trips', 0)}  "
+        f"deadline_exceeded {s.get('deadline_exceeded', 0)}  "
+        f"shed {s.get('shed', 0)}",
     ]
     slowest = sorted(
         (r for r in s["requests"].values()
