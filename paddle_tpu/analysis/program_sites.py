@@ -267,6 +267,46 @@ def build_decode_program(cast_bf16: bool = True):
     return fn, args
 
 
+def _build_spec_verify():
+    """The speculative-decoding batched verify program (ISSUE 12,
+    inference/speculative.py): one streamed prefill-chunk pass over the
+    (k+1)-token draft window with the fused accept-prefix/bonus tail.
+    Built over a bf16-cast tiny ContinuousBatchingEngine so the DTYPE
+    pass guards the serving bf16 contract on the verify path too."""
+    import functools
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..inference.engine import (ContinuousBatchingEngine,
+                                    FusedCausalLM)
+
+    paddle.seed(0)
+    model = FusedCausalLM(vocab_size=256, embed_dim=64, num_heads=2,
+                          dim_feedforward=128, num_layers=2,
+                          max_position=256)
+    st = model.stack
+    for n in ("qkv", "out", "ffn1", "ffn2"):
+        for suffix in ("weight", "bias"):
+            p = getattr(st, f"{n}_{suffix}")
+            p._rebind(p._data.astype(jnp.bfloat16))
+    eng = ContinuousBatchingEngine(model, max_batch=4, page_size=16,
+                                   max_length=64, speculative="self",
+                                   spec_k=4)
+    spec = eng._spec
+    b, k = eng.max_batch, spec.k
+    tables = eng._mgr.block_tables(
+        [("slot", i) for i in range(b)], eng._pages_per_seq,
+        allow_missing=True)
+    fn = functools.partial(spec._verify_fn, k=k)
+    args = (eng._gen._weights(), eng._gen._embed(), eng._gen._head_t,
+            model.lnf_scale._data, model.lnf_bias._data,
+            _sds((b, k + 1), jnp.int32), _sds((b,), jnp.int32),
+            _sds((b,), jnp.int32), _sds((b, k), jnp.int32),
+            eng._ck, eng._cv, tables)
+    return fn, args
+
+
 PROGRAM_SITES: List[ProgramSite] = [
     ProgramSite("dispatch.gelu", _build_gelu,
                 compute_dtype="bfloat16",
@@ -287,4 +327,6 @@ PROGRAM_SITES: List[ProgramSite] = [
     ProgramSite("inference.decode", _build_decode,
                 compute_dtype="bfloat16", hot_loop=True,
                 donate_argnums=(7, 8)),
+    ProgramSite("serve.verify", _build_spec_verify,
+                compute_dtype="bfloat16", donate_argnums=(9, 10)),
 ]

@@ -176,6 +176,20 @@ define_flag("serve_shed_burn_rate", 0.0,
             "overload shedding on service health: reject submits "
             "with ServerOverloaded while the rolling SLO burn-rate "
             "gauge (serving/slo.py) exceeds this; 0 disables")
+define_flag("spec_k", 4,
+            "speculative decoding window (inference/speculative.py): "
+            "draft tokens proposed per verify round when the engines "
+            "run with speculative= and no explicit spec_k; the verify "
+            "pass scores k+1 tokens in ONE streamed program, so the "
+            "weight stack is read once per accepted window instead of "
+            "once per token")
+define_flag("spec_drafter", "self",
+            "default drafter for speculative=True: self (Medusa-style "
+            "training-free self-drafting heads off the target's "
+            "hidden state — zero extra weights to stream) | draft "
+            "(requires an explicit FusedCausalLM draft model / "
+            "DraftModelDrafter passed as speculative=, which keeps "
+            "its own tiny non-paged KV state)")
 define_flag("serve_chunk_shrink", True,
             "graceful degradation under pool pressure: before a "
             "prefill chunk stalls/requeues for pages, shrink it "

@@ -474,8 +474,8 @@ class FusedMultiTransformer(Layer):
                 vg = gather_kv_pages(ncv, tbl)
                 S = kg.shape[1]
                 qh = q.reshape(b, c, n_kv, group, hd)
-                # tpu-lint: ok(X-PROMOTE) -- attention scores fp32 by
-                # design (softmax stability; QK reads are KV-bound)
+                # fp32 scores by design (softmax stability; KV-bound)
+                # tpu-lint: ok(X-PROMOTE) -- attention scores fp32 by design
                 logits = jnp.einsum(
                     "btngd,bsnd->bngts",
                     qh.astype(jnp.float32) * scale,
@@ -485,8 +485,7 @@ class FusedMultiTransformer(Layer):
                 logits = jnp.where(mask[:, None, None], logits,
                                    jnp.finfo(jnp.float32).min)
                 wts = jax.nn.softmax(logits, axis=-1)
-                # tpu-lint: ok(X-PROMOTE) -- fp32 PV accumulation
-                # pairs with scores
+                # tpu-lint: ok(X-PROMOTE) -- fp32 PV accumulation pairs with scores
                 out = jnp.einsum("bngts,bsnd->btngd", wts,
                                  vg.astype(jnp.float32))
                 return out.reshape(b, c, n_kv * group, hd) \

@@ -22,11 +22,15 @@ from ..core.tensor import Tensor
 from .engine import (DEFAULT_DECODE_CHUNK, ContinuousBatchingEngine,
                      FusedCausalLM, GenerationEngine, GenRequest)
 from .kv_cache import BlockKVCacheManager
+from .speculative import (Drafter, DraftModelDrafter, ScheduledDrafter,
+                          SelfDraftHeads, SpeculativeDecoder)
 
 __all__ = [
     "Config", "create_predictor", "Predictor", "PredictorTensor",
     "FusedCausalLM", "GenerationEngine", "BlockKVCacheManager",
     "ContinuousBatchingEngine", "GenRequest", "DEFAULT_DECODE_CHUNK",
+    "Drafter", "DraftModelDrafter", "SelfDraftHeads",
+    "ScheduledDrafter", "SpeculativeDecoder",
 ]
 
 

@@ -590,6 +590,12 @@ class ContinuousBatchingEngine:
     prefilled into the shared cache), so decode shapes stay constant and
     nothing recompiles as traffic churns.
 
+    ``speculative=`` (ISSUE 12, inference/speculative.py): replace the
+    decode chunk with draft+verify rounds — k drafted tokens verified
+    in ONE streamed pass, amortizing the per-token weight stream by
+    the accept length, with greedy parity guaranteed whatever the
+    drafter proposes.
+
     Usage::
 
         eng = ContinuousBatchingEngine(model, max_batch=4)
@@ -604,7 +610,8 @@ class ContinuousBatchingEngine:
                  prompt_bucket: int = 16, kv_dtype=None,
                  quant: Optional[str] = None, admit_window: int = 8,
                  starvation_bound: int = 16, mesh=None,
-                 mp_degree: Optional[int] = None):
+                 mp_degree: Optional[int] = None, speculative=None,
+                 spec_k: Optional[int] = None):
         self.model = model
         self.max_batch = int(max_batch)
         self.max_length = int(max_length)
@@ -658,6 +665,19 @@ class ContinuousBatchingEngine:
         self._slots: list = [None] * self.max_batch   # GenRequest or None
         self._lens = np.zeros((self.max_batch,), np.int64)
         self._last_tok = np.zeros((self.max_batch,), np.int64)
+        # speculative decoding (inference/speculative.py): when set,
+        # step() runs one draft+verify round in place of the decode
+        # chunk — the weight stack streams once per ACCEPTED WINDOW
+        # instead of once per token. ``speculative`` accepts True
+        # (FLAGS_spec_drafter), "self" (Medusa-style self-drafting
+        # heads), a Drafter instance, or a small FusedCausalLM draft
+        # model; ``spec_k`` defaults to FLAGS_spec_k.
+        self._spec = None
+        if speculative:
+            from .speculative import build_speculative_decoder
+
+            self._spec = build_speculative_decoder(
+                self, speculative, spec_k)
 
     # ---------------- public API ----------------
 
@@ -675,10 +695,13 @@ class ContinuousBatchingEngine:
 
     def step(self):
         """Admit waiting requests into free slots, then run ONE decode
-        chunk for the active batch. Returns requests finished this step."""
+        chunk — or, with ``speculative=`` set, one draft+verify round —
+        for the active batch. Returns requests finished this step."""
         self._admit()
         if self.num_active == 0:
             return []
+        if self._spec is not None:
+            return self._spec_step()
         k = self.decode_chunk
         active = [i for i, r in enumerate(self._slots) if r is not None]
         fi = self._faults
@@ -771,6 +794,44 @@ class ContinuousBatchingEngine:
         self.finished.extend(done_now)
         return done_now
 
+    def _spec_step(self):
+        """One SPECULATIVE round in place of the decode chunk: the
+        drafter proposes k tokens per active slot, ONE streamed verify
+        pass (``prefill_chunk_raw`` over the paged pool) scores every
+        window, and the fused accept-prefix emits the accepted drafts
+        plus the bonus token — greedy-parity by construction, and a
+        rejection costs only a page-table truncation
+        (inference/speculative.py)."""
+        k = self._spec.k
+        active = [i for i, r in enumerate(self._slots) if r is not None]
+        fi = self._faults
+        if fi is not None and active:
+            # same decode.step fault site as the chunk path, fired
+            # BEFORE the grows so pool squeezes hit the real recovery
+            fi.fire("decode.step")
+        # per-slot window, clamped so the verify never writes past what
+        # the request can still emit (which also bounds it to the page
+        # table: cached + remaining <= max_length by the submit check)
+        win = np.zeros((self.max_batch,), np.int64)
+        for i in active:
+            req = self._slots[i]
+            if req is None:
+                continue  # preempted by an earlier slot's grow
+            remaining = req.max_new_tokens - len(req.generated)
+            w = max(1, min(k + 1, remaining,
+                           self.max_length - (int(self._lens[i]) - 1)))
+            win[i] = w
+            need = min(self._mgr.pages_needed(
+                int(self._lens[i]) - 1 + w), self._pages_per_seq)
+            have = len(self._mgr._owned.get(("slot", i), ()))
+            if need > have and \
+                    not self._grow_decode_slot(i, need - have):
+                continue  # slot preempted (serving override)
+        active = [i for i in active if self._slots[i] is not None]
+        if not active:
+            return []
+        return self._spec.run_round(self, active, win)
+
     def run(self):
         """Drain: step until every submitted request finishes."""
         while self.waiting or self.num_active:
@@ -784,6 +845,10 @@ class ContinuousBatchingEngine:
         self._slots[i] = None
         self._lens[i] = 0
         self._last_tok[i] = 0
+        if self._spec is not None:
+            # slot reuse: the next occupant's drafter state re-drafts
+            # from its own recorded history (resume semantics)
+            self._spec.reset_slot(i)
 
     def _postprocess_tokens(self, toks_np, active):
         """Hook over the decode chunk's fetched token matrix, called

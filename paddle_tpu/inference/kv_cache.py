@@ -165,6 +165,25 @@ class BlockKVCacheManager:
     def free(self, seq_id) -> None:
         self.release_pages(self._owned.pop(seq_id, []))
 
+    def truncate(self, seq_id, new_len: int) -> List[int]:
+        """Page-granular ROLLBACK: shrink ``seq_id``'s page list to
+        exactly ``pages_needed(new_len)`` leading pages, releasing the
+        tail (the speculative-decoding rejection path — KV written in
+        the rejected window is masked-dead, so only the page TABLE
+        rolls back; no data moves). Releasing is refcount-aware: a
+        tail page also held by the prefix cache or another sequence
+        just drops this sequence's reference and stays live — shared
+        prefix pages are NEVER freed by a rejection. Returns the pages
+        released (possibly still live under other references)."""
+        keep = self.pages_needed(max(int(new_len), 0))
+        owned = self._owned.get(seq_id)
+        if owned is None or keep >= len(owned):
+            return []
+        tail = owned[keep:]
+        del owned[keep:]
+        self.release_pages(tail)
+        return tail
+
     # ---------- refcounting (prefix/KV reuse) ----------
 
     def retain(self, pages: Sequence[int]) -> None:

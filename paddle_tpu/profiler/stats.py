@@ -53,6 +53,12 @@ Conventions for the built-in instrumentation (all optional reading):
   TTFT and TPOT targets, ``slo.burn_rate`` error-budget burn,
   ``slo.{finished,ok,ttft_miss,tpot_miss}`` counters and
   ``slo.{queue_depth,slot_occupancy}`` load gauges
+- ``spec.*``                   speculative decoding
+  (inference/speculative.py): ``spec.k`` / ``spec.draft_params``
+  gauges and ``spec.{propose_ms,verify_ms}`` timing histograms; the
+  round/token accounting lives in
+  ``serving.spec_{rounds,drafted_tokens,accepted_tokens,
+  rejected_tokens}`` and the ``serve.accept_len`` histogram
 - ``quant.{act_quant_calls,a8w8_matmuls}``  executed dynamic
   activation-quant ops / int8 x int8 serving matmuls (A8W8 decode,
   QuantedLinear(a8w8=True)) — counted at the dispatch layer, since
@@ -96,8 +102,8 @@ __all__ = [
 #: starts with one of these
 CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
-    "inference.", "serving.", "serve.", "journal.", "slo.", "quant.",
-    "moe.", "dist.", "roofline.", "hbm.", "lint.", "t.",
+    "inference.", "serving.", "serve.", "journal.", "slo.", "spec.",
+    "quant.", "moe.", "dist.", "roofline.", "hbm.", "lint.", "t.",
 )
 
 _ENABLED = True

@@ -62,11 +62,14 @@ __all__ = ["FlightRecorder", "LIFECYCLE_EVENTS", "chrome_trace",
 #: (ISSUE 11 adds the failure-semantics events: ``fault`` = an
 #: injected-fault fire, ``retry`` = a crash-isolated step backoff,
 #: ``watchdog`` = a no-progress trip, and the terminal
-#: ``deadline_exceeded`` / ``shed``)
+#: ``deadline_exceeded`` / ``shed``; ISSUE 12 adds ``spec_verify`` —
+#: one speculative draft+verify round on a decode slot, with
+#: ``k``/``accepted``/``dur_ms`` extras, rendered as a span in the
+#: chrome trace and folded into serve_top's accept-rate row)
 LIFECYCLE_EVENTS = (
     "submit", "queued", "admitted", "prefill_chunk", "first_token",
-    "decode", "preempt", "requeue", "stall", "evict_trigger",
-    "fault", "retry", "watchdog",
+    "decode", "spec_verify", "preempt", "requeue", "stall",
+    "evict_trigger", "fault", "retry", "watchdog",
     "finish", "error", "deadline_exceeded", "shed",
 )
 
@@ -249,6 +252,15 @@ def chrome_trace(events: List[dict], process_index: int = 0) -> dict:
             args = {k: v for k, v in e.items()
                     if k not in ("seq", "ts", "ev", "rid", "slot")}
             args["rid"] = rid
+            if ev == "spec_verify" and "dur_ms" in args:
+                # the verify round is journaled at COMPLETION with its
+                # wall time — render a proper duration span ending at
+                # ts instead of an instant mark
+                dur = max(float(args["dur_ms"]) * 1e3, 0.0)
+                out.append({"name": "spec_verify", "ph": "X",
+                            "pid": pid, "tid": tid, "ts": ts - dur,
+                            "dur": dur, "cat": "serve", "args": args})
+                continue
             out.append({"name": ev, "ph": "i", "pid": pid, "tid": tid,
                         "ts": ts, "s": "t", "cat": "serve",
                         "args": args})

@@ -38,6 +38,20 @@ stats snapshot + every still-unserved request to a JSONL crash
 artifact on any raise (``crash_dump``), so a production stack trace
 always arrives with the request timelines that led to it.
 
+Speculative decoding (ISSUE 12): constructed with ``speculative=``
+(and optionally ``spec_k=``), the engine's decode slot of the
+SLO-weighted interleave cycle runs DRAFT+VERIFY rounds instead of
+token-by-token chunks (``inference/speculative.py`` — one streamed
+``serve.verify[k=*,mp=N]`` pass per accepted window, greedy parity by
+construction). It composes with everything here: chunked prefill
+interleaves unchanged, preemption-by-recompute resets the drafter
+slot so a resumed request re-drafts, accepted tokens count as
+watchdog/deadline progress, and under TP the verify pass shard_maps
+like ``prefill_chunk_raw`` while draft weights stay replicated. Each
+round lands a ``spec_verify[k,accepted]`` journal event and the
+``serve.accept_len`` histogram; serve_top renders the accept-rate
+row.
+
 Failure semantics (ISSUE 11 — see README "Failure semantics"): one
 request's failure must never take the loop down. Per-request
 ``deadline_ms`` aborts a request wherever it sits (queue/prefill/

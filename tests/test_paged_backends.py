@@ -340,6 +340,154 @@ def test_int8_kv_fused_kernel_parity():
         np.testing.assert_array_equal(np.asarray(kq2)[other], kq[other])
 
 
+class TestTruncateRollback:
+    """BlockKVCacheManager.truncate (ISSUE 12): the speculative-
+    decoding rejection path is a PAGE-TABLE rollback with exact
+    free-pool/refcount accounting — shared prefix pages must never be
+    freed by a rejection while another holder is live."""
+
+    def _mgr(self, ps=4, pages=32):
+        from paddle_tpu.inference.kv_cache import BlockKVCacheManager
+
+        return BlockKVCacheManager(2, 2, 8, ps, num_pages=pages,
+                                   reserve_scratch=True)
+
+    def test_exact_free_pool_accounting(self):
+        mgr = self._mgr()
+        free0 = mgr.free_pages
+        mgr.allocate("s", 20)                      # 5 pages
+        assert mgr.free_pages == free0 - 5
+        released = mgr.truncate("s", 9)            # keep ceil(9/4) = 3
+        assert len(released) == 2
+        assert mgr.free_pages == free0 - 3
+        assert len(mgr._owned["s"]) == 3
+        for p in released:
+            assert mgr.refcount(p) == 0
+        # released pages are immediately reusable
+        mgr.grow("s", 2)
+        assert mgr.free_pages == free0 - 5
+        mgr.free("s")
+        assert mgr.free_pages == free0 and mgr._refs == {}
+
+    def test_noop_when_already_covered(self):
+        mgr = self._mgr()
+        mgr.allocate("s", 8)                       # 2 pages
+        assert mgr.truncate("s", 8) == []
+        assert mgr.truncate("s", 12) == []         # larger than held
+        assert len(mgr._owned["s"]) == 2
+        assert mgr.truncate("missing", 0) == []    # unknown seq: no-op
+
+    def test_shared_prefix_pages_survive_truncate(self):
+        """A truncated tail page also held by the prefix cache (or any
+        sharer) drops to its other holder instead of the free list."""
+        mgr = self._mgr()
+        free0 = mgr.free_pages
+        pages = mgr.allocate("a", 16)              # 4 pages
+        mgr.retain(pages[:2])                      # prefix-cache refs
+        released = mgr.truncate("a", 0)            # drop everything
+        assert released == pages
+        # tail pages freed; the retained prefix pages stay live at rc 1
+        assert mgr.refcount(pages[0]) == 1
+        assert mgr.refcount(pages[1]) == 1
+        assert mgr.refcount(pages[2]) == 0
+        assert mgr.free_pages == free0 - 2
+        mgr.release_pages(pages[:2])               # cache eviction
+        assert mgr.free_pages == free0
+
+    def test_truncate_sharer_keeps_prefix_alive_for_owner(self):
+        mgr = self._mgr()
+        pa = mgr.allocate("a", 8)                  # 2 full pages
+        mgr.share("b", pa)                         # b maps a's prefix
+        mgr.grow("b", 2)                           # b's private tail
+        # b speculates past its tail and rolls all the way back into
+        # the SHARED region: a's pages must survive at refcount 1
+        mgr.truncate("b", 4)                       # keep 1 shared page
+        assert mgr.refcount(pa[0]) == 2
+        assert mgr.refcount(pa[1]) == 1            # b's ref dropped
+        assert pa[1] not in mgr._free              # a still owns it
+        mgr.free("b")
+        mgr.free("a")
+        assert mgr._refs == {}
+
+    def test_property_randomized_refcount_model(self):
+        """Property test: a random op sequence (allocate/grow/share/
+        truncate/free) against a pure-python refcount model — the
+        manager's free list and refcounts must match the model after
+        EVERY op."""
+        rng = np.random.RandomState(0xC0FFEE)
+        mgr = self._mgr(ps=4, pages=64)
+        model_refs = {}                            # page -> rc
+        model_owned = {}                           # seq -> [pages]
+        next_seq = 0
+
+        def check():
+            assert mgr._refs == model_refs
+            live = set(model_refs)
+            expect_free = (mgr.num_pages - 1) - len(live)  # -scratch
+            assert mgr.free_pages == expect_free
+            for s, pgs in model_owned.items():
+                assert mgr._owned.get(s, []) == pgs
+
+        for _step in range(300):
+            ops = ["alloc"]
+            if model_owned:
+                ops += ["grow", "truncate", "free", "share"]
+            op = ops[rng.randint(len(ops))]
+            seqs = list(model_owned)
+            if op == "alloc" and mgr.free_pages >= 4:
+                sid = f"s{next_seq}"
+                next_seq += 1
+                n_tok = int(rng.randint(1, 17))
+                got = mgr.allocate(sid, n_tok)
+                model_owned[sid] = list(got)
+                for p in got:
+                    model_refs[p] = 1
+            elif op == "grow" and seqs and mgr.free_pages >= 2:
+                sid = seqs[rng.randint(len(seqs))]
+                got = mgr.grow(sid, int(rng.randint(1, 3)))
+                model_owned[sid].extend(got)
+                for p in got:
+                    model_refs[p] = 1
+            elif op == "share" and seqs:
+                src = seqs[rng.randint(len(seqs))]
+                if not model_owned[src]:
+                    continue
+                sid = f"s{next_seq}"
+                next_seq += 1
+                shared = model_owned[src][:rng.randint(
+                    1, len(model_owned[src]) + 1)]
+                mgr.share(sid, shared)
+                model_owned[sid] = list(shared)
+                for p in shared:
+                    model_refs[p] += 1
+            elif op == "truncate" and seqs:
+                sid = seqs[rng.randint(len(seqs))]
+                new_len = int(rng.randint(
+                    0, 4 * len(model_owned[sid]) + 1))
+                keep = -(-new_len // 4)
+                expect_rel = model_owned[sid][keep:]
+                got = mgr.truncate(sid, new_len)
+                assert got == expect_rel
+                del model_owned[sid][keep:]
+                for p in expect_rel:
+                    model_refs[p] -= 1
+                    if model_refs[p] == 0:
+                        del model_refs[p]
+            elif op == "free" and seqs:
+                sid = seqs[rng.randint(len(seqs))]
+                mgr.free(sid)
+                for p in model_owned.pop(sid):
+                    model_refs[p] -= 1
+                    if model_refs[p] == 0:
+                        del model_refs[p]
+            check()
+        # drain everything: the pool must return to pristine
+        for sid in list(model_owned):
+            mgr.free(sid)
+        assert mgr._refs == {}
+        assert mgr.free_pages == mgr.num_pages - 1
+
+
 def test_int8_kv_engine_tokens():
     """GenerationEngine kv_dtype='int8' end-to-end vs full-precision KV:
     greedy tokens must agree on a small model."""

@@ -1,0 +1,408 @@
+"""Speculative decoding (ISSUE 12): greedy-token parity for every
+drafter, fused-verify numerics, rollback accounting, scheduler
+composition (chunked prefill / preemption / deadlines / faults), the
+trace-pinned amortization bound, and TP mp2.
+
+The load-bearing invariant: ACCEPTANCE NEVER CHANGES OUTPUT. The
+verify pass computes the target's own greedy picks at every window
+position and accepts a draft token only when it equals them — so the
+emitted stream is byte-identical to non-speculative greedy decode for
+ANY drafter, including adversarially wrong ones.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn.fused_transformer import (
+    FusedMultiTransformer, PagedKV, rope_table)
+from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                  DraftModelDrafter, FusedCausalLM,
+                                  ScheduledDrafter)
+from paddle_tpu.inference.kv_cache import BlockKVCacheManager
+from paddle_tpu.profiler import stats
+
+
+def _model(seed=7):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=64, embed_dim=32, num_heads=4,
+                         dim_feedforward=64, num_layers=2,
+                         max_position=128)
+
+
+def _draft_model(seed=99):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=64, embed_dim=16, num_heads=2,
+                         dim_feedforward=32, num_layers=1,
+                         max_position=128)
+
+
+def _prompts(n=3, seed=3):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 64, (L,)) for L in (3, 6, 9)[:n]]
+
+
+def _run(speculative=None, prompts=None, n_new=8, seed=7, eos=None,
+         **kw):
+    """Engine run -> per-submission generated streams (id-ordered)."""
+    prompts = _prompts() if prompts is None else prompts
+    eng = ContinuousBatchingEngine(
+        _model(seed), max_batch=4, page_size=4, max_length=64,
+        decode_chunk=2, speculative=speculative, **kw)
+    rids = [eng.submit(p, max_new_tokens=n_new, eos_token_id=eos)
+            for p in prompts]
+    eng.run()
+    by = {r.id: list(r.generated) for r in eng.finished}
+    return [by[r] for r in rids]
+
+
+# =====================================================================
+# the verify program's numerics: chunked verify == sequential decode
+# =====================================================================
+
+class TestVerifyProgramNumerics:
+    def test_chunk_verify_matches_sequential_decode(self):
+        """The verify pass scores a window with prefill_chunk_raw; the
+        non-speculative engine scores it token-by-token with
+        decode_raw. Over a RANDOM cache state and a random window the
+        two paths must agree on every hidden state — the numeric
+        foundation under every parity test below (discriminating even
+        where tiny random models emit convergent streams)."""
+        paddle.seed(13)
+        st = FusedMultiTransformer(32, 4, 64, 2, max_position=64)
+        cos, sin = rope_table(64, st.head_dim)
+        w = st._stack()
+        b, L, win_len, ps, pp = 2, 6, 4, 4, 8
+        mgr = BlockKVCacheManager(st.num_layers, st.num_kv_heads,
+                                  st.head_dim, ps, num_pages=32,
+                                  reserve_scratch=True)
+        for i in range(b):
+            mgr.allocate(i, L + win_len)
+        tables = mgr.block_tables(range(b), pp)
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(b, L, 32).astype(np.float32))
+        _h, cache = st.prefill_raw(w, x, mgr.fresh_cache(), tables,
+                                   cos, sin)
+        win = jnp.asarray(rng.randn(b, win_len, 32).astype(np.float32))
+
+        h_chunk, _c = st.prefill_chunk_raw(
+            w, win, cache, tables, jnp.full((b,), L, jnp.int32),
+            jnp.full((b,), win_len, jnp.int32), cos, sin)
+
+        ck, cv = cache.k, cache.v
+        seq = []
+        for j in range(win_len):
+            hj, c2 = st.decode_raw(
+                w, win[:, j], PagedKV(ck, cv), tables,
+                jnp.full((b,), L + j, jnp.int32), cos, sin)
+            ck, cv = c2.k, c2.v
+            seq.append(np.asarray(hj))
+        np.testing.assert_allclose(
+            np.asarray(h_chunk), np.stack(seq, axis=1),
+            atol=2e-4, rtol=2e-4)
+
+
+# =====================================================================
+# greedy-token parity: every drafter, forced schedules
+# =====================================================================
+
+class TestGreedyParity:
+    def test_self_draft_heads_parity(self):
+        assert _run() == _run("self", spec_k=3)
+
+    def test_draft_model_parity(self):
+        assert _run() == _run(DraftModelDrafter(_draft_model()),
+                              spec_k=3)
+
+    def test_draft_model_instance_shorthand(self):
+        # a bare FusedCausalLM wraps into a DraftModelDrafter
+        assert _run() == _run(_draft_model(), spec_k=2)
+
+    @pytest.mark.parametrize("k", [1, 3, 5])
+    def test_forced_full_accept_schedule(self, k):
+        """Oracle drafts (the true greedy stream) — every draft
+        accepts, and the output still matches exactly."""
+        base = _run()
+        prompts = _prompts()
+        exp = {np.asarray(p, np.int32).tobytes(): g
+               for p, g in zip(prompts, base)}
+        stats.reset()
+        got = _run(ScheduledDrafter(
+            lambda r: exp[np.asarray(r.prompt).tobytes()]),
+            prompts=prompts, spec_k=k)
+        assert got == base
+        drafted = stats.counter("serving.spec_drafted_tokens").value
+        accepted = stats.counter("serving.spec_accepted_tokens").value
+        assert drafted > 0
+        # full-accept schedule: only window clamping (request tails)
+        # may reject
+        assert accepted >= drafted - len(prompts) * k
+
+    def test_forced_full_reject_schedule(self):
+        """Adversarial drafts (true next token + 1, guaranteed wrong)
+        — every round rejects everything and emits only the bonus
+        token, degenerating to per-token decode THROUGH THE VERIFY
+        PATH; output still byte-identical."""
+        base = _run()
+        prompts = _prompts()
+        wrong = {np.asarray(p, np.int32).tobytes(): [(t + 1) % 64 for t in g]
+                 for p, g in zip(prompts, base)}
+        stats.reset()
+        got = _run(ScheduledDrafter(
+            lambda r: wrong[np.asarray(r.prompt).tobytes()]),
+            prompts=prompts, spec_k=3)
+        assert got == base
+        assert stats.counter("serving.spec_accepted_tokens").value == 0
+        assert stats.counter("serving.spec_rejected_tokens").value > 0
+
+    def test_eos_mid_window_parity(self):
+        base = _run(n_new=8)
+        eos = base[0][0]  # a token the stream actually emits
+        assert _run(n_new=8, eos=eos) == \
+            _run("self", n_new=8, eos=eos, spec_k=3)
+
+    def test_single_token_requests(self):
+        # max_new_tokens=1 finishes at admission; spec must not break
+        assert _run(n_new=1) == _run("self", n_new=1, spec_k=3)
+
+
+# =====================================================================
+# rollback + telemetry accounting
+# =====================================================================
+
+class TestAccountingAndRollback:
+    def test_counters_and_accept_len_histogram(self):
+        stats.reset()
+        _run("self", prompts=_prompts(1), spec_k=3, n_new=8)
+        rounds = stats.counter("serving.spec_rounds").value
+        drafted = stats.counter("serving.spec_drafted_tokens").value
+        accepted = stats.counter("serving.spec_accepted_tokens").value
+        rejected = stats.counter("serving.spec_rejected_tokens").value
+        assert rounds > 0 and drafted == rounds * 3
+        assert accepted + rejected == drafted
+        h = stats.histogram("serve.accept_len")
+        assert h.count == rounds  # one observation per slot per round
+        assert stats.gauge("spec.k").value == 3
+
+    def test_no_page_leak_and_exact_pool_drain(self):
+        """Every speculative run must drain back to the exact starting
+        free-pool count — grows for rejected windows are handed back
+        by BlockKVCacheManager.truncate."""
+        eng = ContinuousBatchingEngine(
+            _model(), max_batch=2, page_size=4, max_length=64,
+            speculative="self", spec_k=4)
+        free0 = eng._mgr.free_pages
+        for p in _prompts(2):
+            eng.submit(p, max_new_tokens=10)
+        eng.run()
+        assert eng._mgr.free_pages == free0
+        assert eng._mgr._refs == {}
+
+    def test_amortization_bound_trace_pinned(self):
+        """ONE streamed verify pass per accepted window: with oracle
+        drafts (accept rate 1.0) the round count is exactly
+        ceil((n_new - 1) / (k + 1)) — vs n_new - 1 streamed chunks for
+        non-speculative decode at chunk 1 — and never exceeds the
+        non-speculative streamed-call count / mean(accept_len)."""
+        n_new, k = 16, 3
+        prompts = _prompts(1)
+        base = _run(prompts=prompts, n_new=n_new)
+        exp = {np.asarray(p, np.int32).tobytes(): g
+               for p, g in zip(prompts, base)}
+        stats.reset()
+        got = _run(ScheduledDrafter(
+            lambda r: exp[np.asarray(r.prompt).tobytes()]),
+            prompts=prompts, spec_k=k, n_new=n_new)
+        assert got == base
+        rounds = stats.counter("serving.spec_rounds").value
+        drafted = stats.counter("serving.spec_drafted_tokens").value
+        accepted = stats.counter("serving.spec_accepted_tokens").value
+        assert rounds == -(-(n_new - 1) // (k + 1))  # ceil: 4, not 15
+        mean_accept = accepted / rounds
+        assert mean_accept > 0
+        assert rounds <= (n_new - 1) / mean_accept
+
+    def test_bad_spec_k_raises(self):
+        with pytest.raises(ValueError, match="spec_k"):
+            ContinuousBatchingEngine(_model(), max_batch=2,
+                                     page_size=4, max_length=64,
+                                     speculative="self", spec_k=0)
+
+    def test_draft_flag_without_model_raises(self):
+        with pytest.raises(ValueError, match="draft model"):
+            ContinuousBatchingEngine(_model(), max_batch=2,
+                                     page_size=4, max_length=64,
+                                     speculative="draft")
+
+
+# =====================================================================
+# serving-scheduler composition
+# =====================================================================
+
+def _serve(speculative=None, prompts=None, n_new=8, seed=7,
+           max_batch=4, **kw):
+    from paddle_tpu.serving import ServingEngine, SLOConfig
+
+    prompts = _prompts() if prompts is None else prompts
+    eng = ServingEngine(
+        _model(seed), max_batch=max_batch, page_size=4, max_length=64,
+        decode_chunk=2, slo=SLOConfig(prefill_chunk=4),
+        speculative=speculative, spec_k=3, **kw)
+    rids = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+    eng.run()
+    by = {r.id: r for r in eng.finished}
+    return eng, [by[r] for r in rids]
+
+
+class TestServingComposition:
+    def test_serving_engine_spec_parity_with_chunked_prefill(self):
+        _e1, base = _serve()
+        _e2, spec = _serve("self")
+        assert [r.generated for r in base] == \
+            [r.generated for r in spec]
+        assert all(r.state == "ok" for r in spec)
+
+    def test_spec_verify_journal_events_and_chrome_span(self):
+        from paddle_tpu.serving.journal import (LIFECYCLE_EVENTS,
+                                                chrome_trace)
+
+        assert "spec_verify" in LIFECYCLE_EVENTS
+        eng, done = _serve("self", prompts=_prompts(1))
+        evs = eng.journal.events(done[0].id)
+        sv = [e for e in evs if e["ev"] == "spec_verify"]
+        assert sv and all("k" in e and "accepted" in e for e in sv)
+        trace = chrome_trace(eng.journal.events())
+        spans = [t for t in trace["traceEvents"]
+                 if t.get("name") == "spec_verify"]
+        assert spans and all(t["ph"] == "X" for t in spans)
+
+    def test_preemption_resume_redrafts_parity(self):
+        """Preempt a speculating slot by recompute mid-stream: the
+        request re-admits, its drafter state resets ('resume
+        re-drafts'), and the user-visible stream continues exactly —
+        token parity with the untouched run."""
+        _e0, base = _serve("self", prompts=_prompts(2), n_new=10)
+        from paddle_tpu.serving import ServingEngine, SLOConfig
+
+        eng = ServingEngine(
+            _model(), max_batch=2, page_size=4, max_length=64,
+            decode_chunk=2, slo=SLOConfig(prefill_chunk=4),
+            speculative="self", spec_k=3)
+        rids = [eng.submit(p, max_new_tokens=10)
+                for p in _prompts(2)]
+        # run until both are decoding with a few tokens out, then
+        # preempt slot 0 (vLLM-style recompute), then drain
+        for _ in range(30):
+            eng.step()
+            if all(r is not None for r in eng._slots) and \
+                    len(eng._slots[0].generated) >= 3:
+                break
+        assert eng._slots[0] is not None
+        eng._preempt_slot(0)
+        eng.run()
+        by = {r.id: r for r in eng.finished}
+        assert [by[r].generated for r in rids] == \
+            [r.generated for r in base]
+        assert stats is not None
+
+    def test_mid_verify_fault_retries_cleanly(self):
+        """An injected decode.step raise lands INSIDE a speculative
+        round; the crash-isolated retry re-runs the round (drafter
+        propose is idempotent) and the stream stays byte-identical."""
+        from paddle_tpu.serving import FaultInjector
+
+        _e0, base = _serve("self", prompts=_prompts(2))
+        inj = FaultInjector(seed=0).add("decode.step", kind="raise",
+                                        at=2)
+        _e1, got = _serve("self", prompts=_prompts(2), faults=inj)
+        assert [r.generated for r in got] == \
+            [r.generated for r in base]
+        assert stats.counter("serving.step_retries").value > 0
+
+    def test_deadline_expiry_mid_speculation(self):
+        """A deadline landing while a request speculates aborts only
+        that request (pages freed, drafter slot reset); the survivor's
+        stream keeps parity. Accepted tokens count as watchdog/SLO
+        progress via len(req.generated)."""
+        from paddle_tpu.serving import (ManualClock, ServingEngine,
+                                        SLOConfig, use_clock)
+
+        _e0, base = _serve("self", prompts=_prompts(2), n_new=10)
+        with use_clock(ManualClock()) as clk:
+            eng = ServingEngine(
+                _model(), max_batch=2, page_size=4, max_length=64,
+                decode_chunk=2, slo=SLOConfig(prefill_chunk=4),
+                speculative="self", spec_k=3)
+            free0 = eng._mgr.free_pages
+            r_ok = eng.submit(_prompts(2)[0], max_new_tokens=10)
+            r_dead = eng.submit(_prompts(2)[1], max_new_tokens=10,
+                                deadline_ms=50.0)
+            for _ in range(6):
+                eng.step()
+            clk.advance(1.0)
+            eng.run()
+            by = {r.id: r for r in eng.finished}
+            assert by[r_dead].state == "deadline_exceeded"
+            assert by[r_ok].state == "ok"
+            assert by[r_ok].generated == base[0].generated
+            # exact-pool drain once the prefix cache's legitimate
+            # references (full prompt pages) are dropped
+            if eng.prefix_cache is not None:
+                eng.prefix_cache.clear()
+            assert eng._mgr.free_pages == free0
+
+    def test_serve_top_accept_rate_row(self):
+        from tools import serve_top
+
+        eng, _done = _serve("self", prompts=_prompts(1))
+        s = serve_top.summarize(eng.journal.events())
+        assert s["spec_rounds"] > 0
+        assert s["spec_accept_rate"] is not None
+        assert "accept_rate" in serve_top.render(s)
+
+
+# =====================================================================
+# tensor parallelism: verify shard_mapped, draft weights replicated
+# =====================================================================
+
+class TestSpeculativeTP:
+    def test_mp2_spec_parity(self, virtual_devices):
+        """mp2 speculative serving must emit the mp1 non-speculative
+        engine's exact tokens — the verify pass runs shard_mapped like
+        prefill_chunk_raw while the self-draft heads stay replicated."""
+        _e0, base = _serve(None, prompts=_prompts(2))
+        _e1, spec = _serve("self", prompts=_prompts(2), mp_degree=2)
+        assert [r.generated for r in spec] == \
+            [r.generated for r in base]
+        assert _e1._gen._tp is not None and _e1._gen._tp.mp == 2
+
+    def test_mp2_draft_model_parity(self, virtual_devices):
+        """Draft-model speculation under TP: draft weights replicated
+        (plain jit), target verify sharded."""
+        _e0, base = _serve(None, prompts=_prompts(2))
+        _e1, spec = _serve(DraftModelDrafter(_draft_model()),
+                           prompts=_prompts(2), mp_degree=2)
+        assert [r.generated for r in spec] == \
+            [r.generated for r in base]
+
+    def test_verify_rung_carries_mp_suffix(self, virtual_devices):
+        eng = ContinuousBatchingEngine(
+            _model(), max_batch=2, page_size=4, max_length=64,
+            speculative="self", spec_k=3, mp_degree=2)
+        assert eng._spec._rung() == "serve.verify[k=3,mp=2]"
+
+
+# =====================================================================
+# program-site registration (tpu_lint preflight coverage)
+# =====================================================================
+
+class TestVerifyProgramSite:
+    def test_serve_verify_site_traces(self):
+        from paddle_tpu.analysis.program_sites import (PROGRAM_SITES,
+                                                       trace_program)
+
+        site = {s.name: s for s in PROGRAM_SITES}["serve.verify"]
+        traced = trace_program(site)
+        assert traced.donated_invars  # the pool operands may die
+        assert site.compute_dtype == "bfloat16"

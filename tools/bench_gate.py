@@ -95,6 +95,19 @@ DEFAULT_METRICS: Dict[str, str] = {
     # the slo.goodput rolling telemetry gauge regress DOWN
     "serve_goodput": "down",
     "slo.goodput": "down",
+    # speculative-decoding rungs (ISSUE 12): delivered throughput and
+    # the draft accept rate regress DOWN (a drafter/verify regression
+    # shows in accept rate before it shows in tokens/s), TTFT UP like
+    # its non-speculative sibling; decode_spec_* is the engine-level
+    # acceptance-ceiling rung (bench.py --decode-spec)
+    "serve_spec_tokens_per_sec": "down",
+    "serve_spec_accept_rate": "down",
+    "serve_spec_p50_ttft_ms": "up",
+    "serve_spec_p99_ttft_ms": "up",
+    "serve_spec_goodput": "down",
+    "decode_spec_tokens_per_sec": "down",
+    "decode_spec_accept_rate": "down",
+    "decode_spec_vs_plain": "down",
     # chaos-hardened serving rungs (tools/serve_bench.py --chaos,
     # ISSUE 11): survivor token parity is binary and must stay 1.0,
     # chaos goodput/throughput regress DOWN like their fault-free

@@ -25,6 +25,14 @@ pass the 1.3B geometry (--d-model 2048 --layers 24 --heads 16
 every chunk/decode program first (--no-warmup to include compiles in
 the measured TTFTs — the cold-start view).
 
+``--speculative`` (ISSUE 12) runs the scheduler's decode slot as
+draft+verify rounds (``--spec-drafter self|draft|oracle``,
+``--spec-k``): every ``serve_*`` key re-emits as ``serve_spec_*`` plus
+``serve_spec_accept_rate`` / ``serve_spec_rounds``, so bench_gate
+tracks the speculative SLO rungs (throughput/accept-rate regress
+DOWN, TTFT UP) independently of the plain ones. ``oracle`` drives the
+target model as its own drafter — the acceptance-ceiling workload.
+
 ``--chaos`` (ISSUE 11) re-drives the SAME measured workload against a
 fresh engine with a seeded fault schedule installed
 (``serving/faults.py`` — raises, delays, token corruption, and pool
@@ -95,11 +103,40 @@ def build_engine(args, faults=None):
                     prefill_chunk=args.prefill_chunk,
                     ttft_target_ms=args.ttft_target,
                     tpot_target_ms=args.tpot_target)
+    spec = None
+    if getattr(args, "speculative", False):
+        spec = _build_drafter(args, model, max_len)
     return ServingEngine(
         model, max_batch=args.streams, page_size=args.page_size,
         max_length=max_len, decode_chunk=args.decode_chunk,
         quant=args.quant, slo=slo, faults=faults,
+        speculative=spec, spec_k=args.spec_k,
         mp_degree=args.mp if args.mp and args.mp > 1 else None), lens
+
+
+def _build_drafter(args, model, max_len):
+    """--spec-drafter resolution: ``self`` = Medusa-style training-free
+    heads (no extra weights); ``draft`` = a quarter-size FusedCausalLM
+    draft model with its own tiny non-paged KV state; ``oracle`` =
+    the target model ITSELF as draft model — every draft is the
+    target's own greedy pick, accept rate 1.0, the amortization
+    ceiling rung (an acceptance-friendly workload by construction)."""
+    from paddle_tpu.inference import DraftModelDrafter, FusedCausalLM
+
+    if args.spec_drafter == "self":
+        return "self"
+    if args.spec_drafter == "oracle":
+        return DraftModelDrafter(model)
+    import paddle_tpu as paddle
+
+    paddle.seed(args.seed + 1)
+    draft = FusedCausalLM(
+        vocab_size=args.vocab, embed_dim=max(args.d_model // 4, 8),
+        num_heads=max(args.heads // 2, 1),
+        dim_feedforward=max(args.d_model, 32),
+        num_layers=max(args.layers // 2, 1),
+        max_position=max_len + 1)
+    return DraftModelDrafter(draft)
 
 
 def make_requests(args, lens, rng):
@@ -316,6 +353,21 @@ def main():
                     help="per-request deadline from arrival; exceeded "
                          "-> the request aborts in the "
                          "deadline_exceeded terminal state")
+    ap.add_argument("--speculative", action="store_true",
+                    help="speculative decoding: the scheduler's decode "
+                         "slot runs draft+verify rounds instead of "
+                         "token-by-token chunks; every serve_* key "
+                         "re-emits as serve_spec_* plus "
+                         "serve_spec_accept_rate (bench_gate gates "
+                         "throughput/accept down, TTFT up)")
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="draft window (default: FLAGS_spec_k)")
+    ap.add_argument("--spec-drafter", default="self",
+                    choices=["self", "draft", "oracle"],
+                    help="self = training-free self-draft heads; "
+                         "draft = quarter-size draft model; oracle = "
+                         "the target model as its own drafter (accept "
+                         "rate 1.0 — the amortization ceiling)")
     ap.add_argument("--chaos", action="store_true",
                     help="re-drive the measured workload under a "
                          "seeded >=5-site fault schedule and pin "
@@ -463,6 +515,24 @@ def main():
         chaos_out, chaos_ok = run_chaos(args, reqs, rids, done,
                                         goodput)
         out.update(chaos_out)
+    if args.speculative:
+        # speculative rung keys: serve_spec_* so bench_gate tracks the
+        # draft+verify SLO rungs independently of the plain serve_*
+        # ones; accept rate is the amortization health signal (gated
+        # DOWN — a drafter regression shows here before throughput)
+        drafted = int(
+            stats.counter("serving.spec_drafted_tokens").value)
+        accepted = int(
+            stats.counter("serving.spec_accepted_tokens").value)
+        out["serve_accept_rate"] = round(accepted / drafted, 4) \
+            if drafted else None
+        out["serve_rounds"] = int(
+            stats.counter("serving.spec_rounds").value)
+        out["serve_drafter"] = args.spec_drafter
+        out["serve_k"] = int(eng._spec.k)
+        out = {(f"serve_spec_{k[len('serve_'):]}"
+                if k.startswith("serve_") else k): v
+               for k, v in out.items()}
     if args.mp and args.mp > 1:
         # TP rung keys: serve_tp{N}_* so bench_gate tracks the
         # mp-sharded SLO rungs independently of the mp1 ones (whose

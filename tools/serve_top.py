@@ -61,11 +61,16 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
               "deadline_exceeded": 0, "shed": 0, "retry": 0,
               "watchdog": 0, "fault": 0}
     evicted_pages = 0
+    spec_rounds = spec_drafted = spec_accepted = 0
     for e in events:
         ev = e.get("ev")
         rid = int(e.get("rid", -1))
         if ev == "evict_trigger":
             evicted_pages += int(e.get("pages", 0))
+        if ev == "spec_verify":
+            spec_rounds += 1
+            spec_drafted += int(e.get("k", 0))
+            spec_accepted += int(e.get("accepted", 0))
         if ev in counts:
             counts[ev] += 1
         if rid < 0:
@@ -149,6 +154,11 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
         "watchdog_trips": counts["watchdog"],
         "faults_injected": counts["fault"],
         "evicted_pages": evicted_pages,
+        "spec_rounds": spec_rounds,
+        "spec_drafted": spec_drafted,
+        "spec_accepted": spec_accepted,
+        "spec_accept_rate": (spec_accepted / spec_drafted)
+        if spec_drafted else None,
         "slots": None,  # live mode fills the real max_batch
     }
 
@@ -221,6 +231,15 @@ def render(summary: dict, top: int = 5,
         f"deadline_exceeded {s.get('deadline_exceeded', 0)}  "
         f"shed {s.get('shed', 0)}",
     ]
+    if s.get("spec_rounds"):
+        # speculative decoding (ISSUE 12): the accept-rate row — the
+        # one number that says whether the drafter is paying for its
+        # verify passes
+        lines.append(
+            f"speculative: rounds {s['spec_rounds']}  "
+            f"accept_rate {_fmt(s.get('spec_accept_rate'), 3)} "
+            f"({s.get('spec_accepted', 0)}/{s.get('spec_drafted', 0)} "
+            "drafts accepted)")
     slowest = sorted(
         (r for r in s["requests"].values()
          if r["phase"] == "finished" and r["ttft_ms"] is not None),
