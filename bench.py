@@ -452,6 +452,58 @@ def run_bert_bench(batch=32, seq=512, steps=8):
     return tps, round(mfu, 4), (rl.as_dict() if rl else None)
 
 
+def run_attn_varlen_bench():
+    """Varlen flash-attention rung (ISSUE 13): a long packed batch
+    through the segment-aware block-skipping kernel
+    (nn/functional/flash_varlen.py). Returns (tokens/s,
+    peak_bytes, total_tokens, backend). ``peak_bytes`` is the compiled
+    program's argument+temp+output footprint from XLA's memory
+    analysis — the number that was O(T²) on the dense path (a 32k-token
+    pack would need a 64 GiB [h, T, T] fp32 intermediate; the varlen
+    path stays O(T·d)). Gated by bench_gate: tokens/s regresses DOWN,
+    peak bytes UP."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.flash_varlen import (
+        flash_varlen_packed)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        h, d, dtype = 16, 128, jnp.bfloat16
+        lens, iters = [4096] * 8, 20          # T = 32768 packed
+    else:
+        # CPU smoke: correctness of the rung plumbing only
+        h, d, dtype = 2, 64, jnp.float32
+        lens, iters = [512] * 4, 3
+    T = int(sum(lens))
+    cu = jnp.asarray(np.concatenate([[0], np.cumsum(lens)])
+                     .astype(np.int32))
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(T, h, d), dtype)
+
+    fn = jax.jit(lambda q, k, v, cu: flash_varlen_packed(
+        q, k, v, cu, cu, causal=True))
+    fn(q, q, q, cu).block_until_ready()       # compile outside timing
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn(q, q, q, cu)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    if not np.isfinite(np.asarray(out[:8], np.float32)).all():
+        raise RuntimeError("attn-varlen bench: non-finite output")
+    peak = None
+    try:
+        mem = fn.lower(q, q, q, cu).compile().memory_analysis()
+        peak = int(mem.temp_size_in_bytes + mem.argument_size_in_bytes
+                   + mem.output_size_in_bytes)
+    except Exception:
+        pass
+    backend = "pallas" if on_tpu else "xla"
+    return iters * T / dt, peak, T, backend
+
+
 def _run_one(name):
     """Run a single ladder rung (used in a fresh subprocess so a failed
     bigger config leaves no stale HBM buffers behind)."""
@@ -581,6 +633,46 @@ def _run_secondary(kind):
              "decode_spec_accept_rate": rate,
              "decode_spec_rounds": rounds,
              "decode_spec_telemetry": _telemetry()}))
+    elif kind == "--attn-varlen":
+        # varlen / long-context attention rung (ISSUE 13): the packed
+        # block-skipping kernel on a 32k-token pack — throughput plus
+        # the O(T·d) peak-bytes pin, gated by bench_gate (tokens/s
+        # DOWN, peak bytes UP)
+        tps, peak, total, backend = run_attn_varlen_bench()
+        print(json.dumps(
+            {"attn_varlen_tokens_per_sec": round(tps, 1),
+             "attn_varlen_peak_bytes": peak,
+             "attn_varlen_total_tokens": total,
+             "attn_varlen_backend": backend,
+             "attn_varlen_telemetry": _telemetry()}))
+    elif kind == "--serve-long":
+        # long-context serving rung: chunked prefill over the paged
+        # pool routed through the in-place varlen kernel (no per-chunk
+        # dense gather) — serve_long_* keys, gated by bench_gate
+        import os
+        import subprocess
+
+        import jax
+
+        tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "serve_bench.py")
+        argv = [sys.executable, tool, "--no-lint", "--seed", "0",
+                "--streams", "8", "--long-context"]
+        if jax.default_backend() == "tpu":
+            argv += ["--d-model", "2048", "--layers", "24", "--heads",
+                     "16", "--vocab", "51200", "--bf16",
+                     "--prompt-mix", "2048,8192,16384",
+                     "--prefill-chunk", "512", "--max-new", "32",
+                     "--page-size", "16", "--rate", "8"]
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=2400)
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"serve_bench --long-context rc={proc.returncode}: "
+                f"{proc.stderr[-300:]}")
+        print(lines[-1])
     elif kind == "--decode-int8kv":
         # best-throughput serving config: int8 weights + int8 KV cache
         # (cache-KV quant pays once KV traffic rivals the weight
@@ -654,7 +746,7 @@ def main():
     for kind in ("--decode", "--decode-int8", "--decode-a8w8",
                  "--decode-bf16-grouped", "--decode-tp",
                  "--decode-spec", "--decode-int8kv", "--serve",
-                 "--bert", "--s2048"):
+                 "--serve-long", "--attn-varlen", "--bert", "--s2048"):
         if kind in sys.argv:
             _run_secondary(kind)
             return
@@ -698,7 +790,8 @@ def main():
         for kind in ("--s2048", "--decode", "--decode-int8",
                      "--decode-a8w8", "--decode-bf16-grouped",
                      "--decode-tp", "--decode-spec",
-                     "--decode-int8kv", "--serve", "--bert"):
+                     "--decode-int8kv", "--serve", "--serve-long",
+                     "--attn-varlen", "--bert"):
             # s2048's flash-attention bwd compile alone can take ~25min
             # cold (measured r5); the run itself is seconds
             extra, err = _sub([kind], 2400 if kind == "--s2048" else 1500)

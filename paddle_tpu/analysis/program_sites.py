@@ -307,6 +307,27 @@ def _build_spec_verify():
     return fn, args
 
 
+def _build_varlen_packed():
+    """The packed varlen flash-attention program (ISSUE 13) as the
+    dispatch layer compiles it: cu_seqlens ride as TRACED operands
+    (the recompile-storm fix), the XLA tile-walk fallback is the
+    CPU-traced body. bf16 inputs so the DTYPE pass guards the fp32
+    softmax-accumulator waivers."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from ..nn.functional.attention import _unpadded_varlen_raw
+
+    fn = functools.partial(_unpadded_varlen_raw, scale=0.088,
+                           causal=True)
+    T, h, d = 1024, 8, 128
+    return fn, (_sds((T, h, d), jnp.bfloat16),
+                _sds((T, h, d), jnp.bfloat16),
+                _sds((T, h, d), jnp.bfloat16),
+                _sds((5,), jnp.int32), _sds((5,), jnp.int32))
+
+
 PROGRAM_SITES: List[ProgramSite] = [
     ProgramSite("dispatch.gelu", _build_gelu,
                 compute_dtype="bfloat16",
@@ -329,4 +350,6 @@ PROGRAM_SITES: List[ProgramSite] = [
                 donate_argnums=(7, 8)),
     ProgramSite("serve.verify", _build_spec_verify,
                 compute_dtype="bfloat16", donate_argnums=(9, 10)),
+    ProgramSite("attn.varlen_packed", _build_varlen_packed,
+                compute_dtype="bfloat16"),
 ]

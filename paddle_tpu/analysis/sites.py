@@ -49,9 +49,11 @@ def _force_tpu_routing():
     import jax
 
     import paddle_tpu.nn.functional.attention as att
+    import paddle_tpu.nn.functional.flash_varlen as fv
     import paddle_tpu.nn.functional.stream_linear as sl
 
-    saved = [(sl, "_on_tpu", sl._on_tpu), (att, "_on_tpu", att._on_tpu)]
+    saved = [(sl, "_on_tpu", sl._on_tpu), (att, "_on_tpu", att._on_tpu),
+             (fv, "_on_tpu", fv._on_tpu)]
     x64 = bool(jax.config.jax_enable_x64)
     try:
         for mod, name, _ in saved:
@@ -311,6 +313,107 @@ def _build_flash():
     return fn, (q, q, q)
 
 
+# varlen flash (ISSUE 13): a serving-shaped packed batch — 8 heads,
+# d128, 1024 tokens in 4 segments, 128x128 tiles, bf16
+_VARLEN = dict(h=8, T=1024, d=128, nseg=4, bq=128, bk=128)
+
+
+def _varlen_args():
+    import jax.numpy as jnp
+
+    h, T, d, nseg = (_VARLEN[k] for k in ("h", "T", "d", "nseg"))
+    return (_sds((T, h, d), jnp.bfloat16),
+            _sds((T, h, d), jnp.bfloat16),
+            _sds((T, h, d), jnp.bfloat16),
+            _sds((nseg + 1,), jnp.int32),
+            _sds((nseg + 1,), jnp.int32))
+
+
+def _build_flash_varlen_fwd():
+    from paddle_tpu.nn.functional.flash_varlen import flash_varlen_packed
+
+    def fn(q, k, v, cu_q, cu_k):
+        return flash_varlen_packed(q, k, v, cu_q, cu_k, causal=True,
+                                   backend="pallas")
+
+    return fn, _varlen_args()
+
+
+def _expected_flash_varlen_fwd():
+    h, d, bq, bk = (_VARLEN[k] for k in ("h", "d", "bq", "bk"))
+    return (2 * _B((2, bq), "int32")               # qmeta tile stream
+            + 2 * _B((h, bq, d), "bfloat16")       # q tile stream
+            + 2 * _B((h, bq, d), "float32")        # out tile stream
+            + 2 * _B((h, bq), "float32")           # lse tile stream
+            + _B((2, h, bk, d), "bfloat16") * 2    # k + v DMA scratch
+            + _B((2, 2, bk), "int32"))             # kmeta DMA scratch
+
+
+def _build_flash_varlen_bwd():
+    import jax
+
+    from paddle_tpu.nn.functional.flash_varlen import flash_varlen_packed
+
+    def fn(q, k, v, cu_q, cu_k):
+        def loss(q, k, v):
+            out = flash_varlen_packed(q, k, v, cu_q, cu_k, causal=True,
+                                      backend="pallas")
+            return jax.numpy.sum(out.astype(jax.numpy.float32))
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    return fn, _varlen_args()
+
+
+def _expected_flash_varlen_bwd():
+    h, d, bq, bk = (_VARLEN[k] for k in ("h", "d", "bq", "bk"))
+    bf = "bfloat16"
+    fwd = _expected_flash_varlen_fwd()
+    dq = (2 * _B((2, bq), "int32")                 # qmeta tile stream
+          + 2 * _B((h, bq, d), bf)                 # q tile stream
+          + 2 * _B((h, bq, d), bf)                 # dout tile stream
+          + 2 * _B((2, h, bq), "float32")          # lse+delta stream
+          + 2 * _B((h, bq, d), "float32")          # dq tile stream
+          + _B((2, h, bk, d), bf) * 2              # k + v DMA scratch
+          + _B((2, 2, bk), "int32"))               # kmeta DMA scratch
+    dkv = (2 * _B((2, bk), "int32")                # kmeta tile stream
+           + 2 * _B((h, bk, d), bf) * 2            # k + v tile streams
+           + 2 * _B((h, bk, d), "float32") * 2     # dk + dv tile streams
+           + _B((2, h, bq, d), bf) * 2             # q + dout DMA scratch
+           + _B((2, 2, h, bq), "float32")          # lse+delta DMA scratch
+           + _B((2, 2, bq), "int32"))              # qmeta DMA scratch
+    return fwd + dq + dkv
+
+
+def _build_flash_varlen_paged():
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.flash_varlen import (
+        paged_prefill_attention)
+
+    b, n_kv, d, ps = (_POOL[k] for k in ("b", "n_kv", "d", "ps"))
+    c, pp, P = 64, 16, 256
+
+    def fn(q, kc, vc, tables, start):
+        return paged_prefill_attention(q, kc, vc, tables, start,
+                                       n_kv=n_kv, backend="pallas")
+
+    return fn, (_sds((b, c, n_kv, d), jnp.bfloat16),
+                _sds((P, n_kv, ps, d), jnp.bfloat16),
+                _sds((P, n_kv, ps, d), jnp.bfloat16),
+                _sds((b, pp), jnp.int32),
+                _sds((b,), jnp.int32))
+
+
+def _expected_flash_varlen_paged():
+    # bk = 8 pages x ps16 = 128 tokens; q/out blocks stream per row
+    n_kv, d, ps = (_POOL[k] for k in ("n_kv", "d", "ps"))
+    c, npp = 64, 8
+    return (2 * _B((1, n_kv, c, d), "bfloat16")    # q row stream
+            + 2 * _B((1, n_kv, c, d), "float32")   # out row stream
+            + _B((2, npp, n_kv, ps, d), "bfloat16") * 2)  # k+v page DMA
+
+
 KERNEL_SITES: List[KernelSite] = [
     KernelSite("stream_linear.bf16", "nn/functional/stream_linear.py",
                _build_stream_linear, _expected_stream_linear),
@@ -334,6 +437,16 @@ KERNEL_SITES: List[KernelSite] = [
     # list (its internals are jax's, not ours)
     KernelSite("attention.flash", "nn/functional/attention.py",
                _build_flash, None),
+    KernelSite("flash_varlen.packed_fwd",
+               "nn/functional/flash_varlen.py",
+               _build_flash_varlen_fwd, _expected_flash_varlen_fwd),
+    # grad trace records fwd (residuals) + dq + dk/dv kernels
+    KernelSite("flash_varlen.packed_bwd",
+               "nn/functional/flash_varlen.py",
+               _build_flash_varlen_bwd, _expected_flash_varlen_bwd,
+               n_calls=3),
+    KernelSite("flash_varlen.paged", "nn/functional/flash_varlen.py",
+               _build_flash_varlen_paged, _expected_flash_varlen_paged),
 ]
 
 

@@ -90,6 +90,22 @@ define_flag("paged_attention_backend", "auto",
             "nn/functional/paged_attention.py) | stream | xla | fused "
             "(r4 per-sequence page-DMA Pallas kernel, opt-in) | pallas "
             "(stock jax kernel via a layout transpose)")
+define_flag("attn_varlen_backend", "auto",
+            "flash_attn_unpadded varlen flash-attention backend "
+            "(nn/functional/flash_varlen.py): auto (segment-aware "
+            "block-skipping Pallas kernel on TPU, the math-identical "
+            "tiled XLA walk elsewhere) | pallas | interpret (the "
+            "Pallas kernel through the interpreter — debug) | xla | "
+            "dense (the legacy O(T^2) masked-dense path, reference "
+            "only)")
+define_flag("prefill_attention_backend", "auto",
+            "chunked-prefill / speculative-verify attention over the "
+            "paged pool (nn/functional/flash_varlen.py "
+            "paged_prefill_attention): auto (block-table-indexed "
+            "varlen kernel on TPU reading pages in place, tiled XLA "
+            "walk elsewhere) | varlen (force the tiled walk family) | "
+            "gather (legacy dense gather_kv_pages copy per chunk — "
+            "also the forced path for int8-quantized pools)")
 define_flag("decode_linear", "auto",
             "UNGROUPED decode matmul path (used when decode_grouped "
             "is off): auto (stream for int8 weights, XLA dots over "

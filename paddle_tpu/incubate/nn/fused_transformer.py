@@ -440,6 +440,8 @@ class FusedMultiTransformer(Layer):
                                  cache, block_tables,
                                  (start, chunk_lens), cos_t, sin_t,
                                  a8w8)
+        from ...core.flags import flag
+        from ...nn.functional.flash_varlen import paged_prefill_attention
         from ...nn.functional.paged_attention import (
             gather_kv_pages, write_prefill_kv_pages)
 
@@ -453,6 +455,11 @@ class FusedMultiTransformer(Layer):
         hd = self.head_dim
         npages = self._pages_per_layer(cache)
         scale = hd ** -0.5
+        # int8-quantized pools keep the dequantizing gather path; bf16/
+        # f32 pools route through the varlen kernel, which reads the
+        # pages IN PLACE (no per-chunk dense gather copy)
+        use_varlen = (flag("prefill_attention_backend") != "gather"
+                      and not isinstance(cache.k, tuple))
 
         def body(l, carry):
             h, ck, cv = carry
@@ -466,10 +473,17 @@ class FusedMultiTransformer(Layer):
                     valid_lens=chunk_lens)
 
             def attend(q, k, v, nck, ncv):
-                # gather the sequence's whole cached span (the chunk's
-                # own KV was just written) token-major and mask
-                # causally: key position <= query position covers both
-                # the prefix pages and the in-chunk triangle
+                # the sequence's whole cached span (the chunk's own KV
+                # was just written): key position <= query position
+                # covers both the prefix pages and the in-chunk
+                # triangle
+                if use_varlen:
+                    fb = flag("prefill_attention_backend")
+                    return paged_prefill_attention(
+                        q, nck, ncv, tbl, start, n_kv=n_kv,
+                        scale=scale,
+                        backend="auto" if fb in ("auto", "varlen")
+                        else fb)
                 kg = gather_kv_pages(nck, tbl)
                 vg = gather_kv_pages(ncv, tbl)
                 S = kg.shape[1]

@@ -245,6 +245,49 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def _unpadded_dense_raw(q, k, v, cu_q, cu_k, *, scale, causal):
+    """LEGACY dense varlen path: reconstructs the full segment mask and
+    materializes [h, total_q, total_k] logits — O(T²) memory. Kept as
+    the numerical reference for the block-skipping kernel (tests,
+    bench) and behind FLAGS_attn_varlen_backend=dense; unusable at
+    real packed batch sizes (a 16k-token pack needs a >=1 GiB
+    intermediate per head)."""
+    total_q, h, d = q.shape
+    total_k = k.shape[0]
+    pos_q = jnp.arange(total_q)
+    pos_k = jnp.arange(total_k)
+    seg_q = jnp.searchsorted(cu_q[1:], pos_q, side="right")
+    seg_k = jnp.searchsorted(cu_k[1:], pos_k, side="right")
+    mask = seg_q[:, None] == seg_k[None, :]
+    logits = jnp.einsum("qhd,khd->hqk", q, k) * scale
+    if causal:
+        off_q = pos_q - cu_q[jnp.minimum(seg_q, cu_q.shape[0] - 1)]
+        off_k = pos_k - cu_k[jnp.minimum(seg_k, cu_k.shape[0] - 1)]
+        mask = mask & (off_q[:, None] >= off_k[None, :])
+    logits = jnp.where(mask[None], logits, jnp.finfo(logits.dtype).min)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", w, v)
+
+
+def _unpadded_varlen_raw(q, k, v, cu_q, cu_k, *, scale, causal):
+    """Varlen flash attention over a packed batch: the segment-aware
+    block-skipping kernel family (nn/functional/flash_varlen.py).
+    MODULE-LEVEL by design: a stable function identity plus cu_seqlens
+    as TRACED operands is what lets the dispatch caches admit it — the
+    old per-call closure baked cu_q/cu_k in as constants, so every
+    distinct packing was a fresh function object that re-traced
+    (the recompile storm; pinned by tests/test_flash_varlen.py)."""
+    from ...core.flags import flag
+    from .flash_varlen import flash_varlen_packed
+
+    backend = flag("attn_varlen_backend")
+    if backend == "dense":
+        return _unpadded_dense_raw(q, k, v, cu_q, cu_k, scale=scale,
+                                   causal=causal)
+    return flash_varlen_packed(q, k, v, cu_q, cu_k, scale=scale,
+                               causal=causal, backend=backend)
+
+
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False,
@@ -252,35 +295,18 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         name=None):
     """Varlen flash attention (reference flash_attention.py:302).
 
-    TPU-native treatment: varlen batches are segment-masked dense batches
-    (dynamic shapes would defeat XLA); we reconstruct the segment mask from
-    cu_seqlens and run the dense kernel with masking.
+    TPU-native treatment: the packed batch stays packed — a
+    segment-aware block-skipping flash kernel visits only the tiles
+    where seg_q ∩ seg_k ≠ ∅ (block map from cu_seqlens), with online
+    softmax — memory O(T·d), work ∝ the sum of per-segment areas.
+    cu_seqlens ride as traced operands so one compiled program serves
+    every packing of the same shape.
     """
-    tensors = as_tensor_args(query, key, value)
-    cu_q = jnp.asarray(cu_seqlens_q._data if hasattr(cu_seqlens_q, "_data")
-                       else cu_seqlens_q)
-    cu_k = jnp.asarray(cu_seqlens_k._data if hasattr(cu_seqlens_k, "_data")
-                       else cu_seqlens_k)
-
-    def raw(q, k, v):
-        # q: [total_q, h, d] packed; build per-token segment ids
-        total_q, h, d = q.shape
-        total_k = k.shape[0]
-        pos_q = jnp.arange(total_q)
-        pos_k = jnp.arange(total_k)
-        seg_q = jnp.searchsorted(cu_q[1:], pos_q, side="right")
-        seg_k = jnp.searchsorted(cu_k[1:], pos_k, side="right")
-        mask = seg_q[:, None] == seg_k[None, :]
-        logits = jnp.einsum("qhd,khd->hqk", q, k) * scale
-        if causal:
-            off_q = pos_q - cu_q[seg_q]
-            off_k = pos_k - cu_k[seg_k]
-            mask = mask & (off_q[:, None] >= off_k[None, :])
-        logits = jnp.where(mask[None], logits, jnp.finfo(logits.dtype).min)
-        w = jax.nn.softmax(logits, axis=-1)
-        return jnp.einsum("hqk,khd->qhd", w, v)
-
-    out = eager_apply("flash_attn_unpadded", raw, tensors)
+    tensors = as_tensor_args(query, key, value, cu_seqlens_q,
+                             cu_seqlens_k)
+    out = eager_apply(
+        "flash_attn_unpadded", _unpadded_varlen_raw, tensors,
+        static_kwargs={"scale": float(scale), "causal": bool(causal)})
     return out, None
 
 

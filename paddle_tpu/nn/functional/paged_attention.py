@@ -915,11 +915,16 @@ def write_prefill_kv_pages(key_cache, value_cache, k, v, block_tables,
 
 def gather_kv_pages(cache_side, block_tables, out_dtype=None):
     """Gather one cache side's pages into token-major [b, S, n_kv, d]
-    (S = table_width * page_size, token t = page t//ps, slot t%ps) —
-    the chunked-prefill attention's K/V view. Quantized (int8 rows +
-    f32 scale plane) sides are dequantized on the way out; callers mask
-    dead positions by seq_lens/causality, so garbage rows are harmless.
-    ``block_tables`` must hold ABSOLUTE (layer-offset) page ids."""
+    (S = table_width * page_size, token t = page t//ps, slot t%ps).
+    LEGACY chunked-prefill K/V view: since ISSUE 13 the default prefill
+    attend reads the pool IN PLACE through
+    ``flash_varlen.paged_prefill_attention`` (this dense copy cost an
+    extra O(S) HBM write+read per chunk per layer); this gather remains
+    the int8-quantized-pool path (it dequantizes on the way out) and
+    the ``FLAGS_prefill_attention_backend=gather`` reference. Callers
+    mask dead positions by seq_lens/causality, so garbage rows are
+    harmless. ``block_tables`` must hold ABSOLUTE (layer-offset) page
+    ids."""
     quant = isinstance(cache_side, tuple)
     pool = cache_side[0] if quant else cache_side
     b, P = block_tables.shape

@@ -108,6 +108,18 @@ DEFAULT_METRICS: Dict[str, str] = {
     "decode_spec_tokens_per_sec": "down",
     "decode_spec_accept_rate": "down",
     "decode_spec_vs_plain": "down",
+    # varlen / long-context attention rungs (ISSUE 13): the packed
+    # block-skipping kernel's throughput regresses DOWN and its
+    # compiled-program peak bytes UP (the O(T·d) memory pin — a
+    # regression back toward the dense path shows here first); the
+    # long-context serving rung gates like its short-mix sibling
+    "attn_varlen_tokens_per_sec": "down",
+    "attn_varlen_peak_bytes": "up",
+    "serve_long_p50_ttft_ms": "up",
+    "serve_long_p99_ttft_ms": "up",
+    "serve_long_p50_tpot_ms": "up",
+    "serve_long_tokens_per_sec": "down",
+    "serve_long_goodput": "down",
     # chaos-hardened serving rungs (tools/serve_bench.py --chaos,
     # ISSUE 11): survivor token parity is binary and must stay 1.0,
     # chaos goodput/throughput regress DOWN like their fault-free

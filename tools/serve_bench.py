@@ -368,6 +368,14 @@ def main():
                          "draft = quarter-size draft model; oracle = "
                          "the target model as its own drafter (accept "
                          "rate 1.0 — the amortization ceiling)")
+    ap.add_argument("--long-context", action="store_true",
+                    help="long-context serving rung (ISSUE 13): "
+                         "defaults the prompt mix to long prompts so "
+                         "chunked prefill attends deep into the paged "
+                         "pool through the in-place varlen kernel; "
+                         "every serve_* key re-emits as serve_long_* "
+                         "(gated by bench_gate: TTFT UP, tokens/s "
+                         "DOWN)")
     ap.add_argument("--chaos", action="store_true",
                     help="re-drive the measured workload under a "
                          "seeded >=5-site fault schedule and pin "
@@ -403,6 +411,12 @@ def main():
     ap.add_argument("--no-lint", action="store_true",
                     help="skip the tpu_lint preflight gate")
     args = ap.parse_args()
+    if args.long_context and args.prompt_mix == "8,32,96":
+        # CPU-sized long mix (a chip run passes its own, e.g.
+        # 2048,8192,16384 via bench.py --serve-long); long prompts +
+        # a modest rate keep the run prefill-dominated
+        args.prompt_mix = "64,256,768"
+        args.rate = min(args.rate, 16.0)
     if args.requests is None:
         args.requests = 3 * args.streams
 
@@ -531,6 +545,13 @@ def main():
         out["serve_drafter"] = args.spec_drafter
         out["serve_k"] = int(eng._spec.k)
         out = {(f"serve_spec_{k[len('serve_'):]}"
+                if k.startswith("serve_") else k): v
+               for k, v in out.items()}
+    if args.long_context:
+        # long-context rung keys: serve_long_* so bench_gate tracks
+        # the varlen-prefill SLO rungs independently of the short-mix
+        # serve_* ones
+        out = {(f"serve_long_{k[len('serve_'):]}"
                 if k.startswith("serve_") else k): v
                for k, v in out.items()}
     if args.mp and args.mp > 1:
