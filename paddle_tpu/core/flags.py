@@ -206,6 +206,29 @@ define_flag("spec_drafter", "self",
             "(requires an explicit FusedCausalLM draft model / "
             "DraftModelDrafter passed as speculative=, which keeps "
             "its own tiny non-paged KV state)")
+define_flag("fleet_heartbeat_ms", 50.0,
+            "fleet replica heartbeat interval (serving/router.py): "
+            "each replica's serve loop stamps a beat through the "
+            "injectable serving clock once per iteration; the "
+            "router's health checker measures missed beats against "
+            "this interval to walk a silent replica through the "
+            "suspect -> dead state machine")
+define_flag("fleet_suspect_beats", 3,
+            "missed heartbeats before a fleet replica is marked "
+            "SUSPECT (its queued-but-unadmitted requests hedge to a "
+            "healthy peer); twice this many marks it DEAD and every "
+            "in-flight request fails over via the recompute resume "
+            "path")
+define_flag("fleet_breaker_threshold", 3,
+            "per-replica circuit breaker (serving/router.py): "
+            "consecutive dispatch errors against one replica before "
+            "its breaker opens and the router stops routing to it; a "
+            "half-open probe re-admits it after the cooldown")
+define_flag("fleet_dispatch_queue", 4096,
+            "router-tier overload bound: fleet-wide queued-but-not-"
+            "yet-admitted requests (every replica's inbox + waiting "
+            "list) past this shed new submits with the typed "
+            "FleetOverloaded BEFORE any replica admits; 0 = unbounded")
 define_flag("serve_chunk_shrink", True,
             "graceful degradation under pool pressure: before a "
             "prefill chunk stalls/requeues for pages, shrink it "

@@ -838,6 +838,67 @@ class ContinuousBatchingEngine:
             self.step()
         return self.finished
 
+    # ------------- slot migration (fleet drain, ISSUE 14) -------------
+
+    def can_migrate(self) -> bool:
+        """Page-granular KV export/import is supported for plain
+        (unsharded, non-int8) pools; int8 cache-KV carries scale
+        planes and TP pools shard by kv-head — both fall back to the
+        preemption-by-recompute path on a fleet drain."""
+        return not isinstance(self._ck, tuple) \
+            and self._mgr._mesh is None
+
+    def export_slot(self, i: int) -> dict:
+        """Export decode slot ``i``'s live state for page-granular
+        migration to a peer engine: the request, its sequence
+        position, and the slot's KV pages gathered out of the pool
+        (one contiguous blob per K/V, layer-major — see
+        ``BlockKVCacheManager.phys_rows``). Pages are NOT freed here;
+        the caller releases the slot only after the import lands, so
+        a failed migration leaves this engine untouched."""
+        if not self.can_migrate():
+            raise NotImplementedError(
+                "KV-page migration needs a plain pool (no int8 "
+                "cache-KV, no TP kv-head sharding) — use the "
+                "recompute resume path instead")
+        req = self._slots[i]
+        if req is None:
+            raise KeyError(f"slot {i} is not decoding")
+        pages = list(self._mgr._owned[("slot", i)])
+        rows = jnp.asarray(self._mgr.phys_rows(pages))
+        return {"req": req, "len": int(self._lens[i]),
+                "last_tok": int(self._last_tok[i]),
+                "n_pages": len(pages),
+                "k": np.asarray(self._ck[rows]),
+                "v": np.asarray(self._cv[rows])}
+
+    def import_slot(self, i: int, blob: dict) -> bool:
+        """Adopt an exported decode slot into free slot ``i``: allocate
+        exactly ``n_pages`` fresh pages, scatter the K/V blob into
+        them, and re-home the request mid-decode — its next token
+        comes out byte-identical because the cached KV (and the
+        replicated weights) are byte-identical. False when the slot is
+        occupied or the pool can't cover the pages (the caller falls
+        back to recompute)."""
+        if not self.can_migrate():
+            raise NotImplementedError(
+                "KV-page migration needs a plain pool (no int8 "
+                "cache-KV, no TP kv-head sharding)")
+        n = int(blob["n_pages"])
+        if not self._slot_free(i) or n > self._mgr.free_pages \
+                or n > self._pages_per_seq:
+            return False
+        pages = self._mgr.allocate(("slot", i), n * self.page_size)
+        rows = jnp.asarray(self._mgr.phys_rows(pages))
+        self._ck = self._ck.at[rows].set(
+            jnp.asarray(blob["k"], self._ck.dtype))
+        self._cv = self._cv.at[rows].set(
+            jnp.asarray(blob["v"], self._cv.dtype))
+        self._slots[i] = blob["req"]
+        self._lens[i] = int(blob["len"])
+        self._last_tok[i] = int(blob["last_tok"])
+        return True
+
     # ---------------- internals ----------------
 
     def _release(self, i: int):

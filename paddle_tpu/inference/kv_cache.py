@@ -129,6 +129,18 @@ class BlockKVCacheManager:
     def pages_needed(self, length: int) -> int:
         return -(-length // self.page_size)
 
+    def phys_rows(self, pages: Sequence[int]) -> np.ndarray:
+        """Physical pool-row indices of logical ``pages`` across the
+        layer-folded pool — layer l's copy of page p is row
+        ``l * num_pages + p``. LAYER-MAJOR ``[num_layers * len(pages)]``
+        so a KV blob gathered with one manager's rows scatters into
+        another manager's rows even when their ``num_pages`` differ
+        (the fleet page-migration path, serving/router.py)."""
+        pages = np.asarray(list(pages), np.int64)
+        layers = np.arange(self.num_layers,
+                           dtype=np.int64) * self.num_pages
+        return (layers[:, None] + pages[None, :]).reshape(-1)
+
     def allocate(self, seq_id, max_length: int) -> List[int]:
         """Reserve pages covering max_length tokens for one sequence."""
         n = self.pages_needed(max_length)

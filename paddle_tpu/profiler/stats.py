@@ -72,6 +72,12 @@ Conventions for the built-in instrumentation (all optional reading):
   (analysis/preflight.py) — so bench telemetry records the lint state
   its numbers were measured under and bench_gate can ratchet on it
 - ``dist.<op>.{calls,bytes}``  collective op counts and payload bytes
+- ``fleet.*``                  multi-replica serving router
+  (serving/router.py): ``fleet.{replicas,replicas_alive,
+  circuit_open}`` gauges and ``fleet.{dispatches,failovers,
+  failover_requests,migrations,migrated_pages,hedges,shed}``
+  counters — the front-tier health/failover/drain accounting
+  tools/serve_top.py --fleet renders
 - ``roofline.*``               achieved FLOP/s / bytes/s / MFU / BW
   utilization vs device peaks (profiler/roofline.py)
 - ``hbm.*``                    device memory telemetry
@@ -103,7 +109,8 @@ __all__ = [
 CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
     "inference.", "serving.", "serve.", "journal.", "slo.", "spec.",
-    "quant.", "moe.", "dist.", "roofline.", "hbm.", "lint.", "t.",
+    "quant.", "moe.", "dist.", "fleet.", "roofline.", "hbm.", "lint.",
+    "t.",
 )
 
 _ENABLED = True

@@ -25,6 +25,18 @@ injectable monotonic clock so every timing behavior tests
 deterministically. ``tools/serve_bench.py --chaos`` pins survivor
 token parity and bounded goodput loss under a seeded fault schedule.
 
+Fleet serving (ISSUE 14): ``router.py`` scales OUT — a
+:class:`FleetRouter` front tier over N replicas with blake2b
+prefix-affinity + load/SLO-aware dispatch, heartbeat health checking
+(missed-beat → suspect → dead on the same injectable clock), a
+per-replica circuit breaker, crash FAILOVER through the
+preemption-by-recompute resume path (zero admitted requests lost, and
+survivors keep greedy-token parity), graceful DRAIN by page-granular
+KV migration, router-tier overload shedding (typed
+:class:`FleetOverloaded`), and hedged re-dispatch past suspect
+replicas. ``tools/serve_bench.py --fleet N`` benches it;
+``tools/serve_top.py --fleet`` renders per-replica health.
+
 The TP (ROADMAP item 1) and EP-MoE (item 4) serving engines plug into
 this scheduler: it only talks to the engine's compiled prefill/decode
 programs and the page manager, both of which shard underneath it.
@@ -32,18 +44,21 @@ programs and the page manager, both of which shard underneath it.
 from __future__ import annotations
 
 from .faults import (Clock, DeadlineExceeded, FaultInjector, FaultSpec,
-                     InjectedFault, ManualClock, PoolSizingError,
-                     ServerOverloaded, TokenCorruption, WatchdogTimeout,
-                     set_clock, use_clock)
+                     FleetOverloaded, InjectedFault, ManualClock,
+                     PoolSizingError, ReplicaKilled, ServerOverloaded,
+                     TokenCorruption, WatchdogTimeout, set_clock,
+                     use_clock)
 from .journal import FlightRecorder
 from .prefix_cache import PrefixCache
 from .request import Request
+from .router import CircuitBreaker, FleetRouter, Replica
 from .scheduler import ServingEngine, SLOConfig
 from .slo import SLOMonitor
 
 __all__ = ["Request", "PrefixCache", "ServingEngine", "SLOConfig",
            "FlightRecorder", "SLOMonitor",
+           "FleetRouter", "Replica", "CircuitBreaker",
            "FaultInjector", "FaultSpec", "Clock", "ManualClock",
            "set_clock", "use_clock", "InjectedFault", "TokenCorruption",
            "DeadlineExceeded", "ServerOverloaded", "WatchdogTimeout",
-           "PoolSizingError"]
+           "PoolSizingError", "ReplicaKilled", "FleetOverloaded"]

@@ -22,7 +22,17 @@ per-site hit counter advancing once per call):
 - ``prefix.insert`` — prefix-cache registration
   (``serving/prefix_cache.py``; failures are absorbed, never fatal);
 - ``journal.dump`` — crash-dump/journal export (``crash_dump`` must
-  never let a failed dump mask the original exception).
+  never let a failed dump mask the original exception);
+- ``router.dispatch`` — one fleet-router dispatch attempt
+  (``serving/router.py``; a raise counts against the chosen
+  replica's circuit breaker and the router retries a healthy peer);
+- ``replica.step`` — one fleet replica's scheduler step (the
+  ``kill``/``hang`` kinds live here: a kill crashes the replica's
+  serve loop, a hang wedges it long enough to miss heartbeats);
+- ``replica.heartbeat`` — a replica's per-loop heartbeat stamp (a
+  raise SUPPRESSES that beat, so the health checker's
+  missed-beat → suspect → dead machine is drivable without killing
+  the replica).
 
 Fault kinds per scheduled hit:
 
@@ -37,7 +47,14 @@ Fault kinds per scheduled hit:
   key (deterministic pool exhaustion: the engine's REAL recovery
   paths — cold-prefix eviction, prefill stall/requeue,
   preemption-by-recompute — engage on the genuine free-list state);
-- ``release`` — free every squeezed page.
+- ``release`` — free every squeezed page;
+- ``kill``    — raise :class:`ReplicaKilled` at the site (the fleet
+  replica serve loop treats it as a process crash: the loop exits,
+  heartbeats stop, and the router fails its requests over);
+- ``hang``    — sleep ``delay_ms`` (default 30 s) through the
+  injected clock: the replica wedges mid-step, misses beats, and the
+  health checker walks it suspect → dead while it sleeps (a
+  ManualClock makes the wedge a pure time-warp).
 
 Scheduling is deterministic: ``at`` (hit index or indices), ``every``
 (every k-th hit), ``times`` (max fires), and ``p`` (per-hit
@@ -62,7 +79,7 @@ __all__ = [
     "Clock", "ManualClock", "now", "clock", "set_clock", "use_clock",
     "FaultSpec", "FaultInjector", "InjectedFault", "TokenCorruption",
     "DeadlineExceeded", "ServerOverloaded", "WatchdogTimeout",
-    "PoolSizingError",
+    "PoolSizingError", "ReplicaKilled", "FleetOverloaded",
 ]
 
 
@@ -108,6 +125,30 @@ class PoolSizingError(RuntimeError):
     """Configuration error: a request's pages can NEVER fit the pool,
     even with the prefix cache drained and every peer evicted. Not
     retryable — propagates out of ``run()`` with sizing guidance."""
+
+
+class ReplicaKilled(RuntimeError):
+    """A fleet replica's serve loop died — raised by a scheduled
+    ``kill`` fault at ``replica.step`` (the simulated process crash)
+    or recorded by :meth:`FleetRouter.kill`. The router detects it,
+    marks the replica dead, and FAILS OVER its in-flight requests to
+    healthy peers (serving/router.py)."""
+
+    def __init__(self, site: str = "replica.step", hit: int = -1,
+                 message: str = ""):
+        super().__init__(
+            message or f"replica killed at {site!r} (hit {hit})")
+        self.site = site
+        self.hit = hit
+
+
+class FleetOverloaded(ServerOverloaded):
+    """Router-tier overload shedding: the fleet-wide dispatch queue
+    (queued-not-yet-admitted requests across every replica) is past
+    ``FLAGS_fleet_dispatch_queue``, or no replica is dispatchable
+    (every one dead/draining or circuit-open). Raised to the
+    SUBMITTING thread BEFORE any replica admits — a subclass of
+    :class:`ServerOverloaded` so producers catch both the same way."""
 
 
 # ---------------------------------------------------------------------
@@ -194,9 +235,17 @@ def now() -> float:
 #: the named-site vocabulary (sites outside it still work — the list
 #: documents what the stack wires today)
 FAULT_SITES = ("kv.alloc", "kv.grow", "prefill.dispatch",
-               "decode.step", "prefix.insert", "journal.dump")
+               "decode.step", "prefix.insert", "journal.dump",
+               "router.dispatch", "replica.step", "replica.heartbeat")
 
-_KINDS = ("raise", "delay", "corrupt", "squeeze", "release")
+_KINDS = ("raise", "delay", "corrupt", "squeeze", "release", "kill",
+          "hang")
+
+#: a ``hang`` spec with no explicit delay_ms wedges this long — far
+#: past any heartbeat budget, so the health checker always sees the
+#: replica miss its beats (a ManualClock turns the wedge into a pure
+#: time-warp)
+DEFAULT_HANG_MS = 30_000.0
 
 
 class FaultSpec:
@@ -223,6 +272,8 @@ class FaultSpec:
         self.every = None if every is None else max(int(every), 1)
         self.times = int(times)
         self.p = p
+        if kind == "hang" and not delay_ms:
+            delay_ms = DEFAULT_HANG_MS
         self.delay_ms = float(delay_ms)
         self.exc = exc
         self.pages = int(pages)
@@ -340,12 +391,15 @@ class FaultInjector:
                 continue
             spec.fires += 1
             self._log(site, hit, spec.kind, rid)
-            if spec.kind == "delay":
+            if spec.kind in ("delay", "hang"):
                 clock().sleep(spec.delay_ms / 1e3)
             elif spec.kind == "squeeze":
                 self._squeeze(spec.pages)
             elif spec.kind == "release":
                 self._release_squeezed()
+            elif spec.kind == "kill":
+                to_raise = spec.exc if spec.exc is not None \
+                    else ReplicaKilled(site, hit)
             elif spec.kind == "raise":
                 to_raise = spec.exc if spec.exc is not None \
                     else InjectedFault(site, hit)

@@ -65,11 +65,20 @@ __all__ = ["FlightRecorder", "LIFECYCLE_EVENTS", "chrome_trace",
 #: ``deadline_exceeded`` / ``shed``; ISSUE 12 adds ``spec_verify`` —
 #: one speculative draft+verify round on a decode slot, with
 #: ``k``/``accepted``/``dur_ms`` extras, rendered as a span in the
-#: chrome trace and folded into serve_top's accept-rate row)
+#: chrome trace and folded into serve_top's accept-rate row; ISSUE 14
+#: adds the fleet-tier events — ``failover`` = a dead replica's
+#: request re-dispatched to this replica (extras ``from``/``to``/
+#: ``n_generated``), ``migrate`` = a mid-decode request's KV pages
+#: handed to this replica during a graceful drain (``from``/``to``/
+#: ``pages``), ``drain`` = this replica entering/finishing its drain;
+#: each lands in the DESTINATION (failover/migrate) or draining
+#: replica's journal, and replica journals export with pid = replica
+#: id so tools/trace_merge.py folds a fleet serve into one timeline)
 LIFECYCLE_EVENTS = (
     "submit", "queued", "admitted", "prefill_chunk", "first_token",
     "decode", "spec_verify", "preempt", "requeue", "stall",
     "evict_trigger", "fault", "retry", "watchdog",
+    "failover", "migrate", "drain",
     "finish", "error", "deadline_exceeded", "shed",
 )
 
@@ -183,8 +192,11 @@ def load_jsonl(path: str):
 
 
 #: lifecycle transitions that OPEN a phase span on a request's lane
+#: (``failover`` re-queues the request on the surviving replica's
+#: lane; ``migrate`` lands it straight in decode — no prefill replay)
 _PHASE_OF = {"submit": "queued", "queued": "queued",
-             "admitted": "prefill", "decode": "decode"}
+             "admitted": "prefill", "decode": "decode",
+             "failover": "queued", "migrate": "decode"}
 #: transitions that CLOSE whatever phase is open
 _CLOSERS = ("preempt", "requeue", "finish", "error",
             "deadline_exceeded", "shed")

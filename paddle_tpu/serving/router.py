@@ -1,0 +1,783 @@
+"""Fleet router: a health-checked front tier over N serving replicas.
+
+PR 10 scaled one engine UP (tensor parallelism); this scales OUT
+(ROADMAP item 2): a :class:`FleetRouter` owns N ``ServingEngine``
+replicas (threads with their own engines on CPU; each replica may
+itself be a TP group) and is exactly where the fleet's robustness
+lives — a single replica crash without it loses every in-flight
+request with no detection, no retry, no redirect.
+
+Dispatch — ``policy="affinity"`` (default):
+
+- **prefix affinity**: the router keys each prompt's leading FULL
+  pages by the same blake2b hash CHAIN the per-replica
+  ``PrefixCache`` uses, and remembers which replica last served each
+  chain. A request sharing a system prompt routes to the replica that
+  already OWNS those pages, so the fleet-wide hit rate approaches the
+  single-replica one instead of dividing by N (the routed >
+  round-robin goodput pin under a skewed-prefix Poisson load).
+- **load/SLO tie-break**: no chain match → the replica with the
+  shallowest queue (inbox + waiting + prefilling + decoding), ties
+  broken toward the best rolling ``slo.goodput`` gauge (PR 9).
+- ``policy="rr"`` is the round-robin baseline the affinity policy is
+  benched against (``serve_bench --fleet --fleet-policy rr``).
+
+Health — every replica's serve loop stamps a HEARTBEAT through the
+PR 11 clock seam once per iteration; the router's health checker
+walks a missed-beat state machine::
+
+    alive --(>= FLAGS_fleet_suspect_beats missed)--> suspect
+          --(>= 2x missed, or a crashed loop)------> dead
+
+- **suspect**: new dispatch avoids the replica, and requests still
+  parked in its admission inbox HEDGE to a healthy peer
+  (``fleet.hedges``) — they have no KV state yet, so re-dispatch is
+  free and nobody queues behind a maybe-dead replica.
+- **dead**: crash FAILOVER — every in-flight request (queued,
+  prefilling, decoding) re-dispatches to a healthy replica through
+  the existing preemption-by-recompute resume path: prompt +
+  generated tokens replay (prefix-cache-hot on the survivor) and the
+  greedy stream continues byte-identically, so killing 1 of N
+  replicas mid-load loses ZERO admitted requests.
+- recovered beats walk a suspect replica back to alive.
+
+A per-replica CIRCUIT BREAKER trips after
+``FLAGS_fleet_breaker_threshold`` consecutive dispatch errors (the
+router stops routing there), then HALF-OPENS after a cooldown: one
+probe dispatch re-closes it on success or re-opens it on failure.
+
+Graceful DRAIN (``drain(idx)``) empties a replica WITHOUT recompute:
+queued/prefilling requests re-dispatch (no KV worth moving), but each
+mid-decode slot's KV pages migrate by PAGE-GRANULAR handoff — a
+gather of the slot's pages out of the source pool, a put into freshly
+allocated pages on the destination, and a page-table re-home
+(``export_slot``/``import_slot``, inference/engine.py). The paged
+layout makes this a copy of exactly the live pages; subsequent tokens
+are byte-identical because the cached KV and the (factory-replicated)
+weights are. Pools that can't hand pages across (int8 cache-KV, TP
+kv-head sharding) fall back to the recompute path automatically.
+
+Overload sheds at the ROUTER tier: once the fleet-wide dispatch queue
+(every replica's queued-but-unadmitted requests) passes
+``FLAGS_fleet_dispatch_queue`` — or no replica is dispatchable — new
+submits raise the typed :class:`FleetOverloaded` BEFORE any replica
+admits.
+
+Everything is drivable deterministically: the seeded
+``serving/faults.py`` registry gains ``router.dispatch`` /
+``replica.step`` / ``replica.heartbeat`` sites and ``kill``/``hang``
+kinds, and synchronous stepping (``step()``/``run()``) plus the
+``ManualClock`` make every transition a unit test
+(tests/test_fleet_router.py). ``tools/serve_bench.py --fleet N``
+drives the threaded form under Poisson load;
+``tools/serve_top.py --fleet`` renders per-replica health rows; each
+replica's journal exports with ``pid = replica id`` so
+``tools/trace_merge.py`` folds a fleet serve into one timeline.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.flags import flag as _flag
+from ..profiler import stats as _stats
+from . import faults as _faults
+from .faults import FleetOverloaded, ReplicaKilled
+from .prefix_cache import _page_key
+from .request import Request
+from .scheduler import ServingEngine
+
+__all__ = ["FleetRouter", "Replica", "CircuitBreaker",
+           "FleetOverloaded", "ReplicaKilled", "REPLICA_STATES"]
+
+#: replica lifecycle (serve-loop + health-checker state machine)
+REPLICA_STATES = ("alive", "suspect", "dead", "draining", "drained")
+
+#: failovers one request may survive before the router fails it
+#: terminally — a poison-pill request (e.g. one whose pages can never
+#: fit) must not cascade a crash across the whole fleet
+MAX_FAILOVERS = 3
+
+
+class CircuitBreaker:
+    """Per-replica dispatch circuit breaker (closed → open →
+    half-open), on the injectable serving clock.
+
+    ``record_failure`` after ``FLAGS_fleet_breaker_threshold``
+    CONSECUTIVE dispatch errors opens the breaker; ``allow()`` then
+    rejects until ``cooldown_ms`` elapses, after which it half-opens
+    and each ``allow()`` is a probe — the next outcome re-closes
+    (success) or re-opens (failure) it."""
+
+    def __init__(self, threshold: Optional[int] = None,
+                 cooldown_ms: float = 250.0):
+        self._threshold = threshold
+        self.cooldown_ms = float(cooldown_ms)
+        self.state = "closed"
+        self.failures = 0          # consecutive
+        self.trips = 0
+        self._opened_at = 0.0
+
+    @property
+    def threshold(self) -> int:
+        return self._threshold if self._threshold is not None \
+            else int(_flag("fleet_breaker_threshold"))
+
+    def allow(self) -> bool:
+        if self.state == "open":
+            if (_faults.now() - self._opened_at) * 1e3 \
+                    >= self.cooldown_ms:
+                self.state = "half_open"
+        return self.state != "open"
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self.state = "closed"
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.state == "half_open" or \
+                (self.state == "closed"
+                 and self.failures >= self.threshold):
+            self.state = "open"
+            self.trips += 1
+            self._opened_at = _faults.now()
+
+
+class Replica:
+    """One fleet replica: a ``ServingEngine`` plus its serve-loop /
+    health / breaker state. ``step_once()`` is the unit both the
+    per-replica thread and the router's synchronous ``step()`` drive;
+    any exception escaping the engine's (already crash-isolated)
+    scheduler step is a REPLICA-LEVEL crash — the loop stops beating
+    and the health checker fails its requests over."""
+
+    def __init__(self, idx: int, eng: ServingEngine,
+                 router: "FleetRouter",
+                 breaker_cooldown_ms: float = 250.0):
+        self.idx = idx
+        self.eng = eng
+        self.router = router
+        self.state = "alive"
+        self.last_beat = _faults.now()
+        self.crashed: Optional[BaseException] = None
+        self.breaker = CircuitBreaker(cooldown_ms=breaker_cooldown_ms)
+        self.thread: Optional[threading.Thread] = None
+        #: serializes engine steps against cross-replica mutation
+        #: (page import during a drain migration)
+        self.step_lock = threading.Lock()
+
+    # ---------------- serve loop ----------------
+
+    @property
+    def dead(self) -> bool:
+        return self.state in ("dead", "drained")
+
+    def beat(self) -> None:
+        """Stamp a heartbeat through the serving clock. A scheduled
+        ``replica.heartbeat`` fault SUPPRESSES the stamp — the health
+        checker then sees missed beats without the replica dying."""
+        fi = self.router.faults
+        if fi is not None:
+            try:
+                fi.fire("replica.heartbeat", rid=self.idx)
+            except BaseException:
+                return
+        self.last_beat = _faults.now()
+
+    def step_once(self) -> bool:
+        """One serve-loop iteration: fire the ``replica.step`` fault
+        site (kill/hang land here), run one scheduler step when there
+        is work, stamp a beat. Returns whether work was done; a crash
+        is recorded in ``crashed`` (the loop never raises)."""
+        if self.dead or self.crashed is not None:
+            return False
+        did = False
+        try:
+            with self.step_lock:
+                if self.eng.has_work:
+                    fi = self.router.faults
+                    if fi is not None:
+                        fi.fire("replica.step", rid=self.idx)
+                    self.eng.step()
+                    did = True
+        except BaseException as e:
+            # the scheduler step is already crash-isolated per
+            # request; anything that still escapes (an injected
+            # kill/raise, PoolSizingError, a wedged runtime) is a
+            # replica-level crash: stop beating, let the health
+            # checker fail our requests over
+            self.crashed = e
+            return False
+        self.beat()
+        return did
+
+    def _loop(self) -> None:
+        """Thread body (threaded mode): step until stopped, dead, or
+        drained; a ``draining`` state hands the thread to the
+        router's migration path so no step races the page export."""
+        while not self.router._stop and not self.dead \
+                and self.crashed is None:
+            if self.state == "draining":
+                self.router._drain_now(self)
+                return
+            if not self.step_once():
+                time.sleep(0.0005)
+
+
+class FleetRouter:
+    """Front tier over N serving replicas (see module docstring).
+
+    Usage::
+
+        router = FleetRouter(engine_factory=lambda i: make_engine(),
+                             n_replicas=2)
+        router.submit([1, 2, 3], max_new_tokens=16)   # routed
+        router.run()                 # synchronous drain (tests), or
+        router.start(); ...; router.stop()   # one thread per replica
+
+    ``engine_factory(i)`` must build IDENTICAL engines (same seed →
+    same weights): failover replays a request's tokens on a peer and
+    migration hands its KV pages across, both of which are
+    byte-exact only because every replica computes the same function.
+    Pre-built engines can be passed via ``engines=`` instead.
+    """
+
+    def __init__(self, engines: Optional[Sequence[ServingEngine]] = None,
+                 *, engine_factory: Optional[Callable[[int],
+                                                      ServingEngine]] = None,
+                 n_replicas: Optional[int] = None,
+                 policy: str = "affinity", faults=None,
+                 affinity_pages: int = 8,
+                 breaker_cooldown_ms: float = 250.0):
+        if policy not in ("affinity", "rr"):
+            raise ValueError(
+                f"policy={policy!r}: expected 'affinity' or 'rr'")
+        if engines is None:
+            if engine_factory is None or not n_replicas:
+                raise ValueError("pass engines= or engine_factory= "
+                                 "with n_replicas=")
+            engines = [engine_factory(i) for i in range(n_replicas)]
+        engines = list(engines)
+        if not engines:
+            raise ValueError("a fleet needs at least one replica")
+        ps = {e.page_size for e in engines}
+        if len(ps) != 1:
+            raise ValueError(
+                f"replicas disagree on page_size ({sorted(ps)}) — "
+                "affinity chains and page migration need one layout")
+        self.page_size = ps.pop()
+        self.policy = policy
+        self.affinity_pages = max(int(affinity_pages), 1)
+        self.replicas: List[Replica] = [
+            Replica(i, e, self, breaker_cooldown_ms)
+            for i, e in enumerate(engines)]
+        #: blake2b chain key -> replica idx that owns the pages
+        self._affinity: Dict[bytes, int] = {}
+        self._rr = 0
+        self._tracked: List[Request] = []
+        self._dispatch_lock = threading.Lock()
+        self._stop = False
+        self._monitor: Optional[threading.Thread] = None
+        #: walk the missed-beat state machine in ``check_health``.
+        #: OFF in synchronous mode — one driver steps the replicas
+        #: sequentially, so "replica 0 missed beats" only means the
+        #: driver was busy stepping replica 1 (a several-second XLA
+        #: compile would false-kill the whole fleet). ``start()``
+        #: turns it on (each replica beats from its own thread);
+        #: ManualClock tests set it explicitly. Crash detection
+        #: (``crashed`` → dead → failover) is always on.
+        self.enforce_beats = False
+        self.faults = None
+        if faults is not None:
+            self.install_faults(faults)
+        self._update_gauges()
+
+    # ---------------- faults ----------------
+
+    def install_faults(self, faults) -> None:
+        """Arm one seeded injector fleet-wide: the router sites
+        (``router.dispatch``/``replica.step``/``replica.heartbeat``)
+        fire here, and every replica engine wires its own sites
+        (callable after construction so a chaos bench warms compile
+        caches fault-free first). NOTE: ``squeeze`` specs target the
+        LAST replica's page manager (the injector binds one)."""
+        self.faults = faults
+        for rep in self.replicas:
+            rep.eng.install_faults(faults)
+
+    # ---------------- submission / dispatch ----------------
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_token_id=None, priority: int = 0, on_token=None,
+               deadline_ms: Optional[float] = None) -> int:
+        """Route one request to a replica (affinity, then load/SLO)
+        and return its fleet-unique id. Raises
+        :class:`FleetOverloaded` when the fleet-wide dispatch queue is
+        past ``FLAGS_fleet_dispatch_queue`` or no replica is
+        dispatchable — backpressure BEFORE any replica admits."""
+        req = Request(prompt, max_new_tokens, eos_token_id,
+                      priority=priority, on_token=on_token,
+                      deadline_ms=deadline_ms)
+        self._dispatch(req)
+        self._tracked.append(req)
+        return req.id
+
+    def _dispatchable(self, exclude=frozenset(),
+                      breaker: bool = True) -> List[Replica]:
+        """Replicas new work may route to: alive first, suspect only
+        as a last resort, open breakers (optionally) skipped."""
+        alive, backup = [], []
+        for rep in self.replicas:
+            if rep.idx in exclude or rep.dead \
+                    or rep.state == "draining" \
+                    or rep.crashed is not None:
+                continue
+            if breaker and not rep.breaker.allow():
+                continue
+            (alive if rep.state == "alive" else backup).append(rep)
+        return alive or backup
+
+    def _load_score(self, rep: Replica):
+        eng = rep.eng
+        depth = eng.queue_depth + eng.num_prefilling + eng.num_active
+        good = eng.slo_monitor.goodput
+        return (depth, -(1.0 if good is None else good), rep.idx)
+
+    def _affinity_chain(self, prompt) -> List[bytes]:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        ps = self.page_size
+        n = min(len(prompt) // ps, self.affinity_pages)
+        keys, key = [], b""
+        for p in range(n):
+            key = _page_key(key, prompt[p * ps: (p + 1) * ps])
+            keys.append(key)
+        return keys
+
+    def _candidate_order(self, req: Request,
+                         cands: List[Replica]) -> List[Replica]:
+        if self.policy == "rr":
+            cands = sorted(cands, key=lambda r: r.idx)
+            k = self._rr % len(cands)
+            self._rr += 1
+            return cands[k:] + cands[:k]
+        by_load = sorted(cands, key=self._load_score)
+        # longest matching chain wins: walk the prompt's chain keys
+        # back-to-front so deeper (more specific) matches route first
+        by_idx = {r.idx: r for r in cands}
+        for key in reversed(self._affinity_chain(req.prompt)):
+            owner = self._affinity.get(key)
+            if owner is not None and owner in by_idx:
+                tgt = by_idx[owner]
+                return [tgt] + [r for r in by_load if r is not tgt]
+        return by_load
+
+    def _register_affinity(self, req: Request, rep: Replica) -> None:
+        if self.policy != "affinity":
+            return
+        for key in self._affinity_chain(req.prompt):
+            self._affinity[key] = rep.idx
+
+    def _dispatch(self, req: Request, exclude=frozenset(),
+                  force: bool = False) -> Replica:
+        """Pick a replica and hand ``req`` to its admission inbox.
+        ``force`` (failover/hedge/drain re-dispatch) bypasses both the
+        router-tier queue bound and the per-engine overload check —
+        the request was already admitted to the FLEET once. A dispatch
+        error (injected fault, engine shed) counts against the chosen
+        replica's breaker and the next candidate is tried."""
+        with self._dispatch_lock:
+            cands = self._dispatchable(exclude)
+            if not cands:
+                _stats.inc("fleet.shed")
+                raise FleetOverloaded(
+                    f"request {req.id}: no dispatchable replica "
+                    f"(states: "
+                    f"{[r.state for r in self.replicas]})")
+            cap = int(_flag("fleet_dispatch_queue"))
+            if not force and cap > 0:
+                depth = sum(r.eng.queue_depth for r in cands)
+                if depth >= cap:
+                    _stats.inc("fleet.shed")
+                    raise FleetOverloaded(
+                        f"request {req.id} shed at the router: "
+                        f"fleet dispatch queue {depth} >= {cap}")
+            fi = self.faults
+            last: Optional[BaseException] = None
+            for rep in self._candidate_order(req, cands):
+                try:
+                    if fi is not None:
+                        fi.fire("router.dispatch", rid=req.id)
+                    if force:
+                        rep.eng.adopt_request(req)
+                    else:
+                        rep.eng.submit_request(req)
+                except ValueError:
+                    raise   # request/engine config mismatch — not a
+                    # replica fault, don't burn its breaker
+                except BaseException as e:
+                    last = e
+                    rep.breaker.record_failure()
+                    self._update_gauges()
+                    continue
+                rep.breaker.record_success()
+                self._register_affinity(req, rep)
+                _stats.inc("fleet.dispatches")
+                return rep
+            _stats.inc("fleet.shed")
+            raise FleetOverloaded(
+                f"request {req.id}: every dispatch attempt failed "
+                f"(last: {last!r})")
+
+    # ---------------- health ----------------
+
+    def check_health(self) -> None:
+        """One health-checker pass on the serving clock: crashed loops
+        go straight to dead; silent replicas walk
+        alive → suspect (``FLAGS_fleet_suspect_beats`` missed beats)
+        → dead (twice that); recovered beats walk suspect back to
+        alive. Suspect entry hedges the replica's inbox; death fails
+        its in-flight requests over."""
+        hb = float(_flag("fleet_heartbeat_ms")) / 1e3
+        sus = max(int(_flag("fleet_suspect_beats")), 1)
+        now = _faults.now()
+        for rep in self.replicas:
+            if rep.dead:
+                continue
+            if rep.crashed is not None:
+                self._mark_dead(rep, f"crashed: {rep.crashed!r}")
+                continue
+            if hb <= 0 or not self.enforce_beats:
+                continue
+            missed = (now - rep.last_beat) / hb
+            if missed >= 2 * sus:
+                self._mark_dead(
+                    rep, f"missed {missed:.0f} heartbeats")
+            elif missed >= sus:
+                if rep.state == "alive":
+                    rep.state = "suspect"
+                    _stats.inc("fleet.suspects")
+                    self._hedge(rep)
+            elif rep.state == "suspect":
+                rep.state = "alive"   # beats resumed
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        # re-stamped every health pass so bench post-warmup
+        # stats.reset() never erases the fleet shape
+        _stats.set_gauge("fleet.replicas", len(self.replicas))
+        _stats.set_gauge("fleet.replicas_alive",
+                         sum(not r.dead for r in self.replicas))
+        _stats.set_gauge("fleet.circuit_open",
+                         sum(r.breaker.state != "closed"
+                             for r in self.replicas))
+
+    def kill(self, idx: int, exc: Optional[BaseException] = None) -> None:
+        """Operator/test API: declare replica ``idx`` crashed and run
+        the health pass (→ dead → failover) immediately."""
+        rep = self.replicas[idx]
+        rep.crashed = exc if exc is not None else ReplicaKilled(
+            message=f"replica {idx} killed")
+        self.check_health()
+
+    def _mark_dead(self, rep: Replica, why: str) -> None:
+        rep.state = "dead"
+        jr = rep.eng.journal
+        if jr is not None:
+            # the dead replica's journal survives in host memory —
+            # export_journals/serve_top show WHY its lane went dark
+            jr.record("error", -1, -1,
+                      {"replica": rep.idx, "reason": why[:200]})
+        _stats.inc("fleet.deaths")
+        self._update_gauges()
+        self._failover(rep)
+
+    # ---------------- failover / hedging ----------------
+
+    def _fail(self, req: Request, exc: BaseException) -> None:
+        """Terminal router-tier failure (failover budget spent / no
+        replica left): the request — not the fleet — dies."""
+        req.done = True
+        req.state = "error"
+        req.error = exc
+        req.slo_ok = False
+        req.t_done = _faults.now()
+        _stats.inc("serving.request_errors")
+
+    def _failover(self, rep: Replica) -> None:
+        """Crash failover: strip every in-flight request off the dead
+        replica and re-dispatch each through the recompute resume path
+        (prompt + generated replayed on the survivor; greedy tokens
+        byte-identical). A request past ``MAX_FAILOVERS`` — or with no
+        healthy replica left — fails terminally instead of cascading.
+
+        The detach briefly waits for the replica's step lock so a
+        loop that crashed BETWEEN steps (the common case — injected
+        kills fire before the engine mutates) is detached quietly;
+        a replica wedged INSIDE a step keeps the lock forever, so
+        after the timeout we detach anyway — it is dead and fenced
+        (``step_once`` refuses dead replicas), and stranded pool
+        pages die with its pool."""
+        got = rep.step_lock.acquire(timeout=0.2)
+        try:
+            reqs = rep.eng.detach_inflight()
+        finally:
+            if got:
+                rep.step_lock.release()
+        if not reqs:
+            return
+        _stats.inc("fleet.failovers")
+        for req in reqs:
+            if req.generated:
+                req._resume_tokens = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.generated, np.int32)])
+            req.n_failovers = getattr(req, "n_failovers", 0) + 1
+            if req.n_failovers > MAX_FAILOVERS:
+                self._fail(req, ReplicaKilled(message=(
+                    f"request {req.id} exceeded {MAX_FAILOVERS} "
+                    "failovers — poison request dropped")))
+                continue
+            try:
+                dest = self._dispatch(req, exclude={rep.idx},
+                                      force=True)
+            except FleetOverloaded as e:
+                self._fail(req, e)
+                continue
+            _stats.inc("fleet.failover_requests")
+            jr = dest.eng.journal
+            if jr is not None:
+                jr.record("failover", req.id, -1,
+                          {"from": rep.idx, "to": dest.idx,
+                           "n_generated": len(req.generated)})
+
+    def _hedge(self, rep: Replica) -> None:
+        """Suspect-entry hedging: requests still parked in the
+        replica's admission INBOX (no KV state, and the inbox lock
+        makes the steal race-free even against a live-but-slow
+        replica) re-dispatch to a healthy peer instead of queueing
+        behind a maybe-dead one."""
+        with rep.eng._inbox_lock:
+            stolen, rep.eng._inbox = rep.eng._inbox, []
+        for req in stolen:
+            _stats.inc("fleet.hedges")
+            try:
+                self._dispatch(req, exclude={rep.idx}, force=True)
+            except FleetOverloaded as e:
+                self._fail(req, e)
+
+    # ---------------- graceful drain ----------------
+
+    def drain(self, idx: int) -> None:
+        """Gracefully drain replica ``idx``: dispatch stops, queued/
+        prefilling requests re-dispatch to peers, and every mid-decode
+        slot MIGRATES its KV pages to a healthy replica (page-granular
+        handoff — no recompute; falls back to the resume path only
+        when no peer can take the pages). Synchronous callers drain
+        inline; in threaded mode the replica's own thread performs the
+        drain so no step races the page export."""
+        rep = self.replicas[idx]
+        if rep.dead or rep.state == "draining":
+            return
+        rep.state = "draining"
+        jr = rep.eng.journal
+        if jr is not None:
+            jr.record("drain", -1, -1, {"replica": idx})
+        if rep.thread is None or not rep.thread.is_alive():
+            self._drain_now(rep)
+
+    def _drain_now(self, rep: Replica) -> None:
+        eng = rep.eng
+        with rep.step_lock:
+            with eng._inbox_lock:
+                queued, eng._inbox = eng._inbox, []
+            queued += eng.waiting
+            eng.waiting = []
+            for i in sorted(eng._prefilling):
+                req = eng._prefilling[i].req
+                eng._drop_prefill_slot(i)
+                queued.append(req)
+            for req in queued:
+                if req.generated:   # a preempted-then-requeued stream
+                    req._resume_tokens = np.concatenate(
+                        [req.prompt,
+                         np.asarray(req.generated, np.int32)])
+                self._redispatch_from(rep, req)
+            for i in range(eng.max_batch):
+                if eng._slots[i] is None:
+                    continue
+                req = eng._slots[i]
+                if not self._migrate_slot(rep, i):
+                    # no peer could take the pages — recompute resume
+                    req._resume_tokens = np.concatenate(
+                        [req.prompt,
+                         np.asarray(req.generated, np.int32)])
+                    eng._release(i)
+                    self._redispatch_from(rep, req)
+            if eng.prefix_cache is not None:
+                # the replica leaves service: hand its pages back so
+                # the drain's page accounting closes exactly
+                eng.prefix_cache.clear()
+        rep.state = "drained"
+        jr = eng.journal
+        if jr is not None:
+            jr.record("drain", -1, -1,
+                      {"replica": rep.idx, "done": True})
+        self._update_gauges()
+
+    def _redispatch_from(self, rep: Replica, req: Request) -> None:
+        try:
+            self._dispatch(req, exclude={rep.idx}, force=True)
+        except FleetOverloaded as e:
+            self._fail(req, e)
+
+    def _migrate_slot(self, src: Replica, i: int) -> bool:
+        """Hand decode slot ``i``'s KV pages from ``src`` to a healthy
+        peer: export (gather), import (alloc + put + slot re-home),
+        THEN release the source pages — a failed import leaves the
+        source untouched. Counted in ``fleet.{migrations,
+        migrated_pages}`` and journaled on the destination's lane."""
+        eng = src.eng
+        if not eng.can_migrate():
+            return False
+        req = eng._slots[i]
+        blob = eng.export_slot(i)
+        for dest in self._dispatchable(exclude={src.idx}):
+            if not dest.eng.can_migrate():
+                continue
+            with dest.step_lock:
+                j = next((j for j in range(dest.eng.max_batch)
+                          if dest.eng._slot_free(j)), None)
+                if j is None or not dest.eng.import_slot(j, blob):
+                    continue
+            req.n_migrations = getattr(req, "n_migrations", 0) + 1
+            eng._release(i)
+            _stats.inc("fleet.migrations")
+            _stats.inc("fleet.migrated_pages", blob["n_pages"])
+            jr = dest.eng.journal
+            if jr is not None:
+                jr.record("migrate", req.id, j,
+                          {"from": src.idx, "to": dest.idx,
+                           "pages": blob["n_pages"],
+                           "n_generated": len(req.generated)})
+            return True
+        return False
+
+    # ---------------- driving ----------------
+
+    def step(self) -> bool:
+        """One synchronous fleet step: a health pass, then one
+        scheduler step per live replica (tests and the dryrun drive
+        this; ``start()`` runs the same loop on one thread per
+        replica). Returns whether any replica did work."""
+        self.check_health()
+        did = False
+        for rep in self.replicas:
+            did = rep.step_once() or did
+        return did
+
+    def pending(self) -> int:
+        """Tracked requests not yet in a terminal state."""
+        return sum(not r.done for r in self._tracked)
+
+    def run(self, max_steps: int = 200_000) -> List[Request]:
+        """Synchronous drain: step until every tracked request reaches
+        a terminal state (ok / error / deadline_exceeded / shed)."""
+        steps = 0
+        while self.pending():
+            self.step()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet stalled: {self.pending()} requests still "
+                    f"in flight after {max_steps} steps (replica "
+                    f"states: {[r.state for r in self.replicas]})")
+        return list(self._tracked)
+
+    def start(self) -> None:
+        """Threaded mode: one serve-loop thread per replica plus a
+        health-monitor thread (real clock). ``stop()`` joins them.
+        Beat enforcement turns on here — each replica now beats from
+        its own thread, so a silent one really is wedged."""
+        self._stop = False
+        self.enforce_beats = True
+        for rep in self.replicas:
+            rep.last_beat = _faults.now()   # fresh grace period
+        for rep in self.replicas:
+            if rep.thread is None or not rep.thread.is_alive():
+                rep.thread = threading.Thread(
+                    target=rep._loop, daemon=True,
+                    name=f"fleet-replica-{rep.idx}")
+                rep.thread.start()
+        if self._monitor is None or not self._monitor.is_alive():
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name="fleet-monitor")
+            self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        hb = max(float(_flag("fleet_heartbeat_ms")), 1.0) / 1e3
+        while not self._stop:
+            self.check_health()
+            time.sleep(hb / 2)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop = True
+        for rep in self.replicas:
+            if rep.thread is not None:
+                rep.thread.join(timeout)
+        if self._monitor is not None:
+            self._monitor.join(timeout)
+
+    # ---------------- results / introspection ----------------
+
+    def requests(self) -> List[Request]:
+        """Every tracked request, in submission order."""
+        return list(self._tracked)
+
+    def results(self) -> Dict[int, Request]:
+        """Tracked requests keyed by id."""
+        return {r.id: r for r in self._tracked}
+
+    def export_journals(self, dirpath: str,
+                        prefix: str = "fleet_journal") -> List[str]:
+        """Dump each replica's flight recorder as
+        ``<prefix>_r<idx>.jsonl`` (tools/serve_top.py --fleet input;
+        chrome traces exported from them with pid = replica id fold
+        through tools/trace_merge.py)."""
+        import os
+
+        paths = []
+        for rep in self.replicas:
+            if rep.eng.journal is None:
+                continue
+            p = os.path.join(dirpath, f"{prefix}_r{rep.idx}.jsonl")
+            rep.eng.journal.dump_jsonl(p)
+            paths.append(p)
+        return paths
+
+    def export_traces(self, dirpath: str,
+                      prefix: str = "fleet_trace") -> List[str]:
+        """One chrome trace per replica, REPLICA-STAMPED (``pid =
+        replica id``, one lane per request) — feed them straight
+        through ``tools/trace_merge.py`` for a single fleet timeline
+        where a failover/migration hop shows the request's lane
+        continuing on the destination replica's pid."""
+        import json as _json
+        import os
+
+        from .journal import chrome_trace
+
+        paths = []
+        for rep in self.replicas:
+            if rep.eng.journal is None:
+                continue
+            p = os.path.join(dirpath, f"{prefix}_r{rep.idx}.json")
+            with open(p, "w") as f:
+                _json.dump(chrome_trace(rep.eng.journal.events(),
+                                        process_index=rep.idx), f)
+            paths.append(p)
+        return paths

@@ -318,6 +318,66 @@ class ServingEngine(ContinuousBatchingEngine):
     def num_prefilling(self) -> int:
         return len(self._prefilling)
 
+    @property
+    def queue_depth(self) -> int:
+        """Queued-but-not-yet-admitted requests (inbox + waiting) —
+        the fleet router's load/shed signal for this replica."""
+        with self._inbox_lock:
+            return len(self._inbox) + len(self.waiting)
+
+    @property
+    def has_work(self) -> bool:
+        """Anything for ``step()`` to do (the fleet replica loop's
+        idle test)."""
+        return bool(self._inbox or self.waiting or self._prefilling
+                    or self.num_active)
+
+    # ---------------- fleet hooks (ISSUE 14) ----------------
+
+    def adopt_request(self, req: Request) -> int:
+        """Fleet-tier admission (serving/router.py): enqueue an
+        already-constructed request WITHOUT the per-engine overload
+        check — the router owns shedding at its tier, and a failover/
+        hedge re-dispatch must never bounce off the surviving
+        replica's thresholds. The request keeps its original lifecycle
+        marks (arrival, TTFT) and any ``_resume_tokens``, so a
+        failed-over stream just continues."""
+        if len(req.prompt) + req.max_new_tokens > self.max_length:
+            raise ValueError("request exceeds engine max_length")
+        with self._inbox_lock:
+            self._inbox.append(req)
+        jr = self.journal
+        if jr is not None:
+            jr.record("submit", req.id, -1,
+                      {"prompt_len": int(len(req.prompt)),
+                       "max_new": int(req.max_new_tokens),
+                       "adopted": True})
+        _stats.inc("serve.submitted")
+        return req.id
+
+    def detach_inflight(self) -> List[Request]:
+        """Crash-failover support (serving/router.py): strip and
+        return EVERY in-flight request — inbox, waiting list, prefill
+        slots, decode slots — in admission-priority order (queued
+        first, then prefilling, then decoding). Pages are deliberately
+        NOT freed: this runs against a replica the router already
+        declared dead, whose pool (and possibly wedged step) dies with
+        it; touching the manager from another thread would race a
+        half-finished step. The caller re-dispatches the requests via
+        the recompute resume path."""
+        with self._inbox_lock:
+            inbox, self._inbox = self._inbox, []
+        waiting, self.waiting = list(self.waiting), []
+        prefilling = [self._prefilling[i].req
+                      for i in sorted(self._prefilling)]
+        self._prefilling.clear()
+        decoding = [r for r in self._slots if r is not None]
+        self._slots = [None] * self.max_batch
+        self._lens[:] = 0
+        self._last_tok[:] = 0
+        return [r for r in inbox + waiting + prefilling + decoding
+                if not r.done]
+
     def step(self):
         """One scheduler action: drain admissions (shed-aware), expire
         deadlines, tick the progress watchdog, then run EITHER one

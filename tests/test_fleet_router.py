@@ -1,0 +1,752 @@
+"""Fault-tolerant fleet serving (ISSUE 14): health-checked
+multi-replica router with crash failover, graceful drain via KV-page
+migration, and fleet-wide chaos.
+
+Tier-1 acceptance pins:
+
+- killing 1 of 2 replicas mid-load loses ZERO admitted requests:
+  every in-flight request finishes on the survivor with greedy-token
+  parity vs an undisturbed run
+  (``TestCrashFailover.test_kill_one_of_two_zero_loss_parity``);
+- graceful drain migrates a mid-decode request's KV pages across
+  replicas with byte-identical subsequent tokens and EXACT page
+  accounting on both pools — no recompute on the drain path
+  (``TestMigration``);
+- prefix-affinity routing beats round-robin on goodput under a
+  skewed-prefix Poisson load, pinned deterministically on a
+  work-proportional ManualClock (``TestRoutedBeatsRoundRobin``);
+- circuit breaker trip/half-open/re-close, the heartbeat
+  missed-beat → suspect → dead machine on a ManualClock, hedged
+  re-dispatch past a suspect replica, and router-tier
+  ``FleetOverloaded`` shedding.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import stats
+from paddle_tpu.inference import FusedCausalLM
+from paddle_tpu.serving import (CircuitBreaker, FaultInjector,
+                                FleetOverloaded, FleetRouter,
+                                ManualClock, ReplicaKilled, Request,
+                                ServerOverloaded, ServingEngine,
+                                SLOConfig, use_clock)
+from paddle_tpu.serving import faults as faults_mod
+
+pytestmark = pytest.mark.chaos
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model(seed=7, max_position=256):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=64, embed_dim=32, num_heads=4,
+                         dim_feedforward=64, num_layers=2,
+                         max_position=max_position)
+
+
+def _engine(seed=7, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_length", 96)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("slo", SLOConfig(prefill_chunk=8))
+    return ServingEngine(_model(seed), **kw)
+
+
+def _router(n=2, seed=7, policy="affinity", faults=None, **kw):
+    return FleetRouter(
+        engine_factory=lambda i: _engine(seed, **kw),
+        n_replicas=n, policy=policy, faults=faults)
+
+
+#: fault-free single-engine reference outputs, memoized per workload —
+#: chunked-serving parity is prompt-deterministic (pinned since ISSUE
+#: 8), so ONE ServingEngine run references every fleet run over the
+#: same prompts whatever replica each lands on
+_REF_CACHE: dict = {}
+
+
+def _ref_tokens(prompts, max_new, seed=7):
+    key = (tuple(np.asarray(p, np.int32).tobytes() for p in prompts),
+           int(max_new), int(seed))
+    if key not in _REF_CACHE:
+        eng = _engine(seed)
+        rids = [eng.submit(p, max_new_tokens=max_new)
+                for p in prompts]
+        done = {r.id: r for r in eng.run()}
+        assert all(done[r].state == "ok" for r in rids)
+        _REF_CACHE[key] = [list(done[r].generated) for r in rids]
+    return _REF_CACHE[key]
+
+
+_PROMPTS = None
+
+
+def _prompts():
+    global _PROMPTS
+    if _PROMPTS is None:
+        rng = np.random.RandomState(0)
+        _PROMPTS = [rng.randint(0, 64, (L,)) for L in (6, 10, 14, 9)]
+    return _PROMPTS
+
+
+class _flags:
+    """Scoped flag override (flags are process-global)."""
+
+    def __init__(self, **kw):
+        self._new = {f"FLAGS_{k}": v for k, v in kw.items()}
+
+    def __enter__(self):
+        self._old = paddle.get_flags(list(self._new))
+        paddle.set_flags(self._new)
+        return self
+
+    def __exit__(self, *exc):
+        paddle.set_flags(self._old)
+
+
+# =====================================================================
+# fault kinds / typed errors
+# =====================================================================
+
+class TestFaultVocabulary:
+    def test_new_sites_registered(self):
+        for site in ("router.dispatch", "replica.step",
+                     "replica.heartbeat"):
+            assert site in faults_mod.FAULT_SITES
+
+    def test_kill_kind_raises_replica_killed(self):
+        inj = FaultInjector().add("replica.step", kind="kill", at=1)
+        inj.fire("replica.step")                     # hit 0: clean
+        with pytest.raises(ReplicaKilled) as ei:
+            inj.fire("replica.step")                 # hit 1: kill
+        assert ei.value.site == "replica.step"
+        assert ei.value.hit == 1
+
+    def test_hang_kind_warps_the_clock(self):
+        with use_clock(ManualClock()) as clk:
+            inj = FaultInjector().add("replica.step", kind="hang",
+                                      at=0, delay_ms=250.0)
+            inj.fire("replica.step")
+            assert clk.now() == pytest.approx(0.25)
+        # default hang duration is far past any heartbeat budget
+        with use_clock(ManualClock()) as clk:
+            inj = FaultInjector().add("replica.step", kind="hang",
+                                      at=0)
+            inj.fire("replica.step")
+            assert clk.now() == pytest.approx(
+                faults_mod.DEFAULT_HANG_MS / 1e3)
+
+    def test_fleet_overloaded_is_server_overloaded(self):
+        # producers catching ServerOverloaded keep working unchanged
+        assert issubclass(FleetOverloaded, ServerOverloaded)
+
+    def test_fleet_prefix_registered(self):
+        assert "fleet." in stats.CONVENTION_PREFIXES
+
+    def test_journal_events_extended(self):
+        from paddle_tpu.serving.journal import LIFECYCLE_EVENTS
+
+        for ev in ("failover", "migrate", "drain"):
+            assert ev in LIFECYCLE_EVENTS
+
+
+# =====================================================================
+# circuit breaker
+# =====================================================================
+
+class TestCircuitBreaker:
+    def test_trip_half_open_reclose(self):
+        with use_clock(ManualClock()) as clk:
+            br = CircuitBreaker(threshold=3, cooldown_ms=100.0)
+            assert br.allow()
+            br.record_failure()
+            br.record_failure()
+            assert br.state == "closed"      # under threshold
+            br.record_failure()              # 3rd consecutive: trip
+            assert br.state == "open" and br.trips == 1
+            assert not br.allow()
+            clk.advance(0.05)
+            assert not br.allow()            # cooldown not elapsed
+            clk.advance(0.06)
+            assert br.allow()                # half-open probe
+            assert br.state == "half_open"
+            br.record_success()              # probe succeeded
+            assert br.state == "closed" and br.failures == 0
+
+    def test_half_open_failure_reopens(self):
+        with use_clock(ManualClock()) as clk:
+            br = CircuitBreaker(threshold=2, cooldown_ms=100.0)
+            br.record_failure()
+            br.record_failure()
+            assert br.state == "open"
+            clk.advance(0.11)
+            assert br.allow()
+            br.record_failure()              # probe failed
+            assert br.state == "open" and br.trips == 2
+            assert not br.allow()
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(threshold=2)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == "closed"          # never 2 consecutive
+
+    def test_threshold_follows_flag(self):
+        with _flags(fleet_breaker_threshold=5):
+            assert CircuitBreaker().threshold == 5
+
+    def test_dispatch_faults_trip_breaker_and_reroute(self):
+        """Injected router.dispatch raises always land on replica 0
+        (it stays least-loaded because it never admits): three
+        consecutive failures OPEN its breaker, every request still
+        lands on the peer, and the fleet.circuit_open gauge reports
+        the trip."""
+        stats.reset()
+        # replica 0 is tried first on every submit (empty = least
+        # loaded); hits 0/2/4 are exactly those first attempts
+        inj = FaultInjector().add("router.dispatch", kind="raise",
+                                  at=(0, 2, 4), times=3)
+        router = _router(2, faults=inj)
+        prompts = _prompts()
+        rids = [router.submit(p, max_new_tokens=4) for p in prompts]
+        r0, r1 = router.replicas
+        assert r0.breaker.state == "open" and r0.breaker.trips == 1
+        assert r0.eng.queue_depth == 0       # nothing ever landed
+        assert r1.eng.queue_depth == len(rids)
+        assert stats.gauge("fleet.circuit_open").value == 1
+        done = {r.id: r for r in router.run()}
+        assert all(done[r].state == "ok" for r in rids)
+
+
+# =====================================================================
+# heartbeat state machine (ManualClock)
+# =====================================================================
+
+class TestHeartbeatStateMachine:
+    def test_alive_suspect_dead_walk(self):
+        with use_clock(ManualClock()), \
+                _flags(fleet_heartbeat_ms=50.0, fleet_suspect_beats=3):
+            router = _router(2)
+            router.enforce_beats = True
+            r0, r1 = router.replicas
+            assert (r0.state, r1.state) == ("alive", "alive")
+            # r1 beats, r0 goes silent
+            clk = faults_mod.clock()
+            clk.advance(0.16)                # 3.2 missed beats
+            r1.beat()
+            router.check_health()
+            assert r0.state == "suspect"
+            assert r1.state == "alive"
+            clk.advance(0.15)                # 6.2 missed total
+            r1.beat()
+            router.check_health()
+            assert r0.state == "dead"
+            assert stats.gauge("fleet.replicas_alive").value == 1
+
+    def test_recovered_beats_walk_suspect_back_alive(self):
+        with use_clock(ManualClock()), \
+                _flags(fleet_heartbeat_ms=50.0, fleet_suspect_beats=3):
+            router = _router(2)
+            router.enforce_beats = True
+            r0, r1 = router.replicas
+            faults_mod.clock().advance(0.16)
+            r1.beat()
+            router.check_health()
+            assert r0.state == "suspect"
+            r0.beat()                        # it was only slow
+            router.check_health()
+            assert r0.state == "alive"
+
+    def test_sync_mode_never_beat_kills(self):
+        """Without enforce_beats (synchronous driving), wall-clock
+        silence never kills a replica — one driver stepping replicas
+        sequentially through multi-second compiles must not false-kill
+        the fleet. Crash detection stays on."""
+        with use_clock(ManualClock()):
+            router = _router(2)
+            faults_mod.clock().advance(999.0)
+            router.check_health()
+            assert all(r.state == "alive" for r in router.replicas)
+
+    def test_suppressed_heartbeats_drive_suspicion(self):
+        """A raise scheduled at replica.heartbeat SUPPRESSES the stamp
+        — the replica keeps stepping but looks silent, which is
+        exactly the partial-failure the state machine must catch."""
+        with use_clock(ManualClock()), \
+                _flags(fleet_heartbeat_ms=50.0, fleet_suspect_beats=3):
+            inj = FaultInjector().add("replica.heartbeat",
+                                      kind="raise", every=1, times=-1)
+            router = _router(1, faults=inj)
+            router.enforce_beats = True
+            rep = router.replicas[0]
+            rep.beat()                       # suppressed
+            faults_mod.clock().advance(0.16)
+            rep.beat()                       # suppressed again
+            router.check_health()
+            assert rep.state == "suspect"
+
+
+# =====================================================================
+# crash failover
+# =====================================================================
+
+class TestCrashFailover:
+    def test_kill_one_of_two_zero_loss_parity(self):
+        """THE acceptance pin: killing 1 of 2 replicas mid-load loses
+        zero admitted requests — every one finishes on the survivor
+        in the ``ok`` state with greedy tokens identical to an
+        undisturbed run."""
+        stats.reset()
+        prompts = _prompts()
+        ref = _ref_tokens(prompts, 6)
+        router = _router(2)
+        rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        for _ in range(3):                   # some tokens in flight
+            router.step()
+        victim = next(r.idx for r in router.replicas
+                      if r.eng.has_work)
+        router.kill(victim)
+        assert router.replicas[victim].state == "dead"
+        done = {r.id: r for r in router.run()}
+        assert all(done[r].state == "ok" for r in rids), \
+            [(done[r].state, repr(done[r].error)) for r in rids]
+        for i, rid in enumerate(rids):
+            assert list(done[rid].generated) == ref[i], i
+        assert stats.counter("fleet.failovers").value == 1
+        assert stats.counter("fleet.failover_requests").value >= 1
+        assert stats.gauge("fleet.replicas_alive").value == 1
+
+    def test_injected_kill_at_replica_step(self):
+        """The same pin driven end-to-end by a scheduled ``kill``
+        fault at the replica.step site (the chaos-bench form)."""
+        stats.reset()
+        prompts = _prompts()
+        ref = _ref_tokens(prompts, 6)
+        inj = FaultInjector().add("replica.step", kind="kill", at=4)
+        router = _router(2, faults=inj)
+        rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        done = {r.id: r for r in router.run()}
+        assert sum(r.dead for r in router.replicas) == 1
+        assert all(done[r].state == "ok" for r in rids)
+        for i, rid in enumerate(rids):
+            assert list(done[rid].generated) == ref[i], i
+        assert any(f["kind"] == "kill" for f in inj.fired)
+
+    def test_failover_journaled_on_destination(self):
+        router = _router(2)
+        prompts = _prompts()
+        rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        for _ in range(3):
+            router.step()
+        victim = next(r.idx for r in router.replicas
+                      if r.eng.has_work)
+        router.kill(victim)
+        router.run()
+        survivor = router.replicas[1 - victim]
+        evs = [e for e in survivor.eng.journal.events()
+               if e["ev"] == "failover"]
+        assert evs, "no failover event on the survivor's journal"
+        assert all(e["from"] == victim and e["to"] == survivor.idx
+                   for e in evs)
+
+    def test_all_replicas_dead_fails_requests_not_the_fleet(self):
+        """Total fleet death terminates the tracked requests (typed
+        errors) instead of hanging run() or raising out of it."""
+        router = _router(2)
+        rids = [router.submit(p, max_new_tokens=4)
+                for p in _prompts()[:2]]
+        router.kill(0)
+        router.kill(1)
+        done = {r.id: r for r in router.run()}
+        for rid in rids:
+            assert done[rid].state == "error"
+            assert isinstance(done[rid].error,
+                              (FleetOverloaded, ReplicaKilled))
+
+    def test_submit_after_total_death_sheds(self):
+        router = _router(2)
+        router.kill(0)
+        router.kill(1)
+        with pytest.raises(FleetOverloaded):
+            router.submit(_prompts()[0], max_new_tokens=4)
+
+
+# =====================================================================
+# graceful drain / KV-page migration
+# =====================================================================
+
+class TestMigration:
+    def _mid_decode_router(self, n_generated=2, max_new=8):
+        """A 2-replica fleet with one request mid-decode on replica
+        ``src`` (>= n_generated tokens out, not done)."""
+        router = _router(2)
+        rid = router.submit(_prompts()[1], max_new_tokens=max_new)
+        steps = 0
+        while True:
+            router.step()
+            steps += 1
+            assert steps < 500
+            req = router.results()[rid]
+            if len(req.generated) >= n_generated and not req.done:
+                break
+        src = next(r.idx for r in router.replicas
+                   if r.eng.num_active)
+        return router, rid, src
+
+    def test_migration_token_parity_and_exact_accounting(self):
+        """THE drain acceptance pin: the mid-decode request's KV pages
+        hand over page-granularly (no recompute anywhere on the drain
+        path), subsequent tokens are byte-identical to an undisturbed
+        run, and page accounting closes EXACTLY on both pools."""
+        stats.reset()
+        ref = _ref_tokens([_prompts()[1]], 8)[0]
+        router, rid, src = self._mid_decode_router()
+        src_eng = router.replicas[src].eng
+        dst_eng = router.replicas[1 - src].eng
+        pages_live = len(src_eng._mgr._owned[
+            ("slot", next(i for i in range(src_eng.max_batch)
+                          if src_eng._slots[i] is not None))])
+        dst_free_before = dst_eng._mgr.free_pages
+        router.drain(src)
+        assert router.replicas[src].state == "drained"
+        # no recompute: pages moved, nothing preempted/re-admitted
+        assert stats.counter("fleet.migrations").value == 1
+        assert stats.counter("fleet.migrated_pages").value \
+            == pages_live
+        assert stats.counter("serving.preemptions").value == 0
+        # exact accounting: the source pool drained to empty (scratch
+        # page 0 stays reserved) with zero live refcounts ...
+        assert src_eng._mgr.free_pages == src_eng._mgr.num_pages - 1
+        assert src_eng._mgr._refs == {}
+        assert src_eng._mgr._owned == {}
+        # ... and the destination paid exactly the migrated pages,
+        # each at refcount 1
+        assert dst_free_before - dst_eng._mgr.free_pages == pages_live
+        j = next(i for i in range(dst_eng.max_batch)
+                 if dst_eng._slots[i] is not None)
+        for p in dst_eng._mgr._owned[("slot", j)]:
+            assert dst_eng._mgr.refcount(p) == 1
+        # destination journal carries the migrate event and NO
+        # admitted event for this request — it never re-prefilled
+        evs = dst_eng.journal.events(rid)
+        assert any(e["ev"] == "migrate" for e in evs)
+        assert not any(e["ev"] == "admitted" for e in evs)
+        done = {r.id: r for r in router.run()}
+        assert done[rid].state == "ok"
+        assert list(done[rid].generated) == ref
+        assert stats.counter("serving.preemptions").value == 0
+
+    def test_drain_with_no_peer_slot_falls_back_to_recompute(self):
+        """Every destination slot busy -> the drain still empties the
+        replica, via the resume path, with token parity."""
+        stats.reset()
+        prompts = _prompts()
+        ref = _ref_tokens(prompts, 6)
+        # max_batch=1 per replica: one decoding request each, so the
+        # drained replica's slot has nowhere to migrate
+        router = _router(2, max_batch=1)
+        rids = [router.submit(p, max_new_tokens=6)
+                for p in prompts[:2]]
+        steps = 0
+        while not all(r.eng.num_active for r in router.replicas):
+            router.step()
+            steps += 1
+            assert steps < 500
+        router.drain(0)
+        assert router.replicas[0].state == "drained"
+        assert stats.counter("fleet.migrations").value == 0
+        done = {r.id: r for r in router.run()}
+        for i, rid in enumerate(rids):
+            assert done[rid].state == "ok"
+            assert list(done[rid].generated) == ref[i], i
+
+    def test_drained_replica_receives_no_new_dispatch(self):
+        router = _router(2)
+        router.drain(0)
+        assert router.replicas[0].state == "drained"
+        rids = [router.submit(p, max_new_tokens=4)
+                for p in _prompts()]
+        assert router.replicas[0].eng.queue_depth == 0
+        done = {r.id: r for r in router.run()}
+        assert all(done[r].state == "ok" for r in rids)
+
+    def test_queued_and_prefilling_requests_redispatch(self):
+        """Drain of a replica mid-prefill: the half-prefilled request
+        re-dispatches (its pages freed) and still finishes with
+        parity."""
+        prompts = _prompts()
+        ref = _ref_tokens(prompts, 6)
+        router = _router(2)
+        rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        router.step()                        # some mid-prefill
+        tgt = next((r.idx for r in router.replicas
+                    if r.eng.num_prefilling), None)
+        if tgt is None:
+            tgt = next(r.idx for r in router.replicas
+                       if r.eng.has_work)
+        router.drain(tgt)
+        eng = router.replicas[tgt].eng
+        assert eng.num_prefilling == 0 and eng.queue_depth == 0
+        done = {r.id: r for r in router.run()}
+        for i, rid in enumerate(rids):
+            assert done[rid].state == "ok"
+            assert list(done[rid].generated) == ref[i], i
+
+
+# =====================================================================
+# routed beats round-robin (the goodput pin)
+# =====================================================================
+
+class TestRoutedBeatsRoundRobin:
+    #: the skewed-prefix Poisson workload: 4 distinct system prompts
+    #: (16 tokens = 4 full pages) with Zipf-ish popularity, short
+    #: bodies, exponential inter-arrival gaps — all seeded
+    TTFT_TARGET_MS = 12.0
+
+    def _workload(self):
+        rng = np.random.RandomState(3)
+        prefixes = [rng.randint(0, 64, (16,)) for _ in range(4)]
+        order = list(rng.choice(4, size=12,
+                                p=[0.4, 0.3, 0.2, 0.1]))
+        bodies = [rng.randint(0, 64, (4,)) for _ in range(12)]
+        arrivals = np.cumsum(rng.exponential(0.025, size=12))
+        return prefixes, order, bodies, arrivals
+
+    def _run(self, policy):
+        """Deterministic serve: Poisson arrivals and TTFTs measured on
+        a WORK-PROPORTIONAL ManualClock (1ms per prefill token, 0.1ms
+        per decode step) — prefix hits save prefill work, so they save
+        'time', exactly the mechanism affinity routing exploits."""
+        prefixes, order, bodies, arrivals = self._workload()
+        stats.reset()
+        with use_clock(ManualClock()) as clk:
+            router = _router(2, policy=policy)
+
+            def work_ms():
+                return (stats.counter("serve.prefill_tokens").value
+                        * 1.0
+                        + stats.counter(
+                            "serving.decode_steps").value * 0.1)
+
+            rids, w0, i, steps = [], work_ms(), 0, 0
+            while i < len(order) or router.pending():
+                while i < len(order) and clk.now() >= arrivals[i]:
+                    prompt = np.concatenate(
+                        [prefixes[order[i]], bodies[i]])
+                    rids.append(router.submit(prompt,
+                                              max_new_tokens=4))
+                    i += 1
+                did = False
+                for rep in router.replicas:
+                    did = rep.step_once() or did
+                    w1 = work_ms()
+                    clk.advance((w1 - w0) / 1e3)
+                    w0 = w1
+                if not did and i < len(order):
+                    clk.advance(max(arrivals[i] - clk.now(), 0.0)
+                                + 1e-6)
+                steps += 1
+                assert steps < 20000
+            done = router.results()
+            ttfts = [done[r].ttft_s * 1e3 for r in rids]
+        goodput = sum(t <= self.TTFT_TARGET_MS
+                      for t in ttfts) / len(ttfts)
+        return goodput, \
+            stats.counter("serving.prefix_pages_saved").value
+
+    def test_affinity_beats_round_robin_goodput(self):
+        good_aff, saved_aff = self._run("affinity")
+        good_rr, saved_rr = self._run("rr")
+        # affinity keeps every prefix on ONE replica: fewer cold
+        # prefills fleet-wide -> strictly more pages saved AND
+        # strictly better goodput at the pinned target
+        assert saved_aff > saved_rr, (saved_aff, saved_rr)
+        assert good_aff > good_rr, (good_aff, good_rr)
+
+    def test_affinity_routes_same_prefix_to_same_replica(self):
+        router = _router(2)
+        rng = np.random.RandomState(5)
+        prefix = rng.randint(0, 64, (8,))    # 2 full pages
+        reps = []
+        for _ in range(4):
+            body = rng.randint(0, 64, (5,))
+            rep = router._dispatch(Request(
+                np.concatenate([prefix, body]), 4))
+            reps.append(rep.idx)
+        assert len(set(reps)) == 1
+        # a disjoint prefix balances to the OTHER (now less loaded)
+        other = router._dispatch(Request(rng.randint(0, 64, (9,)), 4))
+        assert other.idx != reps[0]
+
+
+# =====================================================================
+# hedging + router-tier shedding
+# =====================================================================
+
+class TestHedgingAndShedding:
+    def test_suspect_inbox_hedges_to_healthy_peer(self):
+        stats.reset()
+        with use_clock(ManualClock()), \
+                _flags(fleet_heartbeat_ms=50.0, fleet_suspect_beats=3):
+            router = _router(2)
+            router.enforce_beats = True
+            rng = np.random.RandomState(5)
+            prefix = rng.randint(0, 64, (8,))
+            rids = [router.submit(
+                np.concatenate([prefix, rng.randint(0, 64, (4,))]),
+                max_new_tokens=4) for _ in range(2)]
+            tgt = router.replicas[
+                router._affinity[router._affinity_chain(prefix)[0]]]
+            assert len(tgt.eng._inbox) == 2
+            other = router.replicas[1 - tgt.idx]
+            # tgt goes silent; the peer keeps beating
+            faults_mod.clock().advance(0.16)
+            other.beat()
+            router.check_health()
+            assert tgt.state == "suspect"
+            assert len(tgt.eng._inbox) == 0      # stolen
+            assert stats.counter("fleet.hedges").value == 2
+            done = {r.id: r for r in router.run()}
+            assert all(done[r].state == "ok" for r in rids)
+            # the hedged requests ran on the healthy peer
+            assert {r.id for r in other.eng.finished} == set(rids)
+
+    def test_dispatch_queue_bound_sheds_typed(self):
+        stats.reset()
+        with _flags(fleet_dispatch_queue=2):
+            router = _router(2)
+            router.submit(_prompts()[0], max_new_tokens=4)
+            router.submit(_prompts()[1], max_new_tokens=4)
+            with pytest.raises(FleetOverloaded):
+                router.submit(_prompts()[2], max_new_tokens=4)
+            assert stats.counter("fleet.shed").value == 1
+            # shed before ANY replica admitted it
+            assert sum(r.eng.queue_depth
+                       for r in router.replicas) == 2
+            done = router.run()
+            assert all(r.state == "ok" for r in done)
+
+    def test_engine_shed_reroutes_via_breaker(self):
+        """A replica whose OWN inbox bound rejects (engine-tier
+        ServerOverloaded) counts as a dispatch failure: the router
+        retries the peer instead of surfacing the shed, and the
+        request is never lost."""
+        with _flags(serve_inbox_limit=1):
+            router = _router(2)
+            rng = np.random.RandomState(5)
+            prefix = rng.randint(0, 64, (8,))
+            mk = lambda: np.concatenate(  # noqa: E731
+                [prefix, rng.randint(0, 64, (4,))])
+            rid1 = router.submit(mk(), max_new_tokens=4)
+            tgt = router.replicas[
+                router._affinity[router._affinity_chain(prefix)[0]]]
+            # same prefix routes to tgt first, whose inbox (limit 1)
+            # rejects -> breaker failure -> peer takes it
+            rid2 = router.submit(mk(), max_new_tokens=4)
+            other = router.replicas[1 - tgt.idx]
+            assert tgt.breaker.failures == 1
+            assert tgt.eng.queue_depth == 1
+            assert other.eng.queue_depth == 1
+            done = {r.id: r for r in router.run()}
+            assert done[rid1].state == "ok"
+            assert done[rid2].state == "ok"
+
+
+# =====================================================================
+# serve_top / bench plumbing
+# =====================================================================
+
+class TestFleetTooling:
+    def test_render_fleet_and_offline_dashboard(self):
+        sys.path.insert(0, _REPO)
+        from tools import serve_top
+
+        router = _router(2)
+        rids = [router.submit(p, max_new_tokens=4)
+                for p in _prompts()]
+        for _ in range(3):
+            router.step()
+        victim = next(r.idx for r in router.replicas
+                      if r.eng.has_work)
+        router.kill(victim)
+        router.run()
+        live = serve_top.render_fleet(router)
+        assert "replicas (policy affinity)" in live
+        assert "dead" in live and "failovers" in live
+        with tempfile.TemporaryDirectory() as d:
+            paths = router.export_journals(d)
+            assert len(paths) == 2
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "tools", "serve_top.py"),
+                 "--fleet"] + paths,
+                capture_output=True, text=True, timeout=120)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            assert "replica journals" in proc.stdout
+            assert "merged fleet view:" in proc.stdout
+            # replica-stamped chrome traces fold through trace_merge:
+            # one pid per replica in a single fleet timeline
+            from tools.trace_merge import merge_traces
+
+            tpaths = router.export_traces(d)
+            merged = merge_traces(tpaths)
+            pids = {e.get("pid") for e in merged["traceEvents"]}
+            assert pids == {0, 1}
+        # every tracked request's journal lanes fold by rid across
+        # replica files; the survivor's journal carries the failover
+        survivor = router.replicas[1 - victim]
+        assert any(e["ev"] == "failover"
+                   for e in survivor.eng.journal.events())
+        assert all(router.results()[r].state == "ok" for r in rids)
+
+    def test_bench_gate_directions_for_fleet_keys(self):
+        from tools.bench_gate import DEFAULT_METRICS
+
+        assert DEFAULT_METRICS["fleet_goodput"] == "down"
+        assert DEFAULT_METRICS["fleet_tokens_per_sec"] == "down"
+        assert DEFAULT_METRICS["fleet_p99_ttft_ms"] == "up"
+        assert DEFAULT_METRICS["fleet_chaos_survivor_parity"] \
+            == "down"
+        assert DEFAULT_METRICS["fleet_chaos_lost"] == "up"
+        assert DEFAULT_METRICS["fleet_chaos_request_errors"] == "up"
+        assert DEFAULT_METRICS["fleet_failovers"] == "up"
+
+    def test_serve_bench_fleet_chaos_cli(self):
+        """CPU CLI smoke of the fleet bench WITH the chaos pins: the
+        bench itself exits nonzero if the zero-loss failover, parity,
+        goodput-bound, or site-coverage pins fail."""
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "serve_bench.py"),
+             "--fleet", "2", "--streams", "2", "--requests", "8",
+             "--seed", "0", "--prompt-mix", "6,14",
+             "--system-prompt", "8", "--system-prompts", "3",
+             "--max-new", "4", "--prefill-chunk", "8",
+             "--decode-chunk", "2", "--d-model", "32",
+             "--layers", "1", "--heads", "2", "--vocab", "64",
+             "--rate", "200", "--chaos", "--no-lint"],
+            capture_output=True, text=True, timeout=420,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, \
+            proc.stdout[-1000:] + proc.stderr[-2000:]
+        doc = json.loads(
+            [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")][-1])
+        assert doc["fleet_replicas"] == 2
+        for key in ("fleet_goodput", "fleet_tokens_per_sec",
+                    "fleet_p99_ttft_ms"):
+            assert isinstance(doc[key], (int, float)), key
+        assert doc["fleet_chaos_survivor_parity"] == 1.0
+        assert doc["fleet_chaos_lost"] == 0
+        assert doc["fleet_chaos_replicas_dead"] == 1
+        assert doc["fleet_chaos_failovers"] >= 1
+        assert len(doc["fleet_chaos_sites_fired"]) >= 5

@@ -130,6 +130,25 @@ DEFAULT_METRICS: Dict[str, str] = {
     "serve_chaos_goodput": "down",
     "serve_chaos_tokens_per_sec": "down",
     "serve_chaos_request_errors": "up",
+    # fleet serving rungs (tools/serve_bench.py --fleet, ISSUE 14):
+    # routed goodput/throughput regress DOWN and latency UP like the
+    # single-replica serve_* siblings; failovers/hedges in the
+    # FAULT-FREE fleet run regress UP (any appearing = replicas are
+    # falsely suspected/dying under clean load); under the seeded
+    # chaos schedule survivor parity is binary (must stay 1.0), lost
+    # requests regress UP (the zero-loss failover pin), and chaos
+    # goodput/throughput regress DOWN
+    "fleet_goodput": "down",
+    "fleet_tokens_per_sec": "down",
+    "fleet_p50_ttft_ms": "up",
+    "fleet_p99_ttft_ms": "up",
+    "fleet_failovers": "up",
+    "fleet_hedges": "up",
+    "fleet_chaos_survivor_parity": "down",
+    "fleet_chaos_lost": "up",
+    "fleet_chaos_request_errors": "up",
+    "fleet_chaos_goodput": "down",
+    "fleet_chaos_tokens_per_sec": "down",
     # static-analysis state the numbers were measured under: the
     # finding count must only go DOWN between rounds, so any growth
     # regresses (direction "up" = an increase fails the gate); gates

@@ -33,6 +33,19 @@ tracks the speculative SLO rungs (throughput/accept-rate regress
 DOWN, TTFT UP) independently of the plain ones. ``oracle`` drives the
 target model as its own drafter — the acceptance-ceiling workload.
 
+``--fleet N`` (ISSUE 14) drives a :class:`FleetRouter` over N
+replicas (one serve-loop thread each) under a SKEWED-PREFIX Poisson
+load — ``--system-prompts K`` distinct system prompts with Zipf-ish
+popularity — and emits ``fleet_{goodput,tokens_per_sec,p50_ttft_ms,
+p99_ttft_ms,failovers,migrations,...}``. ``--fleet-policy rr`` runs
+the round-robin baseline the affinity policy is pinned against.
+``--fleet --chaos`` re-drives the measured workload with a seeded
+fleet fault schedule (a replica KILL mid-load, a hang, dispatch
+faults, beat suppression) and pins the ISSUE 14 acceptance: zero
+admitted requests lost, survivor greedy-token parity vs the
+undisturbed run, and bounded goodput loss (``fleet_chaos_*`` keys,
+nonzero exit on a failed pin).
+
 ``--chaos`` (ISSUE 11) re-drives the SAME measured workload against a
 fresh engine with a seeded fault schedule installed
 (``serving/faults.py`` — raises, delays, token corruption, and pool
@@ -208,6 +221,269 @@ def drive(eng, reqs, max_new, deadline_ms=None):
     return time.monotonic() - t0, list(rids)
 
 
+def make_fleet_requests(args, lens, rng):
+    """Skewed-prefix Poisson load (the fleet routing workload):
+    ``--system-prompts`` DISTINCT system prompts with Zipf-ish
+    popularity (rank k drawn ∝ 1/(k+1)), mixed body lengths,
+    exponential inter-arrival gaps. Returns (prompt, gap) pairs."""
+    k = max(int(args.system_prompts), 1)
+    prefixes = [rng.randint(0, args.vocab, (args.system_prompt,))
+                for _ in range(k)]
+    w = np.array([1.0 / (i + 1) for i in range(k)])
+    w /= w.sum()
+    reqs = []
+    for _ in range(args.requests):
+        L = int(lens[int(rng.randint(len(lens)))])
+        body = rng.randint(0, args.vocab, (L,))
+        if args.system_prompt and rng.rand() < args.system_frac:
+            prompt = np.concatenate(
+                [prefixes[int(rng.choice(k, p=w))], body])
+        else:
+            prompt = body
+        reqs.append((prompt, float(rng.exponential(1.0 / args.rate))))
+    return reqs, prefixes
+
+
+def build_fleet(args, faults=None):
+    """N identical replicas from one seeded factory (failover replays
+    and page migration are byte-exact only because every replica
+    computes the same function)."""
+    from paddle_tpu.serving import FleetRouter
+
+    def factory(i):
+        eng, _ = build_engine(args)
+        return eng
+
+    lens = [int(x) for x in args.prompt_mix.split(",")]
+    return FleetRouter(engine_factory=factory, n_replicas=args.fleet,
+                       policy=args.fleet_policy, faults=faults), lens
+
+
+def _fleet_warm(router, args, lens, prefixes):
+    """Compile every chunk/decode program on every replica OUTSIDE
+    the measured window (synchronous stepping — no beat enforcement,
+    so multi-second compiles can't false-kill a replica), then reset
+    telemetry/journals to describe only the load run."""
+    from paddle_tpu.profiler import stats
+    from paddle_tpu.serving import Request
+
+    warm = [np.full((L,), 1, np.int32) for L in lens]
+    if args.system_prompt:
+        warm += [np.concatenate([p, warm[0]]) for p in prefixes]
+    for rep in router.replicas:      # every replica compiles
+        for p in warm:
+            rep.eng.submit_request(
+                Request(p, max_new_tokens=args.max_new))
+    while any(r.eng.has_work for r in router.replicas):
+        for rep in router.replicas:
+            rep.step_once()
+    for rep in router.replicas:
+        rep.eng.finished.clear()
+        rep.eng.action_log.clear()
+        rep.eng.slo_monitor.reset()
+        if rep.eng.journal is not None:
+            rep.eng.journal.clear()
+    router._tracked.clear()
+    stats.reset()
+
+
+def drive_fleet(router, reqs, max_new, deadline_ms=None,
+                timeout_s=600.0):
+    """Threaded fleet drive: start the replica loops + health monitor,
+    submit at the Poisson arrival times, wait until every tracked
+    request is terminal. Returns (wall_s, rids) with None for
+    router-shed submissions."""
+    from paddle_tpu.serving import ServerOverloaded
+
+    router.start()
+    rids = []
+    t0 = time.monotonic()
+    t_next = t0
+    for prompt, gap in reqs:
+        t_next += gap
+        delay = t_next - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            rids.append(router.submit(prompt, max_new_tokens=max_new,
+                                      deadline_ms=deadline_ms))
+        except ServerOverloaded:
+            rids.append(None)
+    deadline = time.monotonic() + timeout_s
+    while router.pending():
+        if time.monotonic() > deadline:
+            router.stop()
+            raise RuntimeError(
+                f"fleet bench stalled: {router.pending()} requests "
+                f"in flight, replica states "
+                f"{[r.state for r in router.replicas]}")
+        time.sleep(0.001)
+    wall = time.monotonic() - t0
+    router.stop()
+    return wall, rids
+
+
+def fleet_chaos_injector(seed):
+    """Seeded FLEET fault schedule (>=5 distinct sites): a replica
+    KILL mid-load (the headline crash), a replica.step hang long
+    enough to walk suspect -> dead, suppressed heartbeats, dispatch
+    faults that trip a circuit breaker, and engine-level chunk faults
+    — all of which the router must absorb with zero lost requests."""
+    from paddle_tpu.serving import FaultInjector
+
+    return (FaultInjector(seed=seed)
+            .add("replica.step", kind="kill", at=10)
+            # the hang lands between the suspect (3 beats = 150ms)
+            # and dead (6 beats = 300ms) thresholds: the replica is
+            # suspected (inbox hedges away) and then RECOVERS — only
+            # the kill above may take a replica down, so 1 of 2 dying
+            # is exactly the zero-loss acceptance scenario
+            .add("replica.step", kind="hang", at=30, delay_ms=200.0)
+            .add("replica.heartbeat", kind="raise", at=(5, 6))
+            .add("router.dispatch", kind="raise", at=(3, 7))
+            .add("prefill.dispatch", kind="raise", at=4)
+            .add("decode.step", kind="raise", at=6))
+
+
+def run_fleet(args):
+    """The --fleet bench: warmup, measured Poisson run, fleet_* keys;
+    with --chaos, a second run under the seeded fleet fault schedule
+    pinning zero-loss failover + survivor parity + bounded goodput
+    loss. Returns (out dict, ok)."""
+    from paddle_tpu.profiler import stats
+
+    rng = np.random.RandomState(args.seed)
+    router, lens = build_fleet(args)
+    reqs, prefixes = make_fleet_requests(args, lens, rng)
+    if not args.no_warmup:
+        _fleet_warm(router, args, lens, prefixes)
+    wall, rids = drive_fleet(router, reqs, args.max_new,
+                             deadline_ms=args.deadline_ms)
+    done = router.results()
+    finished = [done[r] for r in rids if r is not None]
+    ttfts = np.array([r.ttft_s for r in finished
+                      if r.ttft_s is not None], np.float64) * 1e3
+    if ttfts.size == 0:
+        ttfts = np.array([0.0])
+    judged = [r for r in finished
+              if getattr(r, "slo_ok", None) is not None]
+    goodput = round(sum(1 for r in judged if r.slo_ok)
+                    / len(judged), 4) if judged else None
+    total_tokens = sum(len(r.generated) for r in finished)
+    if args.journal_out:
+        import os
+
+        d = os.path.dirname(args.journal_out) or "."
+        base = os.path.basename(args.journal_out)
+        router.export_journals(d, prefix=base.replace(".jsonl", ""))
+    out = {
+        "fleet_replicas": args.fleet,
+        "fleet_policy": args.fleet_policy,
+        "fleet_p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 3),
+        "fleet_p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 3),
+        "fleet_tokens_per_sec": round(total_tokens / wall, 1)
+        if wall > 0 else None,
+        "fleet_goodput": goodput,
+        "fleet_requests": len(finished),
+        "fleet_shed": sum(1 for r in rids if r is None),
+        "fleet_failovers": int(
+            stats.counter("fleet.failovers").value),
+        "fleet_migrations": int(
+            stats.counter("fleet.migrations").value),
+        "fleet_migrated_pages": int(
+            stats.counter("fleet.migrated_pages").value),
+        "fleet_hedges": int(stats.counter("fleet.hedges").value),
+        "fleet_prefix_pages_saved": int(
+            stats.counter("serving.prefix_pages_saved").value),
+        "fleet_system_prompts": int(args.system_prompts),
+        "fleet_rate": args.rate,
+        "fleet_wall_s": round(wall, 3),
+        "telemetry": _telemetry(),
+    }
+    ok = True
+    if args.chaos:
+        chaos_out, ok = run_fleet_chaos(args, reqs, rids, done,
+                                        goodput, lens, prefixes)
+        out.update(chaos_out)
+    return out, ok
+
+
+def run_fleet_chaos(args, reqs, base_rids, base_done, base_goodput,
+                    lens, prefixes):
+    """Re-drive the measured fleet workload with the seeded fleet
+    fault schedule armed (after a fault-free warmup). Pins the ISSUE
+    14 acceptance: a replica dies mid-load yet ZERO admitted requests
+    are lost — every one finishes ``ok`` on a survivor with greedy
+    tokens identical to the undisturbed run — and goodput stays
+    within a pinned bound."""
+    from paddle_tpu.profiler import stats
+
+    seed = args.chaos_seed if args.chaos_seed is not None \
+        else args.seed
+    inj = fleet_chaos_injector(seed)
+    router, _ = build_fleet(args)
+    if not args.no_warmup:
+        _fleet_warm(router, args, lens, prefixes)
+    router.install_faults(inj)
+    t0 = time.monotonic()
+    wall, rids = drive_fleet(router, reqs, args.max_new,
+                             deadline_ms=args.deadline_ms)
+    done = router.results()
+    survivors = mismatches = lost = 0
+    shed = 0
+    for idx, rid in enumerate(rids):
+        if rid is None:
+            shed += 1
+            continue
+        req = done.get(rid)
+        if req is None or getattr(req, "state", None) != "ok":
+            lost += 1
+            continue
+        survivors += 1
+        brid = base_rids[idx] if idx < len(base_rids) else None
+        base = base_done.get(brid) if brid is not None else None
+        if base is not None and \
+                list(base.generated) != list(req.generated):
+            mismatches += 1
+    judged = [r for r in done.values()
+              if getattr(r, "slo_ok", None) is not None]
+    goodput = round(sum(1 for r in judged if r.slo_ok)
+                    / len(judged), 4) if judged else None
+    parity = 1.0 if mismatches == 0 and survivors > 0 else 0.0
+    bound_ok = True
+    if base_goodput is not None and goodput is not None:
+        bound_ok = goodput >= base_goodput - 0.3
+    failovers = int(stats.counter("fleet.failovers").value)
+    dead = sum(1 for r in router.replicas if r.dead)
+    sites = sorted({f["site"] for f in inj.fired})
+    out = {
+        "fleet_chaos_seed": seed,
+        "fleet_chaos_survivor_parity": parity,
+        "fleet_chaos_survivors": survivors,
+        "fleet_chaos_lost": lost,
+        "fleet_chaos_shed": shed,
+        "fleet_chaos_request_errors": lost,
+        "fleet_chaos_goodput": goodput,
+        "fleet_chaos_goodput_bound_ok": int(bound_ok),
+        "fleet_chaos_tokens_per_sec": round(
+            sum(len(r.generated) for r in done.values()) / wall, 1)
+        if wall > 0 else None,
+        "fleet_chaos_failovers": failovers,
+        "fleet_chaos_replicas_dead": dead,
+        "fleet_chaos_hedges": int(
+            stats.counter("fleet.hedges").value),
+        "fleet_chaos_faults_injected": len(inj.fired),
+        "fleet_chaos_sites_fired": sites,
+        "fleet_chaos_wall_s": round(time.monotonic() - t0, 3),
+    }
+    # the acceptance pins: zero admitted requests lost, survivor
+    # parity, exactly the killed replica died (a second death means
+    # the hang overshot and the run proved nothing), >=5 sites
+    ok = (parity == 1.0 and lost == 0 and bound_ok
+          and failovers >= 1 and dead == 1 and len(sites) >= 5)
+    return out, ok
+
+
 def chaos_injector(seed):
     """The seeded chaos schedule: >=5 distinct serving-hot-path sites
     (kv.grow, prefill.dispatch, decode.step, prefix.insert,
@@ -376,6 +652,21 @@ def main():
                          "every serve_* key re-emits as serve_long_* "
                          "(gated by bench_gate: TTFT UP, tokens/s "
                          "DOWN)")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="fleet mode (ISSUE 14): route the load "
+                         "through a FleetRouter over N replicas (one "
+                         "serve-loop thread each); emits fleet_* keys "
+                         "instead of serve_*; composes with --chaos "
+                         "(replica kill mid-load, zero-loss pins)")
+    ap.add_argument("--fleet-policy", default="affinity",
+                    choices=["affinity", "rr"],
+                    help="dispatch policy: blake2b prefix-affinity + "
+                         "load/SLO tie-break (default), or the "
+                         "round-robin baseline it is pinned against")
+    ap.add_argument("--system-prompts", type=int, default=4,
+                    help="distinct system prompts in the fleet's "
+                         "skewed-prefix load (Zipf-ish popularity; "
+                         "each is --system-prompt tokens long)")
     ap.add_argument("--chaos", action="store_true",
                     help="re-drive the measured workload under a "
                          "seeded >=5-site fault schedule and pin "
@@ -438,6 +729,17 @@ def main():
     preflight("serve_bench", no_lint=args.no_lint)
 
     from paddle_tpu.profiler import stats
+
+    if args.fleet and args.fleet > 1:
+        out, fleet_ok = run_fleet(args)
+        print(json.dumps(out))
+        if not fleet_ok:
+            print("serve_bench --fleet --chaos: zero-loss failover "
+                  "pins FAILED (survivor parity / lost requests / "
+                  "goodput bound / failover+death accounting / site "
+                  "coverage)", file=sys.stderr)
+            sys.exit(1)
+        return
 
     eng, lens = build_engine(args)
     rng = np.random.RandomState(args.seed)

@@ -11,6 +11,14 @@ running engine:
     python tools/serve_top.py j.jsonl --req 17          # one timeline
     python tools/serve_top.py j.jsonl --export-trace t.json --rank 0
     python tools/serve_top.py j.jsonl --watch 2         # re-render
+    python tools/serve_top.py --fleet j_r0.jsonl j_r1.jsonl  # fleet
+
+``--fleet`` (ISSUE 14) takes one journal per replica
+(``FleetRouter.export_journals``) and renders a per-replica
+health/occupancy/goodput row plus the merged request-level view —
+request ids are fleet-unique, so a failover/migration hop shows up on
+every replica lane it touched. The live in-process form is
+``serve_top.render_fleet(router)``.
 
 Offline mode is stdlib-only — ``serving/journal.py`` is loaded
 standalone, so a post-mortem over a crash dump never pays the
@@ -37,7 +45,8 @@ from typing import List, Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-__all__ = ["summarize", "render", "render_engine", "main"]
+__all__ = ["summarize", "render", "render_engine", "render_fleet",
+           "render_fleet_offline", "main"]
 
 
 def _journal_mod():
@@ -59,7 +68,8 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
     reqs: dict = {}
     counts = {"preempt": 0, "requeue": 0, "stall": 0, "error": 0,
               "deadline_exceeded": 0, "shed": 0, "retry": 0,
-              "watchdog": 0, "fault": 0}
+              "watchdog": 0, "fault": 0, "failover": 0, "migrate": 0,
+              "drain": 0}
     evicted_pages = 0
     spec_rounds = spec_drafted = spec_accepted = 0
     for e in events:
@@ -101,6 +111,12 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
             r["phase"] = "waiting"
         elif ev == "stall":
             r["stalls"] += 1
+        elif ev == "failover":
+            # re-dispatched from a dead replica — queued again here
+            r["phase"] = "waiting"
+        elif ev == "migrate":
+            # KV pages handed over mid-decode — no prefill replay
+            r["phase"] = "decode"
         elif ev == "finish":
             r["phase"] = "finished"
             r["ttft_ms"] = e.get("ttft_ms", r["ttft_ms"])
@@ -153,6 +169,9 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
         "retries": counts["retry"],
         "watchdog_trips": counts["watchdog"],
         "faults_injected": counts["fault"],
+        "failovers": counts["failover"],
+        "migrations": counts["migrate"],
+        "drains": counts["drain"],
         "evicted_pages": evicted_pages,
         "spec_rounds": spec_rounds,
         "spec_drafted": spec_drafted,
@@ -231,6 +250,12 @@ def render(summary: dict, top: int = 5,
         f"deadline_exceeded {s.get('deadline_exceeded', 0)}  "
         f"shed {s.get('shed', 0)}",
     ]
+    if s.get("failovers") or s.get("migrations") or s.get("drains"):
+        # fleet tier (ISSUE 14): requests that crossed replicas
+        lines.append(
+            f"fleet: failovers_in {s.get('failovers', 0)}  "
+            f"migrations_in {s.get('migrations', 0)}  "
+            f"drains {s.get('drains', 0)}")
     if s.get("spec_rounds"):
         # speculative decoding (ISSUE 12): the accept-rate row — the
         # one number that says whether the drafter is paying for its
@@ -284,6 +309,85 @@ def render_engine(eng, top: int = 5) -> str:
     return head + render(s, top=top)
 
 
+def _fleet_row(idx, state, queue, prefill, active, finished, errors,
+               goodput, failovers, migrations, extra="") -> str:
+    return (f"  r{idx:<3} {state:<9} queue {queue:>3}  "
+            f"prefill {prefill:>2}  decode {active:>2}  "
+            f"finished {finished:>4}  errors {errors:>3}  "
+            f"goodput {_fmt(goodput, 3):>6}  "
+            f"failovers_in {failovers:>2}  migrations_in "
+            f"{migrations:>2}{extra}")
+
+
+def render_fleet_offline(paths: List[str], jm, ttft_target=None,
+                         tpot_target=None, objective=0.99) -> str:
+    """Fleet dashboard from per-replica journal JSONLs
+    (``FleetRouter.export_journals`` / ``serve_bench --fleet
+    --journal-out``): one health/occupancy/goodput row per replica
+    (replica id = file order) + the merged request-level view —
+    request ids are fleet-unique, so one request's failover/migration
+    hops appear on every replica journal they touched."""
+    all_events: List[dict] = []
+    rows = [f"serve_top --fleet — {len(paths)} replica journals"]
+    for i, p in enumerate(paths):
+        events, _extras = jm.load_jsonl(p)
+        all_events.extend(events)
+        s = summarize(events, ttft_target=ttft_target,
+                      tpot_target=tpot_target, objective=objective)
+        rows.append(_fleet_row(
+            i, "journal", s["queue_depth"], s["prefilling"],
+            s["active"], s["finished"], s["errors"], s["goodput"],
+            s["failovers"], s["migrations"],
+            extra=f"  ({len(events)} events)"))
+    merged = summarize(all_events, ttft_target=ttft_target,
+                       tpot_target=tpot_target, objective=objective)
+    rows.append("merged fleet view:")
+    rows.append(render(merged))
+    return "\n".join(rows)
+
+
+def render_fleet(router, top: int = 5) -> str:
+    """Live dashboard over a RUNNING FleetRouter: per-replica
+    health/breaker/occupancy/goodput rows plus the fleet-tier
+    failover/migration/hedge accounting from the stats registry."""
+    from paddle_tpu.profiler import stats
+
+    lines = [f"serve_top --fleet — {len(router.replicas)} replicas "
+             f"(policy {router.policy})"]
+    for rep in router.replicas:
+        eng = rep.eng
+        mon = getattr(eng, "slo_monitor", None)
+        goodput = mon.goodput if mon is not None else None
+        extra = ""
+        if rep.breaker.state != "closed":
+            extra = f"  breaker {rep.breaker.state}"
+        jr = getattr(eng, "journal", None)
+        n_fo = n_mig = 0
+        if jr is not None:
+            for e in jr.events():
+                if e["ev"] == "failover":
+                    n_fo += 1
+                elif e["ev"] == "migrate":
+                    n_mig += 1
+        lines.append(_fleet_row(
+            rep.idx, rep.state, eng.queue_depth, eng.num_prefilling,
+            eng.num_active, len(eng.finished),
+            sum(1 for r in eng.finished
+                if getattr(r, "state", "ok") != "ok"),
+            goodput, n_fo, n_mig, extra=extra))
+    c = stats.counter
+    lines.append(
+        f"fleet: failovers {int(c('fleet.failovers').value)}  "
+        f"failover_requests "
+        f"{int(c('fleet.failover_requests').value)}  "
+        f"migrations {int(c('fleet.migrations').value)} "
+        f"({int(c('fleet.migrated_pages').value)} pages)  "
+        f"hedges {int(c('fleet.hedges').value)}  "
+        f"shed {int(c('fleet.shed').value)}  pending "
+        f"{router.pending()}")
+    return "\n".join(lines)
+
+
 def _crash_lines(extras: dict) -> List[str]:
     crash = extras.get("crash")
     if not crash:
@@ -304,7 +408,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="text dashboard over a serving journal / crash "
                     "dump (serving/journal.py JSONL)")
-    ap.add_argument("journal", help="journal or crash-dump JSONL path")
+    ap.add_argument("journal", nargs="+",
+                    help="journal or crash-dump JSONL path; with "
+                         "--fleet, one per replica (replica id = "
+                         "argument order)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet view (ISSUE 14): one health/"
+                         "occupancy/goodput row per replica journal "
+                         "+ the merged request-level dashboard "
+                         "(failover/migration hops fold by request "
+                         "id)")
     ap.add_argument("--top", type=int, default=5,
                     help="slowest-request timelines to render")
     ap.add_argument("--req", type=int, default=None,
@@ -327,8 +440,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     jm = _journal_mod()
+    if args.fleet or len(args.journal) > 1:
+        while True:
+            print(render_fleet_offline(
+                args.journal, jm, ttft_target=args.ttft_target,
+                tpot_target=args.tpot_target,
+                objective=args.objective))
+            if args.watch <= 0:
+                return 0
+            time.sleep(args.watch)
+            print("\033[2J\033[H", end="")
     while True:
-        events, extras = jm.load_jsonl(args.journal)
+        events, extras = jm.load_jsonl(args.journal[0])
         summary = summarize(events, ttft_target=args.ttft_target,
                             tpot_target=args.tpot_target,
                             objective=args.objective)
