@@ -390,6 +390,151 @@ def run_decode_spec_bench(batch=8, prompt=128, new_tokens=128,
             (accepted / drafted) if drafted else None, int(rounds))
 
 
+def build_moe_model(d_model, n_layers, n_heads, seq, num_experts,
+                    top_k=2):
+    """GPT with the dense FFN replaced by a NO-DROP MoELayer
+    (capacity_factor=None → the ragged grouped-GEMM path, ISSUE 15)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.incubate.moe import MoELayer
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ln1 = nn.LayerNorm(d_model)
+            self.qkv = nn.Linear(d_model, 3 * d_model)
+            self.proj = nn.Linear(d_model, d_model)
+            self.ln2 = nn.LayerNorm(d_model)
+            self.moe = MoELayer(d_model, num_experts=num_experts,
+                                gate="gshard", top_k=top_k,
+                                d_hidden=4 * d_model,
+                                capacity_factor=None)
+
+        def forward(self, x):
+            b, s, _ = x.shape
+            h = self.ln1(x)
+            qkv = self.qkv(h).reshape(
+                [b, s, 3, n_heads, d_model // n_heads])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            att = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            x = x + self.proj(att.reshape([b, s, d_model]))
+            return x + self.moe(self.ln2(x))
+
+    class GPTMoE(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(VOCAB, d_model)
+            self.pos = nn.Embedding(seq, d_model)
+            self.blocks = nn.LayerList([Block() for _ in range(n_layers)])
+            self.norm = nn.LayerNorm(d_model)
+            self.head = nn.Linear(d_model, VOCAB, bias_attr=False)
+
+        def forward(self, ids, pos_ids):
+            h = self.embed(ids) + self.pos(pos_ids)
+            for blk in self.blocks:
+                h = blk(h)
+            return self.head(self.norm(h))
+
+    return GPTMoE()
+
+
+def run_moe_train_bench(d_model, n_layers, n_heads, seq, batch,
+                        num_experts, top_k=2, steps=8):
+    """No-drop MoE training rung: whole-step-compiled GPT-MoE, AMP O2.
+    Returns (tokens/s, mfu, activated params, total params). MFU
+    charges the ACTIVATED FLOPs (dense params + top_k/E of the expert
+    FFN bank) — the honest MoE utilization accounting."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.profiler import stats as _stats
+
+    paddle.seed(0)
+    model = build_moe_model(d_model, n_layers, n_heads, seq,
+                            num_experts, top_k)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 weight_decay=0.01,
+                                 moment_dtype="bfloat16")
+    model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                     dtype="bfloat16")
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits.reshape([-1, VOCAB]),
+                               labels.reshape([-1]))
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, VOCAB, (batch, seq)))
+    pos = paddle.to_tensor(np.tile(np.arange(seq), (batch, 1)))
+    labels = paddle.to_tensor(rng.randint(0, VOCAB, (batch, seq)))
+
+    # one EAGER forward first: stamps the data-dependent moe.* routing
+    # telemetry (tokens_per_expert / imbalance / dropped_tokens) that
+    # the traced step cannot — then assert the no-drop pin held
+    drop0 = _stats.counter("moe.dropped_tokens").value
+    model(ids, pos)
+    if _stats.counter("moe.dropped_tokens").value != drop0:
+        raise RuntimeError("moe-train rung: no-drop mode dropped "
+                           "tokens (moe.dropped_tokens moved)")
+
+    loss = step([ids, pos], [labels])  # compile
+    _ = float(loss.numpy())
+    t0 = time.perf_counter()
+    for _i in range(steps):
+        loss = step([ids, pos], [labels])
+    final = float(loss.numpy())
+    dt = time.perf_counter() - t0
+    if not np.isfinite(final):
+        raise RuntimeError("moe-train rung: non-finite loss")
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    # expert FFN bank: E * (w1 + b1 + w2 + b2) per block; only top_k/E
+    # of it is activated per token
+    dff = 4 * d_model
+    bank = n_layers * num_experts * (2 * d_model * dff + dff + d_model)
+    n_active = n_params - bank + bank * top_k // num_experts
+    tps = steps * batch * seq / dt
+    flops_per_token = 6 * n_active + 12 * n_layers * seq * d_model
+    mfu = tps * flops_per_token / _chip_peak(jax.devices()[0])
+    return tps, round(mfu, 4), n_active, n_params
+
+
+def run_moe_decode_bench(batch=32, prompt=128, new_tokens=65,
+                         d_model=1024, n_layers=12, n_heads=16,
+                         num_experts=8, top_k=2):
+    """MoE serving decode rung: FusedCausalLM with the expert-bank FFN
+    through GenerationEngine (the no-drop ragged MoE FFN per layer).
+    Returns (tokens/s, total stack params)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import FusedCausalLM, GenerationEngine
+
+    paddle.seed(0)
+    model = FusedCausalLM(
+        vocab_size=VOCAB, embed_dim=d_model, num_heads=n_heads,
+        dim_feedforward=4 * d_model, num_layers=n_layers,
+        max_position=prompt + new_tokens + 1,
+        moe_num_experts=num_experts, moe_top_k=top_k)
+    st = model.stack
+    for n, p in st.named_parameters():
+        if "weight" in n or n.startswith(("moe_w", "gate")):
+            p._rebind(p._data.astype(jnp.bfloat16))
+    engine = GenerationEngine(model, page_size=16,
+                              max_length=prompt + new_tokens)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, VOCAB, (batch, prompt))
+    engine.generate(ids, max_new_tokens=new_tokens)   # warmup/compile
+    t0 = time.perf_counter()
+    out = engine.generate(ids, max_new_tokens=new_tokens)
+    dt = time.perf_counter() - t0
+    assert out.shape == (batch, prompt + new_tokens)
+    n_params = sum(int(np.prod(p.shape)) for _n, p in
+                   st.named_parameters())
+    return batch * new_tokens / dt, n_params
+
+
 def run_bert_bench(batch=32, seq=512, steps=8):
     """BERT-base pretraining rung (BASELINE configs[2]): MLM+NSP whole-
     step compiled, AMP O2 bf16, single chip. Returns (tokens/s, mfu).
@@ -711,6 +856,46 @@ def _run_secondary(kind):
                 f"serve_bench rc={proc.returncode}: "
                 f"{proc.stderr[-300:]}")
         print(lines[-1])
+    elif kind == "--moe-train":
+        # no-drop MoE training rung (ISSUE 15 / ROADMAP item 4): the
+        # ragged grouped-GEMM MoE FFN in a whole-compiled train step.
+        # TPU gets a ~1B-param 8-expert config; CPU a smoke geometry
+        # (correctness of the rung plumbing + the no-drop pin only).
+        # Gated by bench_gate: tokens/s and MFU regress DOWN,
+        # moe.dropped_tokens regresses UP with NO noise floor.
+        import jax
+
+        if jax.default_backend() == "tpu":
+            tps, mfu, n_active, n_params = run_moe_train_bench(
+                d_model=1024, n_layers=12, n_heads=16, seq=1024,
+                batch=4, num_experts=8)
+        else:
+            tps, mfu, n_active, n_params = run_moe_train_bench(
+                d_model=64, n_layers=2, n_heads=4, seq=64, batch=2,
+                num_experts=4, steps=2)
+        print(json.dumps(
+            {"moe_train_tokens_per_sec": round(tps, 1),
+             "moe_train_mfu": mfu,
+             "moe_train_params": n_params,
+             "moe_train_activated_params": n_active,
+             "moe_train_telemetry": _telemetry()}))
+    elif kind == "--moe-decode":
+        # MoE serving decode rung: the expert-bank FusedCausalLM
+        # through GenerationEngine (no-drop ragged MoE FFN per layer);
+        # EP-sharded decode is exercised by dryrun_multichip's MoE
+        # phase — this rung is the single-chip throughput number.
+        import jax
+
+        if jax.default_backend() == "tpu":
+            tps, n_params = run_moe_decode_bench()
+        else:
+            tps, n_params = run_moe_decode_bench(
+                batch=2, prompt=16, new_tokens=9, d_model=64,
+                n_layers=2, n_heads=4, num_experts=4)
+        print(json.dumps(
+            {"moe_decode_tokens_per_sec": round(tps, 1),
+             "moe_decode_params": n_params,
+             "moe_decode_telemetry": _telemetry()}))
     elif kind == "--bert":
         tps, mfu, roofline = run_bert_bench()
         print(json.dumps({"bert_train_tokens_per_sec": round(tps, 1),
@@ -746,7 +931,8 @@ def main():
     for kind in ("--decode", "--decode-int8", "--decode-a8w8",
                  "--decode-bf16-grouped", "--decode-tp",
                  "--decode-spec", "--decode-int8kv", "--serve",
-                 "--serve-long", "--attn-varlen", "--bert", "--s2048"):
+                 "--serve-long", "--attn-varlen", "--moe-train",
+                 "--moe-decode", "--bert", "--s2048"):
         if kind in sys.argv:
             _run_secondary(kind)
             return
@@ -791,7 +977,8 @@ def main():
                      "--decode-a8w8", "--decode-bf16-grouped",
                      "--decode-tp", "--decode-spec",
                      "--decode-int8kv", "--serve", "--serve-long",
-                     "--attn-varlen", "--bert"):
+                     "--attn-varlen", "--moe-train", "--moe-decode",
+                     "--bert"):
             # s2048's flash-attention bwd compile alone can take ~25min
             # cold (measured r5); the run itself is seconds
             extra, err = _sub([kind], 2400 if kind == "--s2048" else 1500)

@@ -328,6 +328,29 @@ def _build_varlen_packed():
                 _sds((5,), jnp.int32), _sds((5,), jnp.int32))
 
 
+def _build_moe_ffn():
+    """The no-drop MoE FFN program (ISSUE 15): fp32 router → stable
+    sort by expert → two ragged grouped GEMMs → scatter-combine, as
+    the dispatch layer compiles it off-TPU (the math-identical XLA
+    tile walk). bf16 inputs so the DTYPE pass guards the fp32-router
+    waivers; serving-ish expert-bank widths."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from ..nn.functional.grouped_gemm import moe_ffn_nodrop
+
+    T, d, dff, E = 256, 512, 1024, 8
+    fn = functools.partial(moe_ffn_nodrop, top_k=2, activation="gelu",
+                           backend="xla")
+    return fn, (_sds((T, d), jnp.bfloat16),
+                _sds((d, E), jnp.float32),
+                _sds((E, d, dff), jnp.bfloat16),
+                _sds((E, dff), jnp.float32),
+                _sds((E, dff, d), jnp.bfloat16),
+                _sds((E, d), jnp.float32))
+
+
 PROGRAM_SITES: List[ProgramSite] = [
     ProgramSite("dispatch.gelu", _build_gelu,
                 compute_dtype="bfloat16",
@@ -352,4 +375,5 @@ PROGRAM_SITES: List[ProgramSite] = [
                 compute_dtype="bfloat16", donate_argnums=(9, 10)),
     ProgramSite("attn.varlen_packed", _build_varlen_packed,
                 compute_dtype="bfloat16"),
+    ProgramSite("moe.ffn", _build_moe_ffn, compute_dtype="bfloat16"),
 ]

@@ -50,10 +50,11 @@ def _force_tpu_routing():
 
     import paddle_tpu.nn.functional.attention as att
     import paddle_tpu.nn.functional.flash_varlen as fv
+    import paddle_tpu.nn.functional.grouped_gemm as gg
     import paddle_tpu.nn.functional.stream_linear as sl
 
     saved = [(sl, "_on_tpu", sl._on_tpu), (att, "_on_tpu", att._on_tpu),
-             (fv, "_on_tpu", fv._on_tpu)]
+             (fv, "_on_tpu", fv._on_tpu), (gg, "_on_tpu", gg._on_tpu)]
     x64 = bool(jax.config.jax_enable_x64)
     try:
         for mod, name, _ in saved:
@@ -414,6 +415,75 @@ def _expected_flash_varlen_paged():
             + _B((2, npp, n_kv, ps, d), "bfloat16") * 2)  # k+v page DMA
 
 
+# ragged grouped-GEMM MoE kernel (ISSUE 15): a serving-shaped FFN1
+# bank — 8 experts, d=2048 -> dff=8192, 1024 expert-sorted rows, bf16
+# weights. bn = 2048 (8 MiB bf16 stream target / K=2048), bm = 128;
+# nwu = 1024/128 + 2*8 + 1 = 25 work units.
+_GROUPED = dict(T=1024, K=2048, N=8192, E=8, bm=128, bn=2048)
+
+
+def _grouped_args():
+    import jax.numpy as jnp
+
+    T, K, N, E = (_GROUPED[k] for k in ("T", "K", "N", "E"))
+    return (_sds((T, K), jnp.bfloat16),
+            _sds((E, K, N), jnp.bfloat16),
+            _sds((E, N), jnp.float32),
+            _sds((E + 1,), jnp.int32))
+
+
+def _build_grouped_gemm_fwd():
+    from paddle_tpu.nn.functional.grouped_gemm import grouped_gemm
+
+    def fn(x, w, b, offsets):
+        return grouped_gemm(x, w, offsets, bias=b, activation="gelu",
+                            backend="pallas")
+
+    return fn, _grouped_args()
+
+
+def _expected_grouped_gemm_fwd():
+    K, N, bm, bn = (_GROUPED[k] for k in ("K", "N", "bm", "bn"))
+    return (_B((bm, K), "bfloat16")            # x row tile (dynamic map)
+            + 2 * _B((1, K, bn), "bfloat16")   # expert weight stream
+            + 2 * _B((1, 1, bn), "float32")    # bias blocks
+            + 2 * _B((bm, bn), "float32"))     # out tile stream
+
+
+def _build_grouped_gemm_bwd():
+    import jax
+
+    from paddle_tpu.nn.functional.grouped_gemm import grouped_gemm
+
+    def fn(x, w, b, offsets):
+        def loss(x, w, b):
+            y = grouped_gemm(x, w, offsets, bias=b, activation="gelu",
+                             backend="pallas")
+            return jax.numpy.sum(y.astype(jax.numpy.float32))
+
+        return jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
+
+    return fn, _grouped_args()
+
+
+def _expected_grouped_gemm_bwd():
+    # grad trace records fwd + pre-activation recompute (same geometry
+    # as fwd), the dx walk against the transposed bank (bn = 512: the
+    # 8 MiB bf16 target over K = dff = 8192), and the dw segment
+    # accumulation
+    K, N, bm, bn = (_GROUPED[k] for k in ("K", "N", "bm", "bn"))
+    bn_dx = 512
+    fwd = _expected_grouped_gemm_fwd()
+    dx = (_B((bm, N), "float32")               # dz row tile (dynamic)
+          + 2 * _B((1, N, bn_dx), "bfloat16")  # transposed weight stream
+          + 2 * _B((1, 1, bn_dx), "float32")   # zero-bias blocks
+          + 2 * _B((bm, bn_dx), "float32"))    # dx tile stream
+    dw = (_B((bm, K), "bfloat16")              # x row tile (dynamic)
+          + 2 * _B((bm, bn), "float32")        # dz tile stream
+          + 2 * _B((1, K, bn), "float32"))     # dw expert-block stream
+    return 2 * fwd + dx + dw
+
+
 KERNEL_SITES: List[KernelSite] = [
     KernelSite("stream_linear.bf16", "nn/functional/stream_linear.py",
                _build_stream_linear, _expected_stream_linear),
@@ -447,6 +517,13 @@ KERNEL_SITES: List[KernelSite] = [
                n_calls=3),
     KernelSite("flash_varlen.paged", "nn/functional/flash_varlen.py",
                _build_flash_varlen_paged, _expected_flash_varlen_paged),
+    # ragged grouped-GEMM MoE (ISSUE 15): fwd, and the grad trace's
+    # fwd + pre-activation recompute + dx walk + dw segment kernel
+    KernelSite("grouped_gemm.fwd", "nn/functional/grouped_gemm.py",
+               _build_grouped_gemm_fwd, _expected_grouped_gemm_fwd),
+    KernelSite("grouped_gemm.bwd", "nn/functional/grouped_gemm.py",
+               _build_grouped_gemm_bwd, _expected_grouped_gemm_bwd,
+               n_calls=4),
 ]
 
 

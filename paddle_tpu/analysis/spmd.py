@@ -292,6 +292,50 @@ def _build_moe_ep():
     return fn, (x,)
 
 
+def _build_moe_ep_decode():
+    """The ep2 expert-parallel MoE decode step (ISSUE 15): the only
+    collectives its partitioned HLO may carry are the per-MoE-layer
+    all-to-all dispatch/combine PAIR plus the replicated-hidden
+    all-gather — a reduce-formulated exchange or an extra gather means
+    the expert-bank sharding broke."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..distributed.tp import TPContext, serving_mesh
+    from ..incubate.nn.fused_transformer import (FusedMultiTransformer,
+                                                 PagedKV, rope_table)
+    from ..inference.kv_cache import BlockKVCacheManager
+
+    paddle.seed(0)
+    st = FusedMultiTransformer(32, 4, 64, 2, num_kv_heads=2,
+                               max_position=64, moe_num_experts=4,
+                               moe_top_k=2)
+    tp = TPContext.create(
+        st.num_heads, st.num_kv_heads, st.head_dim,
+        mesh=serving_mesh(2, devices=jax.devices("cpu")[:2],
+                          axis="ep"))
+    w_tp = tp.shard_stack(st._stack())
+    mgr = BlockKVCacheManager(st.num_layers, st.num_kv_heads,
+                              st.head_dim, page_size=4, num_pages=16,
+                              reserve_scratch=True, mp_degree=tp.mp,
+                              mesh=tp.mesh)
+    for i in range(2):
+        mgr.allocate(i, 8)
+    tables = mgr.block_tables(range(2), 4)
+    cache = mgr.fresh_cache()
+    cos, sin = rope_table(64, st.head_dim)
+    lens = jnp.array([6, 6], jnp.int32)
+    x = jnp.ones((2, st.embed_dim), jnp.float32)
+
+    def fn(w, xb, ck, cv):
+        h, cache2 = st.decode_raw(w, xb, PagedKV(ck, cv), tables,
+                                  lens, cos, sin, tp=tp)
+        return h, cache2.k, cache2.v
+
+    return fn, (w_tp, x, cache.k, cache.v)
+
+
 def _tp_serving_setup():
     """Shared builder state for the TP serving sites: a tiny
     FusedMultiTransformer, its shard-at-load mp2 stacks, and a
@@ -384,6 +428,11 @@ SPMD_SITES: List[SpmdSite] = [
              expects_constraint=True),
     SpmdSite("tp.prefill_chunk", _build_tp_prefill_chunk,
              allowed=frozenset({"all-reduce"}),
+             expects_constraint=True),
+    # expert-parallel MoE decode (ISSUE 15): the per-layer all-to-all
+    # dispatch/combine pair + the replicated-hidden all-gather
+    SpmdSite("moe.ep_decode", _build_moe_ep_decode,
+             allowed=frozenset({"all-to-all", "all-gather"}),
              expects_constraint=True),
 ]
 

@@ -119,6 +119,12 @@ define_flag("decode_grouped", "auto",
             "(grouped for bf16/f32/weight-only-int8 stacks; A8W8 "
             "keeps the ungrouped int8 x int8 act-quant kernel) | on | "
             "off")
+define_flag("moe_grouped_backend", "auto",
+            "no-drop MoE ragged grouped-GEMM backend "
+            "(nn/functional/grouped_gemm.py): auto (Pallas kernel on "
+            "TPU, the math-identical tiled XLA walk elsewhere) | "
+            "pallas | interpret (the kernel through the Pallas "
+            "interpreter — debug/parity) | xla")
 define_flag("decode_prefetch", True,
             "cross-layer prefetch inside the grouped decode tail: "
             "layer l+1's LN1+QKV projection runs as the tail kernel's "

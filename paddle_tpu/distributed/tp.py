@@ -101,25 +101,35 @@ def serving_mesh(mp_degree: int, devices=None, axis: str = "mp"):
 #: output (last) axis of [L, K, N]; ``row3`` shards the contraction
 #: axis; ``col2`` shards per-output vectors [L, N]; ``rep`` replicates
 #: (LN params and the row-parallel biases/scales, which apply to the
-#: FULL output and are added once, after the psum).
+#: FULL output and are added once, after the psum). ``ep4``/``ep3``
+#: shard the EXPERT axis (dim 1) of the MoE bank over the ``ep`` mesh
+#: axis — each chip streams only its 1/ep expert slice; the gate stays
+#: replicated (every shard routes its own token block).
 _STACK_LAYOUT = {
     "qkv_weight": "col3", "qkv_bias": "col2", "qkv_scale": "col2",
     "ffn1_weight": "col3", "ffn1_bias": "col2", "ffn1_scale": "col2",
     "out_weight": "row3", "ffn2_weight": "row3",
+    "gate_weight": "rep",
+    "moe_w1": "ep4", "moe_b1": "ep3",
+    "moe_w2": "ep4", "moe_b2": "ep3",
 }
 
 
 @dataclasses.dataclass(frozen=True)
 class TPContext:
-    """Resolved tensor-parallel geometry for one serving engine.
+    """Resolved tensor/expert-parallel geometry for one serving engine.
 
     ``heads_per_shard`` / ``kv_heads_per_shard`` are what the per-shard
     transformer view computes with; ``kv_replication`` > 1 marks the
     GQA fallback (shard ``s`` holds kv head ``s // kv_replication``).
+    ``ep`` > 1 marks expert parallelism (ISSUE 15): the MoE expert
+    bank shards 1/ep per chip over the ``ep_axis`` mesh axis and the
+    MoE FFN's dispatch/combine run as the two ``lax.all_to_all`` of
+    the EP exchange inside the same shard_map the ``mp`` path uses.
     """
 
-    mesh: Any               # jax.sharding.Mesh with the mp axis
-    axis: str               # mesh axis name ("mp")
+    mesh: Any               # jax.sharding.Mesh with the mp and/or ep axis
+    axis: str               # tensor-parallel mesh axis name ("mp")
     mp: int
     num_heads: int          # global query heads
     num_kv_heads: int       # global kv heads
@@ -127,36 +137,65 @@ class TPContext:
     heads_per_shard: int
     kv_heads_per_shard: int
     kv_replication: int
+    ep: int = 1             # expert-parallel degree
+    ep_axis: str = "ep"     # expert-parallel mesh axis name
 
     @classmethod
     def create(cls, num_heads: int, num_kv_heads: int, head_dim: int,
                mp_degree: Optional[int] = None, mesh=None,
-               axis: str = "mp") -> Optional["TPContext"]:
+               axis: str = "mp", ep_degree: Optional[int] = None,
+               ep_axis: str = "ep") -> Optional["TPContext"]:
         """Resolve engine kwargs into a context (None = single-chip).
 
         ``mesh`` may be a jax Mesh or anything with ``.jax_mesh()``
-        (e.g. a ProcessMesh); it must carry an ``mp``-named axis. With
-        only ``mp_degree`` given, a 1-D mesh over the first N devices
-        is built.
+        (e.g. a ProcessMesh); it must carry an ``mp``- and/or
+        ``ep``-named axis. With only degrees given, a mesh over the
+        first ``ep*mp`` devices is built (``(ep, mp)`` axes when both
+        exceed 1).
         """
-        if mesh is None and (mp_degree is None or int(mp_degree) <= 1):
+        mp_req = None if mp_degree is None else int(mp_degree)
+        ep_req = None if ep_degree is None else int(ep_degree)
+        if mesh is None and (mp_req or 1) <= 1 and (ep_req or 1) <= 1:
             return None
         if mesh is not None and hasattr(mesh, "jax_mesh"):
             mesh = mesh.jax_mesh()
         if mesh is None:
-            mesh = serving_mesh(int(mp_degree), axis=axis)
-        if axis not in mesh.axis_names:
+            import numpy as np
+
+            import jax
+            from jax.sharding import Mesh
+
+            mp_n, ep_n = mp_req or 1, ep_req or 1
+            if ep_n > 1 and mp_n > 1:
+                devices = jax.devices()
+                if len(devices) < ep_n * mp_n:
+                    raise ValueError(
+                        f"ep{ep_n} x mp{mp_n} needs {ep_n * mp_n} "
+                        f"devices, have {len(devices)}")
+                mesh = Mesh(np.array(devices[:ep_n * mp_n])
+                            .reshape(ep_n, mp_n), (ep_axis, axis))
+            elif ep_n > 1:
+                mesh = serving_mesh(ep_n, axis=ep_axis)
+            else:
+                mesh = serving_mesh(mp_n, axis=axis)
+        names = tuple(mesh.axis_names)
+        if axis not in names and ep_axis not in names:
             raise ValueError(
-                f"tensor-parallel mesh must carry an {axis!r} axis, "
-                f"got axes {tuple(mesh.axis_names)}")
-        mp = int(mesh.shape[axis])
-        if mp_degree is not None and int(mp_degree) != mp:
+                f"tensor/expert-parallel mesh must carry an {axis!r} "
+                f"and/or {ep_axis!r} axis, got axes {names}")
+        mp = int(mesh.shape[axis]) if axis in names else 1
+        ep = int(mesh.shape[ep_axis]) if ep_axis in names else 1
+        if mp_req is not None and mp_req != mp:
             raise ValueError(
-                f"mp_degree={mp_degree} disagrees with the mesh's "
+                f"mp_degree={mp_req} disagrees with the mesh's "
                 f"{axis!r} extent {mp}")
-        if mp <= 1:
+        if ep_req is not None and ep_req != ep:
+            raise ValueError(
+                f"ep_degree={ep_req} disagrees with the mesh's "
+                f"{ep_axis!r} extent {ep}")
+        if mp <= 1 and ep <= 1:
             return None
-        if num_heads % mp != 0:
+        if mp > 1 and num_heads % mp != 0:
             raise ValueError(
                 f"num_heads={num_heads} must divide evenly over "
                 f"mp_degree={mp} (query heads partition with the QKV "
@@ -165,7 +204,8 @@ class TPContext:
         return cls(mesh=mesh, axis=axis, mp=mp, num_heads=num_heads,
                    num_kv_heads=num_kv_heads, head_dim=head_dim,
                    heads_per_shard=num_heads // mp,
-                   kv_heads_per_shard=kvs, kv_replication=repl)
+                   kv_heads_per_shard=kvs, kv_replication=repl,
+                   ep=ep, ep_axis=ep_axis)
 
     # ---------------- specs ----------------
 
@@ -187,19 +227,28 @@ class TPContext:
         return NamedSharding(self.mesh, self.pspec(*parts))
 
     def kv_spec(self):
-        """PartitionSpec of a pool side [L*P, kv_heads, page, hd]."""
+        """PartitionSpec of a pool side [L*P, kv_heads, page, hd]:
+        kv-head-sharded over ``mp``; replicated on an ep-only mesh
+        (EP shards the EXPERT bank — every shard attends its own token
+        block against the same replicated pool)."""
+        if self.mp <= 1:
+            return self.pspec()
         return self.pspec(None, self.axis, None, None)
 
     def stack_spec(self, name: str):
         """PartitionSpec for one stacked-weight entry (shard_map
         in_spec / device placement)."""
         kind = _STACK_LAYOUT.get(name, "rep")
-        if kind == "col3":
+        if kind == "col3" and self.mp > 1:
             return self.pspec(None, None, self.axis)
-        if kind == "row3":
+        if kind == "row3" and self.mp > 1:
             return self.pspec(None, self.axis, None)
-        if kind == "col2":
+        if kind == "col2" and self.mp > 1:
             return self.pspec(None, self.axis)
+        if kind == "ep4" and self.ep > 1:
+            return self.pspec(None, self.ep_axis, None, None)
+        if kind == "ep3" and self.ep > 1:
+            return self.pspec(None, self.ep_axis, None)
         return self.pspec()
 
     def replicate(self, arr):
@@ -257,7 +306,7 @@ class TPContext:
         out = {}
         for name, arr in weights.items():
             a = np.asarray(arr)
-            if name.startswith("qkv_"):
+            if name.startswith("qkv_") and self.mp > 1:
                 if qkv_idx is None:
                     qkv_idx = self.qkv_col_index()
                 a = np.take(a, qkv_idx, axis=-1)

@@ -5,12 +5,24 @@ python/paddle/incubate/distributed/models/moe/moe_layer.py:263 MoELayer,
 gates gshard_gate.py/switch_gate.py/naive_gate.py; expert-parallel
 dispatch via global_scatter/global_gather all-to-all
 fluid/operators/collective/global_scatter_op.cu; cutlass grouped-GEMM
-moe_kernel.cu). The TPU formulation is the GShard einsum algebra:
-top-k gate → capacity-bounded one-hot dispatch/combine tensors → einsum
-dispatch → per-expert FFN (stacked weights; one batched matmul on the
-MXU = the grouped GEMM) → einsum combine. Expert parallelism = shard the
-expert dim of the stacked weights over the mesh's ep/mp axis; GSPMD emits
-the all-to-all the reference launches by hand.
+moe_kernel.cu). Two formulations live here:
+
+- **capacity-factor (GShard einsum)**: top-k gate → capacity-bounded
+  one-hot dispatch/combine tensors → einsum dispatch → per-expert FFN
+  (stacked weights; one batched matmul on the MXU) → einsum combine.
+  Over-capacity assignments DROP (counted in ``moe.dropped_tokens``).
+- **no-drop ragged (``capacity_factor=None``, ISSUE 15)**: the stacked
+  path routes through ``nn.functional.grouped_gemm.moe_ffn_nodrop`` —
+  fp32 router → tokens stable-sorted by expert → two ragged grouped
+  GEMMs → scatter-combine. ZERO capacity padding, ZERO dropped tokens,
+  and no ``[T, E, capacity]`` intermediate anywhere in the trace.
+
+Gate routing (softmax, top-k, top-k renormalization) runs in fp32 on
+EVERY path regardless of AMP dtype: bf16 router probs make top-k ties
+and the combine normalization unstable (pinned by the bf16-vs-fp32
+routing-parity test). Expert parallelism = shard the expert dim of the
+stacked weights over the mesh's ep/mp axis; GSPMD emits the all-to-all
+the reference launches by hand.
 """
 from __future__ import annotations
 
@@ -47,6 +59,30 @@ def _count_dropped(drop):
     if isinstance(arr, jax.core.Tracer):
         return  # under trace (TrainStep/jit): no per-execution count
     _stats.inc("moe.dropped_tokens", int(float(np.asarray(arr))))
+
+
+def _stamp_moe_stats(counts):
+    """Per-forward routing telemetry on the EAGER path: observe each
+    expert's assignment count into the ``moe.tokens_per_expert``
+    histogram and stamp the ``moe.imbalance`` gauge (max/mean expert
+    load; 1.0 = perfectly balanced). Like ``_count_dropped``, this is
+    data-dependent and therefore eager/profiling-only — inside a
+    jit-compiled step the traced body runs once per compile."""
+    from ...profiler import stats as _stats
+
+    if not _stats.is_enabled():
+        return
+    arr = counts._data if isinstance(counts, Tensor) else counts
+    if isinstance(arr, jax.core.Tracer):
+        return
+    c = np.asarray(arr, np.float64).reshape(-1)
+    if not c.size:
+        return
+    for v in c:
+        _stats.observe("moe.tokens_per_expert", float(v))
+    mean = float(c.mean())
+    _stats.set_gauge("moe.imbalance",
+                     float(c.max()) / mean if mean > 0 else 0.0)
 
 
 class BaseGate(Layer):
@@ -187,9 +223,14 @@ class MoELayer(Layer):
         tokens = int(np.prod(orig_shape[:-1]))
         # capacity is per (expert, shard): receive buffers CONCAT across
         # shards (no cross-shard sum), which is what makes the exchange
-        # an all-to-all instead of a reduce
-        capacity = max(int(math.ceil((tokens // ep) * K *
-                                     self.capacity_factor / E)), 1)
+        # an all-to-all instead of a reduce. No-drop mode
+        # (capacity_factor=None) sizes the buffers for the worst case
+        # (every local assignment to one expert) so nothing can drop.
+        if self.capacity_factor is None:
+            capacity = max((tokens // ep) * K, 1)
+        else:
+            capacity = max(int(math.ceil((tokens // ep) * K *
+                                         self.capacity_factor / E)), 1)
         st = self.stacked
         act = jax.nn.gelu if st.activation == "gelu" else jax.nn.relu
         aux_w = getattr(self.gate, "aux_loss_weight", 0.0)
@@ -200,9 +241,14 @@ class MoELayer(Layer):
         def raw(xa, wg, w1, b1, w2, b2):
             def body(x_loc, wg_, w1_loc, b1_loc, w2_loc, b2_loc):
                 xt = x_loc.reshape(-1, d)
-                probs = jax.nn.softmax(xt @ wg_, -1)
-                combine, dispatch, aux, drop = _gshard_dispatch(
+                # tpu-lint: ok(X-PROMOTE) -- fp32 gate routing by design
+                probs = jax.nn.softmax(
+                    xt.astype(jnp.float32) @ wg_.astype(jnp.float32),
+                    -1)
+                combine, dispatch, aux, drop, cnt = _gshard_dispatch(
                     probs, E, K, capacity)
+                combine = combine.astype(xt.dtype)
+                dispatch = dispatch.astype(xt.dtype)
                 exp_in = jnp.einsum("tec,td->ecd", dispatch, xt)
                 # [E, c, d] -> [E/ep, ep*c, d]: rows for MY experts from
                 # every shard land here, capacities concatenated
@@ -216,12 +262,14 @@ class MoELayer(Layer):
                 y = jnp.einsum("tec,ecd->td", combine, back)
                 return (y.reshape(x_loc.shape),
                         jax.lax.pmean(aux, axis),
-                        jax.lax.psum(drop, axis))
+                        jax.lax.psum(drop, axis),
+                        jax.lax.psum(cnt, axis))
 
-            y, aux, drop = shard_map(
+            y, aux, drop, cnt = shard_map(
                 body, mesh=jmesh,
                 in_specs=(x_spec, P(), w_spec, w_spec, w_spec, w_spec),
-                out_specs=(x_spec, P(), P()))(xa, wg, w1, b1, w2, b2)
+                out_specs=(x_spec, P(), P(), P()))(xa, wg, w1, b1, w2,
+                                                   b2)
             # zero-weight edge tying aux into the differentiated
             # output: when a whole-step AD (TrainStep) never consumes
             # aux, shard_map's transpose would otherwise receive a
@@ -229,14 +277,56 @@ class MoELayer(Layer):
             # that (drop is int32 — non-differentiable by dtype — so
             # it needs no edge); XLA folds the multiply away
             y = y + (jnp.zeros((), y.dtype) * aux.astype(y.dtype))
-            return y, aux, drop
+            return y, aux, drop, cnt
 
         tensors = as_tensor_args(x, self.gate.weight, st.w1, st.b1,
                                  st.w2, st.b2)
-        out, aux, drop = eager_apply("moe_layer_ep", raw, tensors,
-                                     n_outputs=3)
+        out, aux, drop, cnt = eager_apply("moe_layer_ep", raw, tensors,
+                                          n_outputs=4)
         self.aux_loss = aux * aux_w if aux_w else aux
         _count_dropped(drop)
+        _stamp_moe_stats(cnt)
+        return out
+
+    def _nodrop_forward(self, x):
+        """No-drop stacked path (``capacity_factor=None``): fp32 router
+        → stable sort by expert → ragged grouped-GEMM FFN →
+        scatter-combine. Zero capacity padding, zero drops, no
+        ``[T, E, C]`` intermediate in the traced program."""
+        from ...core.flags import flag
+        from ...nn.functional.grouped_gemm import moe_ffn_nodrop
+
+        orig_shape = x.shape
+        d = self.d_model
+        tokens = int(np.prod(orig_shape[:-1]))
+        E, K = self.num_experts, self.top_k
+        aux_w = getattr(self.gate, "aux_loss_weight", 0.0)
+        st = self.stacked
+        act = st.activation
+        backend = flag("moe_grouped_backend")
+        tensors = as_tensor_args(x, self.gate.weight, st.w1, st.b1,
+                                 st.w2, st.b2)
+
+        def raw(xa, wg, w1, b1, w2, b2):
+            xt = xa.reshape(tokens, d)
+            y, probs, topk_idx, counts = moe_ffn_nodrop(
+                xt, wg, w1, b1.reshape(E, -1), w2, b2.reshape(E, -1),
+                top_k=K, activation=act, backend=backend)
+            # load-balance aux loss: the same GShard formula as the
+            # capacity path (fp32 probs)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(jax.nn.one_hot(topk_idx[:, 0], E,
+                                         dtype=probs.dtype), axis=0)
+            aux = jnp.sum(me * ce) * E
+            return y.reshape(xa.shape), aux, counts
+
+        out, aux, cnt = eager_apply("moe_layer_nodrop", raw, tensors,
+                                    n_outputs=3)
+        self.aux_loss = aux * aux_w if aux_w else aux
+        # no-drop by construction — the counter moves by exactly 0, so
+        # drop-rate dashboards see the mode switch, not a gap
+        _count_dropped(jnp.zeros((), jnp.int32))
+        _stamp_moe_stats(cnt)
         return out
 
     def forward(self, x):
@@ -244,8 +334,16 @@ class MoELayer(Layer):
         d = self.d_model
         tokens = int(np.prod(orig_shape[:-1]))
         E, K = self.num_experts, self.top_k
-        capacity = max(int(math.ceil(tokens * K * self.capacity_factor / E)),
-                       1)
+        if self.capacity_factor is None and self._ep_mesh is None:
+            if self.stacked is None:
+                raise ValueError(
+                    "no-drop MoE (capacity_factor=None) needs the "
+                    "stacked ExpertFFN form — heterogeneous per-expert "
+                    "Layers still route through the capacity-bounded "
+                    "dispatch")
+            return self._nodrop_forward(x)
+        capacity = None if self.capacity_factor is None else max(
+            int(math.ceil(tokens * K * self.capacity_factor / E)), 1)
         aux_w = getattr(self.gate, "aux_loss_weight", 0.0)
 
         if self._ep_mesh is not None and self.stacked is not None:
@@ -259,22 +357,27 @@ class MoELayer(Layer):
 
             def raw(xa, wg, w1, b1, w2, b2):
                 xt = xa.reshape(tokens, d)
-                logits = xt @ wg                               # [T, E]
+                # tpu-lint: ok(X-PROMOTE) -- fp32 gate routing by design
+                logits = xt.astype(jnp.float32) \
+                    @ wg.astype(jnp.float32)                   # [T, E]
                 probs = jax.nn.softmax(logits, -1)
-                combine, dispatch, aux, drop = _gshard_dispatch(
+                combine, dispatch, aux, drop, cnt = _gshard_dispatch(
                     probs, E, K, capacity)
+                combine = combine.astype(xt.dtype)
+                dispatch = dispatch.astype(xt.dtype)
                 # dispatch: [T, E, C] → expert inputs [E, C, d]
                 exp_in = jnp.einsum("tec,td->ecd", dispatch, xt)
                 h = exp_in @ w1 + b1                           # [E, C, ff]
                 h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
                 exp_out = h @ w2 + b2                          # [E, C, d]
                 out = jnp.einsum("tec,ecd->td", combine, exp_out)
-                return out.reshape(xa.shape), aux, drop
+                return out.reshape(xa.shape), aux, drop, cnt
 
-            out, aux, drop = eager_apply("moe_layer", raw, tensors,
-                                         n_outputs=3)
+            out, aux, drop, cnt = eager_apply("moe_layer", raw, tensors,
+                                              n_outputs=4)
             self.aux_loss = aux * aux_w if aux_w else aux
             _count_dropped(drop)
+            _stamp_moe_stats(cnt)
             return out
 
         # generic per-expert path (heterogeneous experts); gate grads flow
@@ -282,17 +385,21 @@ class MoELayer(Layer):
         xt = x.reshape([tokens, d])
 
         def raw_dispatch(xa, wg):
-            logits = xa @ wg
+            # tpu-lint: ok(X-PROMOTE) -- fp32 gate routing by design
+            logits = xa.astype(jnp.float32) @ wg.astype(jnp.float32)
             probs = jax.nn.softmax(logits, -1)
-            combine, dispatch, aux, drop = _gshard_dispatch(
+            combine, dispatch, aux, drop, cnt = _gshard_dispatch(
                 probs, E, K, capacity)
+            combine = combine.astype(xa.dtype)
+            dispatch = dispatch.astype(xa.dtype)
             exp_in = jnp.einsum("tec,td->ecd", dispatch, xa)
-            return exp_in, combine, aux, drop
+            return exp_in, combine, aux, drop, cnt
 
-        exp_in_all, combine_t, aux, drop = eager_apply(
+        exp_in_all, combine_t, aux, drop, cnt = eager_apply(
             "moe_dispatch", raw_dispatch,
-            as_tensor_args(xt, self.gate.weight), n_outputs=4)
+            as_tensor_args(xt, self.gate.weight), n_outputs=5)
         _count_dropped(drop)
+        _stamp_moe_stats(cnt)
         outs = []
         for e, expert in enumerate(self.experts):
             outs.append(expert(exp_in_all[e]))
@@ -311,12 +418,14 @@ def _gshard_dispatch(probs, E, K, capacity):
     """GShard top-K dispatch with capacity (pure jnp; differentiable
     through the combine weights).
 
-    Returns (combine, dispatch, aux, dropped): ``dropped`` (int32
-    scalar) is the number of token->expert assignments discarded by
-    the capacity bound this batch, counted exactly per top-k pass —
+    Returns (combine, dispatch, aux, dropped, counts): ``dropped``
+    (int32 scalar) is the number of token->expert assignments discarded
+    by the capacity bound this batch, counted exactly per top-k pass —
     the eager MoELayer forward surfaces it as the
     ``moe.dropped_tokens`` stats counter so capacity-overflow drops
-    are observable instead of silent."""
+    are observable instead of silent. ``counts`` (int32 [E]) is the
+    per-expert ROUTED assignment count (before the capacity bound) —
+    the ``moe.tokens_per_expert`` / ``moe.imbalance`` telemetry."""
     T = probs.shape[0]
     topk_val, topk_idx = jax.lax.top_k(probs, K)              # [T, K]
     # normalize selected probabilities
@@ -363,4 +472,4 @@ def _gshard_dispatch(probs, E, K, capacity):
     # int32 on purpose: exact under AMP (a bf16 dispatch.sum() rounds
     # past 256), and non-differentiable by dtype so the ep path's
     # shard_map psum never sees a symbolic-zero cotangent for it
-    return combine, dispatch, aux, dropped
+    return combine, dispatch, aux, dropped, base.astype(jnp.int32)

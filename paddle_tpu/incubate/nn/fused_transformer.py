@@ -109,7 +109,8 @@ class FusedMultiTransformer(Layer):
 
     def __init__(self, embed_dim, num_heads, dim_feedforward, num_layers,
                  num_kv_heads=None, activation="gelu", epsilon=1e-5,
-                 rope_theta=10000.0, max_position=32768, dtype=None):
+                 rope_theta=10000.0, max_position=32768, dtype=None,
+                 moe_num_experts=None, moe_top_k=2):
         super().__init__()
         self.embed_dim = embed_dim
         self.num_heads = num_heads
@@ -121,6 +122,13 @@ class FusedMultiTransformer(Layer):
         self.epsilon = epsilon
         self.rope_theta = rope_theta
         self.max_position = max_position
+        # MoE serving stack (ISSUE 15): moe_num_experts replaces the
+        # dense FFN with a per-layer expert bank routed through the
+        # no-drop ragged grouped-GEMM FFN (nn/functional/grouped_gemm)
+        # — and, under an ep-axis TPContext, the expert-parallel
+        # all-to-all exchange with the bank sharded 1/ep per chip.
+        self.moe_num_experts = moe_num_experts
+        self.moe_top_k = moe_top_k
 
         L, d, dff = num_layers, embed_dim, dim_feedforward
         qkv_out = (self.num_heads + 2 * self.num_kv_heads) * self.head_dim
@@ -142,10 +150,18 @@ class FusedMultiTransformer(Layer):
         self.out_bias = self._mk(zeros(L, d))
         self.ln2_scale = self._mk(ones(L, d))
         self.ln2_bias = self._mk(zeros(L, d))
-        self.ffn1_weight = self._mk(normal(L, d, dff))
-        self.ffn1_bias = self._mk(zeros(L, dff))
-        self.ffn2_weight = self._mk(normal(L, dff, d))
-        self.ffn2_bias = self._mk(zeros(L, d))
+        if moe_num_experts:
+            E = int(moe_num_experts)
+            self.gate_weight = self._mk(normal(L, d, E))
+            self.moe_w1 = self._mk(normal(L, E, d, dff))
+            self.moe_b1 = self._mk(zeros(L, E, dff))
+            self.moe_w2 = self._mk(normal(L, E, dff, d))
+            self.moe_b2 = self._mk(zeros(L, E, d))
+        else:
+            self.ffn1_weight = self._mk(normal(L, d, dff))
+            self.ffn1_bias = self._mk(zeros(L, dff))
+            self.ffn2_weight = self._mk(normal(L, dff, d))
+            self.ffn2_bias = self._mk(zeros(L, d))
 
     def _mk(self, arr):
         from ...core.tensor import Parameter
@@ -156,8 +172,13 @@ class FusedMultiTransformer(Layer):
 
     def _stack(self):
         names = ["ln1_scale", "ln1_bias", "qkv_weight", "qkv_bias",
-                 "out_weight", "out_bias", "ln2_scale", "ln2_bias",
-                 "ffn1_weight", "ffn1_bias", "ffn2_weight", "ffn2_bias"]
+                 "out_weight", "out_bias", "ln2_scale", "ln2_bias"]
+        if self.moe_num_experts:
+            names += ["gate_weight", "moe_w1", "moe_b1", "moe_w2",
+                      "moe_b2"]
+        else:
+            names += ["ffn1_weight", "ffn1_bias", "ffn2_weight",
+                      "ffn2_bias"]
         out = {n: getattr(self, n)._data for n in names}
         for n in ("qkv", "out", "ffn1", "ffn2"):
             s = getattr(self, f"{n}_scale_woq", None)
@@ -172,6 +193,10 @@ class FusedMultiTransformer(Layer):
         per-output-channel scales; biases/LN stay full precision. The
         decode program applies scales on matmul OUTPUTS so weight HBM
         reads halve (see ``_mm``)."""
+        if self.moe_num_experts:
+            raise NotImplementedError(
+                "int8 weight-only quantization of the MoE expert bank "
+                "is not supported yet — serve MoE stacks in bf16/f32")
         from ...core.tensor import Parameter
 
         for n in ("qkv", "out", "ffn1", "ffn2"):
@@ -221,8 +246,34 @@ class FusedMultiTransformer(Layer):
         xq, xs = dynamic_act_quant(x)
         return int8_dot_dequant(xq, xs, w_q, scale)
 
+    def _moe_ffn(self, w, hn, ep_axis=None, ep_size=1):
+        """The MoE FFN of one layer over normalized hidden ``hn`` (any
+        leading dims): flatten to tokens, route through the no-drop
+        ragged grouped-GEMM FFN — or, inside an ep shard_map body, the
+        expert-parallel all-to-all exchange against this shard's 1/ep
+        expert slice (``nn/functional/grouped_gemm.moe_ffn_ep``)."""
+        from ...core.flags import flag
+        from ...nn.functional.grouped_gemm import (moe_ffn_ep,
+                                                   moe_ffn_nodrop)
+
+        lead = hn.shape[:-1]
+        x2 = hn.reshape(-1, self.embed_dim)
+        if ep_axis is not None:
+            y = moe_ffn_ep(
+                x2, w["gate_weight"], w["moe_w1"], w["moe_b1"],
+                w["moe_w2"], w["moe_b2"], top_k=self.moe_top_k,
+                axis=ep_axis, ep=ep_size, activation=self.activation)
+        else:
+            y, _probs, _idx, _cnt = moe_ffn_nodrop(
+                x2, w["gate_weight"], w["moe_w1"], w["moe_b1"],
+                w["moe_w2"], w["moe_b2"], top_k=self.moe_top_k,
+                activation=self.activation,
+                backend=flag("moe_grouped_backend"))
+        return y.reshape(*lead, self.embed_dim)
+
     def _layer_body(self, w, h, positions, kv_write, attend, cos_t,
-                    sin_t, linear=None, a8w8=False, psum_axis=None):
+                    sin_t, linear=None, a8w8=False, psum_axis=None,
+                    ep_axis=None, ep_size=1):
         """One pre-LN transformer layer over hidden ``h`` (any leading
         dims). Compute dtype FOLLOWS h (bf16 weights + bf16 h → pure
         bf16 MXU dots; LN statistics promote to fp32 internally and are
@@ -270,6 +321,10 @@ class FusedMultiTransformer(Layer):
         h = (h + linear(att, "out")).astype(h.dtype)
         hn = self._ln(h, w["ln2_scale"], w["ln2_bias"], eps) \
             .astype(h.dtype)
+        if self.moe_num_experts:
+            h = (h + self._moe_ffn(w, hn, ep_axis, ep_size)) \
+                .astype(h.dtype)
+            return h, ck, cv
         ff = self._act(linear(hn, "ffn1").astype(h.dtype))
         h = (h + linear(ff, "ffn2")).astype(h.dtype)
         return h, ck, cv
@@ -304,7 +359,7 @@ class FusedMultiTransformer(Layer):
         v = object.__new__(FusedMultiTransformer)
         for n in ("embed_dim", "head_dim", "dim_feedforward",
                   "num_layers", "activation", "epsilon", "rope_theta",
-                  "max_position"):
+                  "max_position", "moe_num_experts", "moe_top_k"):
             object.__setattr__(v, n, getattr(self, n))
         object.__setattr__(v, "num_heads", tp.heads_per_shard)
         object.__setattr__(v, "num_kv_heads", tp.kv_heads_per_shard)
@@ -312,13 +367,17 @@ class FusedMultiTransformer(Layer):
 
     def _tp_wrap(self, tp, method: str, weights, x, cache, tables,
                  rep_args, cos_t, sin_t, a8w8):
-        """shard_map a raw phase over the ``mp`` axis: weights enter
-        pre-sharded (TPContext.shard_stack specs), the KV pool sharded
-        by kv-head, everything else — hidden state, block tables,
+        """shard_map a raw phase over the ``mp`` and/or ``ep`` mesh
+        axes: weights enter pre-sharded (TPContext.shard_stack specs —
+        column/row slices over ``mp``, the MoE expert bank 1/ep over
+        ``ep``), the KV pool sharded by kv-head (``mp``) or replicated
+        (ep-only), everything else — hidden state, block tables,
         seq_lens/positions, rope tables — replicated. The body is the
-        SAME raw method on the per-shard view with ``psum_axis`` set,
-        so each column→row projection pair contributes exactly one
-        psum."""
+        SAME raw method on the per-shard view with ``psum_axis`` set
+        when mp > 1 (each column→row projection pair contributes
+        exactly one psum) and ``ep_axis`` set when ep > 1 (each MoE
+        layer contributes exactly the all_to_all dispatch/combine pair
+        plus the replicated-hidden all_gather)."""
         from ...distributed.tp import shard_map_fn
 
         if cache is None:
@@ -333,15 +392,23 @@ class FusedMultiTransformer(Layer):
             raise NotImplementedError(
                 "int8 cache-KV is not supported under tensor "
                 "parallelism yet — serve TP with a bf16/f32 pool")
+        if self.moe_num_experts and tp.mp > 1:
+            raise NotImplementedError(
+                "MoE serving composes with expert parallelism "
+                "(ep_degree) — tensor-parallel (mp) sharding of the "
+                "attention stack around an MoE FFN is not wired yet")
         view = self._tp_view(tp)
         rep = tp.pspec()
         wspecs = {n: tp.stack_spec(n) for n in weights}
         kv = tp.kv_spec()
+        psum_axis = tp.axis if tp.mp > 1 else None
+        ep_axis = tp.ep_axis if tp.ep > 1 else None
 
         def body(w, xb, ck, cv, tbl, cos, sin, *extras):
             h, cache2 = getattr(view, method)(
                 w, xb, PagedKV(ck, cv), tbl, *extras, cos, sin,
-                a8w8=a8w8, psum_axis=tp.axis)
+                a8w8=a8w8, psum_axis=psum_axis, ep_axis=ep_axis,
+                ep_size=tp.ep)
             return h, cache2.k, cache2.v
 
         fn = shard_map_fn()(
@@ -354,7 +421,8 @@ class FusedMultiTransformer(Layer):
         return h, PagedKV(nk, nv)
 
     def prefill_raw(self, weights, x, cache, block_tables, cos_t, sin_t,
-                    a8w8=False, tp=None, psum_axis=None):
+                    a8w8=False, tp=None, psum_axis=None,
+                    ep_axis=None, ep_size=1):
         """Prompt pass: x [b, s, d] → (hidden [b, s, d], filled cache).
 
         Causal dense attention (flash-fusable by XLA/Pallas); each
@@ -391,7 +459,8 @@ class FusedMultiTransformer(Layer):
             def body(h, w):
                 h, _, _ = self._layer_body(
                     w, h, positions, lambda k, v: (None, None), attend,
-                    cos_t, sin_t, a8w8=a8w8, psum_axis=psum_axis)
+                    cos_t, sin_t, a8w8=a8w8, psum_axis=psum_axis,
+                    ep_axis=ep_axis, ep_size=ep_size)
                 return h, None
 
             h, _ = jax.lax.scan(body, x, weights)
@@ -407,7 +476,8 @@ class FusedMultiTransformer(Layer):
             h, ck, cv = self._layer_body(
                 w, h, positions,
                 lambda k, v: write_prefill_kv_pages(ck, cv, k, v, tbl),
-                attend, cos_t, sin_t, a8w8=a8w8, psum_axis=psum_axis)
+                attend, cos_t, sin_t, a8w8=a8w8, psum_axis=psum_axis,
+                ep_axis=ep_axis, ep_size=ep_size)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(
@@ -416,7 +486,8 @@ class FusedMultiTransformer(Layer):
 
     def prefill_chunk_raw(self, weights, x, cache, block_tables, start,
                           chunk_lens, cos_t, sin_t, a8w8=False,
-                          tp=None, psum_axis=None):
+                          tp=None, psum_axis=None, ep_axis=None,
+                          ep_size=1):
         """CHUNKED prompt pass: x [b, c, d] embeds tokens at positions
         ``start[b] .. start[b]+c-1`` of sequences whose earlier tokens
         (previous chunks, or a shared prefix mapped by the prefix
@@ -507,7 +578,8 @@ class FusedMultiTransformer(Layer):
 
             h, ck, cv = self._layer_body(
                 w, h, positions, kv_write, attend, cos_t, sin_t,
-                a8w8=a8w8, psum_axis=psum_axis)
+                a8w8=a8w8, psum_axis=psum_axis, ep_axis=ep_axis,
+                ep_size=ep_size)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(
@@ -528,7 +600,7 @@ class FusedMultiTransformer(Layer):
 
     def decode_raw(self, weights, x, cache: PagedKV, block_tables,
                    seq_lens, cos_t, sin_t, a8w8=False, tp=None,
-                   psum_axis=None):
+                   psum_axis=None, ep_axis=None, ep_size=1):
         """One decode step: x [b, d] token embeddings, seq_lens [b] =
         tokens already cached (the new token's position). Returns
         (hidden [b, d], cache').
@@ -633,13 +705,20 @@ class FusedMultiTransformer(Layer):
             def attend(q, k, v, _ck, _cv):
                 return attend_fn(q, k, v, ck, cv, tbl, base)
             return self._layer_body(w, h, seq_lens, None, attend,
-                                    cos_t, sin_t, linear=linear)
+                                    cos_t, sin_t, linear=linear,
+                                    ep_axis=ep_axis, ep_size=ep_size)
 
         from ...nn.functional.stream_linear import (stream_layer_tail,
                                                     stream_linear)
 
+        is_moe = bool(self.moe_num_experts)
+        if is_moe and isinstance(weights, (list, tuple)):
+            raise NotImplementedError(
+                "MoE decode takes the stacked weight dict (the "
+                "unstacked experimental path has no expert bank form)")
         g_flag = flag("decode_grouped")
-        use_grouped = g_flag == "on" or (g_flag == "auto" and not a8w8)
+        use_grouped = (not is_moe) and (
+            g_flag == "on" or (g_flag == "auto" and not a8w8))
         prefetch = bool(flag("decode_prefetch"))
         d_att = self.num_heads * self.head_dim
 
@@ -822,8 +901,10 @@ class FusedMultiTransformer(Layer):
         # (off-TPU it degrades to the identical-math XLA int32 dot).
         lin_flag = flag("decode_linear")
         is_int8 = weights["qkv_weight"].dtype == jnp.int8
-        use_stream_lin = a8w8 or (x.shape[0] % 8 == 0 and (
-            lin_flag == "stream" or (lin_flag == "auto" and is_int8)))
+        use_stream_lin = (not is_moe) and (
+            a8w8 or (x.shape[0] % 8 == 0 and (
+                lin_flag == "stream"
+                or (lin_flag == "auto" and is_int8))))
         small = {n: a for n, a in weights.items()
                  if not n.startswith(("qkv_", "out_", "ffn1_", "ffn2_"))}
 

@@ -73,7 +73,7 @@ class FusedCausalLM(Layer):
 
     def __init__(self, vocab_size, embed_dim, num_heads, dim_feedforward,
                  num_layers, num_kv_heads=None, max_position=32768,
-                 rope_theta=10000.0):
+                 rope_theta=10000.0, moe_num_experts=None, moe_top_k=2):
         super().__init__()
         from ..core.tensor import Parameter
 
@@ -86,7 +86,8 @@ class FusedCausalLM(Layer):
         self.stack = FusedMultiTransformer(
             embed_dim, num_heads, dim_feedforward, num_layers,
             num_kv_heads=num_kv_heads, max_position=max_position,
-            rope_theta=rope_theta)
+            rope_theta=rope_theta, moe_num_experts=moe_num_experts,
+            moe_top_k=moe_top_k)
         self.lnf_scale = Parameter(jnp.ones((embed_dim,), jnp.float32))
         self.lnf_bias = Parameter(jnp.zeros((embed_dim,), jnp.float32))
 
@@ -121,7 +122,8 @@ class GenerationEngine:
                  max_length: int = 1024, num_pages: Optional[int] = None,
                  decode_chunk: Optional[int] = None, kv_dtype=None,
                  quant: Optional[str] = None, mesh=None,
-                 mp_degree: Optional[int] = None):
+                 mp_degree: Optional[int] = None,
+                 ep_degree: Optional[int] = None):
         self.model = model
         st = model.stack
         self.max_length = max_length
@@ -130,12 +132,13 @@ class GenerationEngine:
         self._cos, self._sin = rope_table(st.max_position, st.head_dim,
                                           st.rope_theta)
         self._init_serving_state(kv_dtype, quant, mesh=mesh,
-                                 mp_degree=mp_degree)
+                                 mp_degree=mp_degree,
+                                 ep_degree=ep_degree)
         self._num_pages = num_pages
         self._mgr = None
 
     def _init_serving_state(self, kv_dtype, quant=None, mesh=None,
-                            mp_degree=None):
+                            mp_degree=None, ep_degree=None):
         """Serving dtype discipline + compiled-program holders (shared
         with ContinuousBatchingEngine): the COMPUTE dtype follows the
         stack weights (cast them bf16 for the bandwidth-bound serving
@@ -166,7 +169,13 @@ class GenerationEngine:
 
         self._tp = TPContext.create(
             st.num_heads, st.num_kv_heads, st.head_dim,
-            mp_degree=mp_degree, mesh=mesh)
+            mp_degree=mp_degree, mesh=mesh, ep_degree=ep_degree)
+        if self._tp is not None and self._tp.ep > 1 \
+                and not st.moe_num_experts:
+            raise ValueError(
+                "ep_degree shards the MoE expert bank — the stack has "
+                "no experts (pass moe_num_experts to the model, or "
+                "use mp_degree for dense tensor parallelism)")
         if quant is not None and \
                 st.qkv_weight._data.dtype != jnp.int8:
             st.quantize_weight_only_int8()
@@ -189,6 +198,8 @@ class GenerationEngine:
             self._lnf_tp = (tp.replicate(self.model.lnf_scale._data),
                             tp.replicate(self.model.lnf_bias._data))
             _stats.set_gauge("dist.mp_degree", tp.mp)
+            if tp.ep > 1:
+                _stats.set_gauge("dist.ep_degree", tp.ep)
         # roofline rung names: A8W8 programs report under their own
         # ``decode.a8w8``/``prefill.a8w8`` keys, and the grouped
         # weight-stream path (FLAGS_decode_grouped, the r6 default for
@@ -199,8 +210,14 @@ class GenerationEngine:
         from ..core.flags import flag as _flag
 
         g = _flag("decode_grouped")
-        self._grouped = g == "on" or (g == "auto" and not self._a8w8)
-        if self._a8w8:
+        is_moe = bool(st.moe_num_experts)
+        self._grouped = (not is_moe) and (
+            g == "on" or (g == "auto" and not self._a8w8))
+        if is_moe:
+            # MoE stacks route the FFN through the ragged grouped-GEMM
+            # path (the fused dense tail doesn't apply) — own rung name
+            self._decode_tag = "decode.moe"
+        elif self._a8w8:
             self._decode_tag = "decode.a8w8"
         elif self._grouped:
             wname = ("int8" if wd == jnp.int8 else
@@ -220,16 +237,29 @@ class GenerationEngine:
             jax.jit(self._prefill_fn, donate_argnums=(7, 8)))
         self._decode_k_jit = {}
 
+    def _dist_coords(self) -> str:
+        """``mp=N`` / ``ep=N`` rung coordinates under tensor/expert
+        parallelism (README metric conventions)."""
+        if self._tp is None:
+            return ""
+        parts = []
+        if self._tp.mp > 1:
+            parts.append(f"mp={self._tp.mp}")
+        if self._tp.ep > 1:
+            parts.append(f"ep={self._tp.ep}")
+        return ",".join(parts)
+
     def _mp_suffix(self) -> str:
-        """``[mp=N]`` rung suffix under tensor parallelism (README
-        metric conventions; composes as ``[k=*,mp=N]`` on decode)."""
-        return f"[mp={self._tp.mp}]" if self._tp is not None else ""
+        """``[mp=N]``/``[ep=N]`` rung suffix under tensor/expert
+        parallelism (composes as ``[k=*,mp=N]`` on decode)."""
+        c = self._dist_coords()
+        return f"[{c}]" if c else ""
 
     def _decode_rung(self, k: int) -> str:
         """Roofline rung name of the k-step decode program —
         ``decode.bf16_grouped[k=8,mp=2]``-shaped under TP."""
-        mp = f",mp={self._tp.mp}" if self._tp is not None else ""
-        return f"{self._decode_tag}[k={k}{mp}]"
+        c = self._dist_coords()
+        return f"{self._decode_tag}[k={k}{',' + c if c else ''}]"
 
     def _weights(self):
         """The decode/prefill weight-stack operand: the shard-at-load
@@ -610,7 +640,8 @@ class ContinuousBatchingEngine:
                  prompt_bucket: int = 16, kv_dtype=None,
                  quant: Optional[str] = None, admit_window: int = 8,
                  starvation_bound: int = 16, mesh=None,
-                 mp_degree: Optional[int] = None, speculative=None,
+                 mp_degree: Optional[int] = None,
+                 ep_degree: Optional[int] = None, speculative=None,
                  spec_k: Optional[int] = None):
         self.model = model
         self.max_batch = int(max_batch)
@@ -630,7 +661,8 @@ class ContinuousBatchingEngine:
         self._gen.page_size = self.page_size
         self._gen.decode_chunk = self.decode_chunk
         self._gen._init_serving_state(kv_dtype, quant, mesh=mesh,
-                                      mp_degree=mp_degree)
+                                      mp_degree=mp_degree,
+                                      ep_degree=ep_degree)
         st = model.stack
         self._pages_per_seq = -(-self.max_length // self.page_size)
         requested = (num_pages or self.max_batch * self._pages_per_seq) + 1

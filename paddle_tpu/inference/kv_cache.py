@@ -114,12 +114,18 @@ class BlockKVCacheManager:
                  jnp.zeros(plane, jnp.float32)))
         if self._mesh is not None:
             # kv-head-sharded pool: allocated directly under its
-            # NamedSharding so no chip ever holds the full pool
+            # NamedSharding so no chip ever holds the full pool. On an
+            # ep-only mesh (mp_degree == 1, expert parallelism — ISSUE
+            # 15) the pool is REPLICATED over the mesh instead: EP
+            # shards the expert bank, and the pool must still be
+            # mesh-committed so the shard_mapped decode programs never
+            # mix single-device arrays with mesh-sharded weights.
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            sh = NamedSharding(
-                self._mesh, P(None, self.mp_axis, None, None))
+            spec = P(None, self.mp_axis, None, None) \
+                if self.mp_degree > 1 else P()
+            sh = NamedSharding(self._mesh, spec)
             zero = jax.jit(lambda: jnp.zeros(shape, self.dtype),
                            out_shardings=sh)
             return PagedKV(zero(), zero())

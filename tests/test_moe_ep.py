@@ -46,8 +46,9 @@ class TestGShardDispatch:
         x = jnp.asarray(rng.randn(T, Dx).astype(np.float32))
         wg = jnp.asarray(rng.randn(Dx, Ex).astype(np.float32) * 0.3)
         probs = jax.nn.softmax(x @ wg, -1)
-        combine, dispatch, _, dropped = _gshard_dispatch(
+        combine, dispatch, _, dropped, counts = _gshard_dispatch(
             probs, Ex, K, T * K)
+        assert int(counts.sum()) == T * K  # every assignment routed
         assert float(dropped) == 0.0  # ample capacity: nothing dropped
         out = jnp.einsum("tec,ecd->td", combine,
                          jnp.einsum("tec,td->ecd", dispatch, x))

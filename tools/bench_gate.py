@@ -149,6 +149,15 @@ DEFAULT_METRICS: Dict[str, str] = {
     "fleet_chaos_request_errors": "up",
     "fleet_chaos_goodput": "down",
     "fleet_chaos_tokens_per_sec": "down",
+    # MoE rungs (ISSUE 15): no-drop train/decode throughput and the
+    # activated-FLOPs MFU regress DOWN; moe.dropped_tokens (inside the
+    # rung telemetry) regresses UP with NO noise floor — the rung runs
+    # in no-drop mode, so a single dropped token is a broken ragged
+    # path, not jitter (strict-compared like the lint counters)
+    "moe_train_tokens_per_sec": "down",
+    "moe_train_mfu": "down",
+    "moe_decode_tokens_per_sec": "down",
+    "moe.dropped_tokens": "up",
     # static-analysis state the numbers were measured under: the
     # finding count must only go DOWN between rounds, so any growth
     # regresses (direction "up" = an increase fails the gate); gates
@@ -217,10 +226,11 @@ def _metric_value(block: dict, name: str) -> Optional[float]:
 
 def _regressed(name: str, direction: str, prev: float, cur: float,
                tol: float) -> bool:
-    if name.startswith("lint"):
-        # lint findings must only go down between rounds — ANY growth
-        # regresses, no noise floor (a single new finding is a real
-        # defect, not measurement jitter)
+    if name.startswith("lint") or name == "moe.dropped_tokens":
+        # lint findings and no-drop-mode dropped tokens must only go
+        # down between rounds — ANY growth regresses, no noise floor
+        # (a single new finding / dropped token is a real defect, not
+        # measurement jitter)
         return cur > prev if direction == "up" else cur < prev
     floor = _ABS_FLOOR_US if name.endswith("_us") else _ABS_FLOOR_COUNT
     if direction == "up":
