@@ -235,6 +235,27 @@ define_flag("fleet_dispatch_queue", 4096,
             "yet-admitted requests (every replica's inbox + waiting "
             "list) past this shed new submits with the typed "
             "FleetOverloaded BEFORE any replica admits; 0 = unbounded")
+define_flag("telemetry_interval_ms", 0.0,
+            "continuous time-series sampler "
+            "(profiler/timeseries.py): default background sampling "
+            "interval for TimeSeriesSampler.start() — every interval "
+            "the sampler folds the stats registry (counters -> delta "
+            "rates, gauges -> levels, histograms -> count/total) into "
+            "bounded per-metric ring windows; 0 disables the default "
+            "sampler (explicit tick() still works in tests)")
+define_flag("telemetry_window", 512,
+            "time-series retention: points kept per metric ring "
+            "(profiler/timeseries.py) — fixed memory however long the "
+            "serve runs; window aggregates (min/mean/max/p99) and "
+            "serve_top --history sparklines read this window")
+define_flag("telemetry_port", 0,
+            "Prometheus text-format scrape endpoint "
+            "(profiler/timeseries.py start_http_server): a stdlib "
+            "http.server thread serves the stats registry as "
+            "/metrics (counters *_total, histogram cumulative "
+            "*_bucket) on this port; FleetRouter.start_telemetry "
+            "serves the fleet-aggregated per-replica series (sum "
+            "counters, max gauges) the same way; 0 = no exporter")
 define_flag("serve_chunk_shrink", True,
             "graceful degradation under pool pressure: before a "
             "prefill chunk stalls/requeues for pages, shrink it "

@@ -1,11 +1,14 @@
-from . import memory, roofline, stats  # noqa: F401
+from . import alerts, memory, roofline, stats, timeseries  # noqa: F401
+from .alerts import AlertEngine, Rule, default_rules  # noqa: F401
 from .profiler import (  # noqa: F401
     Profiler, ProfilerState, ProfilerTarget, RecordEvent, dump_rank,
     export_chrome_tracing, load_profiler_result, make_scheduler,
 )
 from .timer import Benchmark, benchmark  # noqa: F401
+from .timeseries import TimeSeriesSampler  # noqa: F401
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing",
            "load_profiler_result", "Benchmark", "benchmark", "stats",
-           "roofline", "memory", "dump_rank"]
+           "roofline", "memory", "dump_rank", "timeseries", "alerts",
+           "TimeSeriesSampler", "AlertEngine", "Rule", "default_rules"]

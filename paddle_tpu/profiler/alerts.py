@@ -1,0 +1,190 @@
+"""Fleet alerting: a small rule engine evaluated per telemetry tick.
+
+Rules read the :class:`profiler.timeseries.TimeSeriesSampler` rings
+(latest gauge level, counter delta rate, trailing rate distribution)
+and classify each tick as breach / clear. A rule FIRES after
+``for_ticks`` consecutive breaches (sustained-window semantics — one
+noisy tick never pages) and RESOLVES on the first clear tick. Both
+transitions are journaled as ``alert`` lifecycle events (PR 9 flight
+recorder) and counted under ``alert.{fired,resolved}`` with the live
+count on the ``alert.active`` gauge, so the alert trail survives in
+every artifact tier: journal JSONL, stats snapshot, telemetry series,
+and ``serve_top --history``.
+
+Rule kinds:
+
+- ``value`` — compare the metric's latest level (gauge value /
+  histogram count) against the threshold;
+- ``rate``  — compare the counter's latest delta rate (events/s);
+- ``spike`` — compare the counter's latest delta rate against
+  ``scale ×`` the trailing-window mean rate (relative burst
+  detection: preemption storms, fault storms).
+
+Thresholds may be numbers or ANOTHER METRIC NAME (resolved against
+the same tick, scaled by ``scale``) — that is how
+``hbm.bytes_in_use > 0.9 * hbm.bytes_limit`` and
+``fleet.replicas_alive < fleet.replicas`` stay correct whatever the
+device or fleet size.
+
+Stdlib-only at import (the stats import is lazy and guarded) so the
+tools can load it standalone alongside timeseries.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Union
+
+__all__ = ["Rule", "AlertEngine", "default_rules"]
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One alert rule. ``threshold`` is a number or a metric name
+    (resolved per tick); ``scale`` multiplies a metric-name threshold
+    (``0.9 * hbm.bytes_limit``) or, for ``kind="spike"``, the trailing
+    mean rate. ``for_ticks`` is the sustained-window length."""
+
+    name: str
+    metric: str
+    op: str = ">"                    # ">" or "<"
+    threshold: Union[float, str] = 0.0
+    scale: float = 1.0
+    kind: str = "value"              # "value" | "rate" | "spike"
+    for_ticks: int = 1
+
+    def __post_init__(self):
+        if self.op not in (">", "<"):
+            raise ValueError(f"rule {self.name}: op must be > or <")
+        if self.kind not in ("value", "rate", "spike"):
+            raise ValueError(f"rule {self.name}: bad kind "
+                             f"{self.kind!r}")
+        if self.for_ticks < 1:
+            raise ValueError(f"rule {self.name}: for_ticks >= 1")
+
+
+def default_rules(n_replicas: Optional[int] = None) -> List[Rule]:
+    """The ISSUE's standing rule set. ``fleet-replica-down`` compares
+    alive against the registered ``fleet.replicas`` gauge, so it holds
+    for any fleet size; pass ``n_replicas`` to pin a literal floor
+    instead."""
+    rules = [
+        Rule("slo-burn", "slo.burn_rate", ">", 2.0, for_ticks=3),
+        Rule("hbm-pressure", "hbm.bytes_in_use", ">",
+             "hbm.bytes_limit", scale=0.9),
+        Rule("preemption-spike", "serving.preemptions", ">",
+             kind="spike", scale=3.0, for_ticks=1),
+    ]
+    if n_replicas is not None:
+        rules.append(Rule("fleet-replica-down", "fleet.replicas_alive",
+                          "<", float(n_replicas)))
+    else:
+        rules.append(Rule("fleet-replica-down", "fleet.replicas_alive",
+                          "<", "fleet.replicas"))
+    return rules
+
+
+@dataclass
+class _RuleState:
+    streak: int = 0
+    firing: bool = False
+
+
+class AlertEngine:
+    """Evaluate a rule list against a sampler, once per tick.
+
+    ``active`` maps firing rule name -> the fire record; ``history``
+    keeps every fire/resolve transition (tests and serve_top read
+    it). Pass a :class:`serving.journal.FlightRecorder` to journal
+    transitions as ``alert`` lifecycle events.
+    """
+
+    def __init__(self, rules: Optional[List[Rule]] = None,
+                 journal=None):
+        self.rules = list(rules) if rules is not None \
+            else default_rules()
+        self.journal = journal
+        self.active: Dict[str, dict] = {}
+        self.history: List[dict] = []
+        self._state: Dict[str, _RuleState] = {
+            r.name: _RuleState() for r in self.rules}
+
+    # ------------------------------------------------------------
+
+    def _threshold(self, rule: Rule, sampler) -> Optional[float]:
+        if isinstance(rule.threshold, str):
+            ref = sampler.value(rule.threshold)
+            if ref is None:
+                return None
+            return rule.scale * ref
+        return float(rule.threshold)
+
+    def _reading(self, rule: Rule, sampler):
+        """(value, threshold) for this tick, or None when the metric
+        has not been seen yet (absent metrics never breach)."""
+        if rule.kind == "value":
+            v = sampler.value(rule.metric)
+            thr = self._threshold(rule, sampler)
+        elif rule.kind == "rate":
+            v = sampler.rate(rule.metric)
+            thr = self._threshold(rule, sampler)
+        else:  # spike: latest rate vs scale x trailing mean rate
+            rates = sampler.rates(rule.metric)
+            if len(rates) < 2:
+                return None
+            v = rates[-1]
+            trailing = rates[:-1]
+            thr = rule.scale * (sum(trailing) / len(trailing))
+        if v is None or thr is None:
+            return None
+        return v, thr
+
+    def evaluate(self, sampler) -> List[dict]:
+        """One pass over the rules; returns this tick's transitions
+        (fire + resolve records)."""
+        transitions: List[dict] = []
+        for rule in self.rules:
+            st = self._state[rule.name]
+            reading = self._reading(rule, sampler)
+            breach = False
+            value = thr = None
+            if reading is not None:
+                value, thr = reading
+                breach = value > thr if rule.op == ">" else value < thr
+            if breach:
+                st.streak += 1
+                if not st.firing and st.streak >= rule.for_ticks:
+                    st.firing = True
+                    transitions.append(
+                        self._transition(rule, "firing", value, thr))
+            else:
+                st.streak = 0
+                if st.firing:
+                    st.firing = False
+                    transitions.append(
+                        self._transition(rule, "resolved", value, thr))
+        return transitions
+
+    def _transition(self, rule: Rule, state: str, value, thr) -> dict:
+        rec = {"name": rule.name, "metric": rule.metric,
+               "state": state,
+               "value": None if value is None else round(value, 6),
+               "threshold": None if thr is None else round(thr, 6)}
+        if state == "firing":
+            self.active[rule.name] = rec
+        else:
+            self.active.pop(rule.name, None)
+        self.history.append(rec)
+        if self.journal is not None:
+            try:
+                self.journal.record("alert", extra=rec)
+            except Exception:
+                pass
+        try:
+            from . import stats as _stats
+
+            _stats.inc("alert.fired" if state == "firing"
+                       else "alert.resolved")
+            _stats.set_gauge("alert.active", len(self.active))
+        except Exception:
+            pass
+        return rec

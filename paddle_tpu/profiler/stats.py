@@ -82,6 +82,20 @@ Conventions for the built-in instrumentation (all optional reading):
   utilization vs device peaks (profiler/roofline.py)
 - ``hbm.*``                    device memory telemetry
   (profiler/memory.py)
+- ``serve.step.*_ms``          per-step serving-time ATTRIBUTION
+  (serving/scheduler.py ``_observe_step``): each scheduler step's
+  wall time split into ``serve.step.{admit,prefill_chunk,
+  decode_chunk,spec_verify,migration,host_overhead,total}_ms``
+  histograms on the injectable serving clock — the phase sums equal
+  the step wall time (host_overhead is the residual), so "where did
+  the step go" is answerable from telemetry alone
+- ``telemetry.*``              the continuous time-series sampler's
+  own accounting (profiler/timeseries.py):
+  ``telemetry.ticks`` sampler passes and ``telemetry.tick_us`` the
+  measured per-tick overhead histogram
+- ``alert.*``                  the alert rule engine
+  (profiler/alerts.py): ``alert.{fired,resolved}`` lifecycle
+  counters and the ``alert.active`` gauge
 - ``t.*``                      scratch namespace reserved for tests
 
 Every metric the framework registers MUST use one of these prefixes
@@ -100,7 +114,8 @@ from typing import Dict, Optional
 __all__ = [
     "Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
     "inc", "set_gauge", "observe", "snapshot", "reset", "enable",
-    "disable", "is_enabled", "timed", "CONVENTION_PREFIXES",
+    "disable", "is_enabled", "timed", "sample_values",
+    "CONVENTION_PREFIXES",
 ]
 
 #: documented metric-name namespaces (see module docstring / README
@@ -110,6 +125,7 @@ CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
     "inference.", "serving.", "serve.", "journal.", "slo.", "spec.",
     "quant.", "moe.", "dist.", "fleet.", "roofline.", "hbm.", "lint.",
+    "telemetry.", "alert.",
     "t.",
 )
 
@@ -422,33 +438,72 @@ def _process_meta() -> dict:
             "pid": os.getpid()}
 
 
+def _registered():
+    """Consistent copy of the registry's metric lists. Taken under
+    ``_REGISTRY_LOCK`` so a snapshot/reset pass racing a writer thread
+    that is REGISTERING new names (the time-series sampler hammer
+    case) never iterates a mutating dict; per-metric values stay
+    guarded by each metric's own lock."""
+    with _REGISTRY_LOCK:
+        return (sorted(_COUNTERS.items()), sorted(_GAUGES.items()),
+                sorted(_HISTOGRAMS.items()))
+
+
 def snapshot(prefix: Optional[str] = None) -> dict:
     """JSON-able view of every metric (optionally name-prefixed):
     ``{"meta": {...}, "counters": {...}, "gauges": {...},
-    "histograms": {...}}`` — ``meta`` stamps the producing rank."""
+    "histograms": {...}}`` — ``meta`` stamps the producing rank.
+    Safe against concurrent writers/registrations: the name set is
+    copied under the registry lock and each histogram summary is read
+    under its own lock (no torn count/bucket pairs)."""
     def keep(name):
         return prefix is None or name.startswith(prefix)
 
+    counters, gauges, hists = _registered()
     return {
         "meta": _process_meta(),
-        "counters": {n: c.value for n, c in sorted(_COUNTERS.items())
+        "counters": {n: c.value for n, c in counters
                      if keep(n) and c.value},
-        "gauges": {n: g.value for n, g in sorted(_GAUGES.items())
-                   if keep(n)},
-        "histograms": {n: h.summary()
-                       for n, h in sorted(_HISTOGRAMS.items())
+        "gauges": {n: g.value for n, g in gauges if keep(n)},
+        "histograms": {n: h.summary() for n, h in hists
                        if keep(n) and h.count},
     }
 
 
+def sample_values(prefix: Optional[str] = None):
+    """One lock-cheap telemetry pass (the time-series sampler's tick
+    source — profiler/timeseries.py): ``(counters, gauges,
+    histograms)`` plain dicts, where histograms carry only the
+    ``(count, total)`` pair read under the histogram lock — no
+    reservoir sort, no bucket list build, so a tick over hundreds of
+    metrics stays microseconds."""
+    def keep(name):
+        return prefix is None or name.startswith(prefix)
+
+    counters, gauges, hists = _registered()
+    hv = {}
+    for n, h in hists:
+        if not keep(n):
+            continue
+        with h._lock:
+            if h.count:
+                hv[n] = (h.count, h.total)
+    return ({n: c.value for n, c in counters if keep(n) and c.value},
+            {n: g.value for n, g in gauges if keep(n)},
+            hv)
+
+
 def reset() -> None:
     """Zero every metric (keeps the registry's objects alive — cached
-    references in hot paths stay valid)."""
-    for c in list(_COUNTERS.values()):
+    references in hot paths stay valid, and every registered series
+    DEFINITION survives: a concurrent sampler keeps reading the same
+    metric objects, now zeroed)."""
+    counters, gauges, hists = _registered()
+    for _, c in counters:
         c._reset()
-    for g in list(_GAUGES.values()):
+    for _, g in gauges:
         g._reset()
-    for h in list(_HISTOGRAMS.values()):
+    for _, h in hists:
         h._reset()
 
 

@@ -1,0 +1,571 @@
+"""Continuous telemetry: bounded time-series sampling over the stats
+registry, with Prometheus/JSONL exporters and fleet aggregation.
+
+Every signal the stack publishes so far is a point-in-time
+``stats.snapshot()`` or an end-of-run bench block — "goodput dipped
+for 30 s during a failover" is invisible by construction. This module
+closes that gap with a :class:`TimeSeriesSampler`: a periodic
+(background thread, or explicit ``tick()`` for deterministic tests)
+pass that folds the registry into per-metric bounded ring windows:
+
+- **counters** record ``(ts, cumulative, rate)`` — the delta rate
+  (events/s between ticks: tokens/s, faults/s) is derived at sample
+  time, so the ring answers "how fast NOW" without post-processing;
+- **gauges** record ``(ts, value)`` — instantaneous levels (queue
+  depth, goodput, burn rate, HBM bytes);
+- **histograms** record ``(ts, count, total)`` — the cheap pair read
+  under the histogram lock (no reservoir sort per tick), from which
+  per-interval event rates and means derive.
+
+Design constraints (the PR 1 registry / PR 9 journal discipline):
+
+- **bounded**: each metric's ring holds ``window`` points
+  (``FLAGS_telemetry_window``) — fixed memory however long the serve
+  runs;
+- **lock-cheap**: one pass per tick through
+  ``stats.sample_values()`` (registry lock for the name copy,
+  per-histogram lock for the count/total pair only);
+- **zero cost when disabled**: a disabled sampler allocates NO rings
+  and ``tick()`` is a single attribute test;
+- **clock-seam timestamps**: tick timestamps route through the
+  serving clock (serving/faults.py) when available, so ManualClock
+  tests get exact, deterministic delta rates.
+
+Exporters:
+
+- ``dump_jsonl`` — append-only JSONL, one tick per line
+  (``{"ts": ..., "counters": {n: [cum, rate]}, "gauges": {...},
+  "histograms": {n: [count, total]}, "alerts": [...]}``), loadable
+  offline by ``load_jsonl`` / ``tools/serve_top.py --history`` and
+  foldable across ranks by ``tools/trace_merge.py``;
+- ``prometheus_text`` / ``start_http_server`` — text-format scrape
+  (stdlib ``http.server`` thread, ``FLAGS_telemetry_port``) with
+  conventional naming: counters ``*_total`` (monotone), histograms
+  cumulative ``*_bucket{le=...}`` + ``*_sum``/``*_count``;
+- ``aggregate_ticks`` — fold per-replica/per-rank series into one
+  fleet-level set with the trace_merge fold semantics (counters SUM,
+  gauges MAX, histogram counts/totals SUM); ``FleetRouter.
+  start_telemetry`` serves that fold on one port.
+
+This module is deliberately stdlib-only at import time (the flags /
+stats imports are lazy and fall back) so ``tools/trace_merge.py`` and
+``tools/serve_top.py`` can load it standalone for offline folds.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "TimeSeriesSampler", "registry_source", "engine_source",
+    "aggregate_ticks", "load_jsonl", "prometheus_text",
+    "tick_prometheus_text", "start_http_server", "TelemetryServer",
+]
+
+#: fallback defaults when core.flags is unavailable (standalone load)
+_DEFAULT_INTERVAL_MS = 0.0
+_DEFAULT_WINDOW = 512
+
+
+def _flag(name, default):
+    try:
+        from ..core.flags import flag
+
+        return flag(name)
+    except Exception:
+        return default
+
+
+def _clock():
+    """The serving clock seam when importable (serving/faults.py),
+    else a real-monotonic stand-in with the same now()/sleep() API."""
+    try:
+        from ..serving import faults as _faults
+
+        return _faults.clock()
+    except Exception:
+        class _Wall:
+            def now(self):
+                return time.monotonic()
+
+            def sleep(self, s):
+                if s > 0:
+                    time.sleep(s)
+
+        return _Wall()
+
+
+def registry_source() -> Callable[[], tuple]:
+    """The default tick source: one ``stats.sample_values()`` pass
+    over the process-wide registry."""
+    from . import stats as _stats
+
+    return _stats.sample_values
+
+
+def engine_source(eng) -> Callable[[], tuple]:
+    """A PER-REPLICA tick source reading one ServingEngine's live
+    state directly (the process registry is shared by every replica,
+    so per-replica series must come from the engine objects): request
+    completions as a counter, queue/occupancy/SLO levels as gauges.
+    Counter names are chosen so the fleet fold's SUM is exact
+    (completions add across replicas; goodput/occupancy MAX)."""
+    def src():
+        counters = {"serve.finished": len(eng.finished)}
+        jr = getattr(eng, "journal", None)
+        if jr is not None:
+            counters["journal.events"] = jr.recorded
+        mon = getattr(eng, "slo_monitor", None)
+        gauges = {
+            "slo.queue_depth": eng.queue_depth,
+            "slo.slot_occupancy": (eng.num_active / eng.max_batch
+                                   if eng.max_batch else 0.0),
+        }
+        if mon is not None and mon.goodput is not None:
+            gauges["slo.goodput"] = mon.goodput
+            gauges["slo.burn_rate"] = mon.burn_rate
+        return counters, gauges, {}
+    return src
+
+
+class TimeSeriesSampler:
+    """Periodic sampler folding a metrics source into bounded rings.
+
+    Usage (deterministic test form)::
+
+        clk = ManualClock()
+        s = TimeSeriesSampler(interval_ms=100, window=64, clock=clk)
+        s.tick(); clk.advance(2.0); s.tick()
+        s.rate("serving.decode_steps")   # exact delta rate
+        s.aggregate("slo.goodput")       # {min, mean, max, p99, last}
+
+    Background form (real serves): ``start()`` spawns a daemon thread
+    ticking every ``interval_ms``; ``stop()`` joins it. Timestamps
+    route through the serving clock seam either way. A sampler built
+    disabled (``enabled=False``, or default-constructed while
+    ``FLAGS_telemetry_interval_ms`` is 0) allocates no rings and every
+    ``tick()`` is one attribute test.
+    """
+
+    def __init__(self, interval_ms: Optional[float] = None,
+                 window: Optional[int] = None, clock=None,
+                 source: Optional[Callable[[], tuple]] = None,
+                 enabled: Optional[bool] = None):
+        if interval_ms is None:
+            interval_ms = float(_flag("telemetry_interval_ms",
+                                      _DEFAULT_INTERVAL_MS))
+        if window is None:
+            window = int(_flag("telemetry_window", _DEFAULT_WINDOW))
+        self.interval_ms = float(interval_ms)
+        self.window = max(int(window), 2)
+        self.enabled = (self.interval_ms > 0) if enabled is None \
+            else bool(enabled)
+        self._clock = clock if clock is not None else _clock()
+        self._source = source if source is not None \
+            else registry_source()
+        self._alerts = None
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self.n_ticks = 0
+        self._dumped = 0
+        if self.enabled:
+            #: metric name -> deque of points (see module docstring)
+            self._counters: Dict[str, deque] = {}
+            self._gauges: Dict[str, deque] = {}
+            self._hists: Dict[str, deque] = {}
+            self._ticks: deque = deque(maxlen=self.window)
+            self._last_cum: Dict[str, tuple] = {}
+        else:
+            # zero-cost discipline: nothing allocated, nothing to leak
+            self._counters = self._gauges = self._hists = None
+            self._ticks = None
+            self._last_cum = None
+
+    # ---------------- sampling ----------------
+
+    def attach_alerts(self, engine) -> "TimeSeriesSampler":
+        """Evaluate an :class:`profiler.alerts.AlertEngine` every tick;
+        the tick record then carries the active alert names (rendered
+        by serve_top --history)."""
+        self._alerts = engine
+        return self
+
+    def tick(self) -> Optional[dict]:
+        """One sampling pass: read the source, derive counter delta
+        rates against the previous tick, append one point per metric,
+        evaluate attached alert rules. Returns the tick record (the
+        JSONL line shape) or None when disabled."""
+        if not self.enabled:
+            return None
+        t_wall = time.perf_counter_ns()
+        with self._lock:
+            ts = self._clock.now()
+            counters, gauges, hists = self._source()
+            rec_c = {}
+            for n, cum in counters.items():
+                prev = self._last_cum.get(n)
+                rate = None
+                if prev is not None:
+                    dt = ts - prev[0]
+                    if dt > 0:
+                        rate = (cum - prev[1]) / dt
+                self._last_cum[n] = (ts, cum)
+                self._ring(self._counters, n).append((ts, cum, rate))
+                rec_c[n] = [cum, rate]
+            for n, v in gauges.items():
+                self._ring(self._gauges, n).append((ts, v))
+            rec_h = {}
+            for n, (count, total) in hists.items():
+                self._ring(self._hists, n).append((ts, count, total))
+                rec_h[n] = [count, round(total, 6)]
+            rec = {"ts": round(ts, 6), "counters": rec_c,
+                   "gauges": gauges, "histograms": rec_h}
+            self.n_ticks += 1
+        if self._alerts is not None:
+            self._alerts.evaluate(self)
+            rec["alerts"] = sorted(self._alerts.active)
+        with self._lock:
+            self._ticks.append(rec)
+        try:  # the sampler's own accounting (skipped standalone)
+            from . import stats as _stats
+
+            _stats.inc("telemetry.ticks")
+            _stats.observe("telemetry.tick_us",
+                           (time.perf_counter_ns() - t_wall) / 1e3)
+        except Exception:
+            pass
+        return rec
+
+    def _ring(self, table, name):
+        ring = table.get(name)
+        if ring is None:
+            ring = table[name] = deque(maxlen=self.window)
+        return ring
+
+    # ---------------- reading ----------------
+
+    def series(self, name: str) -> List[tuple]:
+        """The raw ring for one metric: counter points are
+        ``(ts, cumulative, rate)``, gauge points ``(ts, value)``,
+        histogram points ``(ts, count, total)``."""
+        if not self.enabled:
+            return []
+        with self._lock:
+            for table in (self._counters, self._gauges, self._hists):
+                if name in table:
+                    return list(table[name])
+        return []
+
+    def metrics(self) -> List[str]:
+        if not self.enabled:
+            return []
+        with self._lock:
+            return sorted(set(self._counters) | set(self._gauges)
+                          | set(self._hists))
+
+    def value(self, name: str):
+        """Latest level: gauge value, counter delta rate, or histogram
+        count — the alert engine's per-tick read."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            if name in self._gauges and self._gauges[name]:
+                return self._gauges[name][-1][1]
+            if name in self._counters and self._counters[name]:
+                return self._counters[name][-1][2]
+            if name in self._hists and self._hists[name]:
+                return self._hists[name][-1][1]
+        return None
+
+    def rate(self, name: str):
+        """Latest counter delta rate (events/s between the last two
+        ticks), None before two ticks saw the counter."""
+        pts = self.series(name)
+        return pts[-1][2] if pts and len(pts[-1]) == 3 else None
+
+    def rates(self, name: str) -> List[float]:
+        """Every non-None delta rate in the window (spike rules read
+        the trailing distribution)."""
+        return [p[2] for p in self.series(name)
+                if len(p) == 3 and p[2] is not None]
+
+    def cum(self, name: str):
+        """Latest cumulative counter value."""
+        pts = self.series(name)
+        return pts[-1][1] if pts else None
+
+    def aggregate(self, name: str) -> Optional[dict]:
+        """Window aggregates over the metric's ring — gauges aggregate
+        their values, counters their delta rates."""
+        pts = self.series(name)
+        if not pts:
+            return None
+        if len(pts[0]) == 3 and name in (self._counters or {}):
+            vals = [p[2] for p in pts if p[2] is not None]
+        else:
+            vals = [p[1] for p in pts]
+        if not vals:
+            return None
+        s = sorted(vals)
+        p99 = s[min(len(s) - 1, max(0, -(-99 * len(s) // 100) - 1))]
+        return {"n": len(vals), "min": s[0], "max": s[-1],
+                "mean": sum(vals) / len(vals), "p99": p99,
+                "last": vals[-1]}
+
+    def ticks(self) -> List[dict]:
+        """The retained tick records, oldest first (the JSONL dump /
+        serve_top --history live input)."""
+        if not self.enabled:
+            return []
+        with self._lock:
+            return list(self._ticks)
+
+    # ---------------- background thread ----------------
+
+    def start(self) -> "TimeSeriesSampler":
+        """Spawn the background sampling thread (daemon). The pace is
+        wall time (interruptible wait); every timestamp still routes
+        through the clock seam. No-op when disabled or started."""
+        if not self.enabled or self.interval_ms <= 0:
+            return self
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop_evt.clear()
+
+        def loop():
+            dt = self.interval_ms / 1e3
+            while not self._stop_evt.wait(dt):
+                self.tick()
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="telemetry-sampler")
+        self._thread.start()
+        return self
+
+    def stop(self, final_tick: bool = True) -> None:
+        """Stop the background thread; by default take one last tick
+        so the series ends at the run's end state."""
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_tick:
+            self.tick()
+
+    # ---------------- exporters ----------------
+
+    def dump_jsonl(self, path: str) -> str:
+        """APPEND the ticks not yet dumped as JSONL lines (one tick
+        per line) — repeated calls grow the file monotonically, so a
+        long serve can checkpoint its series without rewriting."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        ticks = self.ticks()
+        new = ticks[self._dumped:] if self._dumped <= len(ticks) \
+            else ticks
+        with open(path, "a") as f:
+            for rec in new:
+                f.write(json.dumps(rec) + "\n")
+        self._dumped = len(ticks)
+        return path
+
+    def prometheus_text(self) -> str:
+        """Text-format scrape of this sampler's LATEST tick."""
+        ticks = self.ticks()
+        return tick_prometheus_text(ticks[-1]) if ticks else ""
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Parse a series dump back into tick records (offline input for
+    serve_top --history and the trace_merge series fold)."""
+    ticks = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                ticks.append(json.loads(line))
+    ticks.sort(key=lambda t: t.get("ts", 0.0))
+    return ticks
+
+
+# ---------------------------------------------------------------------
+# fleet fold
+# ---------------------------------------------------------------------
+
+def aggregate_ticks(per_rank: List[List[dict]]) -> List[dict]:
+    """Fold per-replica/per-rank tick series into ONE fleet-level
+    series, with the trace_merge fold semantics: ticks align by
+    timestamp order (each rank's series is sorted by ts, then tick i
+    folds with tick i of every other rank — samplers on one cadence
+    line up exactly), counters SUM (cumulative and rate), gauges MAX,
+    histogram counts/totals SUM, alert sets union. The folded tick's
+    ``ts`` is the max of its members' (the fleet saw the state by
+    then)."""
+    ranks = [sorted(t, key=lambda d: d.get("ts", 0.0))
+             for t in per_rank if t]
+    if not ranks:
+        return []
+    out = []
+    for i in range(max(len(r) for r in ranks)):
+        members = [r[i] for r in ranks if i < len(r)]
+        counters: dict = {}
+        gauges: dict = {}
+        hists: dict = {}
+        alerts: set = set()
+        for m in members:
+            for n, (cum, rate) in m.get("counters", {}).items():
+                c = counters.setdefault(n, [0, None])
+                c[0] += cum
+                if rate is not None:
+                    c[1] = rate if c[1] is None else c[1] + rate
+            for n, v in m.get("gauges", {}).items():
+                gauges[n] = v if n not in gauges \
+                    else max(gauges[n], v)
+            for n, (count, total) in m.get("histograms", {}).items():
+                h = hists.setdefault(n, [0, 0.0])
+                h[0] += count
+                h[1] += total
+            alerts.update(m.get("alerts", []))
+        rec = {"ts": max(m.get("ts", 0.0) for m in members),
+               "counters": counters, "gauges": gauges,
+               "histograms": hists}
+        if alerts:
+            rec["alerts"] = sorted(alerts)
+        out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------
+# Prometheus text-format exporter
+# ---------------------------------------------------------------------
+
+def _prom_name(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def prometheus_text(snap: Optional[dict] = None) -> str:
+    """Render a ``stats.snapshot()``-shaped dict (default: a fresh
+    snapshot of the process registry) in Prometheus text format:
+    counters as monotone ``<name>_total``, gauges plain, histograms
+    as CUMULATIVE ``<name>_bucket{le="..."}`` rows plus
+    ``_sum``/``_count`` (the power-of-2 registry buckets become the
+    ``le`` edges; the implicit ``+Inf`` bucket closes the series)."""
+    if snap is None:
+        from . import stats as _stats
+
+        snap = _stats.snapshot()
+    lines: List[str] = []
+    for n, v in sorted(snap.get("counters", {}).items()):
+        pn = _prom_name(n) + "_total"
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {v}")
+    for n, v in sorted(snap.get("gauges", {}).items()):
+        pn = _prom_name(n)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {v}")
+    for n, h in sorted(snap.get("histograms", {}).items()):
+        pn = _prom_name(n)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for edge, cnt in h.get("buckets", []):
+            cum += cnt
+            lines.append(f'{pn}_bucket{{le="{edge:g}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h.get("count", 0)}')
+        lines.append(f"{pn}_sum {h.get('total', 0.0)}")
+        lines.append(f"{pn}_count {h.get('count', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def tick_prometheus_text(tick: dict) -> str:
+    """Prometheus rendering of one (possibly fleet-folded) tick
+    record — counters monotone ``*_total``, gauges plain, histogram
+    pairs as ``_sum``/``_count`` (per-bucket shape lives in the full
+    registry exporter, not the light tick pair)."""
+    lines: List[str] = []
+    for n, (cum, _rate) in sorted(tick.get("counters", {}).items()):
+        pn = _prom_name(n) + "_total"
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {cum}")
+    for n, v in sorted(tick.get("gauges", {}).items()):
+        pn = _prom_name(n)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {v}")
+    for n, (count, total) in sorted(
+            tick.get("histograms", {}).items()):
+        pn = _prom_name(n)
+        lines.append(f"# TYPE {pn} histogram")
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{pn}_sum {total}")
+        lines.append(f"{pn}_count {count}")
+    return "\n".join(lines) + "\n"
+
+
+class TelemetryServer:
+    """Stdlib HTTP scrape endpoint: a daemon ``ThreadingHTTPServer``
+    answering every GET with ``render()`` as
+    ``text/plain; version=0.0.4`` (the Prometheus exposition type).
+    ``port=0`` binds an ephemeral port (tests); ``.port`` reports the
+    bound one."""
+
+    def __init__(self, port: int,
+                 render: Optional[Callable[[], str]] = None,
+                 host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler
+        from http.server import ThreadingHTTPServer
+
+        render = render if render is not None else prometheus_text
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                try:
+                    body = render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:  # a failed render must not
+                    # kill the serve thread
+                    try:
+                        self.send_error(500, str(e)[:100])
+                    except Exception:
+                        pass
+
+            def log_message(self, *a):  # silence per-scrape stderr
+                pass
+
+        self._srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name=f"telemetry-http-{self.port}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def start_http_server(port: Optional[int] = None,
+                      render: Optional[Callable[[], str]] = None
+                      ) -> Optional[TelemetryServer]:
+    """Start the scrape endpoint on ``port`` (default
+    ``FLAGS_telemetry_port``; None is returned when that is 0 — the
+    no-exporter default). ``render`` defaults to the full-registry
+    Prometheus text; FleetRouter passes its fleet-fold renderer."""
+    if port is None:
+        port = int(_flag("telemetry_port", 0))
+        if port <= 0:
+            return None
+    return TelemetryServer(int(port), render)

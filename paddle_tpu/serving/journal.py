@@ -73,12 +73,16 @@ __all__ = ["FlightRecorder", "LIFECYCLE_EVENTS", "chrome_trace",
 #: ``pages``), ``drain`` = this replica entering/finishing its drain;
 #: each lands in the DESTINATION (failover/migrate) or draining
 #: replica's journal, and replica journals export with pid = replica
-#: id so tools/trace_merge.py folds a fleet serve into one timeline)
+#: id so tools/trace_merge.py folds a fleet serve into one timeline;
+#: ISSUE 16 adds ``alert`` — a telemetry alert-rule transition
+#: (extras ``name``/``metric``/``state`` firing|resolved/``value``/
+#: ``threshold``, from profiler/alerts.py), rid/slot = -1 since an
+#: alert belongs to the serve, not one request)
 LIFECYCLE_EVENTS = (
     "submit", "queued", "admitted", "prefill_chunk", "first_token",
     "decode", "spec_verify", "preempt", "requeue", "stall",
     "evict_trigger", "fault", "retry", "watchdog",
-    "failover", "migrate", "drain",
+    "failover", "migrate", "drain", "alert",
     "finish", "error", "deadline_exceeded", "shed",
 )
 

@@ -282,6 +282,10 @@ class FleetRouter:
         self._dispatch_lock = threading.Lock()
         self._stop = False
         self._monitor: Optional[threading.Thread] = None
+        #: per-replica TimeSeriesSamplers + the fleet scrape endpoint
+        #: (``telemetry_samplers`` / ``start_telemetry``, ISSUE 16)
+        self._samplers = None
+        self._telemetry_srv = None
         #: walk the missed-beat state machine in ``check_health``.
         #: OFF in synchronous mode — one driver steps the replicas
         #: sequentially, so "replica 0 missed beats" only means the
@@ -644,6 +648,7 @@ class FleetRouter:
         if not eng.can_migrate():
             return False
         req = eng._slots[i]
+        tm0 = _faults.now()
         blob = eng.export_slot(i)
         for dest in self._dispatchable(exclude={src.idx}):
             if not dest.eng.can_migrate():
@@ -657,6 +662,11 @@ class FleetRouter:
             eng._release(i)
             _stats.inc("fleet.migrations")
             _stats.inc("fleet.migrated_pages", blob["n_pages"])
+            # the migration phase of serving-time attribution: export
+            # through release, stamped via the clock seam (failed
+            # attempts are not a phase — nothing moved)
+            _stats.observe("serve.step.migration_ms",
+                           (_faults.now() - tm0) * 1e3)
             jr = dest.eng.journal
             if jr is not None:
                 jr.record("migrate", req.id, j,
@@ -781,3 +791,71 @@ class FleetRouter:
                                         process_index=rep.idx), f)
             paths.append(p)
         return paths
+
+    # ---------------- continuous telemetry (ISSUE 16) ----------------
+
+    def telemetry_samplers(self, interval_ms: Optional[float] = None,
+                           window: Optional[int] = None, clock=None):
+        """One :class:`profiler.timeseries.TimeSeriesSampler` PER
+        REPLICA, each reading its engine's live state directly
+        (``engine_source`` — the process-wide stats registry is shared
+        by every replica, so per-replica series must come from the
+        engine objects). Built once; repeated calls return the same
+        samplers so folds and exporters see one history."""
+        from ..profiler.timeseries import TimeSeriesSampler
+        from ..profiler.timeseries import engine_source
+
+        if self._samplers is None:
+            self._samplers = [
+                TimeSeriesSampler(interval_ms=interval_ms,
+                                  window=window, clock=clock,
+                                  source=engine_source(rep.eng),
+                                  enabled=True)
+                for rep in self.replicas]
+        return self._samplers
+
+    def telemetry_tick(self) -> None:
+        """Sample every replica once (synchronous drives; threaded
+        serves use ``start_telemetry`` instead)."""
+        for s in self.telemetry_samplers():
+            s.tick()
+
+    def fleet_series(self):
+        """The FLEET-LEVEL series: per-replica ticks folded with the
+        trace_merge semantics (counters SUM — replica completions add
+        exactly; gauges MAX; histogram pairs SUM)."""
+        from ..profiler.timeseries import aggregate_ticks
+
+        return aggregate_ticks(
+            [s.ticks() for s in self.telemetry_samplers()])
+
+    def start_telemetry(self, port: Optional[int] = None,
+                        interval_ms: Optional[float] = None):
+        """Start the per-replica background samplers and (when
+        ``port`` / ``FLAGS_telemetry_port`` is nonzero) ONE scrape
+        endpoint serving the fleet fold's latest tick alongside the
+        full process registry — N replicas, one port. Returns the
+        :class:`profiler.timeseries.TelemetryServer` or None."""
+        from ..profiler import timeseries as _ts
+
+        for s in self.telemetry_samplers(interval_ms=interval_ms):
+            s.start()
+
+        def render():
+            series = self.fleet_series()
+            return _ts.tick_prometheus_text(series[-1]) \
+                if series else ""
+
+        if self._telemetry_srv is None:
+            self._telemetry_srv = _ts.start_http_server(port, render)
+        return self._telemetry_srv
+
+    def stop_telemetry(self) -> None:
+        """Stop the samplers (one final tick each) and the endpoint;
+        the rings stay readable (``fleet_series`` still folds)."""
+        if self._samplers is not None:
+            for s in self._samplers:
+                s.stop()
+        if self._telemetry_srv is not None:
+            self._telemetry_srv.stop()
+            self._telemetry_srv = None

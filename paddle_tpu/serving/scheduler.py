@@ -385,7 +385,15 @@ class ServingEngine(ContinuousBatchingEngine):
         CRASH-ISOLATED: an exception inside admission or either chunk
         retries with capped exponential backoff and then errors out
         only the offending request (``_recover_*``); the loop keeps
-        serving everyone else. Returns requests finished this step."""
+        serving everyone else. Returns requests finished this step.
+
+        Each completed step's wall time is ATTRIBUTED into phase
+        histograms via the clock seam (``serve.step.{admit,
+        prefill_chunk,decode_chunk,spec_verify}_ms`` plus the
+        ``host_overhead_ms`` residual — see ``_observe_step``);
+        recovery early-returns skip attribution so the phase sums
+        stay an exact partition of the observed ``total_ms``."""
+        ts0 = _faults.now()
         self._drain_inbox()
         self._expire_deadlines()
         try:
@@ -396,6 +404,7 @@ class ServingEngine(ContinuousBatchingEngine):
             len(self.waiting) + len(self._inbox), self.num_active,
             len(self._prefilling), self.max_batch)
         self._watchdog_tick()
+        ts_admit = _faults.now()
         action = self._pick_action()
         if action == "prefill":
             self.action_log.append("prefill")
@@ -406,8 +415,11 @@ class ServingEngine(ContinuousBatchingEngine):
             tgt, self._prefill_active = self._prefill_active, None
             if tgt is not None:
                 tgt[0].n_retries = 0  # chunk landed — budget restored
+            self._observe_step(ts0, ts_admit, _faults.now(),
+                               "prefill_chunk")
             return out
         if self.num_active == 0:
+            self._observe_step(ts0, ts_admit, ts_admit, None)
             return []
         self.action_log.append("decode")
         before = [(r, len(r.generated))
@@ -418,6 +430,7 @@ class ServingEngine(ContinuousBatchingEngine):
         except Exception as e:
             return self._recover_decode(e)
         self._decode_retries = 0
+        ts_work = _faults.now()
         dt_ms = (time.perf_counter() - t0) * 1e3
         for req, n0 in before:
             emitted = len(req.generated) - n0
@@ -430,7 +443,34 @@ class ServingEngine(ContinuousBatchingEngine):
             gap = dt_ms / emitted
             for _ in range(emitted):
                 _stats.observe("serve.tpot_ms", gap)
+        self._observe_step(ts0, ts_admit, ts_work,
+                           "spec_verify"
+                           if getattr(self, "_spec", None) is not None
+                           else "decode_chunk")
         return done
+
+    def _observe_step(self, ts0, ts_admit, ts_work, phase):
+        """Per-step serving-time attribution (continuous-telemetry
+        tentpole): split the step's wall clock into admit (drain +
+        deadline sweep + admission + watchdog), the work phase
+        (prefill_chunk / decode_chunk / spec_verify when speculation
+        drives decode; migration is timed by the router around slot
+        export/import), and host_overhead — the RESIDUAL between the
+        work phase's end and step exit (token bookkeeping, tpot
+        observes, finish hooks). admit + phase + host_overhead ==
+        total EXACTLY per step, so the histograms answer "where did
+        the step go" with no unaccounted remainder. All stamps come
+        from the clock seam — ManualClock tests see exact values."""
+        if not _stats.is_enabled():
+            return
+        ts_end = _faults.now()
+        _stats.observe("serve.step.admit_ms", (ts_admit - ts0) * 1e3)
+        if phase is not None:
+            _stats.observe("serve.step.%s_ms" % phase,
+                           (ts_work - ts_admit) * 1e3)
+        _stats.observe("serve.step.host_overhead_ms",
+                       (ts_end - ts_work) * 1e3)
+        _stats.observe("serve.step.total_ms", (ts_end - ts0) * 1e3)
 
     def _finish_hook(self, req, slot: int):
         """Serving finish path (called from the engine the moment a

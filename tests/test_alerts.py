@@ -1,0 +1,268 @@
+"""Fleet alerting (ISSUE 16): rule engine semantics on scripted
+telemetry trajectories, journal integration, and the serve_top
+history rendering.
+
+Tier-1 acceptance pins:
+
+- the burn-rate rule FIRES after 3 sustained breach ticks and
+  RESOLVES on the first clear tick of a scripted SLO trajectory,
+  with both transitions journaled as ``alert`` lifecycle events and
+  counted under ``alert.{fired,resolved}``
+  (``TestBurnRateTrajectory``);
+- metric-name thresholds (``hbm.bytes_in_use > 0.9 *
+  hbm.bytes_limit``, ``fleet.replicas_alive < fleet.replicas``) and
+  the preemption rate-spike rule (``TestRuleKinds``);
+- ``serve_top --history`` renders sparklines + alert markers from a
+  series dump, and ``serve_top`` folds journal alert events into the
+  dashboard (``TestServeTopHistory``).
+"""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+from paddle_tpu.profiler import (AlertEngine, Rule, TimeSeriesSampler,
+                                 default_rules, stats)
+from paddle_tpu.serving import ManualClock
+from paddle_tpu.serving.journal import FlightRecorder
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import serve_top  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    stats.enable()
+    stats.reset()
+    yield
+    stats.reset()
+
+
+class _Scripted:
+    """A tick source driven by a scripted value sequence."""
+
+    def __init__(self, **series):
+        self.series = series
+        self.i = -1
+
+    def __call__(self):
+        self.i = min(self.i + 1, max(len(v) for v in
+                                     self.series.values()) - 1)
+        return ({}, {k: v[min(self.i, len(v) - 1)]
+                     for k, v in self.series.items()}, {})
+
+
+def _drive(source, rules, journal=None, n=None):
+    clk = ManualClock()
+    eng = AlertEngine(rules, journal=journal)
+    s = TimeSeriesSampler(interval_ms=100, window=64, clock=clk,
+                          source=source, enabled=True)
+    s.attach_alerts(eng)
+    n = n if n is not None else max(len(v) for v
+                                    in source.series.values())
+    for _ in range(n):
+        s.tick()
+        clk.advance(0.1)
+    return s, eng
+
+
+class TestRuleValidation:
+    def test_bad_op_kind_for_ticks(self):
+        with pytest.raises(ValueError):
+            Rule("r", "m", op=">=")
+        with pytest.raises(ValueError):
+            Rule("r", "m", kind="derivative")
+        with pytest.raises(ValueError):
+            Rule("r", "m", for_ticks=0)
+
+    def test_default_rules_cover_the_issue_set(self):
+        names = {r.name for r in default_rules()}
+        assert {"slo-burn", "hbm-pressure", "preemption-spike",
+                "fleet-replica-down"} <= names
+        lit = next(r for r in default_rules(3)
+                   if r.name == "fleet-replica-down")
+        assert lit.threshold == 3.0
+
+
+class TestBurnRateTrajectory:
+    def test_fires_after_sustained_window_and_resolves(self):
+        """The scripted SLO trajectory: healthy -> 4 breach ticks ->
+        recovery. for_ticks=3 means tick index 4 (the 3rd consecutive
+        breach) fires; the first clear tick resolves."""
+        jr = FlightRecorder()
+        src = _Scripted(**{"slo.burn_rate":
+                           [0.5, 1.0, 3.0, 3.5, 4.0, 3.0, 0.5, 0.5]})
+        rules = [Rule("slo-burn", "slo.burn_rate", ">", 2.0,
+                      for_ticks=3)]
+        s, eng = _drive(src, rules, journal=jr)
+        assert [h["state"] for h in eng.history] \
+            == ["firing", "resolved"]
+        assert eng.active == {}
+        # the fire tick is the 3rd consecutive breach (index 4), the
+        # resolve tick the first clear one (index 6)
+        marks = [bool(t.get("alerts")) for t in s.ticks()]
+        assert marks == [False, False, False, False,
+                         True, True, False, False]
+        assert stats.counter("alert.fired").value == 1
+        assert stats.counter("alert.resolved").value == 1
+        assert stats.gauge("alert.active").value == 0
+        evs = [e for e in jr.events() if e["ev"] == "alert"]
+        assert [e["state"] for e in evs] == ["firing", "resolved"]
+        assert evs[0]["name"] == "slo-burn"
+        assert evs[0]["value"] == pytest.approx(4.0)
+        assert evs[0]["threshold"] == pytest.approx(2.0)
+        assert evs[0]["rid"] == -1
+
+    def test_streak_resets_on_clear_tick(self):
+        src = _Scripted(**{"slo.burn_rate":
+                           [3.0, 3.0, 0.5, 3.0, 3.0, 0.5] * 2})
+        rules = [Rule("slo-burn", "slo.burn_rate", ">", 2.0,
+                      for_ticks=3)]
+        _s, eng = _drive(src, rules)
+        assert eng.history == []  # never 3 consecutive breaches
+
+    def test_absent_metric_never_breaches(self):
+        src = _Scripted(**{"other.gauge": [1.0, 1.0, 1.0]})
+        _s, eng = _drive(src, [Rule("r", "slo.burn_rate", ">", 0.0)])
+        assert eng.history == [] and eng.active == {}
+
+
+class TestRuleKinds:
+    def test_metric_name_threshold_hbm(self):
+        src = _Scripted(**{"hbm.bytes_in_use":
+                           [100.0, 800.0, 950.0, 500.0],
+                           "hbm.bytes_limit": [1000.0] * 4})
+        rules = [Rule("hbm", "hbm.bytes_in_use", ">",
+                      "hbm.bytes_limit", scale=0.9)]
+        _s, eng = _drive(src, rules)
+        assert [h["state"] for h in eng.history] \
+            == ["firing", "resolved"]
+        assert eng.history[0]["threshold"] == pytest.approx(900.0)
+
+    def test_replica_down_vs_registered_fleet_size(self):
+        src = _Scripted(**{"fleet.replicas_alive":
+                           [2.0, 2.0, 1.0, 1.0, 2.0],
+                           "fleet.replicas": [2.0] * 5})
+        rules = [r for r in default_rules()
+                 if r.name == "fleet-replica-down"]
+        s, eng = _drive(src, rules)
+        assert [h["state"] for h in eng.history] \
+            == ["firing", "resolved"]
+        # active while a replica is down
+        assert [bool(t.get("alerts")) for t in s.ticks()] \
+            == [False, False, True, True, False]
+
+    def test_rate_spike_rule(self):
+        clk = ManualClock()
+        eng = AlertEngine([Rule("spike", "serving.preemptions", ">",
+                                kind="spike", scale=3.0)])
+        s = TimeSeriesSampler(interval_ms=100, window=64, clock=clk,
+                              enabled=True).attach_alerts(eng)
+        # steady 1 preemption/s for 5 ticks, then a 20x burst
+        for _ in range(5):
+            stats.inc("serving.preemptions", 1)
+            s.tick()
+            clk.advance(1.0)
+        assert eng.active == {}
+        stats.inc("serving.preemptions", 20)
+        s.tick()
+        assert "spike" in eng.active
+        clk.advance(1.0)
+        stats.inc("serving.preemptions", 1)
+        s.tick()
+        assert eng.active == {}
+        assert [h["state"] for h in eng.history] \
+            == ["firing", "resolved"]
+
+    def test_less_than_op(self):
+        src = _Scripted(**{"slo.goodput": [0.99, 0.5, 0.99]})
+        _s, eng = _drive(src, [Rule("low", "slo.goodput", "<", 0.9)])
+        assert [h["state"] for h in eng.history] \
+            == ["firing", "resolved"]
+
+
+class TestServeTopHistory:
+    def _dump(self, tmp_path):
+        clk = ManualClock()
+        jr = FlightRecorder()
+        eng = AlertEngine([Rule("slo-burn", "slo.burn_rate", ">",
+                                2.0, for_ticks=2)], journal=jr)
+        src = _Scripted(**{
+            "slo.burn_rate": [1.0, 3.0, 3.0, 3.0, 1.0, 1.0],
+            "slo.goodput": [0.99, 0.7, 0.6, 0.6, 0.95, 0.99],
+            "slo.queue_depth": [0, 4, 6, 5, 1, 0]})
+        s = TimeSeriesSampler(interval_ms=100, window=64, clock=clk,
+                              source=src, enabled=True)
+        s.attach_alerts(eng)
+        for _ in range(6):
+            s.tick()
+            clk.advance(0.1)
+        p = str(tmp_path / "series.jsonl")
+        s.dump_jsonl(p)
+        jp = str(tmp_path / "journal.jsonl")
+        jr.dump_jsonl(jp)
+        return p, jp
+
+    def test_render_history_sparklines_and_alert_marks(self, tmp_path):
+        p, _ = self._dump(tmp_path)
+        ticks = serve_top._ts_mod().load_jsonl(p)
+        out = serve_top.render_history(ticks)
+        assert "goodput" in out and "burn_rate" in out
+        assert "queue" in out
+        assert "slo-burn" in out  # fired-in-window listing
+        alert_row = next(ln for ln in out.splitlines()
+                         if "alerts" in ln)
+        assert "!" in alert_row
+
+    def test_history_cli(self, tmp_path, capsys):
+        p, _ = self._dump(tmp_path)
+        assert serve_top.main(["--history", p]) == 0
+        out = capsys.readouterr().out
+        assert "serve_top --history" in out and "goodput" in out
+
+    def test_journal_alerts_in_dashboard(self, tmp_path):
+        _, jp = self._dump(tmp_path)
+        jm = serve_top._journal_mod()
+        events, _extras = jm.load_jsonl(jp)
+        s = serve_top.summarize(events)
+        assert s["alerts_fired"] == 1 and s["alerts_resolved"] == 1
+        assert s["alerts_active"] == []
+        out = serve_top.render(s)
+        assert "alerts: fired 1  resolved 1" in out
+
+    def test_sparkline_scaling(self):
+        assert serve_top.sparkline([0.0, 1.0], lo=0.0, hi=1.0) \
+            == "▁█"
+        assert serve_top.sparkline([None, 0.5], lo=0.0, hi=1.0)[0] \
+            == " "
+        assert serve_top.sparkline([]) == ""
+
+    def test_watch_loop_manual_clock_no_sleep(self):
+        import io
+
+        clk = ManualClock()
+        frames = []
+
+        def render_once():
+            frames.append(clk.now())
+            return f"frame@{clk.now()}"
+
+        buf = io.StringIO()
+        rc = serve_top._watch_loop(render_once, 2.0, clk=clk,
+                                   max_iters=3, out=buf)
+        assert rc == 0
+        assert frames == [0.0, 2.0, 4.0]  # cadence via the seam
+        # clear-THEN-draw per frame, stable layout
+        assert buf.getvalue().count("\033[2J\033[H") == 3
+
+    def test_watch_loop_renders_once_without_interval(self):
+        import io
+
+        buf = io.StringIO()
+        rc = serve_top._watch_loop(lambda: "once", 0.0,
+                                   clk=ManualClock(), out=buf)
+        assert rc == 0
+        assert buf.getvalue() == "once\n"  # no clear codes one-shot

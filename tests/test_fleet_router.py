@@ -720,10 +720,14 @@ class TestFleetTooling:
         assert DEFAULT_METRICS["fleet_chaos_request_errors"] == "up"
         assert DEFAULT_METRICS["fleet_failovers"] == "up"
 
-    def test_serve_bench_fleet_chaos_cli(self):
+    def test_serve_bench_fleet_chaos_cli(self, tmp_path):
         """CPU CLI smoke of the fleet bench WITH the chaos pins: the
         bench itself exits nonzero if the zero-loss failover, parity,
-        goodput-bound, or site-coverage pins fail."""
+        goodput-bound, or site-coverage pins fail.  ISSUE 16 rides the
+        same run: ``--telemetry-out`` dumps the time series, and the
+        chaos re-drive's replica kill must show up as a fired
+        ``fleet-replica-down`` alert in the ``.chaos`` dump."""
+        tele = str(tmp_path / "fleet.jsonl")
         proc = subprocess.run(
             [sys.executable,
              os.path.join(_REPO, "tools", "serve_bench.py"),
@@ -733,7 +737,9 @@ class TestFleetTooling:
              "--max-new", "4", "--prefill-chunk", "8",
              "--decode-chunk", "2", "--d-model", "32",
              "--layers", "1", "--heads", "2", "--vocab", "64",
-             "--rate", "200", "--chaos", "--no-lint"],
+             "--rate", "200", "--chaos", "--no-lint",
+             "--telemetry-out", tele,
+             "--telemetry-interval-ms", "20"],
             capture_output=True, text=True, timeout=420,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert proc.returncode == 0, \
@@ -750,3 +756,16 @@ class TestFleetTooling:
         assert doc["fleet_chaos_replicas_dead"] == 1
         assert doc["fleet_chaos_failovers"] >= 1
         assert len(doc["fleet_chaos_sites_fired"]) >= 5
+        # the injected kill fired the replica-down alert and the
+        # series dumps landed on disk
+        assert doc["fleet_chaos_alert_fired"] >= 1
+        assert doc["telemetry_ticks"] >= 1
+        assert os.path.exists(tele)
+        with open(tele + ".chaos") as f:
+            ticks = [json.loads(ln) for ln in f if ln.strip()]
+        alert_ticks = [t for t in ticks
+                       if "fleet-replica-down" in t.get("alerts", ())]
+        assert alert_ticks, "replica kill never reached the sampler"
+        # the killed replica stays dead, so the alert is still firing
+        # at the sampler's final (stop-time) tick
+        assert "fleet-replica-down" in ticks[-1].get("alerts", ())

@@ -1,0 +1,230 @@
+"""Per-step serving-time attribution + fleet telemetry (ISSUE 16).
+
+Tier-1 acceptance pins:
+
+- on a real ServingEngine run the ``serve.step.*_ms`` phase
+  histograms PARTITION the step wall time exactly: admit + work
+  phase + host-overhead residual == total, step for step
+  (``TestAttribution``);
+- the spec-verify and migration phases appear EXACTLY when
+  speculation / a drain migration is active
+  (``TestPhasePresence``);
+- ``FleetRouter`` telemetry: per-replica samplers fold into one
+  fleet series whose counters sum the replicas' exactly, served on
+  one Prometheus port (``TestFleetTelemetry``).
+"""
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import FusedCausalLM
+from paddle_tpu.profiler import stats
+from paddle_tpu.serving import (FleetRouter, ServingEngine,
+                                SLOConfig)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    stats.enable()
+    stats.reset()
+    yield
+    stats.reset()
+
+
+def _model(seed=7):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=64, embed_dim=32, num_heads=4,
+                         dim_feedforward=64, num_layers=2,
+                         max_position=256)
+
+
+def _engine(seed=7, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_length", 96)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("slo", SLOConfig(prefill_chunk=8))
+    return ServingEngine(_model(seed), **kw)
+
+
+def _prompts(n=3):
+    rng = np.random.RandomState(0)
+    return [rng.randint(0, 64, (L,)) for L in (6, 10, 14)[:n]]
+
+
+def _phase_hists():
+    snap = stats.snapshot(prefix="serve.step.")
+    return snap["histograms"]
+
+
+class TestAttribution:
+    def test_phases_partition_step_wall_time(self):
+        eng = _engine()
+        for p in _prompts():
+            eng.submit(p, max_new_tokens=6)
+        eng.run()
+        h = _phase_hists()
+        total = h["serve.step.total_ms"]
+        admit = h["serve.step.admit_ms"]
+        host = h["serve.step.host_overhead_ms"]
+        # every completed step observed all three framing stamps
+        assert total["count"] == admit["count"] == host["count"] > 0
+        work = sum(h[n]["total"] for n in
+                   ("serve.step.prefill_chunk_ms",
+                    "serve.step.decode_chunk_ms") if n in h)
+        # the partition: admit + work + host == total (exact stamps,
+        # float-summation tolerance only)
+        assert admit["total"] + work + host["total"] \
+            == pytest.approx(total["total"], rel=1e-6, abs=1e-6)
+        # both work phases ran on this mixed load
+        assert h["serve.step.prefill_chunk_ms"]["count"] > 0
+        assert h["serve.step.decode_chunk_ms"]["count"] > 0
+        # work-phase steps never exceed total steps
+        assert (h["serve.step.prefill_chunk_ms"]["count"]
+                + h["serve.step.decode_chunk_ms"]["count"]) \
+            <= total["count"]
+
+    def test_disabled_stats_records_nothing(self):
+        stats.disable()
+        try:
+            eng = _engine()
+            eng.submit(_prompts(1)[0], max_new_tokens=4)
+            eng.run()
+            assert _phase_hists() == {}
+        finally:
+            stats.enable()
+
+    def test_recovery_steps_skip_attribution(self):
+        """A step that dies in its work phase early-returns through
+        ``_recover_*`` WITHOUT observing — so the partition invariant
+        holds over the completed steps even under faults."""
+        from paddle_tpu.serving import FaultInjector
+
+        inj = (FaultInjector(seed=1)
+               .add("decode.step", kind="raise", at=1)
+               .add("prefill.dispatch", kind="raise", at=1))
+        eng = _engine(faults=inj)
+        for p in _prompts():
+            eng.submit(p, max_new_tokens=6)
+        eng.run()
+        h = _phase_hists()
+        total = h["serve.step.total_ms"]
+        work = sum(h[n]["total"] for n in
+                   ("serve.step.prefill_chunk_ms",
+                    "serve.step.decode_chunk_ms") if n in h)
+        assert h["serve.step.admit_ms"]["total"] + work \
+            + h["serve.step.host_overhead_ms"]["total"] \
+            == pytest.approx(total["total"], rel=1e-6, abs=1e-6)
+
+
+class TestPhasePresence:
+    def test_spec_verify_phase_exactly_when_speculative(self):
+        eng = _engine()
+        for p in _prompts():
+            eng.submit(p, max_new_tokens=6)
+        eng.run()
+        h = _phase_hists()
+        assert "serve.step.spec_verify_ms" not in h
+        assert h["serve.step.decode_chunk_ms"]["count"] > 0
+
+        stats.reset()
+        spec = ServingEngine(
+            _model(), max_batch=2, page_size=4, max_length=96,
+            slo=SLOConfig(prefill_chunk=8), speculative="self",
+            spec_k=3)
+        for p in _prompts():
+            spec.submit(p, max_new_tokens=6)
+        spec.run()
+        h = _phase_hists()
+        # speculation owns the decode slot: its verify rounds land in
+        # the spec_verify phase, never decode_chunk
+        assert h["serve.step.spec_verify_ms"]["count"] > 0
+        assert "serve.step.decode_chunk_ms" not in h
+        total = h["serve.step.total_ms"]
+        work = sum(h[n]["total"] for n in
+                   ("serve.step.prefill_chunk_ms",
+                    "serve.step.spec_verify_ms") if n in h)
+        assert h["serve.step.admit_ms"]["total"] + work \
+            + h["serve.step.host_overhead_ms"]["total"] \
+            == pytest.approx(total["total"], rel=1e-6, abs=1e-6)
+
+    def test_migration_phase_exactly_when_draining(self):
+        router = FleetRouter(
+            engine_factory=lambda i: _engine(), n_replicas=2)
+        rid = router.submit(_prompts(2)[1], max_new_tokens=8)
+        steps = 0
+        while True:
+            router.step()
+            steps += 1
+            assert steps < 500
+            req = router.results()[rid]
+            if len(req.generated) >= 2 and not req.done:
+                break
+        assert "serve.step.migration_ms" not in _phase_hists()
+        src = next(r.idx for r in router.replicas
+                   if r.eng.num_active)
+        router.drain(src)
+        h = _phase_hists()
+        assert h["serve.step.migration_ms"]["count"] \
+            == stats.counter("fleet.migrations").value == 1
+        router.run()
+
+
+class TestFleetTelemetry:
+    def _loaded_router(self, n_reqs=4):
+        router = FleetRouter(
+            engine_factory=lambda i: _engine(), n_replicas=2,
+            policy="rr")
+        for p in _prompts(2) * (n_reqs // 2):
+            router.submit(p, max_new_tokens=4)
+        router.run()
+        return router
+
+    def test_fleet_series_sums_replica_counters_exactly(self):
+        router = self._loaded_router()
+        router.telemetry_tick()
+        samplers = router.telemetry_samplers()
+        assert len(samplers) == 2
+        per_replica = [s.cum("serve.finished") for s in samplers]
+        assert per_replica == [len(r.eng.finished)
+                               for r in router.replicas]
+        assert all(v > 0 for v in per_replica)  # rr spread the load
+        fleet = router.fleet_series()
+        assert fleet[-1]["counters"]["serve.finished"][0] \
+            == sum(per_replica)
+        # gauges fold by MAX
+        assert fleet[-1]["gauges"]["slo.slot_occupancy"] \
+            == max(s.value("slo.slot_occupancy") for s in samplers)
+
+    def test_fleet_prometheus_endpoint_one_port(self):
+        router = self._loaded_router()
+        router.telemetry_tick()
+        srv = router.start_telemetry(port=0)
+        try:
+            assert srv is not None
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            body = urllib.request.urlopen(url, timeout=10) \
+                .read().decode()
+            total = sum(len(r.eng.finished) for r in router.replicas)
+            assert f"serve_finished_total {total}" in body
+        finally:
+            router.stop_telemetry()
+        assert router._telemetry_srv is None
+
+    def test_engine_source_reads_live_state(self):
+        from paddle_tpu.profiler.timeseries import engine_source
+
+        eng = _engine()
+        counters, gauges, hists = engine_source(eng)()
+        assert counters["serve.finished"] == 0
+        assert gauges["slo.queue_depth"] == 0
+        assert hists == {}
+        eng.submit(_prompts(1)[0], max_new_tokens=4)
+        eng.run()
+        counters, gauges, _ = engine_source(eng)()
+        assert counters["serve.finished"] == 1
+        assert counters["journal.events"] > 0

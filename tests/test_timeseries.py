@@ -1,0 +1,387 @@
+"""Continuous telemetry (ISSUE 16): TimeSeriesSampler determinism,
+disabled-path zero cost, registry snapshot/reset concurrency, and the
+Prometheus / JSONL / fleet-fold exporters.
+
+Tier-1 acceptance pins:
+
+- deterministic ManualClock sampling: exact counter-delta rates and
+  window aggregates (``TestDeterministicSampling``);
+- disabled path allocates NO rings and records nothing, with a
+  measured per-tick overhead bound on the enabled path
+  (``TestDisabledAndOverhead``);
+- ``stats.snapshot()``/``reset()`` stay consistent against a
+  concurrent sampler thread — no torn histogram reads, definitions
+  intact after reset (``TestSnapshotResetConcurrency``);
+- the Prometheus endpoint serves a parseable text scrape with
+  monotone counters and cumulative buckets, and ``aggregate_ticks``
+  sums replica counters exactly (``TestExporters``).
+"""
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from paddle_tpu.profiler import TimeSeriesSampler, stats, timeseries
+from paddle_tpu.serving import ManualClock
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    stats.enable()
+    stats.reset()
+    yield
+    stats.reset()
+
+
+def _sampler(clk=None, window=64, interval_ms=100.0, **kw):
+    return TimeSeriesSampler(interval_ms=interval_ms, window=window,
+                             clock=clk or ManualClock(), **kw)
+
+
+# =====================================================================
+# deterministic sampling on a ManualClock
+# =====================================================================
+
+class TestDeterministicSampling:
+    def test_counter_delta_rates_exact(self):
+        clk = ManualClock()
+        s = _sampler(clk)
+        stats.inc("t.events", 10)
+        s.tick()
+        assert s.rate("t.events") is None  # no previous tick
+        clk.advance(2.0)
+        stats.inc("t.events", 100)
+        s.tick()
+        assert s.rate("t.events") == pytest.approx(50.0)
+        assert s.cum("t.events") == 110
+        clk.advance(0.5)
+        stats.inc("t.events", 5)
+        s.tick()
+        assert s.rate("t.events") == pytest.approx(10.0)
+        pts = s.series("t.events")
+        assert [p[1] for p in pts] == [10, 110, 115]
+        assert [p[0] for p in pts] == [0.0, 2.0, 2.5]
+
+    def test_gauge_levels_and_window_aggregates(self):
+        clk = ManualClock()
+        s = _sampler(clk)
+        for v in (0.5, 0.9, 0.7, 0.1):
+            stats.set_gauge("t.level", v)
+            s.tick()
+            clk.advance(1.0)
+        agg = s.aggregate("t.level")
+        assert agg["min"] == pytest.approx(0.1)
+        assert agg["max"] == pytest.approx(0.9)
+        assert agg["mean"] == pytest.approx(0.55)
+        assert agg["p99"] == pytest.approx(0.9)
+        assert agg["last"] == pytest.approx(0.1)
+        assert agg["n"] == 4
+
+    def test_counter_aggregate_is_over_rates(self):
+        clk = ManualClock()
+        s = _sampler(clk)
+        for d in (10, 20, 40):
+            stats.inc("t.c", d)
+            s.tick()
+            clk.advance(1.0)
+        agg = s.aggregate("t.c")
+        # rates: first tick has none; then +20/1s, +40/1s
+        assert agg["n"] == 2
+        assert agg["min"] == pytest.approx(20.0)
+        assert agg["max"] == pytest.approx(40.0)
+
+    def test_histogram_count_total_pairs(self):
+        clk = ManualClock()
+        s = _sampler(clk)
+        stats.observe("t.h_ms", 2.0)
+        stats.observe("t.h_ms", 4.0)
+        s.tick()
+        ts, count, total = s.series("t.h_ms")[-1]
+        assert (count, total) == (2, 6.0)
+
+    def test_window_is_bounded(self):
+        clk = ManualClock()
+        s = _sampler(clk, window=8)
+        for _ in range(50):
+            stats.inc("t.c")
+            s.tick()
+            clk.advance(1.0)
+        assert len(s.series("t.c")) == 8
+        assert len(s.ticks()) == 8
+
+    def test_sampler_accounts_itself(self):
+        s = _sampler()
+        stats.inc("t.c")
+        s.tick()
+        s.tick()
+        assert stats.counter("telemetry.ticks").value == 2
+        assert stats.histogram("telemetry.tick_us").count == 2
+
+    def test_sample_values_prefix_filter(self):
+        stats.inc("t.a")
+        stats.set_gauge("serving.x", 3)
+        stats.observe("t.h", 1.0)
+        counters, gauges, hists = stats.sample_values(prefix="t.")
+        assert "t.a" in counters and "t.h" in hists
+        assert "serving.x" not in gauges
+
+
+# =====================================================================
+# disabled path + overhead bound
+# =====================================================================
+
+class TestDisabledAndOverhead:
+    def test_disabled_records_nothing(self):
+        s = TimeSeriesSampler(interval_ms=0.0, clock=ManualClock())
+        assert not s.enabled
+        # PR 9 discipline: nothing allocated on the disabled path
+        assert s._counters is None and s._gauges is None
+        assert s._hists is None and s._ticks is None
+        stats.inc("t.c")
+        assert s.tick() is None
+        assert s.ticks() == [] and s.series("t.c") == []
+        assert s.value("t.c") is None and s.aggregate("t.c") is None
+        assert s.metrics() == []
+        assert stats.counter("telemetry.ticks").value == 0
+
+    def test_flag_default_disables(self):
+        # FLAGS_telemetry_interval_ms defaults to 0 -> disabled
+        s = TimeSeriesSampler(clock=ManualClock())
+        assert not s.enabled
+
+    def test_per_tick_overhead_bounded(self):
+        import time as _time
+
+        # a realistically-populated registry
+        for i in range(50):
+            stats.inc(f"t.c{i}", i)
+            stats.set_gauge(f"t.g{i}", i * 0.5)
+            stats.observe(f"t.h{i}", float(i))
+        s = _sampler(window=256)
+        t0 = _time.perf_counter()
+        for _ in range(100):
+            s.tick()
+        per_tick_ms = (_time.perf_counter() - t0) * 1e3 / 100
+        # generous CI bound: one pass over 150 metrics must stay
+        # far below any sane sampling interval
+        assert per_tick_ms < 5.0, per_tick_ms
+        h = stats.histogram("telemetry.tick_us")
+        assert h.count == 100
+        assert h.total / h.count < 5000.0  # mean < 5ms in us
+
+
+# =====================================================================
+# snapshot/reset vs a concurrent sampler thread (satellite 1)
+# =====================================================================
+
+class TestSnapshotResetConcurrency:
+    def test_reset_keeps_definitions(self):
+        c = stats.counter("t.c")
+        g = stats.gauge("t.g")
+        h = stats.histogram("t.h")
+        c.inc(5), g.set(2.0), h.observe(1.0)
+        stats.reset()
+        # the REGISTERED OBJECTS survive reset (series definitions
+        # intact — a sampler holding references keeps publishing)
+        assert stats.counter("t.c") is c and c.value == 0
+        assert stats.gauge("t.g") is g and g.value == 0
+        assert stats.histogram("t.h") is h and h.count == 0
+
+    def test_snapshot_hammer_no_torn_histograms(self):
+        """Writers + a reset thread hammer the registry while the
+        main thread snapshots: every histogram summary must be
+        internally consistent (bucket counts sum to count, avg =
+        total/count) — a torn read breaks that invariant."""
+        stop = threading.Event()
+        errors = []
+
+        def writer(k):
+            while not stop.is_set():
+                stats.observe("t.hot%d" % k, 1.0)
+                stats.inc("t.cnt%d" % k)
+
+        def resetter():
+            while not stop.is_set():
+                stats.reset()
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(3)]
+        threads.append(threading.Thread(target=resetter))
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(200):
+                snap = stats.snapshot(prefix="t.")
+                for name, h in snap["histograms"].items():
+                    n_buckets = sum(n for _, n in h["buckets"])
+                    if n_buckets != h["count"]:
+                        errors.append(
+                            f"{name}: buckets {n_buckets} != "
+                            f"count {h['count']}")
+                    if h["count"] and abs(
+                            h["avg"] - h["total"] / h["count"]) > 1e-6:
+                        errors.append(f"{name}: torn avg")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors, errors[:5]
+
+    def test_sampler_thread_vs_snapshot(self):
+        """A live background sampler plus foreground snapshot/reset
+        — the ISSUE's exact concurrency scenario — runs clean."""
+        s = TimeSeriesSampler(interval_ms=1.0, window=32,
+                              enabled=True)
+        s.start()
+        try:
+            for i in range(50):
+                stats.inc("t.c", 2)
+                stats.observe("t.h", float(i))
+                snap = stats.snapshot(prefix="t.")
+                for h in snap["histograms"].values():
+                    assert sum(n for _, n in h["buckets"]) \
+                        == h["count"]
+                if i % 10 == 9:
+                    stats.reset()
+        finally:
+            s.stop()
+        assert s.n_ticks > 0
+
+
+# =====================================================================
+# exporters: JSONL round-trip, fleet fold, Prometheus
+# =====================================================================
+
+class TestExporters:
+    def test_dump_load_round_trip_appends(self, tmp_path):
+        clk = ManualClock()
+        s = _sampler(clk)
+        stats.inc("t.c", 3)
+        s.tick()
+        p = str(tmp_path / "series.jsonl")
+        s.dump_jsonl(p)
+        clk.advance(1.0)
+        stats.inc("t.c", 7)
+        s.tick()
+        s.dump_jsonl(p)  # append-only: only the new tick lands
+        ticks = timeseries.load_jsonl(p)
+        assert len(ticks) == 2
+        assert ticks[0]["counters"]["t.c"] == [3, None]
+        assert ticks[1]["counters"]["t.c"] == [10, 7.0]
+
+    def test_aggregate_ticks_sums_counters_exactly(self):
+        def tick(ts, cum, rate, g, hc, ht):
+            return {"ts": ts, "counters": {"c": [cum, rate]},
+                    "gauges": {"g": g}, "histograms": {"h": [hc, ht]}}
+
+        r0 = [tick(0.0, 10, None, 1.0, 2, 4.0),
+              tick(1.0, 30, 20.0, 3.0, 4, 8.0)]
+        r1 = [tick(0.1, 5, None, 2.0, 1, 1.0),
+              tick(1.1, 25, 20.0, 1.0, 2, 2.0)]
+        fleet = timeseries.aggregate_ticks([r0, r1])
+        assert len(fleet) == 2
+        assert fleet[0]["counters"]["c"] == [15, None]
+        assert fleet[1]["counters"]["c"] == [55, 40.0]  # exact sums
+        assert fleet[0]["gauges"]["g"] == 2.0           # max
+        assert fleet[1]["gauges"]["g"] == 3.0
+        assert fleet[1]["histograms"]["h"] == [6, 10.0]
+        assert fleet[1]["ts"] == 1.1                    # max ts
+
+    def test_aggregate_ticks_ragged_and_alerts(self):
+        r0 = [{"ts": 0.0, "counters": {}, "gauges": {},
+               "histograms": {}, "alerts": ["a"]}]
+        r1 = [{"ts": 0.2, "counters": {}, "gauges": {},
+               "histograms": {}, "alerts": ["b"]},
+              {"ts": 1.2, "counters": {}, "gauges": {"g": 1},
+               "histograms": {}}]
+        fleet = timeseries.aggregate_ticks([r0, r1])
+        assert len(fleet) == 2
+        assert fleet[0]["alerts"] == ["a", "b"]  # union
+        assert "alerts" not in fleet[1]
+
+    def test_prometheus_text_shapes(self):
+        stats.inc("t.reqs", 7)
+        stats.set_gauge("t.depth", 3.5)
+        for v in (0.5, 1.5, 300.0):
+            stats.observe("t.lat_ms", v)
+        txt = timeseries.prometheus_text(stats.snapshot(prefix="t."))
+        assert "# TYPE t_reqs_total counter" in txt
+        assert "t_reqs_total 7" in txt
+        assert "t_depth 3.5" in txt
+        # cumulative buckets, closed by +Inf == count
+        bucket_vals = [int(ln.rsplit(" ", 1)[1])
+                       for ln in txt.splitlines()
+                       if ln.startswith("t_lat_ms_bucket")]
+        assert bucket_vals == sorted(bucket_vals)
+        assert bucket_vals[-1] == 3
+        assert "t_lat_ms_count 3" in txt
+
+    def test_http_endpoint_monotone_counters(self):
+        stats.inc("t.reqs", 1)
+        srv = timeseries.TelemetryServer(0)
+        try:
+            def scrape():
+                url = f"http://127.0.0.1:{srv.port}/metrics"
+                body = urllib.request.urlopen(url, timeout=10)
+                return body.read().decode()
+
+            t1 = scrape()
+            assert "t_reqs_total 1" in t1
+            stats.inc("t.reqs", 4)
+            t2 = scrape()
+            assert "t_reqs_total 5" in t2  # monotone across scrapes
+            # parseable: every sample line is "name[{labels}] value"
+            for ln in t2.splitlines():
+                if ln.startswith("#") or not ln:
+                    continue
+                name, val = ln.rsplit(" ", 1)
+                float(val)
+                assert name
+        finally:
+            srv.stop()
+
+    def test_start_http_server_disabled_by_default(self):
+        # FLAGS_telemetry_port defaults 0 -> no exporter
+        assert timeseries.start_http_server() is None
+
+    def test_trace_merge_series_fold_round_trip(self, tmp_path):
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        clk = ManualClock()
+        for rank in range(2):
+            s = _sampler(clk, source=lambda: (
+                {"serve.finished": 4}, {"slo.goodput": 0.5}, {}))
+            s.tick()
+            s.dump_jsonl(str(tmp_path / f"telemetry_rank{rank}.jsonl"))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "tools", "trace_merge.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["ticks"] == 1 and doc["ranks"] == 2
+        merged = [json.loads(ln) for ln in
+                  open(doc["out_series"]) if ln.strip()]
+        assert merged[0]["counters"]["serve.finished"][0] == 8  # sum
+        assert merged[0]["gauges"]["slo.goodput"] == 0.5        # max
+
+
+# =====================================================================
+# conventions (satellite 4)
+# =====================================================================
+
+class TestConventions:
+    def test_new_prefixes_registered(self):
+        assert "telemetry." in stats.CONVENTION_PREFIXES
+        assert "alert." in stats.CONVENTION_PREFIXES
+
+    def test_alert_event_in_journal_vocabulary(self):
+        from paddle_tpu.serving.journal import LIFECYCLE_EVENTS
+
+        assert "alert" in LIFECYCLE_EVENTS

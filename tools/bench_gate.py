@@ -165,6 +165,15 @@ DEFAULT_METRICS: Dict[str, str] = {
     # top-level lint_findings scalar
     "lint.findings": "up",
     "lint_findings": "up",
+    # continuous telemetry (ISSUE 16): the serving-time attribution's
+    # host-overhead residual regresses UP (bookkeeping creep the
+    # phase split exists to expose), and alert_fired regresses UP
+    # with NO noise floor — the measured rung is a healthy steady
+    # state, so a run that starts firing alerts is a regression
+    # however small the count (strict-compared like lint)
+    "serve_step_host_overhead_ms": "up",
+    "alert_fired": "up",
+    "alert.fired": "up",
 }
 
 #: absolute-change floors so tiny counts/latencies don't trip the
@@ -226,11 +235,12 @@ def _metric_value(block: dict, name: str) -> Optional[float]:
 
 def _regressed(name: str, direction: str, prev: float, cur: float,
                tol: float) -> bool:
-    if name.startswith("lint") or name == "moe.dropped_tokens":
-        # lint findings and no-drop-mode dropped tokens must only go
-        # down between rounds — ANY growth regresses, no noise floor
-        # (a single new finding / dropped token is a real defect, not
-        # measurement jitter)
+    if name.startswith(("lint", "alert")) \
+            or name == "moe.dropped_tokens":
+        # lint findings, alert fires, and no-drop-mode dropped tokens
+        # must only go down between rounds — ANY growth regresses, no
+        # noise floor (a single new finding / alert / dropped token
+        # is a real defect, not measurement jitter)
         return cur > prev if direction == "up" else cur < prev
     floor = _ABS_FLOOR_US if name.endswith("_us") else _ABS_FLOOR_COUNT
     if direction == "up":

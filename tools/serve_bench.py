@@ -90,6 +90,54 @@ def _telemetry():
     return out
 
 
+def _start_telemetry(args, journal=None, n_replicas=None):
+    """Continuous-telemetry wiring (ISSUE 16): when --telemetry-out
+    is set, run a background TimeSeriesSampler over the stats
+    registry for the measured window with the default alert rules
+    attached (burn-rate, HBM pressure, replica-death when fleet,
+    preemption spike), journaling alert transitions into the serve's
+    flight recorder. Returns the sampler or None."""
+    if not getattr(args, "telemetry_out", None):
+        return None
+    from paddle_tpu.profiler import AlertEngine, TimeSeriesSampler
+    from paddle_tpu.profiler import default_rules
+
+    alerts = AlertEngine(default_rules(n_replicas), journal=journal)
+    sampler = TimeSeriesSampler(
+        interval_ms=args.telemetry_interval_ms,
+        enabled=True).attach_alerts(alerts)
+    sampler.start()
+    return sampler
+
+
+def _stop_telemetry(sampler, path):
+    """Stop the measured window's sampler (one final tick) and dump
+    the series JSONL (serve_top --history / trace_merge input)."""
+    if sampler is None:
+        return {}
+    sampler.stop()
+    sampler.dump_jsonl(path)
+    return {"telemetry_ticks": sampler.n_ticks,
+            "telemetry_out": path}
+
+
+def _alert_keys():
+    """The gated alert/attribution scalars — emitted on every run
+    (zero when telemetry is off) so bench_gate can hold the line:
+    ``alert_fired`` UP with no noise floor (a run that starts paging
+    is a regression however small), host overhead UP (the residual
+    the attribution exists to expose)."""
+    from paddle_tpu.profiler import stats
+
+    h = stats.histogram("serve.step.host_overhead_ms")
+    return {
+        "alert_fired": int(stats.counter("alert.fired").value),
+        "alert_resolved": int(stats.counter("alert.resolved").value),
+        "serve_step_host_overhead_ms": round(h.total / h.count, 4)
+        if h.count else None,
+    }
+
+
 def build_engine(args, faults=None):
     import jax.numpy as jnp
 
@@ -357,8 +405,12 @@ def run_fleet(args):
     reqs, prefixes = make_fleet_requests(args, lens, rng)
     if not args.no_warmup:
         _fleet_warm(router, args, lens, prefixes)
+    sampler = _start_telemetry(
+        args, journal=router.replicas[0].eng.journal,
+        n_replicas=args.fleet)
     wall, rids = drive_fleet(router, reqs, args.max_new,
                              deadline_ms=args.deadline_ms)
+    tele_out = _stop_telemetry(sampler, args.telemetry_out)
     done = router.results()
     finished = [done[r] for r in rids if r is not None]
     ttfts = np.array([r.ttft_s for r in finished
@@ -400,6 +452,8 @@ def run_fleet(args):
         "fleet_wall_s": round(wall, 3),
         "telemetry": _telemetry(),
     }
+    out.update(_alert_keys())
+    out.update(tele_out)
     ok = True
     if args.chaos:
         chaos_out, ok = run_fleet_chaos(args, reqs, rids, done,
@@ -425,9 +479,17 @@ def run_fleet_chaos(args, reqs, base_rids, base_done, base_goodput,
     if not args.no_warmup:
         _fleet_warm(router, args, lens, prefixes)
     router.install_faults(inj)
+    # the chaos window gets its own sampler/series: the replica-death
+    # alert must fire at the injected kill, in a dump of its own
+    sampler = _start_telemetry(
+        args, journal=router.replicas[0].eng.journal,
+        n_replicas=args.fleet)
     t0 = time.monotonic()
     wall, rids = drive_fleet(router, reqs, args.max_new,
                              deadline_ms=args.deadline_ms)
+    tele_out = _stop_telemetry(
+        sampler, args.telemetry_out + ".chaos"
+        if args.telemetry_out else None)
     done = router.results()
     survivors = mismatches = lost = 0
     shed = 0
@@ -476,6 +538,9 @@ def run_fleet_chaos(args, reqs, base_rids, base_done, base_goodput,
         "fleet_chaos_sites_fired": sites,
         "fleet_chaos_wall_s": round(time.monotonic() - t0, 3),
     }
+    out.update({f"fleet_chaos_{k}": v for k, v in tele_out.items()})
+    out["fleet_chaos_alert_fired"] = int(
+        stats.counter("alert.fired").value)
     # the acceptance pins: zero admitted requests lost, survivor
     # parity, exactly the killed replica died (a second death means
     # the hang overshot and the run proved nothing), >=5 sites
@@ -525,9 +590,13 @@ def run_chaos(args, reqs, base_rids, base_done, base_goodput):
         if eng.journal is not None:
             eng.journal.clear()
     eng.install_faults(inj)
+    sampler = _start_telemetry(args, journal=eng.journal)
     t0 = time.monotonic()
     wall, rids = drive(eng, reqs, args.max_new,
                        deadline_ms=args.deadline_ms)
+    tele_out = _stop_telemetry(
+        sampler, args.telemetry_out + ".chaos"
+        if args.telemetry_out else None)
     done_by_id = {r.id: r for r in eng.finished}
     base_by_id = {r.id: r for r in base_done}
     # survivor parity: every request the chaos run finished in the
@@ -593,6 +662,7 @@ def run_chaos(args, reqs, base_rids, base_done, base_goodput):
         "serve_chaos_dump_survived": dump_survived,
         "serve_chaos_wall_s": round(time.monotonic() - t0, 3),
     }
+    out.update({f"serve_chaos_{k}": v for k, v in tele_out.items()})
     ok = (parity == 1.0 and bound_ok and dump_survived == 1
           and len(sites) >= 5)
     return out, ok
@@ -683,6 +753,18 @@ def main():
     ap.add_argument("--journal-out", default=None,
                     help="dump the flight-recorder journal JSONL "
                          "(tools/serve_top.py input)")
+    ap.add_argument("--telemetry-out", default=None,
+                    help="continuous telemetry (ISSUE 16): sample "
+                         "the stats registry on a background "
+                         "TimeSeriesSampler with the default alert "
+                         "rules armed during the measured run and "
+                         "dump the time-series JSONL here "
+                         "(serve_top --history input); a --chaos "
+                         "re-drive dumps its own series to "
+                         "<path>.chaos")
+    ap.add_argument("--telemetry-interval-ms", type=float,
+                    default=50.0,
+                    help="sampling interval for --telemetry-out")
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--d-model", type=int, default=64)
     ap.add_argument("--layers", type=int, default=2)
@@ -762,8 +844,10 @@ def main():
         stats.reset()
 
     reqs = make_requests(args, lens, rng)
+    sampler = _start_telemetry(args, journal=eng.journal)
     wall, rids = drive(eng, reqs, args.max_new,
                        deadline_ms=args.deadline_ms)
+    tele_out = _stop_telemetry(sampler, args.telemetry_out)
 
     done = eng.finished
     if eng.journal is not None:
@@ -826,6 +910,8 @@ def main():
         "serve_wall_s": round(wall, 3),
         "telemetry": _telemetry(),
     }
+    out.update(_alert_keys())
+    out.update(tele_out)
     chaos_ok = True
     if args.chaos:
         chaos_out, chaos_ok = run_chaos(args, reqs, rids, done,

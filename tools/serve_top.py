@@ -11,7 +11,17 @@ running engine:
     python tools/serve_top.py j.jsonl --req 17          # one timeline
     python tools/serve_top.py j.jsonl --export-trace t.json --rank 0
     python tools/serve_top.py j.jsonl --watch 2         # re-render
+    python tools/serve_top.py j.jsonl --interval 2      # clock-seam watch
     python tools/serve_top.py --fleet j_r0.jsonl j_r1.jsonl  # fleet
+    python tools/serve_top.py --history telemetry.jsonl # sparklines
+
+``--history`` (ISSUE 16) renders sparkline views (goodput /
+burn-rate / queue depth / throughput / phase occupancy, plus an
+alert-marker row) over a continuous-telemetry series dump
+(``TimeSeriesSampler.dump_jsonl`` / ``serve_bench
+--telemetry-out``); combined with a journal argument it appends the
+history below the dashboard. ``--interval`` is the watch cadence
+routed through the serving clock seam (testable without sleeping).
 
 ``--fleet`` (ISSUE 14) takes one journal per replica
 (``FleetRouter.export_journals``) and renders a per-replica
@@ -40,13 +50,13 @@ import importlib.util
 import json
 import os
 import sys
-import time
 from typing import List, Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 __all__ = ["summarize", "render", "render_engine", "render_fleet",
-           "render_fleet_offline", "main"]
+           "render_fleet_offline", "render_history", "sparkline",
+           "main"]
 
 
 def _journal_mod():
@@ -55,6 +65,30 @@ def _journal_mod():
     spec = importlib.util.spec_from_file_location(
         "_serve_journal", os.path.join(
             _REPO, "paddle_tpu", "serving", "journal.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _faults_mod():
+    """serving/faults.py loaded standalone (also stdlib-only at
+    import) — the watch loop sleeps through ITS clock seam, so tests
+    drive ``--interval`` with a ManualClock instead of real sleeps."""
+    spec = importlib.util.spec_from_file_location(
+        "_serve_faults", os.path.join(
+            _REPO, "paddle_tpu", "serving", "faults.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ts_mod():
+    """profiler/timeseries.py loaded standalone (stdlib-only at
+    import) — ``--history`` parses telemetry dumps with the writer's
+    own loader."""
+    spec = importlib.util.spec_from_file_location(
+        "_serve_timeseries", os.path.join(
+            _REPO, "paddle_tpu", "profiler", "timeseries.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
@@ -72,6 +106,8 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
               "drain": 0}
     evicted_pages = 0
     spec_rounds = spec_drafted = spec_accepted = 0
+    alerts_fired = alerts_resolved = 0
+    alerts_active: set = set()
     for e in events:
         ev = e.get("ev")
         rid = int(e.get("rid", -1))
@@ -81,6 +117,16 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
             spec_rounds += 1
             spec_drafted += int(e.get("k", 0))
             spec_accepted += int(e.get("accepted", 0))
+        if ev == "alert":
+            # ISSUE 16: telemetry alert-rule transitions (rid=-1 —
+            # alerts belong to the serve, not one request)
+            name = e.get("name", "?")
+            if e.get("state") == "firing":
+                alerts_fired += 1
+                alerts_active.add(name)
+            else:
+                alerts_resolved += 1
+                alerts_active.discard(name)
         if ev in counts:
             counts[ev] += 1
         if rid < 0:
@@ -178,6 +224,9 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
         "spec_accepted": spec_accepted,
         "spec_accept_rate": (spec_accepted / spec_drafted)
         if spec_drafted else None,
+        "alerts_fired": alerts_fired,
+        "alerts_resolved": alerts_resolved,
+        "alerts_active": sorted(alerts_active),
         "slots": None,  # live mode fills the real max_batch
     }
 
@@ -265,6 +314,13 @@ def render(summary: dict, top: int = 5,
             f"accept_rate {_fmt(s.get('spec_accept_rate'), 3)} "
             f"({s.get('spec_accepted', 0)}/{s.get('spec_drafted', 0)} "
             "drafts accepted)")
+    if s.get("alerts_fired") or s.get("alerts_resolved"):
+        # continuous telemetry (ISSUE 16): alert-rule transitions
+        active = s.get("alerts_active") or []
+        lines.append(
+            f"alerts: fired {s.get('alerts_fired', 0)}  "
+            f"resolved {s.get('alerts_resolved', 0)}  "
+            f"active {','.join(active) if active else '-'}")
     slowest = sorted(
         (r for r in s["requests"].values()
          if r["phase"] == "finished" and r["ttft_ms"] is not None),
@@ -388,6 +444,162 @@ def render_fleet(router, top: int = 5) -> str:
     return "\n".join(lines)
 
 
+# ---------------- telemetry history (ISSUE 16) ----------------
+
+_SPARKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[Optional[float]], lo=None, hi=None) -> str:
+    """Unicode sparkline; None values render as gaps. ``lo``/``hi``
+    pin the scale (goodput wants 0..1); default is the window's
+    min/max."""
+    present = [v for v in values if v is not None]
+    if not present:
+        return " " * len(values)
+    lo = min(present) if lo is None else lo
+    hi = max(present) if hi is None else hi
+    span = hi - lo
+    out = []
+    for v in values:
+        if v is None:
+            out.append(" ")
+            continue
+        x = 0.5 if span <= 0 else (v - lo) / span
+        out.append(_SPARKS[min(int(x * len(_SPARKS)),
+                               len(_SPARKS) - 1)])
+    return "".join(out)
+
+
+def _gauge_series(ticks, name):
+    return [t.get("gauges", {}).get(name) for t in ticks]
+
+
+def _rate_series(ticks, name):
+    out = []
+    for t in ticks:
+        pair = t.get("counters", {}).get(name)
+        out.append(pair[1] if pair else None)
+    return out
+
+
+def _hist_totals(t, prefix="serve.step.", names=None):
+    h = t.get("histograms", {})
+    tot = 0.0
+    for n, pair in h.items():
+        if names is not None:
+            if n in names:
+                tot += pair[1]
+        elif n.startswith(prefix):
+            tot += pair[1]
+    return tot
+
+
+_WORK_PHASES = ("serve.step.prefill_chunk_ms",
+                "serve.step.decode_chunk_ms",
+                "serve.step.spec_verify_ms",
+                "serve.step.migration_ms")
+
+
+def _occupancy_series(ticks):
+    """Per-tick work fraction: delta of the work-phase histogram
+    totals over the delta of ``serve.step.total_ms`` — how much of
+    each interval's step time was accelerator-facing work vs admit +
+    host overhead."""
+    out: List[Optional[float]] = []
+    prev_w = prev_t = None
+    for t in ticks:
+        w = _hist_totals(t, names=set(_WORK_PHASES))
+        tot = _hist_totals(t, names={"serve.step.total_ms"})
+        if prev_t is None or tot <= prev_t:
+            out.append(None)
+        else:
+            out.append(max(0.0, min(1.0, (w - prev_w)
+                                    / (tot - prev_t))))
+        prev_w, prev_t = w, tot
+    return out
+
+
+def render_history(ticks: List[dict], width: int = 60) -> str:
+    """Sparkline dashboard over a telemetry tick series (a live
+    ``TimeSeriesSampler.ticks()`` or a ``--telemetry-out`` JSONL
+    dump): goodput / burn / queue depth / throughput / phase
+    occupancy over the window, with an alert-marker row (``!`` =
+    tick with active alerts)."""
+    if not ticks:
+        return "serve_top --history: no telemetry ticks"
+    ticks = ticks[-width:]
+    span_s = ticks[-1].get("ts", 0.0) - ticks[0].get("ts", 0.0)
+    lines = [f"serve_top --history — {len(ticks)} ticks "
+             f"({span_s:.1f}s window)"]
+
+    def row(label, values, lo=None, hi=None, fmt="{:.2f}"):
+        present = [v for v in values if v is not None]
+        last = fmt.format(present[-1]) if present else "-"
+        lines.append(f"  {label:<12} {sparkline(values, lo, hi)}"
+                     f"  last {last}")
+
+    goodput = _gauge_series(ticks, "slo.goodput")
+    if any(v is not None for v in goodput):
+        row("goodput", goodput, lo=0.0, hi=1.0, fmt="{:.3f}")
+    burn = _gauge_series(ticks, "slo.burn_rate")
+    if any(v is not None for v in burn):
+        row("burn_rate", burn, lo=0.0, fmt="{:.1f}x")
+    queue = _gauge_series(ticks, "slo.queue_depth")
+    if any(v is not None for v in queue):
+        row("queue", queue, lo=0.0, fmt="{:.0f}")
+    # throughput: the first counter that produced rates, preferring
+    # token/step counters over bookkeeping ones
+    for cname in ("serving.decode_tokens", "serving.decode_steps",
+                  "serve.finished", "serving.finished"):
+        rates = _rate_series(ticks, cname)
+        if any(v is not None for v in rates):
+            row(f"{cname.rsplit('.', 1)[-1]}/s", rates, lo=0.0,
+                fmt="{:.1f}")
+            break
+    occ = _occupancy_series(ticks)
+    if any(v is not None for v in occ):
+        row("work_frac", occ, lo=0.0, hi=1.0)
+    marks = "".join("!" if t.get("alerts") else "." for t in ticks)
+    if "!" in marks:
+        lines.append(f"  {'alerts':<12} {marks}")
+        firing: List[str] = []
+        for t in ticks:
+            for a in t.get("alerts", []):
+                if a not in firing:
+                    firing.append(a)
+        lines.append(f"  fired in window: {', '.join(firing)}")
+    return "\n".join(lines)
+
+
+def _watch_loop(render_once, interval_s: float, clk=None,
+                max_iters: Optional[int] = None,
+                out=None) -> int:
+    """The ``--watch``/``--interval`` loop: clear-then-redraw at a
+    fixed cadence, SLEEPING THROUGH THE CLOCK SEAM (``clk.sleep``) so
+    tests drive it with a ManualClock and ``max_iters`` instead of
+    wall time. ``interval_s <= 0`` renders once."""
+    out = out if out is not None else sys.stdout
+    if clk is None:
+        clk = _faults_mod().clock()
+    i = 0
+    while True:
+        body = render_once()
+        if interval_s > 0:
+            # clear first, THEN draw: the frame lands on a blank
+            # screen in one piece (stable columns, no torn redraw)
+            out.write("\033[2J\033[H")
+        out.write(body + "\n")
+        try:
+            out.flush()
+        except Exception:
+            pass
+        i += 1
+        if interval_s <= 0 or (max_iters is not None
+                               and i >= max_iters):
+            return 0
+        clk.sleep(interval_s)
+
+
 def _crash_lines(extras: dict) -> List[str]:
     crash = extras.get("crash")
     if not crash:
@@ -408,10 +620,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="text dashboard over a serving journal / crash "
                     "dump (serving/journal.py JSONL)")
-    ap.add_argument("journal", nargs="+",
+    ap.add_argument("journal", nargs="*",
                     help="journal or crash-dump JSONL path; with "
                          "--fleet, one per replica (replica id = "
-                         "argument order)")
+                         "argument order); optional with --history")
     ap.add_argument("--fleet", action="store_true",
                     help="fleet view (ISSUE 14): one health/"
                          "occupancy/goodput row per replica journal "
@@ -437,20 +649,42 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--watch", type=float, default=0.0,
                     help="re-read + re-render every N seconds "
                          "(0 = render once)")
+    ap.add_argument("--interval", type=float, default=None,
+                    help="watch cadence in seconds, routed through "
+                         "the serving clock seam (ISSUE 16; implies "
+                         "--watch; ManualClock-testable)")
+    ap.add_argument("--history", default=None, metavar="SERIES.jsonl",
+                    help="sparkline dashboard over a telemetry "
+                         "time-series dump (TimeSeriesSampler."
+                         "dump_jsonl / serve_bench --telemetry-out)")
+    ap.add_argument("--width", type=int, default=60,
+                    help="--history sparkline width (ticks shown)")
     args = ap.parse_args(argv)
 
+    interval = args.interval if args.interval is not None \
+        else args.watch
     jm = _journal_mod()
+
+    if args.history is None and not args.journal:
+        ap.error("pass a journal JSONL (or --history SERIES.jsonl)")
+
+    if args.history is not None and not args.journal:
+        tsm = _ts_mod()
+
+        def render_once():
+            return render_history(tsm.load_jsonl(args.history),
+                                  width=args.width)
+        return _watch_loop(render_once, interval)
+
     if args.fleet or len(args.journal) > 1:
-        while True:
-            print(render_fleet_offline(
+        def render_once():
+            return render_fleet_offline(
                 args.journal, jm, ttft_target=args.ttft_target,
                 tpot_target=args.tpot_target,
-                objective=args.objective))
-            if args.watch <= 0:
-                return 0
-            time.sleep(args.watch)
-            print("\033[2J\033[H", end="")
-    while True:
+                objective=args.objective)
+        return _watch_loop(render_once, interval)
+
+    def render_once():
         events, extras = jm.load_jsonl(args.journal[0])
         summary = summarize(events, ttft_target=args.ttft_target,
                             tpot_target=args.tpot_target,
@@ -459,9 +693,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         crash = _crash_lines(extras)
         if crash:
             out = out + "\n" + "\n".join(crash)
-        if args.watch > 0:
-            print("\033[2J\033[H", end="")
-        print(out)
+        if args.history:
+            tsm = _ts_mod()
+            out += "\n" + render_history(
+                tsm.load_jsonl(args.history), width=args.width)
         if args.export_trace:
             rank = args.rank
             if rank is None:
@@ -470,11 +705,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             with open(args.export_trace, "w") as f:
                 json.dump(jm.chrome_trace(events, process_index=rank),
                           f)
-            print(f"serve_top: chrome trace -> {args.export_trace}")
+            out += f"\nserve_top: chrome trace -> {args.export_trace}"
             args.export_trace = None  # once per invocation
-        if args.watch <= 0:
-            return 0
-        time.sleep(args.watch)
+        return out
+
+    return _watch_loop(render_once, interval)
 
 
 if __name__ == "__main__":

@@ -12,26 +12,53 @@ folds them into ONE fleet view:
   worker names collide across hosts;
 - **fleet stats snapshot**: counters summed, gauges maxed, histograms
   folded bucket-by-bucket (count/total summed, min/max widened,
-  percentiles re-estimated from the folded power-of-2 buckets).
+  percentiles re-estimated from the folded power-of-2 buckets);
+- **fleet telemetry series** (ISSUE 16): per-rank/per-replica
+  time-series JSONL dumps (``TimeSeriesSampler.dump_jsonl`` /
+  ``serve_bench --telemetry-out``, named ``telemetry_rank{i}.jsonl``
+  or ``*.telemetry.jsonl``) fold tick-by-tick with the same
+  semantics — ticks align by timestamp order, counters sum
+  (cumulative + rate), gauges max, histogram count/total pairs sum,
+  alert sets union — into ``merged_telemetry.jsonl``, which
+  ``serve_top --history`` renders directly.
 
 Usage::
 
     python tools/trace_merge.py RUN_DIR \
-        [--out-trace merged_trace.json] [--out-stats fleet_stats.json]
+        [--out-trace merged_trace.json] [--out-stats fleet_stats.json] \
+        [--out-series merged_telemetry.jsonl]
 
-Prints one JSON line {ranks, events, out_trace, out_stats}.
+Prints one JSON line {ranks, events, out_trace, out_stats,
+out_series, ticks}.
 """
 from __future__ import annotations
 
 import argparse
 import glob
+import importlib.util
 import json
 import os
 import re
 import sys
 from typing import List, Optional, Tuple
 
-__all__ = ["merge_traces", "fold_stats", "find_rank_files", "main"]
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+__all__ = ["merge_traces", "fold_stats", "fold_series",
+           "find_rank_files", "find_series_files", "main"]
+
+
+def _ts_mod():
+    """profiler/timeseries.py loaded standalone (stdlib-only at
+    import) — the series fold reuses the writer's own
+    load_jsonl/aggregate_ticks instead of re-implementing the
+    semantics here."""
+    spec = importlib.util.spec_from_file_location(
+        "_tm_timeseries", os.path.join(
+            _REPO, "paddle_tpu", "profiler", "timeseries.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _rank_of(trace: dict, path: str, fallback: int) -> int:
@@ -163,6 +190,25 @@ def find_rank_files(run_dir: str) -> Tuple[List[str], List[str]]:
     return traces, stats
 
 
+def find_series_files(run_dir: str) -> List[str]:
+    """Per-rank/per-replica telemetry time-series dumps in a run dir
+    (``telemetry_rank{i}.jsonl`` / ``*.telemetry.jsonl`` / the
+    serve_bench ``--telemetry-out`` chaos suffix)."""
+    return sorted(
+        set(glob.glob(os.path.join(run_dir, "telemetry_rank*.jsonl")))
+        | set(glob.glob(os.path.join(run_dir, "*.telemetry.jsonl")))
+        | set(glob.glob(os.path.join(run_dir,
+                                     "telemetry_r*.jsonl"))))
+
+
+def fold_series(paths: List[str], tsm=None) -> List[dict]:
+    """Fold per-rank telemetry series into one fleet series via the
+    writer's own ``aggregate_ticks`` (counters sum, gauges max,
+    histogram pairs sum, ticks aligned by timestamp order)."""
+    tsm = tsm if tsm is not None else _ts_mod()
+    return tsm.aggregate_ticks([tsm.load_jsonl(p) for p in paths])
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="merge per-rank chrome traces + stats snapshots "
@@ -174,17 +220,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--out-stats", default=None,
                     help="fleet snapshot path "
                          "(default RUN_DIR/fleet_stats.json)")
+    ap.add_argument("--out-series", default=None,
+                    help="fleet telemetry series path (default "
+                         "RUN_DIR/merged_telemetry.jsonl)")
     args = ap.parse_args(argv)
 
     traces, stats = find_rank_files(args.run_dir)
-    if not traces and not stats:
+    series = find_series_files(args.run_dir)
+    if not traces and not stats and not series:
         print(f"trace_merge: no rank files under {args.run_dir} "
               "(expected trace_rank*.json / stats_rank*.json / "
-              "*.paddle_trace.json)", file=sys.stderr)
+              "*.paddle_trace.json / telemetry_rank*.jsonl)",
+              file=sys.stderr)
         return 2
 
     out = {"ranks": 0, "events": 0,
-           "out_trace": None, "out_stats": None}
+           "out_trace": None, "out_stats": None,
+           "out_series": None, "ticks": 0}
     if traces:
         merged = merge_traces(traces)
         out_trace = args.out_trace or os.path.join(
@@ -206,6 +258,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             json.dump(fleet, f, indent=1)
         out["out_stats"] = out_stats
         out["ranks"] = max(out["ranks"], len(snapshots))
+    if series:
+        folded = fold_series(series)
+        out_series = args.out_series or os.path.join(
+            args.run_dir, "merged_telemetry.jsonl")
+        with open(out_series, "w") as f:
+            for rec in folded:
+                f.write(json.dumps(rec) + "\n")
+        out["out_series"] = out_series
+        out["ticks"] = len(folded)
+        out["ranks"] = max(out["ranks"], len(series))
     print(json.dumps(out))
     return 0
 
