@@ -235,6 +235,21 @@ define_flag("fleet_dispatch_queue", 4096,
             "yet-admitted requests (every replica's inbox + waiting "
             "list) past this shed new submits with the typed "
             "FleetOverloaded BEFORE any replica admits; 0 = unbounded")
+define_flag("usage_ledger", False,
+            "per-request -> per-tenant usage metering "
+            "(serving/accounting.py UsageLedger): partitions every "
+            "serve.step work phase across the requests it served and "
+            "integrates KV page-seconds per request; off = the "
+            "engine holds usage=None and every hook is one attribute "
+            "test (zero per-step allocations)")
+define_flag("usage_tenants_max", 64,
+            "cardinality bound on per-tenant SLO goodput windows "
+            "(serving/slo.py): tenants past this roll into the "
+            "__other__ window instead of growing state unboundedly")
+define_flag("usage_top_k", 4,
+            "tenant gauges exported per telemetry tick "
+            "(tenant.top<i>.device_ms, index-keyed): the bounded "
+            "top-K slice of the ledger's per-tenant device time")
 define_flag("telemetry_interval_ms", 0.0,
             "continuous time-series sampler "
             "(profiler/timeseries.py): default background sampling "

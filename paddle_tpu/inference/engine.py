@@ -693,6 +693,11 @@ class ContinuousBatchingEngine:
         # frontend installs its injector here so the ``decode.step``
         # site fires once per decode chunk; None = one attribute test
         self._faults = None
+        # usage ledger hook (serving/accounting.py): the serving
+        # frontend installs its UsageLedger here so engine-level
+        # token accounting (wasted chunk tails, spec accepts) charges
+        # the owning request; None = one attribute test
+        self._usage = None
         # slot state
         self._slots: list = [None] * self.max_batch   # GenRequest or None
         self._lens = np.zeros((self.max_batch,), np.int64)
@@ -817,6 +822,11 @@ class ContinuousBatchingEngine:
                 # signal (big chunks amortize dispatch, small chunks
                 # waste less tail work on eos/max_new finishes)
                 _stats.inc("serving.wasted_decode_tokens", k - consumed)
+                u = self._usage
+                if u is not None and k > consumed:
+                    # the tail belongs to the FINISHER — the request
+                    # whose eos/max_new ended the chunk early
+                    u.add_tokens(req, wasted=k - consumed)
                 self._finish_hook(req, i)
                 self._release(i)
                 done_now.append(req)

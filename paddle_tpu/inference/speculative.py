@@ -512,6 +512,7 @@ class SpeculativeDecoder:
 
         _stats.inc("serving.spec_rounds")
         jr = eng._journal
+        u = eng._usage
         done_now = []
         alive = []
         for i in active:
@@ -544,6 +545,9 @@ class SpeculativeDecoder:
             # counter (here bounded by the accept length)
             _stats.inc("serving.wasted_decode_tokens",
                        a + 1 - consumed)
+            if u is not None:
+                u.add_tokens(req, spec_accepted=a,
+                             wasted=a + 1 - consumed)
             if req.done:
                 eng._finish_hook(req, i)
                 eng._release(i)          # also resets the drafter slot
@@ -556,6 +560,9 @@ class SpeculativeDecoder:
                 # pool (refcount-aware — shared prefix pages only
                 # drop a reference, never free under a live sharer)
                 mgr.truncate(("slot", i), int(eng._lens[i]) - 1)
+                if u is not None:
+                    u.set_pages(req, len(
+                        mgr._owned.get(("slot", i), ())))
                 self.drafter.commit(i, a)
                 alive.append(i)
         if alive:

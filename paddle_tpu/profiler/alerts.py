@@ -73,6 +73,12 @@ def default_rules(n_replicas: Optional[int] = None) -> List[Rule]:
              "hbm.bytes_limit", scale=0.9),
         Rule("preemption-spike", "serving.preemptions", ">",
              kind="spike", scale=3.0, for_ticks=1),
+        # one tenant persistently consuming >80% of attributed device
+        # time (serving/accounting.py tenant.max_share gauge, ISSUE
+        # 17) — the multi-tenant hog signal; absent gauge (ledger
+        # off / single tenant run idle) never fires
+        Rule("tenant-hog", "tenant.max_share", ">", 0.8,
+             for_ticks=3),
     ]
     if n_replicas is not None:
         rules.append(Rule("fleet-replica-down", "fleet.replicas_alive",

@@ -96,6 +96,14 @@ Conventions for the built-in instrumentation (all optional reading):
 - ``alert.*``                  the alert rule engine
   (profiler/alerts.py): ``alert.{fired,resolved}`` lifecycle
   counters and the ``alert.active`` gauge
+- ``usage.*``                  the per-request usage ledger's own
+  accounting (serving/accounting.py): ``usage.records`` closed
+  usage records
+- ``tenant.*``                 BOUNDED per-tenant rollup gauges
+  (serving/accounting.py + serving/slo.py):
+  ``tenant.{count,max_share,min_goodput}`` and the index-keyed
+  ``tenant.top<i>.device_ms`` top-K slice — never one key per
+  tenant; names live in the usage JSONL, not the registry
 - ``t.*``                      scratch namespace reserved for tests
 
 Every metric the framework registers MUST use one of these prefixes
@@ -125,7 +133,7 @@ CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
     "inference.", "serving.", "serve.", "journal.", "slo.", "spec.",
     "quant.", "moe.", "dist.", "fleet.", "roofline.", "hbm.", "lint.",
-    "telemetry.", "alert.",
+    "telemetry.", "alert.", "usage.", "tenant.",
     "t.",
 )
 

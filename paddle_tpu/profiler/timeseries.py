@@ -129,6 +129,21 @@ def engine_source(eng) -> Callable[[], tuple]:
         if mon is not None and mon.goodput is not None:
             gauges["slo.goodput"] = mon.goodput
             gauges["slo.burn_rate"] = mon.burn_rate
+            tg = mon.tenant_min_goodput
+            if tg is not None:
+                gauges["tenant.min_goodput"] = tg
+        u = getattr(eng, "usage", None)
+        if u is not None:
+            # bounded tenant slice (ISSUE 17): count + hog share +
+            # index-keyed top-K device time — never a key per tenant
+            from ..core.flags import flag as _flag
+
+            gauges["tenant.count"] = u.tenant_count()
+            gauges["tenant.max_share"] = round(u.max_share(), 4)
+            for i, (_, ns) in enumerate(
+                    u.top_tenants(int(_flag("usage_top_k")))):
+                gauges[f"tenant.top{i}.device_ms"] = \
+                    round(ns / 1e6, 3)
         return counters, gauges, {}
     return src
 

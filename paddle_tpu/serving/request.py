@@ -50,10 +50,15 @@ class Request(GenRequest):
                  eos_token_id=None, priority: int = 0,
                  on_token: Optional[Callable] = None,
                  arrival_time: Optional[float] = None,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 tenant: Optional[str] = None):
         super().__init__(prompt, max_new_tokens, eos_token_id)
         self.priority = int(priority)
         self.on_token = on_token
+        # usage-metering identity (ISSUE 17): None bills to the
+        # ledger's default tenant; stamped into journal events and
+        # the per-request usage record
+        self.tenant = tenant
         self.arrival_time = _faults.now() if arrival_time is None \
             else float(arrival_time)
         self.deadline_ms = None if deadline_ms is None \

@@ -85,6 +85,7 @@ import numpy as np
 from ..core.flags import flag as _flag
 from ..profiler import stats as _stats
 from . import faults as _faults
+from .accounting import UsageLedger, fold_records
 from .faults import FleetOverloaded, ReplicaKilled
 from .prefix_cache import _page_key
 from .request import Request
@@ -295,6 +296,13 @@ class FleetRouter:
         #: ManualClock tests set it explicitly. Crash detection
         #: (``crashed`` → dead → failover) is always on.
         self.enforce_beats = False
+        # router-tier usage ledger (ISSUE 17): terminal records for
+        # requests that die AT THE ROUTER (failover budget spent,
+        # fleet shed) — ``fleet_usage`` folds it with every replica
+        # engine's ledger into one record per request
+        self.usage: Optional[UsageLedger] = None
+        if _flag("usage_ledger"):
+            self.usage = UsageLedger()
         self.faults = None
         if faults is not None:
             self.install_faults(faults)
@@ -317,16 +325,25 @@ class FleetRouter:
 
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id=None, priority: int = 0, on_token=None,
-               deadline_ms: Optional[float] = None) -> int:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> int:
         """Route one request to a replica (affinity, then load/SLO)
-        and return its fleet-unique id. Raises
+        and return its fleet-unique id. ``tenant`` stamps the usage
+        ledger's billing identity fleet-wide. Raises
         :class:`FleetOverloaded` when the fleet-wide dispatch queue is
         past ``FLAGS_fleet_dispatch_queue`` or no replica is
         dispatchable — backpressure BEFORE any replica admits."""
         req = Request(prompt, max_new_tokens, eos_token_id,
                       priority=priority, on_token=on_token,
-                      deadline_ms=deadline_ms)
-        self._dispatch(req)
+                      deadline_ms=deadline_ms, tenant=tenant)
+        try:
+            self._dispatch(req)
+        except FleetOverloaded:
+            u = self.usage
+            if u is not None:
+                # router-tier shed still emits exactly one record
+                u.finish(req, "shed")
+            raise
         self._tracked.append(req)
         return req.id
 
@@ -509,6 +526,9 @@ class FleetRouter:
         req.error = exc
         req.slo_ok = False
         req.t_done = _faults.now()
+        u = self.usage
+        if u is not None:
+            u.finish(req, "error")
         _stats.inc("serving.request_errors")
 
     def _failover(self, rep: Replica) -> None:
@@ -659,14 +679,20 @@ class FleetRouter:
                 if j is None or not dest.eng.import_slot(j, blob):
                     continue
             req.n_migrations = getattr(req, "n_migrations", 0) + 1
-            eng._release(i)
+            eng._release(i)   # src ledger closes its page integral
             _stats.inc("fleet.migrations")
             _stats.inc("fleet.migrated_pages", blob["n_pages"])
             # the migration phase of serving-time attribution: export
             # through release, stamped via the clock seam (failed
-            # attempts are not a phase — nothing moved)
-            _stats.observe("serve.step.migration_ms",
-                           (_faults.now() - tm0) * 1e3)
+            # attempts are not a phase — nothing moved). The ledger
+            # charges the migrated request the SAME float on the
+            # DESTINATION replica — where its record continues
+            mig_ms = (_faults.now() - tm0) * 1e3
+            ud = dest.eng.usage
+            if ud is not None:
+                ud.set_pages(req, blob["n_pages"])
+                ud.charge_phase("migration", mig_ms, (req,))
+            _stats.observe("serve.step.migration_ms", mig_ms)
             jr = dest.eng.journal
             if jr is not None:
                 jr.record("migrate", req.id, j,
@@ -766,6 +792,46 @@ class FleetRouter:
                 continue
             p = os.path.join(dirpath, f"{prefix}_r{rep.idx}.jsonl")
             rep.eng.journal.dump_jsonl(p)
+            paths.append(p)
+        return paths
+
+    def fleet_usage(self) -> List[dict]:
+        """The FLEET usage ledger: every replica's per-request records
+        plus the router's own terminal records, folded to ONE record
+        per request (``serving.accounting.fold_records`` — integer
+        phase_ns sums add exactly, the single non-None terminal state
+        survives), so a failed-over or migrated request is charged
+        exactly once fleet-wide."""
+        recs: List[dict] = []
+        for rep in self.replicas:
+            u = rep.eng.usage
+            if u is not None:
+                recs.extend(u.records(include_open=True,
+                                      hop=rep.idx))
+        if self.usage is not None:
+            recs.extend(self.usage.records(include_open=True,
+                                           hop=-1))
+        return fold_records(recs)
+
+    def export_usage(self, dirpath: str,
+                     prefix: str = "fleet_usage") -> List[str]:
+        """Dump each replica's usage ledger as
+        ``<prefix>_r<idx>.jsonl`` (hop-stamped) plus the router's as
+        ``<prefix>_router.jsonl`` — tools/trace_merge.py folds them
+        back into the ``fleet_usage`` view offline."""
+        import os
+
+        paths = []
+        for rep in self.replicas:
+            u = rep.eng.usage
+            if u is None:
+                continue
+            p = os.path.join(dirpath, f"{prefix}_r{rep.idx}.jsonl")
+            u.dump_jsonl(p, hop=rep.idx)
+            paths.append(p)
+        if self.usage is not None:
+            p = os.path.join(dirpath, f"{prefix}_router.jsonl")
+            self.usage.dump_jsonl(p, hop=-1)
             paths.append(p)
         return paths
 

@@ -84,6 +84,7 @@ from ..inference.engine import ContinuousBatchingEngine, FusedCausalLM
 from ..profiler import roofline as _roofline
 from ..profiler import stats as _stats
 from . import faults as _faults
+from .accounting import UsageLedger
 from .faults import (DeadlineExceeded, PoolSizingError, ServerOverloaded,
                      TokenCorruption, WatchdogTimeout)
 from .journal import FlightRecorder
@@ -201,6 +202,13 @@ class ServingEngine(ContinuousBatchingEngine):
             ttft_target_ms=slo.ttft_target_ms,
             tpot_target_ms=slo.tpot_target_ms,
             objective=slo.goodput_objective, window=slo.slo_window)
+        # usage ledger (ISSUE 17, FLAGS_usage_ledger): None when
+        # disabled, so — exactly like the journal — every hot-path
+        # hook is a single attribute test with zero allocations
+        self.usage: Optional[UsageLedger] = None
+        if _flag("usage_ledger"):
+            self.usage = UsageLedger()
+        self._usage = self.usage  # engine/speculative token hooks
         self.last_crash_dump: Optional[str] = None
         self.prefix_cache: Optional[PrefixCache] = None
         if slo.prefix_cache:
@@ -252,17 +260,19 @@ class ServingEngine(ContinuousBatchingEngine):
 
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id=None, priority: int = 0,
-               on_token=None, deadline_ms: Optional[float] = None) -> int:
+               on_token=None, deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> int:
         """Thread-safe admission (any thread): queue a request, return
         its id. Tokens stream through ``on_token`` as they decode.
         ``deadline_ms`` bounds the request's whole life from arrival
-        (see README "Failure semantics"). Raises
-        :class:`ServerOverloaded` — backpressure to the SUBMITTING
-        thread — when the bounded inbox, the queue depth, or the SLO
-        burn rate is past its shed threshold."""
+        (see README "Failure semantics"); ``tenant`` stamps the usage
+        ledger's billing identity (None bills to the default tenant).
+        Raises :class:`ServerOverloaded` — backpressure to the
+        SUBMITTING thread — when the bounded inbox, the queue depth,
+        or the SLO burn rate is past its shed threshold."""
         req = Request(prompt, max_new_tokens, eos_token_id,
                       priority=priority, on_token=on_token,
-                      deadline_ms=deadline_ms)
+                      deadline_ms=deadline_ms, tenant=tenant)
         return self.submit_request(req)
 
     def submit_request(self, req: Request) -> int:
@@ -273,9 +283,11 @@ class ServingEngine(ContinuousBatchingEngine):
             self._inbox.append(req)
         jr = self.journal
         if jr is not None:
-            jr.record("submit", req.id, -1,
-                      {"prompt_len": int(len(req.prompt)),
-                       "max_new": int(req.max_new_tokens)})
+            extra = {"prompt_len": int(len(req.prompt)),
+                     "max_new": int(req.max_new_tokens)}
+            if getattr(req, "tenant", None) is not None:
+                extra["tenant"] = req.tenant
+            jr.record("submit", req.id, -1, extra)
         _stats.inc("serve.submitted")
         return req.id
 
@@ -308,9 +320,17 @@ class ServingEngine(ContinuousBatchingEngine):
         if reason is None:
             return
         _stats.inc("serving.shed")
+        u = self.usage
+        # terminal-state audit (ISSUE 17): a shed-at-submit request
+        # DID enter the system — close its (empty) usage record so
+        # every request emits exactly one
+        rec = u.finish(req, "shed") if u is not None else None
         jr = self.journal
         if jr is not None:
-            jr.record("shed", req.id, -1, {"reason": reason})
+            extra = {"reason": reason}
+            if rec is not None:
+                extra["usage"] = rec
+            jr.record("shed", req.id, -1, extra)
         raise ServerOverloaded(
             f"request {req.id} shed at submit: {reason}")
 
@@ -348,10 +368,12 @@ class ServingEngine(ContinuousBatchingEngine):
             self._inbox.append(req)
         jr = self.journal
         if jr is not None:
-            jr.record("submit", req.id, -1,
-                      {"prompt_len": int(len(req.prompt)),
-                       "max_new": int(req.max_new_tokens),
-                       "adopted": True})
+            extra = {"prompt_len": int(len(req.prompt)),
+                     "max_new": int(req.max_new_tokens),
+                     "adopted": True}
+            if getattr(req, "tenant", None) is not None:
+                extra["tenant"] = req.tenant
+            jr.record("submit", req.id, -1, extra)
         _stats.inc("serve.submitted")
         return req.id
 
@@ -375,6 +397,14 @@ class ServingEngine(ContinuousBatchingEngine):
         self._slots = [None] * self.max_batch
         self._lens[:] = 0
         self._last_tok[:] = 0
+        u = self.usage
+        if u is not None:
+            # the detached requests stop holding USABLE pages here
+            # (the stranded pool dies with the replica): close their
+            # page-second integrals so the fleet fold charges them
+            # only for time the pages could still serve them
+            for r in prefilling + decoding:
+                u.set_pages(r, 0)
         return [r for r in inbox + waiting + prefilling + decoding
                 if not r.done]
 
@@ -415,7 +445,16 @@ class ServingEngine(ContinuousBatchingEngine):
             tgt, self._prefill_active = self._prefill_active, None
             if tgt is not None:
                 tgt[0].n_retries = 0  # chunk landed — budget restored
-            self._observe_step(ts0, ts_admit, _faults.now(),
+            ts_work = _faults.now()
+            u = self.usage
+            if u is not None:
+                # the chunk prefilled exactly one request: charge it
+                # the SAME float the phase histogram observes below —
+                # the ledger's conservation invariant is bitwise
+                u.charge_phase("prefill_chunk",
+                               (ts_work - ts_admit) * 1e3,
+                               (tgt[0],) if tgt is not None else ())
+            self._observe_step(ts0, ts_admit, ts_work,
                                "prefill_chunk")
             return out
         if self.num_active == 0:
@@ -432,10 +471,15 @@ class ServingEngine(ContinuousBatchingEngine):
         self._decode_retries = 0
         ts_work = _faults.now()
         dt_ms = (time.perf_counter() - t0) * 1e3
+        u = self.usage
+        advanced = []
         for req, n0 in before:
             emitted = len(req.generated) - n0
             if emitted <= 0:
                 continue
+            if u is not None:
+                advanced.append(req)
+                u.add_tokens(req, decode=emitted)
             # the request waited the whole chunk for its tokens, so
             # its streaming gap is dt_ms/emitted — observed once PER
             # TOKEN, so a slot that finished mid-chunk neither drops
@@ -443,10 +487,18 @@ class ServingEngine(ContinuousBatchingEngine):
             gap = dt_ms / emitted
             for _ in range(emitted):
                 _stats.observe("serve.tpot_ms", gap)
-        self._observe_step(ts0, ts_admit, ts_work,
-                           "spec_verify"
-                           if getattr(self, "_spec", None) is not None
-                           else "decode_chunk")
+        phase = ("spec_verify"
+                 if getattr(self, "_spec", None) is not None
+                 else "decode_chunk")
+        if u is not None:
+            # the chunk's device time splits over the slots it
+            # ADVANCED (a slot the chunk couldn't move shouldn't pay
+            # for it); a wholly-stalled chunk splits over everyone
+            # who was active when it started — same float as the
+            # histogram observation below
+            u.charge_phase(phase, (ts_work - ts_admit) * 1e3,
+                           advanced or [r for r, _ in before])
+        self._observe_step(ts0, ts_admit, ts_work, phase)
         return done
 
     def _observe_step(self, ts0, ts_admit, ts_work, phase):
@@ -486,13 +538,22 @@ class ServingEngine(ContinuousBatchingEngine):
             # serve.tpot_ms is the streaming-gap view)
             _stats.observe("serve.request_tpot_ms", tpot * 1e3)
         v = self.slo_monitor.observe_finish(req)
+        u = self.usage
+        # close the usage record exactly once (a snapshot rides the
+        # finish event; the chunk that finished the request may still
+        # charge its tail after this — exports read final values)
+        rec = u.finish(req, "ok") if u is not None else None
         jr = self.journal
         if jr is not None:
-            jr.record("finish", req.id, slot,
-                      {"n_tokens": len(req.generated),
-                       "ttft_ms": v["ttft_ms"],
-                       "tpot_ms": v["tpot_ms"],
-                       "slo_ok": v["slo_ok"]})
+            extra = {"n_tokens": len(req.generated),
+                     "ttft_ms": v["ttft_ms"],
+                     "tpot_ms": v["tpot_ms"],
+                     "slo_ok": v["slo_ok"]}
+            if getattr(req, "tenant", None) is not None:
+                extra["tenant"] = req.tenant
+            if rec is not None:
+                extra["usage"] = rec
+            jr.record("finish", req.id, slot, extra)
 
     # ---------------- failure semantics (ISSUE 11) ----------------
 
@@ -513,23 +574,41 @@ class ServingEngine(ContinuousBatchingEngine):
         req.error = exc
         req.t_done = _faults.now()
         self.slo_monitor.observe_error(req)
+        u = self.usage
+        rec = u.finish(req, state) if u is not None else None
         _stats.inc(self._FAIL_COUNTERS.get(
             state, "serving.request_errors"))
         jr = self.journal
         if jr is not None:
             ev = state if state in ("deadline_exceeded", "shed") \
                 else "error"
-            jr.record(ev, req.id, slot,
-                      {"error": type(exc).__name__,
-                       "msg": str(exc)[:200]})
+            extra = {"error": type(exc).__name__,
+                     "msg": str(exc)[:200]}
+            if rec is not None:
+                extra["usage"] = rec
+            jr.record(ev, req.id, slot, extra)
         self.finished.append(req)
 
     def _drop_prefill_slot(self, i: int):
         """Vacate prefill slot ``i`` and free its pages (no requeue —
         the caller decides the request's fate)."""
-        self._prefilling.pop(i, None)
+        stt = self._prefilling.pop(i, None)
         if ("prefill", i) in self._mgr._owned:
             self._mgr.free(("prefill", i))
+        u = self.usage
+        if u is not None and stt is not None:
+            u.set_pages(stt.req, 0)
+
+    def _release(self, i: int) -> None:
+        """Serving override: close the vacating request's page-second
+        integral (the ledger's KV accounting) before the base engine
+        frees slot ``i``'s pages."""
+        u = self.usage
+        if u is not None:
+            req = self._slots[i]
+            if req is not None:
+                u.set_pages(req, 0)
+        super()._release(i)
 
     def _expire_deadlines(self):
         """Abort every request whose ``deadline_ms`` budget elapsed —
@@ -576,6 +655,9 @@ class ServingEngine(ContinuousBatchingEngine):
             return False
         req.n_retries += 1
         _stats.inc("serving.step_retries")
+        u = self.usage
+        if u is not None:
+            u.add_event(req, retry=1)
         delay_ms = min(
             float(_flag("serve_retry_backoff_ms"))
             * (2 ** (req.n_retries - 1)),
@@ -743,6 +825,12 @@ class ServingEngine(ContinuousBatchingEngine):
             unserved = len(self._inbox) + len(self.waiting)
             if unserved:
                 _stats.inc("serving.unserved", unserved)
+                u = self.usage
+                if u is not None:
+                    # terminal-state audit: never-admitted requests
+                    # still emit exactly one usage record each
+                    for req in list(self._inbox) + list(self.waiting):
+                        u.finish(req, "unserved")
             if self.journal is not None:
                 self.journal.publish_gauges()
         return self.finished
@@ -922,6 +1010,7 @@ class ServingEngine(ContinuousBatchingEngine):
         costs a page-table update, not a 4k-token program."""
         self._admitting = (req, i)   # crash-isolation attribution
         now = _faults.now()
+        u = self.usage
         if req.t_admitted is None:
             # first admission only — a preempted/requeued request
             # keeps its original marks (queue-wait and TTFT measure
@@ -932,6 +1021,8 @@ class ServingEngine(ContinuousBatchingEngine):
             _stats.observe("serve.queue_wait_ms",
                            (now - arrival) * 1e3)
             _stats.inc("serving.admitted")
+            if u is not None:
+                u.note_queue(req, now - arrival)
             self._hook_first_token(req)
         toks = self._admit_tokens(req)
         shared = []
@@ -951,6 +1042,13 @@ class ServingEngine(ContinuousBatchingEngine):
         key = ("prefill", i)
         if shared:
             self._mgr.share(key, shared)
+            if u is not None:
+                # shared pages charge EACH holder from its own map
+                # time — the sharer starts paying page-seconds now —
+                # and the pages it did NOT have to prefill are a
+                # credit (the prefix-cache's own refs charge nobody)
+                u.credit_prefix(req, len(shared))
+                u.set_pages(req, len(shared), now=now)
         self._prefilling[i] = _Prefill(
             req, pos=len(shared) * self.page_size, tokens=toks)
         self._admitting = None
@@ -1164,6 +1262,9 @@ class ServingEngine(ContinuousBatchingEngine):
                     f"num_pages or cap prompt/generation length")
         if need > have:
             self._mgr.grow(key, need - have)
+            u = self.usage
+            if u is not None:
+                u.set_pages(req, len(self._mgr._owned[key]))
         fi = self.faults
         if fi is not None:
             fi.fire("prefill.dispatch", rid=req.id)
@@ -1195,6 +1296,9 @@ class ServingEngine(ContinuousBatchingEngine):
                           time.perf_counter() - t0)
         _stats.inc("serve.prefill_chunks")
         _stats.inc("serve.prefill_tokens", n)
+        u = self.usage
+        if u is not None:
+            u.add_tokens(req, prefill=n)
         stt.pos += n
         jr = self._journal
         if jr is not None:
@@ -1217,6 +1321,10 @@ class ServingEngine(ContinuousBatchingEngine):
                 _stats.inc("serving.prefix_insert_errors")
         self._slots[i] = req
         req.generated.append(tok)
+        if u is not None:
+            # the final chunk's logits emitted the stream's first
+            # token — a generated (decode-side) token in the ledger
+            u.add_tokens(req, decode=1)
         cb = getattr(req, "on_token", None)
         if cb is not None:
             cb(req, tok)
@@ -1245,6 +1353,10 @@ class ServingEngine(ContinuousBatchingEngine):
         _stats.inc("serving.prefill_requeues")
         req = stt.req
         req.n_requeues = getattr(req, "n_requeues", 0) + 1
+        u = self.usage
+        if u is not None:
+            u.set_pages(req, 0)
+            u.add_event(req, requeue=1)
         jr = self.journal
         if jr is not None:
             jr.record("requeue", req.id, i, {"pos": int(stt.pos)})
@@ -1263,9 +1375,12 @@ class ServingEngine(ContinuousBatchingEngine):
         req = self._slots[j]
         req._resume_tokens = np.concatenate(
             [req.prompt, np.asarray(req.generated, np.int32)])
-        self._release(j)
+        self._release(j)   # the override closes the page integral
         _stats.inc("serving.preemptions")
         req.n_preempts = getattr(req, "n_preempts", 0) + 1
+        u = self.usage
+        if u is not None:
+            u.add_event(req, preempt=1)
         jr = self.journal
         if jr is not None:
             jr.record("preempt", req.id, j,
@@ -1290,4 +1405,8 @@ class ServingEngine(ContinuousBatchingEngine):
             if victim == i:
                 return False
         self._mgr.grow(("slot", i), n_pages)
+        u = self.usage
+        if u is not None and self._slots[i] is not None:
+            u.set_pages(self._slots[i],
+                        len(self._mgr._owned[("slot", i)]))
         return True

@@ -43,13 +43,23 @@ class SLOMonitor:
 
     def __init__(self, ttft_target_ms: Optional[float] = 1000.0,
                  tpot_target_ms: Optional[float] = 100.0,
-                 objective: float = 0.99, window: int = 256):
+                 objective: float = 0.99, window: int = 256,
+                 tenant_windows_max: Optional[int] = None):
         self.ttft_target_ms = ttft_target_ms
         self.tpot_target_ms = tpot_target_ms
         if not 0.0 < float(objective) < 1.0:
             raise ValueError("goodput objective must be in (0, 1)")
         self.objective = float(objective)
         self._window: deque = deque(maxlen=max(int(window), 1))
+        # per-tenant rolling windows (ISSUE 17): created lazily on
+        # the first finish carrying a non-None req.tenant, bounded by
+        # tenant_windows_max (overflow tenants share "__other__") —
+        # the no-tenant default path never allocates any of this
+        if tenant_windows_max is None:
+            from ..core.flags import flag as _flag
+            tenant_windows_max = int(_flag("usage_tenants_max"))
+        self.tenant_windows_max = max(int(tenant_windows_max), 1)
+        self._tenant_windows: dict = {}
         self._lock = threading.Lock()
 
     # ---------------- verdicts ----------------
@@ -77,6 +87,7 @@ class SLOMonitor:
         with self._lock:
             self._window.append(ok)
             good = sum(self._window) / len(self._window)
+            self._roll_tenant(req, ok)
         _stats.inc("slo.finished")
         if ok:
             _stats.inc("slo.ok")
@@ -102,11 +113,55 @@ class SLOMonitor:
         with self._lock:
             self._window.append(False)
             good = sum(self._window) / len(self._window)
+            self._roll_tenant(req, False)
         _stats.inc("slo.finished")
         _stats.inc("slo.errors")
         _stats.set_gauge("slo.goodput", round(good, 4))
         _stats.set_gauge("slo.burn_rate", round(self._burn(good), 3))
         req.slo_ok = False
+
+    # ---------------- per-tenant windows (ISSUE 17) ----------------
+
+    def _roll_tenant(self, req, ok: bool) -> None:
+        """Roll the verdict into the request's tenant window (lock
+        held by the caller). Requests without a tenant cost exactly
+        one attribute read; past ``tenant_windows_max`` tenants the
+        overflow shares one ``__other__`` window — the cardinality
+        bound. Publishes the worst tenant's rolling goodput as the
+        ``tenant.min_goodput`` gauge (the fairness dashboard row)."""
+        t = getattr(req, "tenant", None)
+        if t is None:
+            return
+        w = self._tenant_windows.get(t)
+        if w is None:
+            if len(self._tenant_windows) >= self.tenant_windows_max:
+                t = "__other__"
+                w = self._tenant_windows.get(t)
+            if w is None:
+                w = self._tenant_windows[t] = deque(
+                    maxlen=self._window.maxlen)
+        w.append(ok)
+        worst = min(sum(win) / len(win)
+                    for win in self._tenant_windows.values() if win)
+        _stats.set_gauge("tenant.min_goodput", round(worst, 4))
+
+    def tenant_goodputs(self) -> dict:
+        """Rolling goodput per tenant window (only tenants that have
+        finished at least one request appear)."""
+        with self._lock:
+            return {t: sum(w) / len(w)
+                    for t, w in self._tenant_windows.items() if w}
+
+    def tenant_burn_rates(self) -> dict:
+        return {t: self._burn(g)
+                for t, g in self.tenant_goodputs().items()}
+
+    @property
+    def tenant_min_goodput(self):
+        """Worst tenant's rolling goodput (None before any tenant-
+        stamped finish)."""
+        g = self.tenant_goodputs()
+        return min(g.values()) if g else None
 
     # ---------------- rolling views ----------------
 
@@ -135,6 +190,7 @@ class SLOMonitor:
                          (active + prefilling) / max(slots, 1))
 
     def reset(self) -> None:
-        """Forget the rolling window (bench warmup boundary)."""
+        """Forget the rolling windows (bench warmup boundary)."""
         with self._lock:
             self._window.clear()
+            self._tenant_windows.clear()

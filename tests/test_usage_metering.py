@@ -1,0 +1,746 @@
+"""Per-tenant usage metering (ISSUE 17): device-time attribution,
+KV page-second ledger, terminal-state audit, and tenant-scoped
+SLO/fleet views.
+
+Tier-1 acceptance pins:
+
+- EXACT conservation under chaos: with a seeded fault schedule firing
+  >=3 distinct sites, every work phase's ledger-attributed float ms is
+  BITWISE equal to the ``serve.step.<phase>_ms`` histogram total, the
+  integer-ns per-request split partitions each observation exactly,
+  and ``unattributed_ms`` is exactly 0.0
+  (``TestConservationChaos``);
+- killing 1 of 2 replicas mid-load keeps the FLEET ledger conserved
+  and exactly-once: every request appears once in the folded
+  ``fleet_usage`` view in the ``ok`` state with its device-ns summed
+  across the replicas that actually served it
+  (``TestFleetConservation``);
+- every submitted request ends with EXACTLY ONE closed usage record
+  in a terminal state from {ok, error, deadline_exceeded, shed,
+  unserved} (``TestTerminalAudit``);
+- ``FLAGS_usage_ledger`` off (the default) means NO ledger object and
+  ZERO accounting calls on the serve path — pinned by poisoning every
+  UsageLedger method (``TestLedgerOff``);
+- ``serve_bench --tenants 8 --usage-out`` runs end-to-end on CPU and
+  its JSONL reconciles with the bench's own token throughput
+  (``TestBenchCLI``), and ``trace_merge`` + ``serve_top --tenants``
+  round-trip a fleet export (``TestMergeTopCLI``).
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import stats
+from paddle_tpu.profiler import alerts as alerts_mod
+from paddle_tpu.inference import FusedCausalLM
+from paddle_tpu.serving import (FaultInjector, FleetRouter,
+                                ManualClock, PoolSizingError,
+                                ServerOverloaded, ServingEngine,
+                                SLOConfig, use_clock)
+from paddle_tpu.serving import accounting
+from paddle_tpu.serving.accounting import (DEFAULT_TENANT,
+                                           TERMINAL_STATES,
+                                           UsageLedger, WORK_PHASES,
+                                           fold_records,
+                                           load_usage_jsonl,
+                                           tenant_rollup,
+                                           unattributed_ms)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model(seed=7, max_position=256):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=64, embed_dim=32, num_heads=4,
+                         dim_feedforward=64, num_layers=2,
+                         max_position=max_position)
+
+
+def _engine(model, faults=None, **kw):
+    kw.setdefault("max_batch", 3)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_length", 128)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("slo", SLOConfig(prefill_chunk=16))
+    return ServingEngine(model, faults=faults, **kw)
+
+
+def _router(n=2, seed=7, policy="affinity", faults=None, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_length", 96)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("slo", SLOConfig(prefill_chunk=8))
+    return FleetRouter(
+        engine_factory=lambda i: ServingEngine(_model(seed), **kw),
+        n_replicas=n, policy=policy, faults=faults)
+
+
+def _prompts(lens=(6, 10, 14, 9), seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 64, (L,)) for L in lens]
+
+
+class _flags:
+    """Scoped flag override (flags are process-global)."""
+
+    def __init__(self, **kw):
+        self._new = {f"FLAGS_{k}": v for k, v in kw.items()}
+
+    def __enter__(self):
+        self._old = paddle.get_flags(list(self._new))
+        paddle.set_flags(self._new)
+        return self
+
+    def __exit__(self, *exc):
+        paddle.set_flags(self._old)
+
+
+@pytest.fixture(autouse=True)
+def _restore_usage_flags():
+    names = ["FLAGS_usage_ledger", "FLAGS_usage_tenants_max",
+             "FLAGS_usage_top_k"]
+    old = paddle.get_flags(names)
+    yield
+    paddle.set_flags(old)
+
+
+def _tools(name):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _fake_req(rid=1, tenant="t0"):
+    return types.SimpleNamespace(id=rid, tenant=tenant)
+
+
+def _assert_conserved(eng):
+    """The tentpole invariant on one engine: per-phase BITWISE float
+    equality with the stats histograms, exact integer-ns partition,
+    and zero unattributed device time."""
+    u = eng.usage
+    led_ms = u.attributed_ms()
+    led_n = u.phase_counts()
+    _, _, hists = stats.sample_values()
+    seen = 0
+    for ph in WORK_PHASES:
+        h = hists.get(f"serve.step.{ph}_ms")
+        if h is None:
+            assert ph not in led_ms or led_ms[ph] == 0.0
+            continue
+        seen += 1
+        count, total = h
+        assert led_ms.get(ph, 0.0) == total, ph   # bitwise ==
+        assert led_n.get(ph, 0) == count, ph
+    assert seen, "no work phase observed at all"
+    # integer-ns conservation: per-request shares + the system residue
+    # re-add to the per-phase ns totals EXACTLY
+    per_req: dict = {}
+    for rec in u.records():
+        for ph, ns in rec["phase_ns"].items():
+            per_req[ph] = per_req.get(ph, 0) + ns
+    sys_ns = u.system_ns_totals()
+    for ph, ns in u.phase_ns_totals().items():
+        assert per_req.get(ph, 0) + sys_ns.get(ph, 0) == ns, ph
+    assert unattributed_ms(u) == 0.0
+
+
+# =====================================================================
+# tentpole: exact conservation under chaos
+# =====================================================================
+
+@pytest.mark.chaos
+class TestConservationChaos:
+    def test_single_engine_chaos_exact_conservation(self):
+        """Seeded faults at >=3 distinct sites (pool squeeze, prefill
+        dispatch raise, decode raise, prefix-insert raise): the
+        retry/requeue churn makes attribution genuinely hard, and the
+        ledger still conserves bitwise."""
+        stats.reset()
+        inj = (FaultInjector()
+               .add("kv.grow", kind="raise", at=1)
+               .add("prefill.dispatch", kind="raise", at=2)
+               .add("decode.step", kind="raise", at=2)
+               .add("decode.step", kind="squeeze", pages=6, at=5)
+               .add("prefix.insert", kind="raise", at=0))
+        with _flags(usage_ledger=True):
+            eng = _engine(_model(), faults=inj)
+            rids = [eng.submit(p, max_new_tokens=6,
+                               tenant=f"t{i % 2}")
+                    for i, p in enumerate(_prompts((37, 6, 9, 12)))]
+            done = {r.id: r for r in eng.run()}
+        assert len({f["site"] for f in inj.fired}) >= 3
+        for rid in rids:
+            assert done[rid].state in TERMINAL_STATES
+        _assert_conserved(eng)
+        # every submitted request has exactly one CLOSED record
+        closed = {r["rid"]: r for r in
+                  eng.usage.records(include_open=False)}
+        assert set(closed) == set(rids)
+        for rid in rids:
+            assert closed[rid]["state"] == done[rid].state
+
+    def test_clean_run_conserves_and_rolls_up_tenants(self):
+        stats.reset()
+        with _flags(usage_ledger=True):
+            eng = _engine(_model())
+            rids = [eng.submit(p, max_new_tokens=5,
+                               tenant=("alpha", "beta")[i % 2])
+                    for i, p in enumerate(_prompts())]
+            done = {r.id: r for r in eng.run()}
+        assert all(done[r].state == "ok" for r in rids)
+        _assert_conserved(eng)
+        roll = tenant_rollup(eng.usage.records())
+        assert set(roll) == {"alpha", "beta"}
+        assert sum(a["n_requests"] for a in roll.values()) == len(rids)
+        # shares partition the attributed device time
+        assert sum(a["share"] for a in roll.values()) \
+            == pytest.approx(1.0, abs=1e-6)
+        # decode tokens reconcile with what each request generated
+        n_decode = sum(a["decode_tokens"] for a in roll.values())
+        assert n_decode == sum(len(done[r].generated) for r in rids)
+
+    def test_untenanted_requests_bill_default_tenant(self):
+        with _flags(usage_ledger=True):
+            eng = _engine(_model())
+            rid = eng.submit(np.arange(6), max_new_tokens=3)
+            eng.run()
+        rec = eng.usage.record_of(rid)
+        assert rec["tenant"] == DEFAULT_TENANT
+
+
+# =====================================================================
+# KV page-seconds on the manual clock (hand-computed trajectory)
+# =====================================================================
+
+class TestPageSeconds:
+    def test_hand_computed_page_second_integral(self):
+        clk = ManualClock(100.0)
+        u = UsageLedger(clock=clk.now)
+        r = _fake_req()
+        u.set_pages(r, 2)           # t=100.0: 0 pages before -> free
+        clk.advance(0.5)
+        u.set_pages(r, 5)           # +2 * 0.5
+        clk.advance(0.25)
+        u.set_pages(r, 0)           # +5 * 0.25
+        clk.advance(1.0)            # holding 0 pages: no charge
+        snap = u.finish(r, "ok")
+        assert snap["kv_page_s"] == pytest.approx(2 * 0.5 + 5 * 0.25)
+
+    def test_finish_closes_open_page_integral(self):
+        clk = ManualClock(0.0)
+        u = UsageLedger(clock=clk.now)
+        r = _fake_req()
+        u.set_pages(r, 3)
+        clk.advance(2.0)
+        snap = u.finish(r, "ok")    # close integrates the open span
+        assert snap["kv_page_s"] == pytest.approx(6.0)
+
+    def test_queue_seconds_and_events(self):
+        u = UsageLedger()
+        r = _fake_req()
+        u.note_queue(r, 0.125)
+        u.add_event(r, retry=2, preempt=1, requeue=3)
+        u.credit_prefix(r, 4)
+        rec = u.finish(r, "ok")
+        assert rec["queue_s"] == pytest.approx(0.125)
+        assert rec["retries"] == 2
+        assert rec["preemptions"] == 1
+        assert rec["requeues"] == 3
+        assert rec["prefix_pages_saved"] == 4
+
+    def test_charge_phase_partitions_ns_exactly(self):
+        u = UsageLedger()
+        reqs = [_fake_req(i, f"t{i}") for i in range(3)]
+        u.charge_phase("decode_chunk", 0.0100001, reqs)
+        total = round(0.0100001 * 1e6)
+        shares = [u.record_of(r.id)["phase_ns"]["decode_chunk"]
+                  for r in reqs]
+        assert sum(shares) == total           # exact partition
+        assert max(shares) - min(shares) <= 1  # fair to the ns
+        assert u.phase_counts()["decode_chunk"] == 1
+        assert u.attributed_ms()["decode_chunk"] == 0.0100001
+
+    def test_empty_target_list_lands_on_system(self):
+        u = UsageLedger()
+        u.charge_phase("decode_chunk", 1.5, ())
+        assert u.system_ns_totals()["decode_chunk"] \
+            == round(1.5 * 1e6)
+        assert u.attributed_ms()["decode_chunk"] == 1.5
+        assert not u.records()
+
+
+# =====================================================================
+# fleet: replica-kill failover + migration stay exactly-once
+# =====================================================================
+
+@pytest.mark.chaos
+class TestFleetConservation:
+    def test_kill_one_of_two_fleet_ledger_exactly_once(self):
+        """The PR's fleet pin: a replica dies mid-load, every request
+        finishes on the survivor, and the FOLDED fleet ledger charges
+        each exactly once — device-ns summed over both hops, one
+        terminal ``ok`` state, zero unattributed time."""
+        stats.reset()
+        with _flags(usage_ledger=True):
+            router = _router(2)
+            prompts = _prompts()
+            rids = [router.submit(p, max_new_tokens=6,
+                                  tenant=f"t{i % 2}")
+                    for i, p in enumerate(prompts)]
+            for _ in range(3):
+                router.step()
+            victim = next(r.idx for r in router.replicas
+                          if r.eng.has_work)
+            router.kill(victim)
+            done = {r.id: r for r in router.run()}
+        assert all(done[r].state == "ok" for r in rids)
+        folded = router.fleet_usage()
+        by_rid = {}
+        for rec in folded:
+            assert rec["rid"] not in by_rid, "rid charged twice"
+            by_rid[rec["rid"]] = rec
+        assert set(by_rid) == set(rids)
+        for rid in rids:
+            assert by_rid[rid]["state"] == "ok"
+        # a failed-over request's record folds across >1 hop
+        assert any(r["hops"] > 1 for r in folded) or \
+            stats.counter("fleet.failover_requests").value >= 1
+        # fleet conservation: Sum ledger ns == Sum histogram ms within
+        # one rounding quantum per observation; no unattributed time
+        ledgers = [rep.eng.usage for rep in router.replicas
+                   if rep.eng.usage is not None]
+        if router.usage is not None:
+            ledgers.append(router.usage)
+        assert unattributed_ms(*ledgers) == 0.0
+        _, _, hists = stats.sample_values()
+        for ph in WORK_PHASES:
+            h = hists.get(f"serve.step.{ph}_ms")
+            if h is None:
+                continue
+            count, total = h
+            led_ns = sum(u.phase_ns_totals().get(ph, 0)
+                         for u in ledgers)
+            assert led_ns / 1e6 == pytest.approx(
+                total, abs=count * 0.5e-6 + 1e-9), ph
+
+    def test_drain_migration_charged_once_on_destination(self):
+        stats.reset()
+        with _flags(usage_ledger=True):
+            router = _router(2)
+            rids = [router.submit(p, max_new_tokens=8, tenant="mig")
+                    for p in _prompts((12, 10))]
+            for _ in range(6):          # get slots mid-decode
+                router.step()
+            src = next((r.idx for r in router.replicas
+                        if r.eng.num_active), None)
+            if src is not None:
+                router.drain(src)
+            done = {r.id: r for r in router.run()}
+        assert all(done[r].state == "ok" for r in rids)
+        folded = {r["rid"]: r for r in router.fleet_usage()}
+        assert set(folded) == set(rids)
+        mig_ns = sum(r["phase_ns"].get("migration", 0)
+                     for r in folded.values())
+        _, _, hists = stats.sample_values()
+        h = hists.get("serve.step.migration_ms")
+        if h is not None:               # a migration actually ran
+            assert mig_ns / 1e6 == pytest.approx(
+                h[1], abs=h[0] * 0.5e-6 + 1e-9)
+        ledgers = [rep.eng.usage for rep in router.replicas]
+        ledgers.append(router.usage)
+        assert unattributed_ms(*[u for u in ledgers
+                                 if u is not None]) == 0.0
+
+
+# =====================================================================
+# terminal-state audit: every request closes exactly once
+# =====================================================================
+
+@pytest.mark.chaos
+class TestTerminalAudit:
+    def test_ok_closes_once_and_refuses_double_close(self):
+        u = UsageLedger()
+        r = _fake_req()
+        u.add_tokens(r, decode=3)
+        assert u.finish(r, "ok") is not None
+        assert u.finish(r, "error") is None      # exactly-once
+        assert u.record_of(r.id)["state"] == "ok"
+
+    def test_persistent_fault_closes_error(self):
+        inj = FaultInjector().add("prefill.dispatch", kind="raise",
+                                  every=1, times=-1)
+        with _flags(usage_ledger=True):
+            eng = _engine(_model(), faults=inj)
+            rids = [eng.submit(p, max_new_tokens=4)
+                    for p in _prompts((6, 9))]
+            done = {r.id: r for r in eng.run()}
+        assert all(done[r].state == "error" for r in rids)
+        recs = {r["rid"]: r for r in
+                eng.usage.records(include_open=False)}
+        assert set(recs) == set(rids)
+        assert all(recs[r]["state"] == "error" for r in rids)
+        assert all(recs[r]["retries"] > 0 for r in rids)
+
+    def test_deadline_closes_deadline_exceeded(self):
+        with _flags(usage_ledger=True), \
+                use_clock(ManualClock()) as clk:
+            eng = _engine(_model(), max_batch=1)
+            r_ok = eng.submit(np.arange(6) + 1, max_new_tokens=4)
+            r_dead = eng.submit(np.arange(9) + 2, max_new_tokens=4,
+                                deadline_ms=50.0)
+            clk.advance(0.2)
+            done = {r.id: r for r in eng.run()}
+        assert done[r_dead].state == "deadline_exceeded"
+        assert done[r_ok].state == "ok"
+        recs = {r["rid"]: r for r in
+                eng.usage.records(include_open=False)}
+        assert recs[r_dead]["state"] == "deadline_exceeded"
+        assert recs[r_ok]["state"] == "ok"
+
+    def test_shed_at_submit_closes_shed(self):
+        with _flags(usage_ledger=True, serve_inbox_limit=2):
+            eng = _engine(_model())
+            eng.submit(np.arange(4), max_new_tokens=2)
+            eng.submit(np.arange(4), max_new_tokens=2)
+            with pytest.raises(ServerOverloaded):
+                eng.submit(np.arange(4), max_new_tokens=2,
+                           tenant="noisy")
+            shed = [r for r in eng.usage.records(include_open=False)
+                    if r["state"] == "shed"]
+            assert len(shed) == 1
+            assert shed[0]["tenant"] == "noisy"
+            eng.run()
+
+    def test_crash_exit_closes_unserved(self, tmp_path):
+        """A config crash aborts the loop with a request still
+        waiting — the audit closes it as ``unserved`` so its queue
+        time is not silently lost."""
+        with _flags(usage_ledger=True,
+                    serve_journal_dir=str(tmp_path)):
+            eng = _engine(_model(), max_batch=1, max_length=64,
+                          num_pages=15, slo=SLOConfig(prefill_chunk=8))
+            rng = np.random.RandomState(37)
+            r_big = eng.submit(rng.randint(0, 64, (56,)),
+                               max_new_tokens=8)
+            r_wait = eng.submit(np.arange(5), max_new_tokens=2,
+                                tenant="queued")
+            with pytest.raises(PoolSizingError):
+                eng.run()
+        states = {r["rid"]: r["state"]
+                  for r in eng.usage.records(include_open=False)}
+        assert states.get(r_wait) == "unserved"
+        assert r_big not in states or \
+            states[r_big] in TERMINAL_STATES
+
+    def test_fold_state_precedence_and_hop_dedup(self):
+        """A dispatch-retried request can close ``shed`` on replica A
+        and ``ok`` on replica B — the fold resolves by rank (ok wins),
+        and re-merging the same hop's dump adds nothing."""
+        base = {"type": "usage", "tenant": "t", "queue_s": 0.0,
+                "kv_page_s": 0.0, "prefill_tokens": 0,
+                "decode_tokens": 0, "spec_accepted_tokens": 0,
+                "wasted_tokens": 0, "retries": 0, "preemptions": 0,
+                "requeues": 0, "prefix_pages_saved": 0}
+        a = dict(base, rid=1, state="shed", hop=0,
+                 phase_ns={"decode_chunk": 100})
+        b = dict(base, rid=1, state="ok", hop=1,
+                 phase_ns={"decode_chunk": 250})
+        folded = fold_records([a, b, dict(a)])   # hop 0 twice
+        assert len(folded) == 1
+        rec = folded[0]
+        assert rec["state"] == "ok"              # rank precedence
+        assert rec["phase_ns"]["decode_chunk"] == 350  # deduped
+        assert rec["hops"] == 2
+
+
+# =====================================================================
+# flag off: zero ledger, zero accounting calls
+# =====================================================================
+
+class TestLedgerOff:
+    def test_flag_off_means_no_ledger_and_zero_calls(self,
+                                                     monkeypatch):
+        """The PR 9 journal-off pin, replayed for the ledger: with
+        ``FLAGS_usage_ledger`` off (the default) the serve path must
+        never touch ANY UsageLedger method — each one is poisoned."""
+        paddle.set_flags({"FLAGS_usage_ledger": False})
+
+        def boom(*a, **kw):
+            raise AssertionError("UsageLedger touched with flag off")
+
+        for name in ("charge_phase", "set_pages", "note_queue",
+                     "add_tokens", "add_event", "credit_prefix",
+                     "finish", "publish_gauges"):
+            monkeypatch.setattr(UsageLedger, name, boom)
+        eng = _engine(_model())
+        assert eng.usage is None
+        assert eng._usage is None
+        rid = eng.submit(np.arange(8), max_new_tokens=4,
+                         tenant="ignored")
+        done = {r.id: r for r in eng.run()}
+        assert done[rid].state == "ok"
+
+    def test_flag_off_router_has_no_ledger(self):
+        paddle.set_flags({"FLAGS_usage_ledger": False})
+        router = _router(2)
+        assert router.usage is None
+        assert all(rep.eng.usage is None for rep in router.replicas)
+        assert router.fleet_usage() == []
+        with tempfile.TemporaryDirectory() as d:
+            assert router.export_usage(d) == []
+
+
+# =====================================================================
+# tenant-scoped SLO windows, gauges, alerting
+# =====================================================================
+
+class TestTenantViews:
+    def _run_tenants(self, tenants, max_new=3):
+        eng = _engine(_model())
+        prompts = _prompts(tuple(6 + 2 * i for i in range(len(tenants))))
+        rids = [eng.submit(p, max_new_tokens=max_new, tenant=t)
+                for p, t in zip(prompts, tenants)]
+        done = {r.id: r for r in eng.run()}
+        return eng, rids, done
+
+    def test_per_tenant_goodput_windows(self):
+        with _flags(usage_ledger=True):
+            eng, rids, done = self._run_tenants(["a", "a", "b"])
+        g = eng.slo_monitor.tenant_goodputs()
+        assert set(g) == {"a", "b"}
+        assert all(0.0 <= v <= 1.0 for v in g.values())
+        mg = eng.slo_monitor.tenant_min_goodput
+        assert mg == pytest.approx(min(g.values()))
+
+    def test_tenant_window_overflow_buckets_other(self):
+        with _flags(usage_ledger=True, usage_tenants_max=2):
+            eng, rids, done = self._run_tenants(
+                ["t0", "t1", "t2", "t3"])
+        g = eng.slo_monitor.tenant_goodputs()
+        assert "__other__" in g
+        assert len(g) <= 3          # 2 named + overflow bucket
+
+    def test_publish_gauges_bounded_cardinality(self):
+        stats.reset()
+        with _flags(usage_ledger=True, usage_top_k=2):
+            eng, rids, done = self._run_tenants(["a", "b", "c"])
+            eng.usage.publish_gauges(top_k=2)
+        assert stats.gauge("tenant.count").value == 3
+        assert 0.0 < stats.gauge("tenant.max_share").value <= 1.0
+        assert stats.gauge("usage.records").value == len(rids)
+        # index-keyed topN: bounded names, no per-tenant explosion
+        names = {n for n in stats.snapshot()["gauges"]
+                 if n.startswith("tenant.top")}
+        assert names <= {"tenant.top0.device_ms",
+                         "tenant.top1.device_ms"}
+
+    def test_tenant_hog_rule_in_default_alerts(self):
+        rules = alerts_mod.default_rules()
+        hog = [r for r in rules if r.name == "tenant-hog"]
+        assert len(hog) == 1
+        assert hog[0].metric == "tenant.max_share"
+        assert hog[0].threshold == pytest.approx(0.8)
+
+    def test_wasted_chunk_tail_charged_to_finisher(self):
+        """decode_chunk=2 with max_new=4 finishes mid-chunk: the
+        executed-but-discarded tail tokens land on the finisher's
+        record and reconcile with the global waste counter."""
+        stats.reset()
+        with _flags(usage_ledger=True):
+            eng = _engine(_model(), slo=SLOConfig(
+                prefill_chunk=16, prefix_cache=False))
+            rids = [eng.submit(p, max_new_tokens=4)
+                    for p in _prompts((7, 11))]
+            eng.run()
+        wasted = sum(r["wasted_tokens"] for r in eng.usage.records())
+        assert wasted == int(
+            stats.counter("serving.wasted_decode_tokens").value)
+        roll = tenant_rollup(eng.usage.records())
+        for agg in roll.values():
+            assert 0.0 <= agg["waste_share"] <= 1.0
+
+    def test_prefix_share_credited(self):
+        """The second request over an identical prompt reuses cached
+        prefix pages; the ledger credits the pages it did NOT have to
+        prefill."""
+        with _flags(usage_ledger=True):
+            eng = _engine(_model())
+            p = np.arange(12) % 64
+            r1 = eng.submit(p, max_new_tokens=2)
+            eng.run()
+            r2 = eng.submit(p, max_new_tokens=2)
+            eng.run()
+        rec2 = eng.usage.record_of(r2)
+        if stats.counter("serving.prefix_hit").value:
+            assert rec2["prefix_pages_saved"] > 0
+
+
+# =====================================================================
+# tools: gate directions, tenant table, fold round-trip
+# =====================================================================
+
+class TestTools:
+    def test_bench_gate_gates_usage_rungs(self):
+        bench_gate = _tools("bench_gate")
+        m = bench_gate.DEFAULT_METRICS
+        assert m["serve_tenant_max_share"] == "up"
+        assert m["usage_unattributed_ms"] == "up"
+
+    def test_serve_top_render_tenants_table(self):
+        serve_top = _tools("serve_top")
+        base = {"tenant": "acme", "rid": 1, "state": "ok",
+                "phase_ns": {"decode_chunk": 2_000_000},
+                "device_ms": 2.0, "queue_s": 0.01, "kv_page_s": 0.5,
+                "prefill_tokens": 8, "decode_tokens": 4,
+                "spec_accepted_tokens": 0, "wasted_tokens": 1,
+                "retries": 0, "preemptions": 0, "requeues": 0,
+                "prefix_pages_saved": 0}
+        other = dict(base, tenant="beta", rid=2,
+                     phase_ns={"decode_chunk": 6_000_000},
+                     device_ms=6.0, wasted_tokens=0)
+        txt = serve_top.render_tenants([base, other], accounting)
+        assert "acme" in txt and "beta" in txt
+        assert "waste" in txt
+        # sorted by device time: beta (6ms) above acme (2ms)
+        assert txt.index("beta") < txt.index("acme")
+
+    def test_serve_top_engine_view_reports_disabled(self):
+        serve_top = _tools("serve_top")
+        paddle.set_flags({"FLAGS_usage_ledger": False})
+        eng = _engine(_model())
+        txt = serve_top.render_tenants_engine(eng)
+        assert "usage" in txt.lower()
+
+    def test_dump_load_fold_round_trip(self, tmp_path):
+        with _flags(usage_ledger=True):
+            eng = _engine(_model())
+            rids = [eng.submit(p, max_new_tokens=3, tenant="rt")
+                    for p in _prompts((6, 9))]
+            eng.run()
+        path = str(tmp_path / "usage_r0.jsonl")
+        eng.usage.dump_jsonl(path, hop=0)
+        loaded = load_usage_jsonl(path)
+        assert {r["rid"] for r in loaded} == set(rids)
+        folded = fold_records(loaded + loaded)   # same hop: dedup
+        assert len(folded) == len(rids)
+        want = {r["rid"]: r["phase_ns"] for r in loaded}
+        for rec in folded:
+            assert rec["phase_ns"] == want[rec["rid"]]
+
+
+# =====================================================================
+# CLI end-to-end (subprocess, CPU)
+# =====================================================================
+
+@pytest.mark.chaos
+class TestBenchCLI:
+    def test_serve_bench_tenants_reconciles(self, tmp_path):
+        """CLI pin: ``--tenants 8 --usage-out`` emits the tenant
+        rungs, writes a JSONL whose closed records cover every served
+        request, reports zero unattributed time, and the ledger's
+        decode tokens reconcile with the bench's own throughput."""
+        usage_path = str(tmp_path / "usage.jsonl")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "serve_bench.py"),
+             "--streams", "2", "--requests", "8", "--max-new", "4",
+             "--prompt-mix", "8,24", "--prefill-chunk", "16",
+             "--decode-chunk", "4", "--rate", "500", "--no-lint",
+             "--tenants", "8", "--tenant-skew", "1.0",
+             "--usage-out", usage_path],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["serve_tenant_count"] >= 2
+        assert 0.0 < out["serve_tenant_max_share"] <= 1.0
+        assert out["usage_unattributed_ms"] == 0.0
+        if out["serve_tenant_min_goodput"] is not None:
+            assert 0.0 <= out["serve_tenant_min_goodput"] <= 1.0
+        recs = load_usage_jsonl(usage_path)
+        closed = [r for r in recs if r["state"] is not None]
+        assert len(closed) == out["serve_requests"]
+        assert all(r["state"] in TERMINAL_STATES for r in closed)
+        assert len({r["tenant"] for r in closed}) \
+            == out["serve_tenant_count"]
+        # throughput reconciliation: the ledger's decode tokens are
+        # the same tokens serve_tokens_per_sec counted
+        n_decode = sum(r["decode_tokens"] for r in recs)
+        bench_tokens = out["serve_tokens_per_sec"] * out["serve_wall_s"]
+        assert n_decode == pytest.approx(
+            bench_tokens, rel=0.05, abs=2.0)
+
+    def test_serve_bench_without_tenants_emits_off_defaults(self):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "serve_bench.py"),
+             "--streams", "1", "--requests", "3", "--max-new", "3",
+             "--prompt-mix", "8", "--prefill-chunk", "16",
+             "--decode-chunk", "4", "--rate", "500", "--no-lint"],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        # gated keys are ALWAYS present (bench_gate needs both sides)
+        assert out["serve_tenant_count"] == 0
+        assert out["serve_tenant_max_share"] == 0.0
+        assert out["usage_unattributed_ms"] == 0.0
+
+
+@pytest.mark.chaos
+class TestMergeTopCLI:
+    def test_fleet_export_merge_top_round_trip(self, tmp_path):
+        with _flags(usage_ledger=True):
+            router = _router(2)
+            rids = [router.submit(p, max_new_tokens=4,
+                                  tenant=f"t{i % 3}")
+                    for i, p in enumerate(_prompts())]
+            done = {r.id: r for r in router.run()}
+            assert all(done[r].state == "ok" for r in rids)
+            paths = router.export_usage(str(tmp_path))
+        assert len(paths) == 3      # 2 replicas + router
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "trace_merge.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["usage_records"] == len(rids)
+        merged = out["out_usage"]
+        assert merged and os.path.exists(merged)
+        folded = [json.loads(line) for line in open(merged)]
+        assert {r["rid"] for r in folded} == set(rids)
+        # re-merging must not double-count: the merged output is
+        # excluded from discovery
+        proc2 = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "trace_merge.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc2.returncode == 0, proc2.stderr[-2000:]
+        out2 = json.loads(proc2.stdout.strip().splitlines()[-1])
+        assert out2["usage_records"] == len(rids)
+        # serve_top renders the merged fleet ledger offline
+        proc3 = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "serve_top.py"),
+             "--tenants", merged],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc3.returncode == 0, proc3.stderr[-2000:]
+        assert "t0" in proc3.stdout
+        assert "device_ms" in proc3.stdout or "device" in proc3.stdout

@@ -174,6 +174,13 @@ DEFAULT_METRICS: Dict[str, str] = {
     "serve_step_host_overhead_ms": "up",
     "alert_fired": "up",
     "alert.fired": "up",
+    # per-tenant usage metering (ISSUE 17): one tenant's share of
+    # attributed device time regresses UP (a hog crowding out the
+    # rest of the mix), and usage_unattributed_ms regresses UP with
+    # NO noise floor — device time the ledger failed to attribute is
+    # an accounting leak however small (strict-compared like lint)
+    "serve_tenant_max_share": "up",
+    "usage_unattributed_ms": "up",
 }
 
 #: absolute-change floors so tiny counts/latencies don't trip the
@@ -235,12 +242,13 @@ def _metric_value(block: dict, name: str) -> Optional[float]:
 
 def _regressed(name: str, direction: str, prev: float, cur: float,
                tol: float) -> bool:
-    if name.startswith(("lint", "alert")) \
+    if name.startswith(("lint", "alert", "usage")) \
             or name == "moe.dropped_tokens":
-        # lint findings, alert fires, and no-drop-mode dropped tokens
-        # must only go down between rounds — ANY growth regresses, no
-        # noise floor (a single new finding / alert / dropped token
-        # is a real defect, not measurement jitter)
+        # lint findings, alert fires, unattributed device time, and
+        # no-drop-mode dropped tokens must only go down between
+        # rounds — ANY growth regresses, no noise floor (a single new
+        # finding / alert / unattributed ms / dropped token is a real
+        # defect, not measurement jitter)
         return cur > prev if direction == "up" else cur < prev
     floor = _ABS_FLOOR_US if name.endswith("_us") else _ABS_FLOOR_COUNT
     if direction == "up":

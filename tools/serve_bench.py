@@ -56,6 +56,18 @@ SURVIVING request's greedy tokens are identical to the fault-free
 run, and goodput stays within a pinned bound of the fault-free run's.
 Emits ``serve_chaos_*`` keys (gated by tools/bench_gate.py) and exits
 nonzero when any pin fails.
+
+``--tenants K`` (ISSUE 17) stamps a Zipf-popular tenant id on every
+request (rank k drawn ∝ 1/(k+1)^``--tenant-skew``) and turns the
+per-tenant usage ledger on (``serving/accounting.py``): the run emits
+``serve_tenant_{count,max_share,min_goodput}`` and
+``usage_unattributed_ms`` — the last gated UP by bench_gate with NO
+noise floor (device time the ledger failed to attribute is an
+accounting leak however small). ``--usage-out`` dumps the per-request
+usage JSONL (``serve_top --tenants`` / ``trace_merge`` input; fleet
+runs write one ``_r<idx>`` file per replica plus ``_router``). The
+usage keys are ALWAYS emitted with ledger-off defaults so the gated
+key set is stable across runs.
 """
 from __future__ import annotations
 
@@ -136,6 +148,60 @@ def _alert_keys():
         "serve_step_host_overhead_ms": round(h.total / h.count, 4)
         if h.count else None,
     }
+
+
+def _usage_keys(eng=None, router=None):
+    """The per-tenant usage scalars (ISSUE 17) — ALWAYS emitted, with
+    ledger-off defaults, so bench_gate's gated key set is stable:
+    ``serve_tenant_max_share`` regresses UP (one tenant crowding out
+    the rest) and ``usage_unattributed_ms`` UP with no noise floor."""
+    from paddle_tpu.serving.accounting import (tenant_rollup,
+                                               unattributed_ms)
+
+    if router is not None:
+        ledgers = [r.eng.usage for r in router.replicas
+                   if r.eng.usage is not None]
+        if router.usage is not None:
+            ledgers.append(router.usage)
+        recs = router.fleet_usage() if ledgers else []
+        mons = [r.eng.slo_monitor for r in router.replicas]
+    else:
+        ledgers = [eng.usage] if eng.usage is not None else []
+        recs = eng.usage.records() if ledgers else []
+        mons = [eng.slo_monitor]
+    if not ledgers:
+        return {"serve_tenant_count": 0,
+                "serve_tenant_max_share": 0.0,
+                "serve_tenant_min_goodput": None,
+                "usage_unattributed_ms": 0.0}
+    roll = tenant_rollup(recs)
+    goodputs = [m.tenant_min_goodput for m in mons
+                if m.tenant_min_goodput is not None]
+    return {
+        "serve_tenant_count": len(roll),
+        "serve_tenant_max_share": round(max(
+            (t["share"] for t in roll.values()), default=0.0), 4),
+        "serve_tenant_min_goodput": round(min(goodputs), 4)
+        if goodputs else None,
+        "usage_unattributed_ms": unattributed_ms(*ledgers),
+    }
+
+
+def _dump_usage(args, eng=None, router=None):
+    """--usage-out: per-request usage JSONL. Single engine writes one
+    hop-0 file; a fleet writes the export_journals shape — one
+    ``<prefix>_r<idx>.jsonl`` per replica plus ``<prefix>_router`` —
+    which trace_merge folds back into one record per request."""
+    if not args.usage_out:
+        return
+    if router is not None:
+        import os
+
+        d = os.path.dirname(args.usage_out) or "."
+        base = os.path.basename(args.usage_out)
+        router.export_usage(d, prefix=base.replace(".jsonl", ""))
+    elif eng is not None and eng.usage is not None:
+        eng.usage.dump_jsonl(args.usage_out, hop=0)
 
 
 def build_engine(args, faults=None):
@@ -219,6 +285,20 @@ def make_requests(args, lens, rng):
     return reqs
 
 
+def _assign_tenants(reqs, args, rng):
+    """Stamp a Zipf-popular tenant id on every request — rank k drawn
+    ∝ 1/(k+1)^``--tenant-skew`` — turning ``(prompt, gap)`` pairs into
+    ``(prompt, gap, tenant)`` triples. The skew is what makes
+    ``tenant.max_share`` move: a uniform tenant mix never trips the
+    tenant-hog alert rule."""
+    k = max(int(args.tenants), 1)
+    w = np.array([1.0 / (i + 1) ** args.tenant_skew
+                  for i in range(k)])
+    w /= w.sum()
+    return [(p, g, f"tenant{int(rng.choice(k, p=w))}")
+            for p, g in reqs]
+
+
 def drive(eng, reqs, max_new, deadline_ms=None):
     """Submit on a background thread at the Poisson arrival times;
     run the scheduler loop here until every submitted request reaches
@@ -235,7 +315,7 @@ def drive(eng, reqs, max_new, deadline_ms=None):
     def submitter():
         try:
             t_next = time.monotonic()
-            for prompt, gap in reqs:
+            for prompt, gap, *rest in reqs:
                 t_next += gap
                 delay = t_next - time.monotonic()
                 if delay > 0:
@@ -243,7 +323,9 @@ def drive(eng, reqs, max_new, deadline_ms=None):
                 try:
                     rids.append(eng.submit(prompt,
                                            max_new_tokens=max_new,
-                                           deadline_ms=deadline_ms))
+                                           deadline_ms=deadline_ms,
+                                           tenant=rest[0] if rest
+                                           else None))
                 except ServerOverloaded:
                     rids.append(None)  # backpressure — dropped load
         except BaseException as e:  # surface on the main thread
@@ -331,6 +413,10 @@ def _fleet_warm(router, args, lens, prefixes):
         rep.eng.slo_monitor.reset()
         if rep.eng.journal is not None:
             rep.eng.journal.clear()
+        if rep.eng.usage is not None:
+            rep.eng.usage.reset()   # the ledger describes the load run
+    if router.usage is not None:
+        router.usage.reset()
     router._tracked.clear()
     stats.reset()
 
@@ -347,14 +433,16 @@ def drive_fleet(router, reqs, max_new, deadline_ms=None,
     rids = []
     t0 = time.monotonic()
     t_next = t0
-    for prompt, gap in reqs:
+    for prompt, gap, *rest in reqs:
         t_next += gap
         delay = t_next - time.monotonic()
         if delay > 0:
             time.sleep(delay)
         try:
             rids.append(router.submit(prompt, max_new_tokens=max_new,
-                                      deadline_ms=deadline_ms))
+                                      deadline_ms=deadline_ms,
+                                      tenant=rest[0] if rest
+                                      else None))
         except ServerOverloaded:
             rids.append(None)
     deadline = time.monotonic() + timeout_s
@@ -403,6 +491,8 @@ def run_fleet(args):
     rng = np.random.RandomState(args.seed)
     router, lens = build_fleet(args)
     reqs, prefixes = make_fleet_requests(args, lens, rng)
+    if args.tenants:
+        reqs = _assign_tenants(reqs, args, rng)
     if not args.no_warmup:
         _fleet_warm(router, args, lens, prefixes)
     sampler = _start_telemetry(
@@ -428,6 +518,7 @@ def run_fleet(args):
         d = os.path.dirname(args.journal_out) or "."
         base = os.path.basename(args.journal_out)
         router.export_journals(d, prefix=base.replace(".jsonl", ""))
+    _dump_usage(args, router=router)
     out = {
         "fleet_replicas": args.fleet,
         "fleet_policy": args.fleet_policy,
@@ -453,6 +544,7 @@ def run_fleet(args):
         "telemetry": _telemetry(),
     }
     out.update(_alert_keys())
+    out.update(_usage_keys(router=router))
     out.update(tele_out)
     ok = True
     if args.chaos:
@@ -745,6 +837,22 @@ def main():
                          "a failed pin)")
     ap.add_argument("--chaos-seed", type=int, default=None,
                     help="fault-schedule seed (default: --seed)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="multi-tenant workload (ISSUE 17): stamp a "
+                         "Zipf-popular tenant id (K distinct) on "
+                         "every request and turn the per-tenant "
+                         "usage ledger on; emits serve_tenant_* and "
+                         "usage_unattributed_ms (the latter gated UP "
+                         "by bench_gate with no noise floor)")
+    ap.add_argument("--tenant-skew", type=float, default=1.0,
+                    help="Zipf exponent for tenant popularity "
+                         "(rank k drawn ∝ 1/(k+1)^skew; 0 = uniform)")
+    ap.add_argument("--usage-out", default=None,
+                    help="dump the per-request usage JSONL "
+                         "(serve_top --tenants / trace_merge input); "
+                         "implies the usage ledger on; fleet runs "
+                         "write <path>_r<idx>.jsonl per replica plus "
+                         "<path>_router.jsonl")
     ap.add_argument("--requests-out", default=None,
                     help="write per-request JSONL (id, lens, waits, "
                          "ttft/tpot, preempt/requeue counts, slo_ok) "
@@ -810,6 +918,13 @@ def main():
 
     preflight("serve_bench", no_lint=args.no_lint)
 
+    if args.tenants or args.usage_out:
+        # must land before any engine/router is constructed — the
+        # ledger is wired (or not) at __init__
+        from paddle_tpu.core.flags import set_flags
+
+        set_flags({"usage_ledger": True})
+
     from paddle_tpu.profiler import stats
 
     if args.fleet and args.fleet > 1:
@@ -841,9 +956,13 @@ def main():
         eng.slo_monitor.reset()
         if eng.journal is not None:
             eng.journal.clear()  # the journal describes the load run
+        if eng.usage is not None:
+            eng.usage.reset()    # so does the usage ledger
         stats.reset()
 
     reqs = make_requests(args, lens, rng)
+    if args.tenants:
+        reqs = _assign_tenants(reqs, args, rng)
     sampler = _start_telemetry(args, journal=eng.journal)
     wall, rids = drive(eng, reqs, args.max_new,
                        deadline_ms=args.deadline_ms)
@@ -886,6 +1005,9 @@ def main():
                 }) + "\n")
     if args.journal_out and eng.journal is not None:
         eng.journal.dump_jsonl(args.journal_out)
+    if eng.usage is not None:
+        eng.usage.publish_gauges()
+    _dump_usage(args, eng=eng)
     out = {
         "serve_p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 3),
         "serve_p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 3),
@@ -911,6 +1033,7 @@ def main():
         "telemetry": _telemetry(),
     }
     out.update(_alert_keys())
+    out.update(_usage_keys(eng=eng))
     out.update(tele_out)
     chaos_ok = True
     if args.chaos:

@@ -14,6 +14,18 @@ running engine:
     python tools/serve_top.py j.jsonl --interval 2      # clock-seam watch
     python tools/serve_top.py --fleet j_r0.jsonl j_r1.jsonl  # fleet
     python tools/serve_top.py --history telemetry.jsonl # sparklines
+    python tools/serve_top.py --tenants usage.jsonl     # per-tenant
+
+``--tenants`` (ISSUE 17) renders the per-tenant usage table —
+attributed device time + share, KV page-seconds, queue seconds,
+token counts and the wasted-token share — from usage JSONL dumps
+(``serve_bench --usage-out`` / ``UsageLedger.dump_jsonl`` /
+``FleetRouter.export_usage``). Passing SEVERAL dumps folds them to
+one record per request first (``accounting.fold_records`` — the
+merged fleet tenant view: a failed-over request is charged once).
+The live in-process forms are ``render_tenants_engine(engine)`` and
+``render_fleet(router)`` (which appends the fleet tenant table when
+the ledger is on).
 
 ``--history`` (ISSUE 16) renders sparkline views (goodput /
 burn-rate / queue depth / throughput / phase occupancy, plus an
@@ -56,7 +68,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 __all__ = ["summarize", "render", "render_engine", "render_fleet",
            "render_fleet_offline", "render_history", "sparkline",
-           "main"]
+           "render_tenants", "render_tenants_engine", "main"]
 
 
 def _journal_mod():
@@ -89,6 +101,18 @@ def _ts_mod():
     spec = importlib.util.spec_from_file_location(
         "_serve_timeseries", os.path.join(
             _REPO, "paddle_tpu", "profiler", "timeseries.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _accounting_mod():
+    """serving/accounting.py loaded standalone (stdlib-only at
+    import) — ``--tenants`` folds usage JSONL dumps without paying
+    the jax import."""
+    spec = importlib.util.spec_from_file_location(
+        "_serve_accounting", os.path.join(
+            _REPO, "paddle_tpu", "serving", "accounting.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
@@ -441,7 +465,66 @@ def render_fleet(router, top: int = 5) -> str:
         f"hedges {int(c('fleet.hedges').value)}  "
         f"shed {int(c('fleet.shed').value)}  pending "
         f"{router.pending()}")
+    if getattr(router, "usage", None) is not None or any(
+            getattr(r.eng, "usage", None) is not None
+            for r in router.replicas):
+        # ISSUE 17: the merged fleet tenant view — per-replica
+        # ledgers folded so a failed-over request is charged once
+        from paddle_tpu.serving import accounting as am
+
+        lines.append(render_tenants(router.fleet_usage(), am,
+                                    top=top))
     return "\n".join(lines)
+
+
+# ---------------- per-tenant usage (ISSUE 17) ----------------
+
+
+def render_tenants(records: List[dict], am, top: int = 10) -> str:
+    """Per-tenant usage table over (possibly folded) usage records:
+    attributed device time + share of it, KV page-seconds, queue
+    seconds, token counts, the wasted-token share (the chunk-tail
+    tokens a finishing request stranded), and the terminal-state mix.
+    ``am`` is the accounting module (standalone or package form)."""
+    roll = am.tenant_rollup(records)
+    if not roll:
+        return "serve_top --tenants: no usage records"
+    rows = sorted(roll.values(),
+                  key=lambda a: (-a["device_ns"], a["tenant"]))
+    lines = [
+        f"serve_top --tenants — {len(rows)} tenants, "
+        f"{sum(a['n_requests'] for a in rows)} requests, "
+        f"{sum(a['device_ms'] for a in rows):.1f}ms attributed "
+        "device time",
+        f"  {'tenant':<14} {'reqs':>5} {'device_ms':>10} "
+        f"{'share':>6} {'kv_page_s':>10} {'queue_s':>8} "
+        f"{'prefill':>8} {'decode':>7} {'waste':>6} states",
+    ]
+    for a in rows[:max(top, 0)]:
+        states = ",".join(f"{k}:{v}" for k, v in
+                          sorted(a["states"].items()))
+        lines.append(
+            f"  {a['tenant']:<14} {a['n_requests']:>5} "
+            f"{a['device_ms']:>10.3f} {a['share']:>6.1%} "
+            f"{a['kv_page_s']:>10.4f} {a['queue_s']:>8.4f} "
+            f"{a['prefill_tokens']:>8} {a['decode_tokens']:>7} "
+            f"{a['waste_share']:>6.1%} {states}")
+    if len(rows) > top > 0:
+        lines.append(f"  ... {len(rows) - top} more tenants")
+    return "\n".join(lines)
+
+
+def render_tenants_engine(eng, top: int = 10) -> str:
+    """Live per-tenant table over a RUNNING ServingEngine's usage
+    ledger (open records included — in-flight requests show their
+    running charges)."""
+    u = getattr(eng, "usage", None)
+    if u is None:
+        return ("serve_top --tenants: usage ledger disabled "
+                "(FLAGS_usage_ledger=0)")
+    from paddle_tpu.serving import accounting as am
+
+    return render_tenants(u.records(include_open=True), am, top=top)
 
 
 # ---------------- telemetry history (ISSUE 16) ----------------
@@ -623,7 +706,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("journal", nargs="*",
                     help="journal or crash-dump JSONL path; with "
                          "--fleet, one per replica (replica id = "
-                         "argument order); optional with --history")
+                         "argument order); with --tenants, usage "
+                         "JSONL dump(s); optional with --history")
+    ap.add_argument("--tenants", action="store_true",
+                    help="per-tenant usage table (ISSUE 17) from "
+                         "usage JSONL dump(s) (serve_bench "
+                         "--usage-out / FleetRouter.export_usage); "
+                         "several dumps fold to one record per "
+                         "request first — the merged fleet tenant "
+                         "view")
     ap.add_argument("--fleet", action="store_true",
                     help="fleet view (ISSUE 14): one health/"
                          "occupancy/goodput row per replica journal "
@@ -664,6 +755,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     interval = args.interval if args.interval is not None \
         else args.watch
     jm = _journal_mod()
+
+    if args.tenants:
+        if not args.journal:
+            ap.error("--tenants needs usage JSONL path(s)")
+        am = _accounting_mod()
+
+        def render_once():
+            recs: List[dict] = []
+            for p in args.journal:
+                recs.extend(am.load_usage_jsonl(p))
+            return render_tenants(am.fold_records(recs), am,
+                                  top=max(args.top, 10))
+        return _watch_loop(render_once, interval)
 
     if args.history is None and not args.journal:
         ap.error("pass a journal JSONL (or --history SERIES.jsonl)")

@@ -20,16 +20,25 @@ folds them into ONE fleet view:
   semantics — ticks align by timestamp order, counters sum
   (cumulative + rate), gauges max, histogram count/total pairs sum,
   alert sets union — into ``merged_telemetry.jsonl``, which
-  ``serve_top --history`` renders directly.
+  ``serve_top --history`` renders directly;
+- **fleet usage ledger** (ISSUE 17): per-replica usage JSONL dumps
+  (``FleetRouter.export_usage`` / ``serve_bench --usage-out``, named
+  ``*usage*_r{i}.jsonl`` / ``*usage*_router.jsonl`` /
+  ``usage_rank{i}.jsonl``) fold via
+  ``serving.accounting.fold_records`` — dedup on (hop, rid), then
+  sum per (tenant, rid) so a failed-over/migrated request is charged
+  exactly once — into ``merged_usage.jsonl``, which ``serve_top
+  --tenants`` renders directly.
 
 Usage::
 
     python tools/trace_merge.py RUN_DIR \
         [--out-trace merged_trace.json] [--out-stats fleet_stats.json] \
-        [--out-series merged_telemetry.jsonl]
+        [--out-series merged_telemetry.jsonl] \
+        [--out-usage merged_usage.jsonl]
 
 Prints one JSON line {ranks, events, out_trace, out_stats,
-out_series, ticks}.
+out_series, ticks, out_usage, usage_records}.
 """
 from __future__ import annotations
 
@@ -45,7 +54,8 @@ from typing import List, Optional, Tuple
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 __all__ = ["merge_traces", "fold_stats", "fold_series",
-           "find_rank_files", "find_series_files", "main"]
+           "fold_usage", "find_rank_files", "find_series_files",
+           "find_usage_files", "main"]
 
 
 def _ts_mod():
@@ -56,6 +66,19 @@ def _ts_mod():
     spec = importlib.util.spec_from_file_location(
         "_tm_timeseries", os.path.join(
             _REPO, "paddle_tpu", "profiler", "timeseries.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _accounting_mod():
+    """serving/accounting.py loaded standalone (stdlib-only at
+    import) — the usage fold reuses the ledger's own
+    load_usage_jsonl/fold_records instead of re-implementing the
+    exactly-once semantics here."""
+    spec = importlib.util.spec_from_file_location(
+        "_tm_accounting", os.path.join(
+            _REPO, "paddle_tpu", "serving", "accounting.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
@@ -209,6 +232,32 @@ def fold_series(paths: List[str], tsm=None) -> List[dict]:
     return tsm.aggregate_ticks([tsm.load_jsonl(p) for p in paths])
 
 
+def find_usage_files(run_dir: str) -> List[str]:
+    """Per-replica usage-ledger dumps in a run dir (the
+    ``FleetRouter.export_usage`` / ``serve_bench --usage-out`` naming:
+    ``<prefix>_r{i}.jsonl`` + ``<prefix>_router.jsonl`` with "usage"
+    in the prefix, or ``usage_rank{i}.jsonl``). The merged output
+    itself is excluded so a re-run never folds its own product."""
+    found = (
+        set(glob.glob(os.path.join(run_dir, "*usage*_r*.jsonl")))
+        | set(glob.glob(os.path.join(run_dir, "*usage*_router.jsonl")))
+        | set(glob.glob(os.path.join(run_dir, "usage_rank*.jsonl"))))
+    return sorted(p for p in found
+                  if os.path.basename(p) != "merged_usage.jsonl")
+
+
+def fold_usage(paths: List[str], am=None) -> List[dict]:
+    """Fold per-replica usage dumps into one record per request via
+    the ledger's own ``fold_records`` (dedup on (hop, rid), integer
+    phase_ns/token counts sum per (tenant, rid), terminal state by
+    precedence — a failed-over request is charged exactly once)."""
+    am = am if am is not None else _accounting_mod()
+    recs: List[dict] = []
+    for p in paths:
+        recs.extend(am.load_usage_jsonl(p))
+    return am.fold_records(recs)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="merge per-rank chrome traces + stats snapshots "
@@ -223,20 +272,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--out-series", default=None,
                     help="fleet telemetry series path (default "
                          "RUN_DIR/merged_telemetry.jsonl)")
+    ap.add_argument("--out-usage", default=None,
+                    help="folded fleet usage-ledger path (default "
+                         "RUN_DIR/merged_usage.jsonl; serve_top "
+                         "--tenants input)")
     args = ap.parse_args(argv)
 
     traces, stats = find_rank_files(args.run_dir)
     series = find_series_files(args.run_dir)
-    if not traces and not stats and not series:
+    usage = find_usage_files(args.run_dir)
+    if not traces and not stats and not series and not usage:
         print(f"trace_merge: no rank files under {args.run_dir} "
               "(expected trace_rank*.json / stats_rank*.json / "
-              "*.paddle_trace.json / telemetry_rank*.jsonl)",
+              "*.paddle_trace.json / telemetry_rank*.jsonl / "
+              "*usage*_r*.jsonl)",
               file=sys.stderr)
         return 2
 
     out = {"ranks": 0, "events": 0,
            "out_trace": None, "out_stats": None,
-           "out_series": None, "ticks": 0}
+           "out_series": None, "ticks": 0,
+           "out_usage": None, "usage_records": 0}
     if traces:
         merged = merge_traces(traces)
         out_trace = args.out_trace or os.path.join(
@@ -268,6 +324,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         out["out_series"] = out_series
         out["ticks"] = len(folded)
         out["ranks"] = max(out["ranks"], len(series))
+    if usage:
+        folded_u = fold_usage(usage)
+        out_usage = args.out_usage or os.path.join(
+            args.run_dir, "merged_usage.jsonl")
+        with open(out_usage, "w") as f:
+            for rec in folded_u:
+                f.write(json.dumps(rec) + "\n")
+        out["out_usage"] = out_usage
+        out["usage_records"] = len(folded_u)
+        out["ranks"] = max(out["ranks"], len(usage))
     print(json.dumps(out))
     return 0
 
