@@ -267,6 +267,31 @@ def build_decode_program(cast_bf16: bool = True):
     return fn, args
 
 
+def _build_decode_lora():
+    """The adaptered k-step decode program (ISSUE 18): the same decode
+    loop with the per-slot adapter ids and the AdapterBank's traced
+    ``{proj}_a``/``{proj}_b`` operands riding along — every adapter's
+    ragged grouped delta is fused onto the weight stream inside the
+    step, so the hot-loop/host-sync and donation contracts must hold
+    exactly as on the plain decode program."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from ..serving.adapters import AdapterBank
+
+    model, eng, cache, tables, b = _tiny_engine()
+    head = _engine_common_args(model, eng, cache, tables)
+    bank = AdapterBank.from_stack(model.stack._stack(), slots=4,
+                                  rank=8)
+    bank.load(bank.random_adapter("site"))
+    fn = functools.partial(eng._decode_k_fn, k=8, sample_cfg=None)
+    args = head + (_sds((b,), jnp.int32), _sds((b,), jnp.int32),
+                   cache.k, cache.v, tables, None, None,
+                   _sds((b,), jnp.int32), bank.operands())
+    return fn, args
+
+
 def _build_spec_verify():
     """The speculative-decoding batched verify program (ISSUE 12,
     inference/speculative.py): one streamed prefill-chunk pass over the
@@ -369,6 +394,9 @@ PROGRAM_SITES: List[ProgramSite] = [
     ProgramSite("inference.prefill", _build_prefill,
                 compute_dtype="bfloat16", donate_argnums=(7, 8)),
     ProgramSite("inference.decode", _build_decode,
+                compute_dtype="bfloat16", hot_loop=True,
+                donate_argnums=(7, 8)),
+    ProgramSite("inference.decode_lora", _build_decode_lora,
                 compute_dtype="bfloat16", hot_loop=True,
                 donate_argnums=(7, 8)),
     ProgramSite("serve.verify", _build_spec_verify,

@@ -51,10 +51,14 @@ def _force_tpu_routing():
     import paddle_tpu.nn.functional.attention as att
     import paddle_tpu.nn.functional.flash_varlen as fv
     import paddle_tpu.nn.functional.grouped_gemm as gg
+    import paddle_tpu.nn.functional.lora as lora
     import paddle_tpu.nn.functional.stream_linear as sl
 
+    # lora.py binds grouped_gemm's _on_tpu by name at import, so it
+    # carries its own module-level reference to patch
     saved = [(sl, "_on_tpu", sl._on_tpu), (att, "_on_tpu", att._on_tpu),
-             (fv, "_on_tpu", fv._on_tpu), (gg, "_on_tpu", gg._on_tpu)]
+             (fv, "_on_tpu", fv._on_tpu), (gg, "_on_tpu", gg._on_tpu),
+             (lora, "_on_tpu", lora._on_tpu)]
     x64 = bool(jax.config.jax_enable_x64)
     try:
         for mod, name, _ in saved:
@@ -484,6 +488,42 @@ def _expected_grouped_gemm_bwd():
     return 2 * fwd + dx + dw
 
 
+# batched multi-LoRA delta kernel (ISSUE 18): a serving-shaped ffn1
+# delta bank — 8 adapter slots, rank 8 padded to the bf16 sublane tile
+# (R = 16), d=2048 -> dff=8192, 1024 adapter-sorted rows. The bank
+# dtype drives the same bm=128 / bn=2048 stream geometry as the MoE
+# bank above; each work unit chains TWO dots (down to the rank, back
+# up) inside one launch.
+_LORA = dict(T=1024, K=2048, N=8192, S=8, R=16, bm=128, bn=2048)
+
+
+def _build_lora_delta():
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.lora import lora_delta
+
+    T, K, N, S, R = (_LORA[k] for k in ("T", "K", "N", "S", "R"))
+
+    def fn(x, a, b, offsets):
+        return lora_delta(x, a, b, offsets, backend="pallas")
+
+    return fn, (_sds((T, K), jnp.bfloat16),
+                _sds((S, K, R), jnp.bfloat16),
+                _sds((S, R, N), jnp.bfloat16),
+                _sds((S + 1,), jnp.int32))
+
+
+def _expected_lora_delta():
+    # x and the A tile index only on the work unit (the slow grid
+    # axis), so neither double-buffers against the bn walk; the A
+    # tile's R=16 lane axis pads to the full 128-lane tile
+    K, R, bm, bn = (_LORA[k] for k in ("K", "R", "bm", "bn"))
+    return (_B((bm, K), "bfloat16")            # x row tile (dynamic map)
+            + _B((1, K, R), "bfloat16")        # A down-proj tile
+            + 2 * _B((1, R, bn), "bfloat16")   # B up-proj stream
+            + 2 * _B((bm, bn), "float32"))     # delta tile stream
+
+
 KERNEL_SITES: List[KernelSite] = [
     KernelSite("stream_linear.bf16", "nn/functional/stream_linear.py",
                _build_stream_linear, _expected_stream_linear),
@@ -524,6 +564,10 @@ KERNEL_SITES: List[KernelSite] = [
     KernelSite("grouped_gemm.bwd", "nn/functional/grouped_gemm.py",
                _build_grouped_gemm_bwd, _expected_grouped_gemm_bwd,
                n_calls=4),
+    # batched multi-LoRA delta (ISSUE 18): one ragged launch carrying
+    # every adapter's x·A·B for an adapter-sorted chunk
+    KernelSite("lora.delta", "nn/functional/lora.py",
+               _build_lora_delta, _expected_lora_delta),
 ]
 
 

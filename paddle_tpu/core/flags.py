@@ -235,6 +235,32 @@ define_flag("fleet_dispatch_queue", 4096,
             "yet-admitted requests (every replica's inbox + waiting "
             "list) past this shed new submits with the typed "
             "FleetOverloaded BEFORE any replica admits; 0 = unbounded")
+define_flag("lora_delta_backend", "auto",
+            "batched multi-LoRA ragged delta-GEMM backend "
+            "(nn/functional/lora.py lora_delta): auto (Pallas kernel "
+            "on TPU, the math-identical tiled XLA walk elsewhere) | "
+            "pallas | interpret (the kernel through the Pallas "
+            "interpreter — debug/parity) | xla")
+define_flag("tenant_quota_rps", 0.0,
+            "router-tier per-tenant request rate limit "
+            "(serving/router.py): submits from one tenant past this "
+            "many requests per second (measured over "
+            "FLAGS_tenant_quota_window_s on the injectable serving "
+            "clock) shed with the typed TenantQuotaExceeded before "
+            "any replica admits; 0 disables")
+define_flag("tenant_quota_tokens", 0,
+            "router-tier per-tenant token quota (serving/router.py): "
+            "tokens billed to one tenant by the usage ledger "
+            "(prefill + decode, FLAGS_usage_ledger must be on) "
+            "within the rolling FLAGS_tenant_quota_window_s window "
+            "past this shed the tenant's new submits with "
+            "TenantQuotaExceeded; 0 disables")
+define_flag("tenant_quota_window_s", 1.0,
+            "rolling window (serving-clock seconds) both tenant "
+            "quota legs measure against: the rate limiter keeps a "
+            "per-tenant arrival deque pruned to this window and the "
+            "token quota re-baselines each tenant's ledger token "
+            "count once the window elapses")
 define_flag("usage_ledger", False,
             "per-request -> per-tenant usage metering "
             "(serving/accounting.py UsageLedger): partitions every "

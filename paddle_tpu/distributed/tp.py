@@ -114,6 +114,22 @@ _STACK_LAYOUT = {
     "moe_w2": "ep4", "moe_b2": "ep3",
 }
 
+#: LoRA adapter-bank operand -> layout (serving/adapters.py, banks
+#: ``{proj}_a [L, S, K, R]`` / ``{proj}_b [L, S, R, N]``). The delta
+#: composes with the base shards WITHOUT new collectives: column-
+#: parallel projections (qkv, ffn1) replicate A and column-split B
+#: (the delta's output columns shard exactly like the base output);
+#: row-parallel projections (out, ffn2) row-split A along the base
+#: contraction shards and replicate B (``x·A = Σ_s x_s·A_s``, so each
+#: shard's delta partial joins the base partial BEFORE the layer's
+#: existing psum — still exactly 2 psums/layer).
+_ADAPTER_LAYOUT = {
+    "qkv_a": "rep", "qkv_b": "col_b",
+    "ffn1_a": "rep", "ffn1_b": "col_b",
+    "out_a": "row_a", "out_b": "rep",
+    "ffn2_a": "row_a", "ffn2_b": "rep",
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class TPContext:
@@ -249,6 +265,19 @@ class TPContext:
             return self.pspec(None, self.ep_axis, None, None)
         if kind == "ep3" and self.ep > 1:
             return self.pspec(None, self.ep_axis, None)
+        return self.pspec()
+
+    def adapter_spec(self, name: str):
+        """PartitionSpec for one LoRA adapter-bank operand
+        (``_ADAPTER_LAYOUT``): B of column-parallel projections splits
+        its output columns [L, S, R, N/mp], A of row-parallel ones
+        splits its contraction rows [L, S, K/mp, R], everything else
+        replicates."""
+        kind = _ADAPTER_LAYOUT.get(name, "rep")
+        if kind == "col_b" and self.mp > 1:
+            return self.pspec(None, None, None, self.axis)
+        if kind == "row_a" and self.mp > 1:
+            return self.pspec(None, None, self.axis, None)
         return self.pspec()
 
     def replicate(self, arr):

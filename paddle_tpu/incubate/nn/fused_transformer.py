@@ -271,9 +271,36 @@ class FusedMultiTransformer(Layer):
                 backend=flag("moe_grouped_backend"))
         return y.reshape(*lead, self.embed_dim)
 
+    @staticmethod
+    def _lora_delta_fn(adapters):
+        """Per-projection LoRA delta closure over ONE layer's adapter
+        view (``{proj}_a [S, K, R]`` / ``{proj}_b`` banks plus the
+        chunk's shared ``order``/``inv``/``offsets`` from
+        ``sort_by_adapter``). Returns f32 ``[.., N]`` or None when the
+        projection has no adapter target — base-model tokens sorted
+        past ``offsets[-1]`` get exact-zero rows from the work map."""
+        from ...core.flags import flag
+        from ...nn.functional.lora import lora_delta
+
+        backend = flag("lora_delta_backend")
+
+        def delta(x, kind):
+            a = adapters.get(f"{kind}_a")
+            if a is None:
+                return None
+            b = adapters[f"{kind}_b"]
+            x2 = x.reshape(-1, x.shape[-1])
+            xs = jnp.take(x2, adapters["order"], axis=0)
+            d = lora_delta(xs, a, b, adapters["offsets"],
+                           backend=backend)
+            d = jnp.take(d, adapters["inv"], axis=0)
+            return d.reshape(*x.shape[:-1], d.shape[-1])
+
+        return delta
+
     def _layer_body(self, w, h, positions, kv_write, attend, cos_t,
                     sin_t, linear=None, a8w8=False, psum_axis=None,
-                    ep_axis=None, ep_size=1):
+                    ep_axis=None, ep_size=1, adapters=None):
         """One pre-LN transformer layer over hidden ``h`` (any leading
         dims). Compute dtype FOLLOWS h (bf16 weights + bf16 h → pure
         bf16 MXU dots; LN statistics promote to fp32 internally and are
@@ -290,6 +317,10 @@ class FusedMultiTransformer(Layer):
         (fused_multi_transformer_op.cu:220,529). Per-output-channel
         int8 scales commute with the sum, so dequant stays per-shard."""
         eps = self.epsilon
+        if adapters is not None and linear is not None:
+            raise ValueError(
+                "_layer_body: adapters compose with the default linear "
+                "only (the decode loop has its own adaptered branch)")
         if linear is None:
             if a8w8:
                 def raw(x, kind):
@@ -300,8 +331,18 @@ class FusedMultiTransformer(Layer):
                     return self._mm(x, w[f"{kind}_weight"],
                                     w.get(f"{kind}_scale"))
 
+            lora = None if adapters is None \
+                else self._lora_delta_fn(adapters)
+
             def linear(x, kind):
                 y = raw(x, kind)
+                if lora is not None:
+                    # the delta joins the per-shard partial BEFORE the
+                    # row-parallel psum (x·A = Σ_shards x_s·A_s), so TP
+                    # keeps exactly its two collectives per layer
+                    d = lora(x, kind)
+                    if d is not None:
+                        y = y + d
                 if psum_axis is not None and kind in ("out", "ffn2"):
                     y = jax.lax.psum(y, psum_axis)
                 return y + w[f"{kind}_bias"]
@@ -366,7 +407,7 @@ class FusedMultiTransformer(Layer):
         return v
 
     def _tp_wrap(self, tp, method: str, weights, x, cache, tables,
-                 rep_args, cos_t, sin_t, a8w8):
+                 rep_args, cos_t, sin_t, a8w8, adapters=None):
         """shard_map a raw phase over the ``mp`` and/or ``ep`` mesh
         axes: weights enter pre-sharded (TPContext.shard_stack specs —
         column/row slices over ``mp``, the MoE expert bank 1/ep over
@@ -403,21 +444,35 @@ class FusedMultiTransformer(Layer):
         kv = tp.kv_spec()
         psum_axis = tp.axis if tp.mp > 1 else None
         ep_axis = tp.ep_axis if tp.ep > 1 else None
+        adaptered = adapters is not None
+        aspecs = None
+        if adaptered:
+            # adapter banks shard alongside the base stacks
+            # (_ADAPTER_LAYOUT): B column-split for col-parallel
+            # projections, A row-split for row-parallel ones, the
+            # per-token slot ids replicated
+            aspecs = {n: (rep if n == "slots" else tp.adapter_spec(n))
+                      for n in adapters}
 
         def body(w, xb, ck, cv, tbl, cos, sin, *extras):
+            kw = dict(a8w8=a8w8, psum_axis=psum_axis, ep_axis=ep_axis,
+                      ep_size=tp.ep)
+            if adaptered:
+                kw["adapters"] = extras[-1]
+                extras = extras[:-1]
             h, cache2 = getattr(view, method)(
-                w, xb, PagedKV(ck, cv), tbl, *extras, cos, sin,
-                a8w8=a8w8, psum_axis=psum_axis, ep_axis=ep_axis,
-                ep_size=tp.ep)
+                w, xb, PagedKV(ck, cv), tbl, *extras, cos, sin, **kw)
             return h, cache2.k, cache2.v
 
         fn = shard_map_fn()(
             body, mesh=tp.mesh,
             in_specs=(wspecs, rep, kv, kv, rep, rep, rep)
-            + (rep,) * len(rep_args),
+            + (rep,) * len(rep_args)
+            + ((aspecs,) if adaptered else ()),
             out_specs=(rep, kv, kv), check_rep=False)
         h, nk, nv = fn(weights, x, cache.k, cache.v, tables,
-                       cos_t, sin_t, *rep_args)
+                       cos_t, sin_t, *rep_args,
+                       *((adapters,) if adaptered else ()))
         return h, PagedKV(nk, nv)
 
     def prefill_raw(self, weights, x, cache, block_tables, cos_t, sin_t,
@@ -487,7 +542,7 @@ class FusedMultiTransformer(Layer):
     def prefill_chunk_raw(self, weights, x, cache, block_tables, start,
                           chunk_lens, cos_t, sin_t, a8w8=False,
                           tp=None, psum_axis=None, ep_axis=None,
-                          ep_size=1):
+                          ep_size=1, adapters=None):
         """CHUNKED prompt pass: x [b, c, d] embeds tokens at positions
         ``start[b] .. start[b]+c-1`` of sequences whose earlier tokens
         (previous chunks, or a shared prefix mapped by the prefix
@@ -510,7 +565,7 @@ class FusedMultiTransformer(Layer):
             return self._tp_wrap(tp, "prefill_chunk_raw", weights, x,
                                  cache, block_tables,
                                  (start, chunk_lens), cos_t, sin_t,
-                                 a8w8)
+                                 a8w8, adapters=adapters)
         from ...core.flags import flag
         from ...nn.functional.flash_varlen import paged_prefill_attention
         from ...nn.functional.paged_attention import (
@@ -531,6 +586,21 @@ class FusedMultiTransformer(Layer):
         # pages IN PLACE (no per-chunk dense gather copy)
         use_varlen = (flag("prefill_attention_backend") != "gather"
                       and not isinstance(cache.k, tuple))
+
+        ad_base = None
+        if adapters is not None:
+            from ...nn.functional.lora import (
+                inverse_order, sort_by_adapter)
+            # per-row slots broadcast to per-token and sorted ONCE for
+            # the whole chunk; the layer loop slices the banks at l.
+            # Padding rows inherit their row's slot — their deltas are
+            # garbage the caller already discards.
+            S_ad = adapters["qkv_a"].shape[1]
+            slots_tok = jnp.repeat(
+                adapters["slots"].astype(jnp.int32), c)
+            order, offsets, _ = sort_by_adapter(slots_tok, S_ad)
+            ad_base = {"order": order, "inv": inverse_order(order),
+                       "offsets": offsets}
 
         def body(l, carry):
             h, ck, cv = carry
@@ -576,10 +646,17 @@ class FusedMultiTransformer(Layer):
                 return out.reshape(b, c, n_kv * group, hd) \
                     .astype(q.dtype)
 
+            ad = None
+            if ad_base is not None:
+                ad = dict(ad_base)
+                for n, a in adapters.items():
+                    if n.endswith("_a") or n.endswith("_b"):
+                        ad[n] = jax.lax.dynamic_index_in_dim(
+                            a, l, 0, False)
             h, ck, cv = self._layer_body(
                 w, h, positions, kv_write, attend, cos_t, sin_t,
                 a8w8=a8w8, psum_axis=psum_axis, ep_axis=ep_axis,
-                ep_size=ep_size)
+                ep_size=ep_size, adapters=ad)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(
@@ -600,7 +677,8 @@ class FusedMultiTransformer(Layer):
 
     def decode_raw(self, weights, x, cache: PagedKV, block_tables,
                    seq_lens, cos_t, sin_t, a8w8=False, tp=None,
-                   psum_axis=None, ep_axis=None, ep_size=1):
+                   psum_axis=None, ep_axis=None, ep_size=1,
+                   adapters=None):
         """One decode step: x [b, d] token embeddings, seq_lens [b] =
         tokens already cached (the new token's position). Returns
         (hidden [b, d], cache').
@@ -645,7 +723,7 @@ class FusedMultiTransformer(Layer):
         if tp is not None:
             return self._tp_wrap(tp, "decode_raw", weights, x, cache,
                                  block_tables, (seq_lens,), cos_t,
-                                 sin_t, a8w8)
+                                 sin_t, a8w8, adapters=adapters)
         npages = self._pages_per_layer(cache)
         lens1 = (seq_lens + 1).astype(jnp.int32)
         # token-level pool ownership (the stream kernels' mask) is
@@ -726,6 +804,95 @@ class FusedMultiTransformer(Layer):
             return _split_rope(qkv.astype(h.dtype), seq_lens,
                                self.num_heads, self.num_kv_heads,
                                self.head_dim, cos_t, sin_t)
+
+        if adapters is not None:
+            # ADAPTERED decode: per-projection streamed base matmul
+            # plus ONE ragged grouped delta launch per target
+            # projection — tokens sorted by adapter slot once per step,
+            # membership riding the traced work map so the compiled
+            # program is independent of which adapters are loaded. The
+            # fused grouped tail is base-only (a delta join point
+            # cannot live inside its Pallas grid), so this branch runs
+            # the four-call per-layer form. Under TP the delta partial
+            # joins the base partial BEFORE the row-parallel psum
+            # (x·A = Σ_shards x_s·A_s with B replicated), keeping
+            # exactly two collectives per layer.
+            if is_moe:
+                raise NotImplementedError(
+                    "adaptered decode composes with the dense stack "
+                    "only (no MoE expert-bank form yet)")
+            if isinstance(weights, (list, tuple)):
+                raise ValueError(
+                    "adaptered decode takes the STACKED weight dict "
+                    "(banks are layer-stacked [L, S, ...] arrays)")
+            from ...nn.functional.lora import (
+                inverse_order, lora_delta, sort_by_adapter)
+            from ...nn.functional.stream_linear import _apply_activation
+
+            lora_backend = flag("lora_delta_backend")
+            S_ad = adapters["qkv_a"].shape[1]
+            order, offsets, _ = sort_by_adapter(
+                adapters["slots"].astype(jnp.int32), S_ad)
+            inv = inverse_order(order)
+            L = self.num_layers
+
+            def small(name, l):
+                return jax.lax.dynamic_index_in_dim(
+                    weights[name], l, 0, False)
+
+            def delta(xx, kind, l):
+                a4 = adapters.get(f"{kind}_a")
+                if a4 is None:
+                    return None
+                a3 = jax.lax.dynamic_index_in_dim(a4, l, 0, False)
+                b3 = jax.lax.dynamic_index_in_dim(
+                    adapters[f"{kind}_b"], l, 0, False)
+                xs = jnp.take(xx, order, axis=0)
+                d = lora_delta(xs, a3, b3, offsets,
+                               backend=lora_backend)
+                return jnp.take(d, inv, axis=0)
+
+            def proj(xx, kind, l, reduce=False, activation=None):
+                # f32 partial with bias/activation deferred past the
+                # delta join (and past the psum for row-parallel kinds)
+                y = stream_linear(
+                    xx, weights[f"{kind}_weight"], layer=l,
+                    scale=weights.get(f"{kind}_scale"),
+                    act_quant=a8w8, out_dtype=jnp.float32)
+                d = delta(xx, kind, l)
+                if d is not None:
+                    y = y + d
+                if reduce and psum_axis is not None:
+                    y = jax.lax.psum(y, psum_axis)
+                y = y + small(f"{kind}_bias", l).astype(jnp.float32)
+                if activation is not None:
+                    y = _apply_activation(y, activation)
+                return y
+
+            def body(l, carry):
+                h, ck, cv = carry
+                hn = self._ln(h, small("ln1_scale", l),
+                              small("ln1_bias", l),
+                              self.epsilon).astype(h.dtype)
+                qkv = proj(hn, "qkv", l)
+                q, k, v = split_rope(qkv, h)
+                att, ck, cv = attend_fn(q, k, v, ck, cv, block_tables,
+                                        l * npages)
+                att = att.reshape(*h.shape[:-1], d_att).astype(h.dtype)
+                h = (h + proj(att, "out", l, reduce=True)) \
+                    .astype(h.dtype)
+                hn = self._ln(h, small("ln2_scale", l),
+                              small("ln2_bias", l),
+                              self.epsilon).astype(h.dtype)
+                ff = proj(hn, "ffn1", l,
+                          activation=self.activation).astype(h.dtype)
+                h = (h + proj(ff, "ffn2", l, reduce=True)) \
+                    .astype(h.dtype)
+                return h, ck, cv
+
+            h, nk, nv = jax.lax.fori_loop(
+                0, L, body, (x, cache.k, cache.v))
+            return h, PagedKV(nk, nv)
 
         if psum_axis is not None:
             # tensor-parallel shard body: four streamed per-shard

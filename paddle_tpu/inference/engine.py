@@ -255,11 +255,14 @@ class GenerationEngine:
         c = self._dist_coords()
         return f"[{c}]" if c else ""
 
-    def _decode_rung(self, k: int) -> str:
+    def _decode_rung(self, k: int, adaptered: bool = False) -> str:
         """Roofline rung name of the k-step decode program —
-        ``decode.bf16_grouped[k=8,mp=2]``-shaped under TP."""
+        ``decode.bf16_grouped[k=8,mp=2]``-shaped under TP. The
+        adaptered variant (multi-LoRA delta path) is its own rung:
+        it runs the per-projection f32 loop, not the grouped tail."""
         c = self._dist_coords()
-        return f"{self._decode_tag}[k={k}{',' + c if c else ''}]"
+        tag = "decode.lora" if adaptered else self._decode_tag
+        return f"{tag}[k={k}{',' + c if c else ''}]"
 
     def _weights(self):
         """The decode/prefill weight-stack operand: the shard-at-load
@@ -277,16 +280,21 @@ class GenerationEngine:
             return self._lnf_tp
         return (self.model.lnf_scale._data, self.model.lnf_bias._data)
 
-    def _get_decode_k(self, k: int, sample_cfg=None):
+    def _get_decode_k(self, k: int, sample_cfg=None,
+                      adaptered: bool = False):
         """One compiled program per (chunk size, greedy-vs-sample,
-        top_k); temperature/top_p flow in as traced scalars so
-        per-request values never recompile."""
-        key = (k, sample_cfg)
+        top_k, adaptered); temperature/top_p flow in as traced
+        scalars so per-request values never recompile. ``adaptered``
+        adds the multi-LoRA delta operands (slot map + weight banks)
+        as TRACED arrays: adapter membership and hot load/unload
+        never retrace — the compiled-program count is independent of
+        the adapter set (at most 2 programs per chunk size)."""
+        key = (k, sample_cfg, adaptered)
         if key not in self._decode_k_jit:
             import functools
 
             self._decode_k_jit[key] = _roofline.AotProgram(
-                self._decode_rung(k),
+                self._decode_rung(k, adaptered),
                 jax.jit(functools.partial(self._decode_k_fn, k=k,
                                           sample_cfg=sample_cfg),
                         donate_argnums=(7, 8)))
@@ -385,7 +393,8 @@ class GenerationEngine:
 
     def _decode_k_fn(self, weights, embed, head_t, lnf_s, lnf_b, tok,
                      seq_lens, cache_k, cache_v, tables, key=None,
-                     sample_params=None, *, k, sample_cfg=None):
+                     sample_params=None, adapter_slots=None,
+                     adapter_banks=None, *, k, sample_cfg=None):
         """K decode steps as ONE XLA program: the picked token feeds back
         into the next step inside lax.scan, so the host syncs once per
         chunk instead of once per token (the per-token dispatch
@@ -401,13 +410,22 @@ class GenerationEngine:
             (top_k,) = sample_cfg
             temperature, top_p = sample_params
             cfg = (temperature, top_k, top_p)
+        adapters = None
+        if adapter_banks is not None:
+            # multi-LoRA delta operands (ISSUE 18): the per-row bank
+            # slot map plus the [L, S, ...] A/B banks, all traced —
+            # the stack sorts rows by slot and issues ONE ragged
+            # grouped delta launch per target projection per step
+            adapters = dict(adapter_banks)
+            adapters["slots"] = adapter_slots
 
         def step(carry, i):
             tok, lens, ck, cv = carry
             x = embed[tok].astype(self._cdtype)
             h, cache = st.decode_raw(
                 weights, x, PagedKV(ck, cv), tables, lens,
-                self._cos, self._sin, a8w8=self._a8w8, tp=self._tp)
+                self._cos, self._sin, a8w8=self._a8w8, tp=self._tp,
+                adapters=adapters)
             logits = self._logits(h, head_t, lnf_s, lnf_b)
             nxt = self._pick_token(logits, jax.random.fold_in(key, i),
                                    cfg)
@@ -783,16 +801,25 @@ class ContinuousBatchingEngine:
         import time as _time
 
         lnf_s, lnf_b = self._gen._lnf()
+        a_slots, a_banks = self._adapter_operands(active)
+        adaptered = a_banks is not None
+        extra = (None, None, a_slots, a_banks) if adaptered else ()
+        if adaptered:
+            # one ragged grouped delta launch per target projection
+            # per executed decode step (4 projections x L layers x k)
+            _stats.inc("lora.grouped_launches",
+                       4 * self.model.stack.num_layers * k)
         t0 = _time.perf_counter()
-        toks, self._ck, self._cv = self._gen._get_decode_k(k)(
+        toks, self._ck, self._cv = self._gen._get_decode_k(
+            k, adaptered=adaptered)(
             self._gen._weights(), self._gen._embed(),
             self._gen._head_t, lnf_s, lnf_b,
             jnp.asarray(self._last_tok, jnp.int32),
             jnp.asarray(cur, jnp.int32),
-            self._ck, self._cv, tables)
+            self._ck, self._cv, tables, *extra)
         toks_np = np.asarray(toks)
         # synced by the fetch above — an honest per-chunk roofline
-        _roofline.analyze(self._gen._decode_rung(k),
+        _roofline.analyze(self._gen._decode_rung(k, adaptered),
                           _time.perf_counter() - t0)
         # overridable token filter: runs BEFORE any request mutates,
         # so a validation raise (serving corruption detection) leaves
@@ -959,6 +986,14 @@ class ContinuousBatchingEngine:
         serving frontend overrides it with fault-injection corruption
         + token-range validation (serving/scheduler.py)."""
         return toks_np
+
+    def _adapter_operands(self, active):
+        """Multi-LoRA decode operands hook: ``(slot_map, banks)``
+        when any active slot decodes through a LoRA adapter, else
+        ``(None, None)`` — the base engine has no adapter bank; the
+        serving frontend overrides this against its AdapterBank
+        (serving/scheduler.py)."""
+        return None, None
 
     def _finish_hook(self, req, slot: int):
         """Called once per finished request, BEFORE its pages release.

@@ -12,7 +12,7 @@ from .attention import *  # noqa: F401,F403
 
 from . import (  # noqa: F401
     activation, attention, common, conv, flash_varlen, grouped_gemm,
-    loss, norm, pooling,
+    lora, loss, norm, pooling,
 )
 
 # flash_attention module alias for `from paddle.nn.functional import

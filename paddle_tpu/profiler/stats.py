@@ -104,6 +104,13 @@ Conventions for the built-in instrumentation (all optional reading):
   ``tenant.{count,max_share,min_goodput}`` and the index-keyed
   ``tenant.top<i>.device_ms`` top-K slice — never one key per
   tenant; names live in the usage JSONL, not the registry
+- ``lora.*``                   batched multi-LoRA serving
+  (serving/adapters.py + nn/functional/lora.py):
+  ``lora.grouped_launches`` ragged delta-GEMM dispatches (one per
+  adaptered chunk; each covers every target projection via the
+  traced work map), ``lora.swaps`` hot load/unload events against
+  the AdapterBank, and the ``lora.active_adapters`` gauge (loaded,
+  non-draining adapter slots)
 - ``t.*``                      scratch namespace reserved for tests
 
 Every metric the framework registers MUST use one of these prefixes
@@ -133,7 +140,7 @@ CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
     "inference.", "serving.", "serve.", "journal.", "slo.", "spec.",
     "quant.", "moe.", "dist.", "fleet.", "roofline.", "hbm.", "lint.",
-    "telemetry.", "alert.", "usage.", "tenant.",
+    "telemetry.", "alert.", "usage.", "tenant.", "lora.",
     "t.",
 )
 

@@ -43,11 +43,12 @@ programs and the page manager, both of which shard underneath it.
 """
 from __future__ import annotations
 
+from .adapters import AdapterBank, LoRAAdapter
 from .faults import (Clock, DeadlineExceeded, FaultInjector, FaultSpec,
                      FleetOverloaded, InjectedFault, ManualClock,
                      PoolSizingError, ReplicaKilled, ServerOverloaded,
-                     TokenCorruption, WatchdogTimeout, set_clock,
-                     use_clock)
+                     TenantQuotaExceeded, TokenCorruption,
+                     WatchdogTimeout, set_clock, use_clock)
 from .journal import FlightRecorder
 from .prefix_cache import PrefixCache
 from .request import Request
@@ -58,7 +59,9 @@ from .slo import SLOMonitor
 __all__ = ["Request", "PrefixCache", "ServingEngine", "SLOConfig",
            "FlightRecorder", "SLOMonitor",
            "FleetRouter", "Replica", "CircuitBreaker",
+           "AdapterBank", "LoRAAdapter",
            "FaultInjector", "FaultSpec", "Clock", "ManualClock",
            "set_clock", "use_clock", "InjectedFault", "TokenCorruption",
            "DeadlineExceeded", "ServerOverloaded", "WatchdogTimeout",
-           "PoolSizingError", "ReplicaKilled", "FleetOverloaded"]
+           "PoolSizingError", "ReplicaKilled", "FleetOverloaded",
+           "TenantQuotaExceeded"]

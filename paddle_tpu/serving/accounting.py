@@ -104,15 +104,17 @@ _STATE_RANK = {"ok": 0, "deadline_exceeded": 1, "error": 2,
 class _ReqUsage:
     """One request's running totals (mutable, ``__slots__``-packed)."""
 
-    __slots__ = ("rid", "tenant", "state", "phase_ns", "queue_s",
-                 "kv_page_s", "pages", "pages_ts", "prefill_tokens",
-                 "decode_tokens", "spec_accepted_tokens",
-                 "wasted_tokens", "retries", "preemptions",
-                 "requeues", "prefix_pages_saved")
+    __slots__ = ("rid", "tenant", "adapter", "state", "phase_ns",
+                 "queue_s", "kv_page_s", "pages", "pages_ts",
+                 "prefill_tokens", "decode_tokens",
+                 "spec_accepted_tokens", "wasted_tokens", "retries",
+                 "preemptions", "requeues", "prefix_pages_saved")
 
-    def __init__(self, rid: int, tenant: str, ts: float):
+    def __init__(self, rid: int, tenant: str, ts: float,
+                 adapter: Optional[str] = None):
         self.rid = rid
         self.tenant = tenant
+        self.adapter = adapter
         self.state: Optional[str] = None
         self.phase_ns: Dict[str, int] = {}
         self.queue_s = 0.0
@@ -135,6 +137,8 @@ class _ReqUsage:
     def as_record(self, hop: Optional[int] = None) -> dict:
         d = {"type": "usage", "rid": self.rid, "tenant": self.tenant,
              "state": self.state,
+             **({"adapter": self.adapter}
+                if self.adapter is not None else {}),
              "phase_ns": dict(self.phase_ns),
              "device_ms": round(self.device_ns / 1e6, 6),
              "queue_s": round(self.queue_s, 9),
@@ -178,7 +182,8 @@ class UsageLedger:
         if rec is None:
             tenant = getattr(req, "tenant", None)
             rec = _ReqUsage(rid, tenant if tenant is not None
-                            else self.default_tenant, self._clock())
+                            else self.default_tenant, self._clock(),
+                            adapter=getattr(req, "adapter_id", None))
             self._recs[rid] = rec
         return rec
 
@@ -434,6 +439,11 @@ def fold_records(records: Iterable[dict]) -> List[dict]:
         st = rec.get("state")
         if _STATE_RANK.get(st, 9) < _STATE_RANK.get(out["state"], 9):
             out["state"] = st
+        if rec.get("adapter") is not None \
+                and out.get("adapter") is None:
+            # adapter id rides the fold — a failed-over adaptered
+            # request keeps its stamp in the merged fleet view
+            out["adapter"] = rec["adapter"]
     folded = [by_rid[k] for k in sorted(by_rid, key=lambda t: t[1])]
     for out in folded:
         out["device_ms"] = round(
@@ -456,10 +466,12 @@ def tenant_rollup(records: Iterable[dict]) -> Dict[str, dict]:
             agg = by_t[t] = {"tenant": t, "n_requests": 0,
                              "device_ms": 0.0, "device_ns": 0,
                              "queue_s": 0.0, "kv_page_s": 0.0,
-                             "states": {}}
+                             "states": {}, "adapters": set()}
             for f in COUNT_FIELDS:
                 agg[f] = 0
         agg["n_requests"] += 1
+        if rec.get("adapter") is not None:
+            agg["adapters"].add(rec["adapter"])
         agg["device_ns"] += sum(
             (rec.get("phase_ns") or {}).values())
         agg["queue_s"] += float(rec.get("queue_s", 0.0))
@@ -470,6 +482,7 @@ def tenant_rollup(records: Iterable[dict]) -> Dict[str, dict]:
         agg["states"][st] = agg["states"].get(st, 0) + 1
     total_ns = sum(a["device_ns"] for a in by_t.values())
     for agg in by_t.values():
+        agg["adapters"] = sorted(agg["adapters"])
         agg["device_ms"] = round(agg["device_ns"] / 1e6, 6)
         agg["share"] = (agg["device_ns"] / total_ns
                         if total_ns > 0 else 0.0)

@@ -1,0 +1,351 @@
+"""AdapterBank: paged multi-LoRA weight banks with hot swap (ISSUE 18).
+
+Thousands of tenants share ONE base weight stream; what differs per
+tenant is a low-rank delta per target projection (qkv / o / ffn1 /
+ffn2). This module owns the serving-side adapter state:
+
+- **Paged, rank-padded banks.** Per projection the bank holds two
+  layer-stacked arrays ``A [L, S, K, R]`` and ``B [L, S, R, N]`` over
+  ``S`` fixed SLOTS (the paging unit — an adapter occupies one slot,
+  load/unload writes one slot's page, nothing else moves). ``R`` is
+  the configured ``rank`` padded to the weight dtype's sublane tile
+  (``nn/functional/lora.py pad_rank``; int8: 32, bf16: 16, f32: 8);
+  adapters with a smaller rank zero-fill the padded columns, which
+  contribute exact +0.0 in the delta kernel. The LoRA scale
+  ``alpha / rank`` is folded into ``B`` at load time, so the serve
+  path never multiplies by it.
+
+- **Hot load/unload under live traffic, refcounted.** ``load`` writes
+  a free slot's page and bumps the bank VERSION (the engine re-
+  device-puts the bank operands lazily on version change — array
+  SHAPES never change, so no compiled program is invalidated and no
+  engine restart happens). ``acquire(name, rid)`` pins the adapter
+  for one request; ``release(rid)`` unpins (idempotent — every
+  terminal path calls it defensively). ``unload`` with live
+  references marks the slot DRAINING: new acquires are rejected
+  (typed ``KeyError``), live requests keep decoding against the still-
+  resident page, and the slot frees the moment its refcount hits
+  zero. An adapter is never ripped out from under an active slot.
+
+- **Shareable across fleet replicas.** The bank is a host-side object
+  (numpy master copy + per-engine device cache); every replica of a
+  fleet can hold the same bank, so failover/migration of an adaptered
+  request needs no weight movement — the request's ``adapter_id``
+  resolves on the destination replica's identical bank.
+
+Telemetry: ``lora.swaps`` counts completed load/unload events,
+``lora.active_adapters`` gauges loaded non-draining slots (both under
+the ``lora.`` prefix in ``CONVENTION_PREFIXES``).
+
+TP composition (distributed/tp.py ``_ADAPTER_LAYOUT``): A of the
+column-parallel projections (qkv, ffn1) replicates while their B
+column-splits ``[L, S, R, N/mp]`` alongside the base shards (qkv B
+takes the SAME column gather as the base qkv stack); A of the
+row-parallel projections (o, ffn2) row-splits ``[L, S, K/mp, R]``
+while their B replicates — ``x·A = Σ_shards x_s·A_s``, so the delta
+partial joins the base partial BEFORE the layer's existing psum and
+the trace-pinned 2 psums/layer survive with no new collectives.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..profiler import stats as _stats
+
+__all__ = ["LoRAAdapter", "AdapterBank", "TARGET_PROJECTIONS"]
+
+#: target projection -> (stacked base weight it rides on)
+TARGET_PROJECTIONS = ("qkv", "out", "ffn1", "ffn2")
+
+
+class LoRAAdapter:
+    """One tenant's LoRA weights, host-side.
+
+    ``weights``: dict ``projection -> (A [L, K, r], B [L, r, N])``
+    over any subset of :data:`TARGET_PROJECTIONS` (missing projections
+    contribute zero delta). ``alpha`` defaults to ``rank`` (scale 1);
+    the ``alpha / rank`` scale is folded into B here, once.
+    """
+
+    def __init__(self, name: str, rank: int, weights: Dict[str, tuple],
+                 alpha: Optional[float] = None):
+        self.name = str(name)
+        self.rank = int(rank)
+        scale = 1.0 if alpha is None else float(alpha) / self.rank
+        self.weights = {}
+        for proj, (a, b) in weights.items():
+            if proj not in TARGET_PROJECTIONS:
+                raise ValueError(
+                    f"LoRAAdapter {name!r}: unknown projection "
+                    f"{proj!r} (targets: {TARGET_PROJECTIONS})")
+            a = np.asarray(a)
+            b = np.asarray(b)
+            if a.ndim != 3 or b.ndim != 3 or a.shape[-1] != self.rank \
+                    or b.shape[1] != self.rank:
+                raise ValueError(
+                    f"LoRAAdapter {name!r}/{proj}: need A [L, K, r], "
+                    f"B [L, r, N] at rank {self.rank}, got "
+                    f"{a.shape} / {b.shape}")
+            self.weights[proj] = (a, b * scale if scale != 1.0 else b)
+
+
+class AdapterBank:
+    """Paged, refcounted multi-LoRA bank for one model's serve stack.
+
+    ``dims``: dict ``projection -> (K, N)`` (use :meth:`from_stack` to
+    derive it from the engine's stacked weights). ``slots``: bank
+    capacity — the ONLY per-adapter-count allocation; the delta path's
+    compiled programs depend on ``(S, R)`` shapes, never on which
+    adapters occupy the slots.
+    """
+
+    def __init__(self, num_layers: int, dims: Dict[str, tuple], *,
+                 slots: int = 8, rank: int = 8, dtype=None):
+        import jax.numpy as jnp
+
+        from ..nn.functional.lora import pad_rank
+
+        if slots < 1:
+            raise ValueError("AdapterBank needs at least one slot")
+        self.num_layers = int(num_layers)
+        self.slots = int(slots)
+        self.rank = int(rank)
+        self.dtype = jnp.dtype(dtype or jnp.float32)
+        self.rank_pad = pad_rank(self.rank, self.dtype)
+        self.dims = {p: (int(k), int(n)) for p, (k, n) in dims.items()
+                     if p in TARGET_PROJECTIONS}
+        if not self.dims:
+            raise ValueError("AdapterBank: no target projections")
+        L, S, R = self.num_layers, self.slots, self.rank_pad
+        # host master copy; written in place on load/unload
+        self._a = {p: np.zeros((L, S, k, R), self.dtype)
+                   for p, (k, n) in self.dims.items()}
+        self._b = {p: np.zeros((L, S, R, n), self.dtype)
+                   for p, (k, n) in self.dims.items()}
+        self._lock = threading.RLock()
+        self._slot_of: Dict[str, int] = {}
+        self._free = list(range(self.slots))
+        self._refs: Dict[str, int] = {}
+        self._draining: Dict[str, bool] = {}
+        self._rid_name: Dict[object, str] = {}
+        self._version = 0
+        self._dev = None            # (version, tp, operand dict)
+
+    # ------------- construction helpers -------------
+
+    @classmethod
+    def from_stack(cls, weights: Dict, *, slots: int = 8,
+                   rank: int = 8, dtype=None) -> "AdapterBank":
+        """Derive projection dims from an engine's stacked weights
+        (``qkv_weight [L, d, Nq]`` etc.; MoE stacks have no ffn1/ffn2
+        targets — their experts are already per-token routed)."""
+        dims = {}
+        L = None
+        for proj in TARGET_PROJECTIONS:
+            w = weights.get(f"{proj}_weight")
+            if w is None:
+                continue
+            L = int(w.shape[0])
+            dims[proj] = (int(w.shape[1]), int(w.shape[2]))
+        if L is None:
+            raise ValueError(
+                "AdapterBank.from_stack: no stacked *_weight entries")
+        if dtype is None:
+            dtype = np.asarray(weights[next(
+                f"{p}_weight" for p in TARGET_PROJECTIONS
+                if f"{p}_weight" in weights)]).dtype
+            if np.dtype(dtype) == np.int8:   # quantized base stack:
+                dtype = None                 # adapters stay fp32
+        return cls(L, dims, slots=slots, rank=rank, dtype=dtype)
+
+    def random_adapter(self, name: str, rank: Optional[int] = None,
+                       seed: int = 0, init_scale: float = 0.02,
+                       projections=None) -> LoRAAdapter:
+        """A random adapter matching this bank's dims (tests/bench)."""
+        rank = self.rank if rank is None else int(rank)
+        if rank > self.rank_pad:
+            raise ValueError(
+                f"rank {rank} exceeds bank rank_pad {self.rank_pad}")
+        rng = np.random.default_rng(
+            np.uint32(hash((name, seed)) & 0xFFFFFFFF))
+        w = {}
+        for proj, (k, n) in self.dims.items():
+            if projections is not None and proj not in projections:
+                continue
+            a = rng.standard_normal((self.num_layers, k, rank)) \
+                * init_scale
+            b = rng.standard_normal((self.num_layers, rank, n)) \
+                * init_scale
+            w[proj] = (a.astype(np.float32), b.astype(np.float32))
+        return LoRAAdapter(name, rank, w)
+
+    # ------------- hot load / unload -------------
+
+    def load(self, adapter: LoRAAdapter) -> int:
+        """Write ``adapter`` into a free slot (hot: version bump only,
+        no shape change, no engine restart). Returns the slot."""
+        with self._lock:
+            if adapter.name in self._slot_of:
+                raise ValueError(
+                    f"adapter {adapter.name!r} is already loaded"
+                    + (" (draining)" if self._draining.get(adapter.name)
+                       else ""))
+            if adapter.rank > self.rank_pad:
+                raise ValueError(
+                    f"adapter {adapter.name!r} rank {adapter.rank} "
+                    f"exceeds bank rank_pad {self.rank_pad}")
+            if not self._free:
+                pinned = {n: self._refs.get(n, 0)
+                          for n in self._slot_of}
+                raise RuntimeError(
+                    f"AdapterBank full ({self.slots} slots); "
+                    f"loaded: {pinned} — unload one first")
+            slot = self._free.pop(0)
+            for proj in self.dims:
+                a_bank = self._a[proj]
+                b_bank = self._b[proj]
+                a_bank[:, slot] = 0
+                b_bank[:, slot] = 0
+                if proj in adapter.weights:
+                    a, b = adapter.weights[proj]
+                    a_bank[:, slot, :, :adapter.rank] = a
+                    b_bank[:, slot, :adapter.rank, :] = b
+            self._slot_of[adapter.name] = slot
+            self._refs[adapter.name] = 0
+            self._draining[adapter.name] = False
+            self._version += 1
+            _stats.inc("lora.swaps")
+            self._publish()
+            return slot
+
+    def unload(self, name: str) -> bool:
+        """Unload ``name``. With live references the slot DRAINS: new
+        acquires are rejected, live requests keep their weights, and
+        the slot frees at refcount zero. Returns True when the slot
+        was freed now, False when draining."""
+        with self._lock:
+            if name not in self._slot_of:
+                raise KeyError(f"adapter {name!r} is not loaded")
+            if self._refs.get(name, 0) > 0:
+                self._draining[name] = True
+                self._publish()
+                return False
+            self._free_slot(name)
+            return True
+
+    def _free_slot(self, name: str) -> None:
+        # lock held
+        slot = self._slot_of.pop(name)
+        for proj in self.dims:
+            self._a[proj][:, slot] = 0
+            self._b[proj][:, slot] = 0
+        self._refs.pop(name, None)
+        self._draining.pop(name, None)
+        self._free.append(slot)
+        self._free.sort()
+        self._version += 1
+        _stats.inc("lora.swaps")
+        self._publish()
+
+    # ------------- per-request pinning -------------
+
+    def acquire(self, name: str, rid) -> int:
+        """Pin ``name`` for request ``rid``; returns its slot. Raises
+        ``KeyError`` for unknown or draining adapters (the submit path
+        surfaces it to the caller before admission)."""
+        with self._lock:
+            if name not in self._slot_of:
+                raise KeyError(f"adapter {name!r} is not loaded")
+            if self._draining.get(name):
+                raise KeyError(f"adapter {name!r} is draining "
+                               "(unload pending)")
+            prev = self._rid_name.get(rid)
+            if prev == name:
+                return self._slot_of[name]
+            if prev is not None:
+                self._release_name(prev)
+            self._rid_name[rid] = name
+            self._refs[name] = self._refs.get(name, 0) + 1
+            return self._slot_of[name]
+
+    def release(self, rid) -> None:
+        """Unpin whatever ``rid`` holds (idempotent — every terminal
+        request path calls this defensively)."""
+        with self._lock:
+            name = self._rid_name.pop(rid, None)
+            if name is not None:
+                self._release_name(name)
+
+    def _release_name(self, name: str) -> None:
+        # lock held
+        if name not in self._refs:
+            return
+        self._refs[name] = max(self._refs[name] - 1, 0)
+        if self._refs[name] == 0 and self._draining.get(name):
+            self._free_slot(name)
+
+    # ------------- inspection -------------
+
+    def slot_of(self, name: str) -> int:
+        with self._lock:
+            return self._slot_of[name]
+
+    def refcount(self, name: str) -> int:
+        with self._lock:
+            return self._refs.get(name, 0)
+
+    def loaded(self):
+        """name -> slot of every resident adapter (draining included)."""
+        with self._lock:
+            return dict(self._slot_of)
+
+    def is_draining(self, name: str) -> bool:
+        with self._lock:
+            return bool(self._draining.get(name))
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def _publish(self) -> None:
+        # lock held
+        active = sum(1 for n in self._slot_of
+                     if not self._draining.get(n))
+        _stats.set_gauge("lora.active_adapters", active)
+
+    # ------------- device operands -------------
+
+    def operands(self, tp=None) -> Dict[str, object]:
+        """The traced bank operands for one dispatch: ``{proj}_a`` /
+        ``{proj}_b`` device arrays (re-``device_put`` lazily when the
+        bank version moved — a hot swap changes VALUES only, so the
+        compiled programs survive). Under TP the arrays are placed per
+        ``distributed/tp.py _ADAPTER_LAYOUT`` (qkv B takes the base
+        stack's column gather)."""
+        import jax
+
+        with self._lock:
+            version = self._version
+            if self._dev is not None and self._dev[0] == version \
+                    and self._dev[1] is tp:
+                return self._dev[2]
+            host = {}
+            for proj in self.dims:
+                host[f"{proj}_a"] = self._a[proj].copy()
+                host[f"{proj}_b"] = self._b[proj].copy()
+        if tp is None:
+            dev = {k: jax.device_put(v) for k, v in host.items()}
+        else:
+            if "qkv_b" in host and tp.mp > 1:
+                host["qkv_b"] = np.take(
+                    host["qkv_b"], tp.qkv_col_index(), axis=-1)
+            dev = {k: jax.device_put(
+                       v, tp.sharding(*tp.adapter_spec(k)))
+                   for k, v in host.items()}
+        with self._lock:
+            self._dev = (version, tp, dev)
+        return dev

@@ -80,6 +80,7 @@ __all__ = [
     "FaultSpec", "FaultInjector", "InjectedFault", "TokenCorruption",
     "DeadlineExceeded", "ServerOverloaded", "WatchdogTimeout",
     "PoolSizingError", "ReplicaKilled", "FleetOverloaded",
+    "TenantQuotaExceeded",
 ]
 
 
@@ -149,6 +150,23 @@ class FleetOverloaded(ServerOverloaded):
     (every one dead/draining or circuit-open). Raised to the
     SUBMITTING thread BEFORE any replica admits — a subclass of
     :class:`ServerOverloaded` so producers catch both the same way."""
+
+
+class TenantQuotaExceeded(ServerOverloaded):
+    """Router-tier per-tenant quota shedding: the tenant is past its
+    ``FLAGS_tenant_quota_rps`` request rate or its
+    ``FLAGS_tenant_quota_tokens`` rolling token budget (fed by the
+    usage ledger). Raised to the SUBMITTING thread before any replica
+    admits — one tenant's burst backpressures that tenant alone. A
+    subclass of :class:`ServerOverloaded` so producers catch both the
+    same way."""
+
+    def __init__(self, tenant: str, kind: str = "rate",
+                 message: str = ""):
+        super().__init__(
+            message or f"tenant {tenant!r} over its {kind} quota")
+        self.tenant = tenant
+        self.kind = kind
 
 
 # ---------------------------------------------------------------------

@@ -51,7 +51,8 @@ class Request(GenRequest):
                  on_token: Optional[Callable] = None,
                  arrival_time: Optional[float] = None,
                  deadline_ms: Optional[float] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 adapter_id: Optional[str] = None):
         super().__init__(prompt, max_new_tokens, eos_token_id)
         self.priority = int(priority)
         self.on_token = on_token
@@ -59,6 +60,14 @@ class Request(GenRequest):
         # ledger's default tenant; stamped into journal events and
         # the per-request usage record
         self.tenant = tenant
+        # batched multi-LoRA (ISSUE 18): name of the AdapterBank
+        # entry this request decodes through (None = base model).
+        # The scheduler acquires the adapter at submit — pinning it
+        # against unload — and stamps the resolved bank slot here;
+        # the slot rides preempt/resume and fleet re-dispatch (each
+        # engine re-resolves against its own bank at adoption).
+        self.adapter_id = adapter_id
+        self._adapter_slot: Optional[int] = None
         self.arrival_time = _faults.now() if arrival_time is None \
             else float(arrival_time)
         self.deadline_ms = None if deadline_ms is None \

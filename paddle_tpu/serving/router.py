@@ -82,11 +82,13 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from collections import deque
+
 from ..core.flags import flag as _flag
 from ..profiler import stats as _stats
 from . import faults as _faults
-from .accounting import UsageLedger, fold_records
-from .faults import FleetOverloaded, ReplicaKilled
+from .accounting import UsageLedger, fold_records, tenant_rollup
+from .faults import FleetOverloaded, ReplicaKilled, TenantQuotaExceeded
 from .prefix_cache import _page_key
 from .request import Request
 from .scheduler import ServingEngine
@@ -303,6 +305,15 @@ class FleetRouter:
         self.usage: Optional[UsageLedger] = None
         if _flag("usage_ledger"):
             self.usage = UsageLedger()
+        # per-tenant quota state (ISSUE 18): submission timestamps for
+        # the rate limit, and (timestamp, cumulative-token) marks the
+        # rolling token budget differences against — all on the
+        # injectable serving clock, all router-tier (one tenant's
+        # burst backpressures that tenant alone, before any replica
+        # admits)
+        self._tenant_times: Dict[str, deque] = {}
+        self._tenant_token_marks: Dict[str, deque] = {}
+        self._quota_lock = threading.Lock()
         self.faults = None
         if faults is not None:
             self.install_faults(faults)
@@ -326,19 +337,28 @@ class FleetRouter:
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id=None, priority: int = 0, on_token=None,
                deadline_ms: Optional[float] = None,
-               tenant: Optional[str] = None) -> int:
+               tenant: Optional[str] = None,
+               adapter_id: Optional[str] = None) -> int:
         """Route one request to a replica (affinity, then load/SLO)
         and return its fleet-unique id. ``tenant`` stamps the usage
-        ledger's billing identity fleet-wide. Raises
+        ledger's billing identity fleet-wide; ``adapter_id`` routes
+        decode through that LoRA adapter on the serving replica (fleet
+        replicas should share ONE AdapterBank so failover/migration
+        re-resolves the same weights). Raises
         :class:`FleetOverloaded` when the fleet-wide dispatch queue is
         past ``FLAGS_fleet_dispatch_queue`` or no replica is
-        dispatchable — backpressure BEFORE any replica admits."""
+        dispatchable, and :class:`TenantQuotaExceeded` when the
+        tenant is past its request-rate or rolling token quota
+        (``FLAGS_tenant_quota_*``) — backpressure BEFORE any replica
+        admits."""
         req = Request(prompt, max_new_tokens, eos_token_id,
                       priority=priority, on_token=on_token,
-                      deadline_ms=deadline_ms, tenant=tenant)
+                      deadline_ms=deadline_ms, tenant=tenant,
+                      adapter_id=adapter_id)
         try:
+            self._check_tenant_quota(req)
             self._dispatch(req)
-        except FleetOverloaded:
+        except (FleetOverloaded, TenantQuotaExceeded):
             u = self.usage
             if u is not None:
                 # router-tier shed still emits exactly one record
@@ -346,6 +366,69 @@ class FleetRouter:
             raise
         self._tracked.append(req)
         return req.id
+
+    # ---------------- per-tenant quotas (ISSUE 18) ----------------
+
+    def _check_tenant_quota(self, req: Request) -> None:
+        """Router-tier per-tenant quota enforcement, BEFORE dispatch:
+
+        - **request rate** (``FLAGS_tenant_quota_rps``): at most
+          ``rps * window_s`` submissions per tenant within the rolling
+          ``FLAGS_tenant_quota_window_s`` window (clock-seam
+          timestamps — a ``ManualClock`` drives it deterministically);
+        - **token budget** (``FLAGS_tenant_quota_tokens``): the
+          tenant's prefill+decode tokens attributed by the FLEET usage
+          ledger (ISSUE 17) within the same rolling window — requires
+          ``FLAGS_usage_ledger`` (without it there is nothing to
+          meter and the token leg is inert).
+
+        Both shed with the typed :class:`TenantQuotaExceeded` (a
+        ``ServerOverloaded`` subclass) so one tenant's burst
+        backpressures that tenant alone. 0 disables each leg."""
+        rps = float(_flag("tenant_quota_rps"))
+        tok_cap = int(_flag("tenant_quota_tokens"))
+        if rps <= 0 and tok_cap <= 0:
+            return
+        tenant = getattr(req, "tenant", None) or "default"
+        window = max(float(_flag("tenant_quota_window_s")), 1e-9)
+        now = _faults.now()
+        with self._quota_lock:
+            if rps > 0:
+                dq = self._tenant_times.setdefault(tenant, deque())
+                while dq and now - dq[0] >= window:
+                    dq.popleft()
+                if len(dq) >= rps * window:
+                    _stats.inc("fleet.quota_sheds")
+                    raise TenantQuotaExceeded(
+                        tenant, "rate",
+                        f"tenant {tenant!r}: {len(dq)} requests in "
+                        f"the last {window}s >= quota "
+                        f"{rps * window:g}")
+                dq.append(now)
+            if tok_cap > 0:
+                cum = self._tenant_tokens(tenant)
+                dq = self._tenant_token_marks.setdefault(
+                    tenant, deque())
+                dq.append((now, cum))
+                # keep the newest mark at-or-before the window start
+                # as the baseline the rolling usage differences from
+                while len(dq) >= 2 and now - dq[1][0] >= window:
+                    dq.popleft()
+                used = cum - dq[0][1]
+                if used > tok_cap:
+                    _stats.inc("fleet.quota_sheds")
+                    raise TenantQuotaExceeded(
+                        tenant, "tokens",
+                        f"tenant {tenant!r}: {used} tokens in the "
+                        f"last {window}s > quota {tok_cap}")
+
+    def _tenant_tokens(self, tenant: str) -> int:
+        """Cumulative prefill+decode tokens the fleet ledgers have
+        attributed to ``tenant`` (0 with the ledger off)."""
+        roll = tenant_rollup(self.fleet_usage()).get(tenant)
+        if roll is None:
+            return 0
+        return int(roll["prefill_tokens"]) + int(roll["decode_tokens"])
 
     def _dispatchable(self, exclude=frozenset(),
                       breaker: bool = True) -> List[Replica]:

@@ -111,6 +111,15 @@ class SLOConfig:
     the monitor (serving/slo.py) judges verdicts against (None
     disables that leg); ``goodput_objective`` + ``slo_window`` shape
     the rolling ``slo.goodput`` gauge and its burn rate.
+    ``tenant_fair``: replace priority-FIFO admission with
+    DEFICIT-WEIGHTED round-robin over per-tenant queues (ISSUE 18) —
+    each admission round credits every waiting tenant
+    ``fair_quantum * weight`` tokens of deficit, the richest tenant's
+    earliest admissible request admits and pays its token cost
+    (prompt + max_new), so a flooding tenant cannot starve a light
+    one; the engine's ``starvation_bound`` still caps how long ANY
+    head-of-queue request can be passed over. ``tenant_weights`` maps
+    tenant name -> relative share (missing tenants weigh 1.0).
     """
 
     def __init__(self, ttft_weight: float = 1.0,
@@ -121,7 +130,9 @@ class SLOConfig:
                  ttft_target_ms: Optional[float] = 1000.0,
                  tpot_target_ms: Optional[float] = 100.0,
                  goodput_objective: float = 0.99,
-                 slo_window: int = 256):
+                 slo_window: int = 256, tenant_fair: bool = False,
+                 tenant_weights: Optional[dict] = None,
+                 fair_quantum: int = 256):
         if ttft_weight <= 0 or tpot_weight <= 0:
             raise ValueError("SLO weights must be positive")
         self.ttft_weight = float(ttft_weight)
@@ -139,6 +150,9 @@ class SLOConfig:
             raise ValueError("goodput_objective must be in (0, 1)")
         self.goodput_objective = float(goodput_objective)
         self.slo_window = max(int(slo_window), 1)
+        self.tenant_fair = bool(tenant_fair)
+        self.tenant_weights = dict(tenant_weights or {})
+        self.fair_quantum = max(int(fair_quantum), 1)
         r = self.ttft_weight / self.tpot_weight
         #: consecutive prefill chunks allowed while decoders wait /
         #: decode chunks between prefill opportunities — the weighted
@@ -183,13 +197,21 @@ class ServingEngine(ContinuousBatchingEngine):
 
     def __init__(self, model: FusedCausalLM,
                  slo: Optional[SLOConfig] = None, faults=None,
-                 **engine_kwargs):
+                 adapters=None, **engine_kwargs):
         slo = slo or SLOConfig()
         engine_kwargs.setdefault("admit_window", slo.admit_window)
         engine_kwargs.setdefault("starvation_bound",
                                  slo.starvation_bound)
         super().__init__(model, **engine_kwargs)
         self.slo = slo
+        # multi-LoRA adapter bank (ISSUE 18, serving/adapters.py):
+        # None serves the base model only; a bank may be SHARED by
+        # fleet replicas (refcounts key on request id). Requests pin
+        # their adapter at submit and release at every terminal path.
+        self.adapters = adapters
+        # deficit-weighted round-robin state (SLOConfig.tenant_fair):
+        # tenant -> accumulated token deficit
+        self._fair_deficit: Dict[str, float] = {}
         # flight recorder (FLAGS_serve_journal): None when disabled,
         # so every hot-path hook is a single attribute test — no
         # event tuples or extra dicts are ever allocated
@@ -261,24 +283,31 @@ class ServingEngine(ContinuousBatchingEngine):
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id=None, priority: int = 0,
                on_token=None, deadline_ms: Optional[float] = None,
-               tenant: Optional[str] = None) -> int:
+               tenant: Optional[str] = None,
+               adapter_id: Optional[str] = None) -> int:
         """Thread-safe admission (any thread): queue a request, return
         its id. Tokens stream through ``on_token`` as they decode.
         ``deadline_ms`` bounds the request's whole life from arrival
         (see README "Failure semantics"); ``tenant`` stamps the usage
-        ledger's billing identity (None bills to the default tenant).
-        Raises :class:`ServerOverloaded` — backpressure to the
-        SUBMITTING thread — when the bounded inbox, the queue depth,
-        or the SLO burn rate is past its shed threshold."""
+        ledger's billing identity (None bills to the default tenant);
+        ``adapter_id`` routes decode through that LoRA adapter in the
+        engine's :class:`~paddle_tpu.serving.adapters.AdapterBank`
+        (the adapter is pinned against unload until this request
+        terminates). Raises :class:`ServerOverloaded` — backpressure
+        to the SUBMITTING thread — when the bounded inbox, the queue
+        depth, or the SLO burn rate is past its shed threshold; a
+        ``KeyError`` rejects an unknown or draining adapter."""
         req = Request(prompt, max_new_tokens, eos_token_id,
                       priority=priority, on_token=on_token,
-                      deadline_ms=deadline_ms, tenant=tenant)
+                      deadline_ms=deadline_ms, tenant=tenant,
+                      adapter_id=adapter_id)
         return self.submit_request(req)
 
     def submit_request(self, req: Request) -> int:
         if len(req.prompt) + req.max_new_tokens > self.max_length:
             raise ValueError("request exceeds engine max_length")
         self._check_overload(req)
+        self._adapter_acquire(req)
         with self._inbox_lock:
             self._inbox.append(req)
         jr = self.journal
@@ -287,9 +316,65 @@ class ServingEngine(ContinuousBatchingEngine):
                      "max_new": int(req.max_new_tokens)}
             if getattr(req, "tenant", None) is not None:
                 extra["tenant"] = req.tenant
+            if getattr(req, "adapter_id", None) is not None:
+                extra["adapter"] = req.adapter_id
             jr.record("submit", req.id, -1, extra)
         _stats.inc("serve.submitted")
         return req.id
+
+    # ---------------- multi-LoRA lifecycle (ISSUE 18) ----------------
+
+    def _adapter_acquire(self, req: Request) -> None:
+        """Pin ``req``'s adapter in this engine's bank and stamp the
+        resolved bank slot on the request. Raises before the request
+        enters any queue: an unknown/draining adapter (``KeyError``
+        from the bank) or an adapter on a bank-less engine
+        (``ValueError``) surfaces to the submitting thread."""
+        name = getattr(req, "adapter_id", None)
+        if name is None:
+            return
+        if self.adapters is None:
+            raise ValueError(
+                f"request {req.id} names adapter {name!r} but the "
+                "engine has no adapter bank")
+        if self._spec is not None:
+            raise ValueError(
+                "adaptered requests don't compose with speculative "
+                "decoding (the verify pass has no delta path yet)")
+        req._adapter_slot = self.adapters.acquire(name, req.id)
+
+    def _adapter_release(self, req) -> None:
+        """Unpin ``req``'s adapter (idempotent — safe on every
+        terminal path, and a no-op for base-model requests)."""
+        bank = self.adapters
+        if bank is not None \
+                and getattr(req, "adapter_id", None) is not None:
+            bank.release(req.id)
+
+    def _adapter_operands(self, active):
+        """Serving override of the decode-chunk adapter hook: when any
+        active slot decodes through a bank adapter, return the traced
+        operands — the per-slot bank-slot map (-1 = base model) plus
+        the bank's device-cached ``[L, S, ...]`` A/B stacks. A pure-
+        base batch returns ``(None, None)`` and keeps the fast grouped
+        decode program; adapter membership rides the slot map, so the
+        compiled-program count never depends on WHICH adapters are
+        live (hot load/unload only bumps the bank's device cache)."""
+        bank = self.adapters
+        if bank is None:
+            return None, None
+        slots = np.full((self.max_batch,), -1, np.int32)
+        any_adaptered = False
+        for i in active:
+            req = self._slots[i]
+            s = getattr(req, "_adapter_slot", None) \
+                if req is not None else None
+            if s is not None and s >= 0:
+                slots[i] = s
+                any_adaptered = True
+        if not any_adaptered:
+            return None, None
+        return jnp.asarray(slots), bank.operands(tp=self._gen._tp)
 
     def _check_overload(self, req: Request) -> None:
         """Admission-time overload shedding (ISSUE 11): reject with a
@@ -364,6 +449,10 @@ class ServingEngine(ContinuousBatchingEngine):
         failed-over stream just continues."""
         if len(req.prompt) + req.max_new_tokens > self.max_length:
             raise ValueError("request exceeds engine max_length")
+        # re-resolve the adapter against THIS engine's bank: the slot
+        # id stamped by the dead replica is meaningless here (acquire
+        # is idempotent by rid, so a shared fleet bank just re-pins)
+        self._adapter_acquire(req)
         with self._inbox_lock:
             self._inbox.append(req)
         jr = self.journal
@@ -373,6 +462,8 @@ class ServingEngine(ContinuousBatchingEngine):
                      "adopted": True}
             if getattr(req, "tenant", None) is not None:
                 extra["tenant"] = req.tenant
+            if getattr(req, "adapter_id", None) is not None:
+                extra["adapter"] = req.adapter_id
             jr.record("submit", req.id, -1, extra)
         _stats.inc("serve.submitted")
         return req.id
@@ -405,8 +496,14 @@ class ServingEngine(ContinuousBatchingEngine):
             # only for time the pages could still serve them
             for r in prefilling + decoding:
                 u.set_pages(r, 0)
-        return [r for r in inbox + waiting + prefilling + decoding
-                if not r.done]
+        out = [r for r in inbox + waiting + prefilling + decoding
+               if not r.done]
+        # unpin adapters held by this (dead) replica's bank — the
+        # adopting replica re-acquires against its own (possibly the
+        # same shared) bank, so refcounts never leak across failover
+        for r in out:
+            self._adapter_release(r)
+        return out
 
     def step(self):
         """One scheduler action: drain admissions (shed-aware), expire
@@ -538,6 +635,7 @@ class ServingEngine(ContinuousBatchingEngine):
             # serve.tpot_ms is the streaming-gap view)
             _stats.observe("serve.request_tpot_ms", tpot * 1e3)
         v = self.slo_monitor.observe_finish(req)
+        self._adapter_release(req)
         u = self.usage
         # close the usage record exactly once (a snapshot rides the
         # finish event; the chunk that finished the request may still
@@ -551,6 +649,8 @@ class ServingEngine(ContinuousBatchingEngine):
                      "slo_ok": v["slo_ok"]}
             if getattr(req, "tenant", None) is not None:
                 extra["tenant"] = req.tenant
+            if getattr(req, "adapter_id", None) is not None:
+                extra["adapter"] = req.adapter_id
             if rec is not None:
                 extra["usage"] = rec
             jr.record("finish", req.id, slot, extra)
@@ -574,6 +674,7 @@ class ServingEngine(ContinuousBatchingEngine):
         req.error = exc
         req.t_done = _faults.now()
         self.slo_monitor.observe_error(req)
+        self._adapter_release(req)
         u = self.usage
         rec = u.finish(req, state) if u is not None else None
         _stats.inc(self._FAIL_COUNTERS.get(
@@ -951,11 +1052,81 @@ class ServingEngine(ContinuousBatchingEngine):
                             f"depth past {cap}"))
 
     def _sort_waiting(self):
-        # higher priority first, FIFO within a level (stable by
-        # arrival); the skip-ahead window then scans THIS order
+        # higher priority first; within a level, STABLE adapter
+        # grouping (ISSUE 18): requests sharing an adapter sort
+        # adjacently, groups ordered by their oldest member's arrival
+        # and FIFO inside each group — same-adapter requests admit
+        # together so a decode chunk carries fewer distinct adapters
+        # (tighter ragged delta groups). With no adapters every
+        # request shares the None group and this is EXACTLY the old
+        # priority-FIFO order. The skip-ahead window scans THIS order.
+        first: Dict[Optional[str], int] = {}
+        for r in self.waiting:
+            a = getattr(r, "adapter_id", None)
+            s = getattr(r, "_seq", r.id)
+            if a not in first or s < first[a]:
+                first[a] = s
         self.waiting.sort(
             key=lambda r: (-getattr(r, "priority", 0),
+                           first[getattr(r, "adapter_id", None)],
                            getattr(r, "_seq", r.id)))
+
+    @staticmethod
+    def _tenant_of(req) -> str:
+        t = getattr(req, "tenant", None)
+        return t if t is not None else "default"
+
+    @staticmethod
+    def _admit_cost(req) -> int:
+        """DWRR cost of admitting ``req``, in tokens: the prompt it
+        will prefill plus the generation budget it may decode — a
+        work proxy known BEFORE the request runs."""
+        return int(len(req.prompt)) + int(req.max_new_tokens)
+
+    def _pick_waiting(self):
+        """Admission pick. Default: the engine's priority-FIFO bounded
+        skip-ahead. With ``SLOConfig.tenant_fair``: DEFICIT-WEIGHTED
+        round-robin over per-tenant queues — each pick credits every
+        waiting tenant ``fair_quantum * weight`` deficit tokens, the
+        richest tenant's first admissible request (within the
+        skip-ahead window of its own queue) admits and pays its token
+        cost. A flooding tenant drains its deficit as fast as it
+        earns it, so light tenants accumulate credit and interleave
+        at their weighted share. The engine's starvation bound is
+        PRESERVED: every pass-over of an earlier arrival bumps its
+        ``_admit_skips``, and a head skipped ``starvation_bound``
+        times admits next regardless of deficits."""
+        if not self.slo.tenant_fair:
+            return super()._pick_waiting()
+        if not self.waiting:
+            return None
+        head = self.waiting[0]
+        if head._admit_skips >= self.starvation_bound:
+            # bounded unfairness: the window collapses to the head
+            return self.waiting.pop(0) if self._can_admit(head) \
+                else None
+        queues: Dict[str, List[Request]] = {}
+        for r in self.waiting:
+            queues.setdefault(self._tenant_of(r), []).append(r)
+        d = self._fair_deficit
+        for t in list(d):
+            if t not in queues:   # vanished tenant banks no credit
+                del d[t]
+        w = self.slo.tenant_weights
+        for t in queues:
+            d[t] = d.get(t, 0.0) \
+                + self.slo.fair_quantum * float(w.get(t, 1.0))
+        for t in sorted(queues, key=lambda q: (-d[q], q)):
+            for r in queues[t][: self.admit_window]:
+                if self._can_admit(r):
+                    d[t] -= self._admit_cost(r)
+                    j = self.waiting.index(r)
+                    if j > 0:
+                        for skipped in self.waiting[:j]:
+                            skipped._admit_skips += 1
+                        _stats.inc("serving.admission_skips", j)
+                    return self.waiting.pop(j)
+        return None
 
     def _slot_free(self, i: int) -> bool:
         return self._slots[i] is None and i not in self._prefilling
@@ -1156,39 +1327,52 @@ class ServingEngine(ContinuousBatchingEngine):
         return min(self._prefilling,
                    key=lambda i: self._urgency(self._prefilling[i].req))
 
-    def _chunk_rung(self, c: int) -> str:
+    def _chunk_rung(self, c: int, adaptered: bool = False) -> str:
         """Rung name of the c-token chunk program —
-        ``serve.prefill[c=N,mp=M]`` under tensor parallelism."""
+        ``serve.prefill[c=N,mp=M]`` under tensor parallelism; the
+        multi-LoRA variant reports as ``serve.prefill.lora[...]``."""
         tp = self._gen._tp
         mp = f",mp={tp.mp}" if tp is not None else ""
-        return f"serve.prefill[c={c}{mp}]"
+        tag = "serve.prefill.lora" if adaptered else "serve.prefill"
+        return f"{tag}[c={c}{mp}]"
 
-    def _get_chunk_prefill(self, c: int):
-        """One compiled chunk program per chunk SIZE (start/len are
-        traced operands — every chunk of every request shares it)."""
-        if c not in self._chunk_jit:
+    def _get_chunk_prefill(self, c: int, adaptered: bool = False):
+        """One compiled chunk program per (chunk SIZE, adaptered):
+        start/len are traced operands — every chunk of every request
+        shares it — and the adapter operands (slot map + banks) are
+        traced too, so adapter membership and hot load/unload never
+        add programs (at most 2 per chunk size)."""
+        key = (c, adaptered)
+        if key not in self._chunk_jit:
             import functools
 
             import jax
 
-            self._chunk_jit[c] = _roofline.AotProgram(
-                self._chunk_rung(c),
+            self._chunk_jit[key] = _roofline.AotProgram(
+                self._chunk_rung(c, adaptered),
                 jax.jit(self._chunk_prefill_fn, donate_argnums=(8, 9)))
-        return self._chunk_jit[c]
+        return self._chunk_jit[key]
 
     def _chunk_prefill_fn(self, weights, embed, head_t, lnf_s, lnf_b,
-                          ids, start, chunk_len, ck, cv, tables):
+                          ids, start, chunk_len, ck, cv, tables,
+                          adapter_slots=None, adapter_banks=None):
         """Compiled chunk program: prefill ``ids`` at positions
         ``start..`` against the cached prefix + in-chunk causal
         triangle, returning the last VALID position's logits (used only
         by the final chunk — one [1, d] @ [d, vocab] head matmul per
-        chunk buys an honest per-chunk device sync)."""
+        chunk buys an honest per-chunk device sync). With adapter
+        operands set, every projection adds its ragged grouped LoRA
+        delta (one launch per projection per layer)."""
         g = self._gen
         st = self.model.stack
+        adapters = None
+        if adapter_banks is not None:
+            adapters = dict(adapter_banks)
+            adapters["slots"] = adapter_slots
         x = embed[ids].astype(g._cdtype)
         h, cache = st.prefill_chunk_raw(
             weights, x, PagedKV(ck, cv), tables, start, chunk_len,
-            g._cos, g._sin, a8w8=g._a8w8, tp=g._tp)
+            g._cos, g._sin, a8w8=g._a8w8, tp=g._tp, adapters=adapters)
         hl = h[jnp.arange(h.shape[0]), chunk_len - 1]
         logits = g._logits(hl, head_t, lnf_s, lnf_b)
         return logits, cache.k, cache.v
@@ -1273,12 +1457,23 @@ class ServingEngine(ContinuousBatchingEngine):
         ids[0, :n] = toks[stt.pos: stt.pos + n]
         self._gen._count_a8w8(1)
         lnf_s, lnf_b = self._gen._lnf()
+        a_slot = getattr(req, "_adapter_slot", None)
+        adaptered = self.adapters is not None and a_slot is not None \
+            and a_slot >= 0
+        extra = ()
+        if adaptered:
+            extra = (jnp.asarray([a_slot], jnp.int32),
+                     self.adapters.operands(tp=self._gen._tp))
+            _stats.inc("lora.grouped_launches",
+                       4 * self.model.stack.num_layers)
         t0 = time.perf_counter()
-        logits, self._ck, self._cv = self._get_chunk_prefill(c)(
+        logits, self._ck, self._cv = self._get_chunk_prefill(
+            c, adaptered)(
             self._gen._weights(), self._gen._embed(),
             self._gen._head_t, lnf_s, lnf_b, jnp.asarray(ids),
             jnp.asarray([stt.pos], jnp.int32),
-            jnp.asarray([n], jnp.int32), self._ck, self._cv, tables)
+            jnp.asarray([n], jnp.int32), self._ck, self._cv, tables,
+            *extra)
         tok = int(np.asarray(
             self._gen._argmax(jnp.asarray(logits)))[0])
         if fi is not None:
@@ -1292,7 +1487,7 @@ class ServingEngine(ContinuousBatchingEngine):
                 f"prefill chunk for request {req.id} produced token "
                 f"{tok} outside [0, {self.model.vocab_size})")
         # the argmax fetch synced the chunk — honest phase roofline
-        _roofline.analyze(self._chunk_rung(c),
+        _roofline.analyze(self._chunk_rung(c, adaptered),
                           time.perf_counter() - t0)
         _stats.inc("serve.prefill_chunks")
         _stats.inc("serve.prefill_tokens", n)

@@ -1,0 +1,242 @@
+"""AdapterBank + ragged batched-LoRA delta kernel (ISSUE 18,
+serving/adapters.py + nn/functional/lora.py).
+
+Pinned here: the adapter-sort helpers' semantics (stable order, base
+tokens past ``offsets[-1]``, exact inverse), forward parity of
+``lora_delta`` against a dense per-segment reference, BITWISE equality
+between the interpreter-run Pallas kernel and the tiled XLA walk (the
+off-TPU path is the exact serving numerics), the structural zero-delta
+for base/pad rows and padded rank columns, and the bank lifecycle:
+hot load/unload, refcounted draining, alpha folding, rank padding,
+full-bank errors, and the version-keyed operand cache.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn.functional.lora import (
+    inverse_order, lora_delta, pad_rank, sort_by_adapter)
+from paddle_tpu.profiler import stats
+from paddle_tpu.serving.adapters import (
+    AdapterBank, LoRAAdapter, TARGET_PROJECTIONS)
+
+
+def _mk(T=96, K=256, N=384, S=3, R=8, seed=0, base_frac=0.3):
+    """Mixed base+adapter chunk: x sorted by slot, plus the sorted
+    offsets — the exact layout the serve path hands to lora_delta."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(T, K).astype(np.float32)
+    a = (rng.randn(S, K, R) * 0.05).astype(np.float32)
+    b = (rng.randn(S, R, N) * 0.05).astype(np.float32)
+    slots = rng.randint(0, S, T).astype(np.int32)
+    slots[rng.rand(T) < base_frac] = -1          # base-model tokens
+    order, offsets, counts = sort_by_adapter(jnp.asarray(slots), S)
+    x_sorted = jnp.asarray(x)[order]
+    return (jnp.asarray(x), jnp.asarray(a), jnp.asarray(b),
+            slots, np.asarray(order), np.asarray(offsets),
+            np.asarray(counts), x_sorted)
+
+
+def _dense_ref(x_sorted, a, b, offsets):
+    """Per-segment dense reference in fp64 — rows past offsets[-1]
+    stay zero."""
+    T = x_sorted.shape[0]
+    out = np.zeros((T, b.shape[-1]), np.float64)
+    for s in range(a.shape[0]):
+        lo, hi = int(offsets[s]), int(offsets[s + 1])
+        seg = np.asarray(x_sorted[lo:hi], np.float64)
+        out[lo:hi] = (seg @ np.asarray(a[s], np.float64)
+                      ) @ np.asarray(b[s], np.float64)
+    return out
+
+
+class TestSortHelpers:
+    def test_pad_rank_tiles(self):
+        assert pad_rank(8, jnp.float32) == 8
+        assert pad_rank(9, jnp.float32) == 16
+        assert pad_rank(4, jnp.bfloat16) == 16
+        assert pad_rank(16, jnp.bfloat16) == 16
+        assert pad_rank(33, jnp.int8) == 64
+
+    def test_sort_semantics(self):
+        slots = jnp.asarray([2, -1, 0, 2, 0, 7, 1, -1], jnp.int32)
+        order, offsets, counts = sort_by_adapter(slots, 3)
+        # 7 is out of range for a 3-slot bank -> base, like -1
+        assert np.asarray(counts).tolist() == [2, 1, 2]
+        assert np.asarray(offsets).tolist() == [0, 2, 3, 5]
+        order = np.asarray(order)
+        # stable: same-slot tokens keep batch order
+        assert order.tolist()[:5] == [2, 4, 6, 0, 3]
+        # base tokens land past offsets[-1]
+        assert set(order.tolist()[5:]) == {1, 5, 7}
+        inv = np.asarray(inverse_order(jnp.asarray(order)))
+        assert (inv[order] == np.arange(8)).all()
+
+    def test_all_base(self):
+        order, offsets, counts = sort_by_adapter(
+            jnp.full((5,), -1, jnp.int32), 2)
+        assert np.asarray(offsets).tolist() == [0, 0, 0]
+        assert np.asarray(counts).tolist() == [0, 0]
+
+
+class TestLoraDelta:
+    def test_parity_vs_dense(self):
+        _, a, b, _, _, offsets, _, x_sorted = _mk()
+        got = np.asarray(lora_delta(
+            x_sorted, a, b, jnp.asarray(offsets), backend="xla"))
+        ref = _dense_ref(x_sorted, a, b, offsets)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+    def test_interpret_bitwise_equals_xla(self):
+        """The CPU fallback IS the serving numerics: the tiled XLA
+        walk must match the interpreter-run Pallas kernel bitwise."""
+        _, a, b, _, _, offsets, _, x_sorted = _mk(seed=3)
+        off = jnp.asarray(offsets)
+        xla = np.asarray(lora_delta(x_sorted, a, b, off,
+                                    backend="xla"))
+        itp = np.asarray(lora_delta(x_sorted, a, b, off,
+                                    backend="interpret"))
+        assert np.array_equal(xla, itp)
+
+    @pytest.mark.parametrize("backend", ["xla", "interpret"])
+    def test_base_rows_exact_zero(self, backend):
+        _, a, b, _, _, offsets, _, x_sorted = _mk(seed=1,
+                                                  base_frac=0.5)
+        got = np.asarray(lora_delta(
+            x_sorted, a, b, jnp.asarray(offsets), backend=backend))
+        tail = got[int(offsets[-1]):]
+        assert tail.size and (tail == 0.0).all()
+
+    def test_padded_rank_columns_zero_delta(self):
+        """rank padded to the sublane tile with zero columns gives the
+        SAME delta as the unpadded rank — the +0.0 contract the bank's
+        rank padding rests on."""
+        _, a, b, _, _, offsets, _, x_sorted = _mk(R=8)
+        R_pad = pad_rank(8 + 1, jnp.float32)     # 16
+        a_pad = np.zeros((a.shape[0], a.shape[1], R_pad), np.float32)
+        b_pad = np.zeros((b.shape[0], R_pad, b.shape[2]), np.float32)
+        a_pad[..., :8] = np.asarray(a)
+        b_pad[:, :8, :] = np.asarray(b)
+        off = jnp.asarray(offsets)
+        base = np.asarray(lora_delta(x_sorted, a, b, off,
+                                     backend="xla"))
+        padded = np.asarray(lora_delta(
+            x_sorted, jnp.asarray(a_pad), jnp.asarray(b_pad), off,
+            backend="xla"))
+        np.testing.assert_allclose(padded, base, rtol=1e-6, atol=1e-7)
+
+    def test_shape_validation(self):
+        x, a, b, _, _, offsets, _, _ = _mk()
+        with pytest.raises(ValueError, match="offsets"):
+            lora_delta(x, a, b, jnp.zeros((2,), jnp.int32))
+        with pytest.raises(ValueError, match="bank mismatch"):
+            lora_delta(x, a, b[:, :4], jnp.asarray(offsets))
+
+
+def _bank(slots=3, rank=4, dtype=np.float32):
+    return AdapterBank(2, {"qkv": (16, 48), "ffn1": (16, 32)},
+                       slots=slots, rank=rank, dtype=dtype)
+
+
+class TestAdapterBank:
+    def test_from_stack_dims_and_int8_base(self):
+        L, d = 2, 16
+        weights = {f"{p}_weight": np.zeros((L, d, 24), np.int8)
+                   for p in TARGET_PROJECTIONS}
+        bank = AdapterBank.from_stack(weights, slots=2, rank=4)
+        assert bank.num_layers == L
+        assert set(bank.dims) == set(TARGET_PROJECTIONS)
+        assert bank.dims["qkv"] == (d, 24)
+        # quantized base: adapters stay fp32 (and rank pads for fp32)
+        assert bank.dtype == jnp.dtype(jnp.float32)
+        assert bank.rank_pad == pad_rank(4, jnp.float32)
+
+    def test_load_acquire_release_lifecycle(self):
+        bank = _bank()
+        s0 = bank.load(bank.random_adapter("t0"))
+        s1 = bank.load(bank.random_adapter("t1"))
+        assert s0 != s1 and bank.loaded() == {"t0": s0, "t1": s1}
+        assert bank.acquire("t0", "r1") == s0
+        assert bank.acquire("t0", "r1") == s0        # idempotent by rid
+        assert bank.refcount("t0") == 1
+        bank.acquire("t0", "r2")
+        assert bank.refcount("t0") == 2
+        bank.release("r1")
+        bank.release("r1")                            # idempotent
+        assert bank.refcount("t0") == 1
+        with pytest.raises(KeyError):
+            bank.acquire("missing", "r3")
+
+    def test_draining_frees_on_last_release(self):
+        bank = _bank()
+        bank.load(bank.random_adapter("t0"))
+        bank.acquire("t0", "r1")
+        assert bank.unload("t0") is False             # draining
+        assert bank.is_draining("t0")
+        with pytest.raises(KeyError, match="draining"):
+            bank.acquire("t0", "r2")                  # no new admits
+        assert "t0" in bank.loaded()                  # still resident
+        v = bank.version
+        bank.release("r1")                            # last ref frees
+        assert "t0" not in bank.loaded()
+        assert bank.version > v
+        # slot is reusable immediately
+        bank.load(bank.random_adapter("t2"))
+
+    def test_full_bank_and_double_load(self):
+        bank = _bank(slots=2)
+        bank.load(bank.random_adapter("t0"))
+        bank.load(bank.random_adapter("t1"))
+        with pytest.raises(RuntimeError, match="full"):
+            bank.load(bank.random_adapter("t2"))
+        with pytest.raises(ValueError, match="already loaded"):
+            bank.load(bank.random_adapter("t0"))
+        assert bank.unload("t0") is True
+        bank.load(bank.random_adapter("t2"))
+        with pytest.raises(KeyError):
+            bank.unload("nope")
+
+    def test_alpha_folds_into_b(self):
+        bank = _bank()
+        ad = bank.random_adapter("t0")
+        a, b = ad.weights["qkv"]
+        doubled = LoRAAdapter("t0x2", bank.rank,
+                              {"qkv": (a, b)}, alpha=2 * bank.rank)
+        a2, b2 = doubled.weights["qkv"]
+        np.testing.assert_allclose(b2, b * 2.0)
+        np.testing.assert_allclose(a2, a)
+
+    def test_rank_padding_in_slot_page(self):
+        bank = _bank(rank=4)                          # rank_pad 8
+        assert bank.rank_pad == 8
+        slot = bank.load(bank.random_adapter("t0", rank=2))
+        ops = bank.operands()
+        qa = np.asarray(ops["qkv_a"])                 # [L, S, K, R]
+        qb = np.asarray(ops["qkv_b"])                 # [L, S, R, N]
+        assert (qa[:, slot, :, 2:] == 0).all()
+        assert (qb[:, slot, 2:, :] == 0).all()
+        assert np.abs(qa[:, slot, :, :2]).sum() > 0
+
+    def test_operand_cache_keyed_by_version(self):
+        bank = _bank()
+        bank.load(bank.random_adapter("t0"))
+        ops1 = bank.operands()
+        assert bank.operands() is ops1                # cache hit
+        bank.load(bank.random_adapter("t1"))          # version bump
+        ops2 = bank.operands()
+        assert ops2 is not ops1
+        assert set(ops2) == {"qkv_a", "qkv_b", "ffn1_a", "ffn1_b"}
+
+    def test_telemetry(self):
+        stats.reset()
+        bank = _bank()
+        bank.load(bank.random_adapter("t0"))
+        bank.load(bank.random_adapter("t1"))
+        assert stats.counter("lora.swaps").value == 2
+        assert stats.gauge("lora.active_adapters").value == 2
+        bank.acquire("t0", "r1")
+        bank.unload("t0")                             # draining
+        assert stats.gauge("lora.active_adapters").value == 1
+        bank.release("r1")                            # freed -> swap #3
+        assert stats.counter("lora.swaps").value == 3

@@ -1,0 +1,325 @@
+"""Batched multi-LoRA serving (ISSUE 18, scheduler/engine/router).
+
+Tier-1 acceptance pins:
+
+- greedy parity: batched multi-adapter serving (mixed base+adapter
+  batches included) produces token streams IDENTICAL to serving each
+  request alone, for K ∈ {1, 4} here and K=32 in the slow tier
+  (``serve_bench --adapters 32`` drives the same pin at bench scale);
+- compiled-program count is independent of the adapter set: hot
+  load/unload under live traffic never recompiles, drops or restarts
+  anything;
+- preempt/resume (pool-pressure recompute) and fleet failover keep
+  adaptered streams exact — replicas share ONE AdapterBank, so
+  adoption re-resolves the same weights;
+- DWRR tenant-fair admission delivers weighted shares with the
+  starvation bound intact, and the router's per-tenant rate quota
+  sheds with typed ``TenantQuotaExceeded`` on the injectable clock.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flags as F
+from paddle_tpu.inference import FusedCausalLM
+from paddle_tpu.serving import (AdapterBank, FaultInjector, FleetRouter,
+                                ManualClock, SLOConfig, ServingEngine,
+                                TenantQuotaExceeded, use_clock)
+from paddle_tpu.profiler import stats
+
+
+def _model(seed=7):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=64, embed_dim=32, num_heads=4,
+                         dim_feedforward=64, num_layers=2,
+                         max_position=256)
+
+
+def _bank(model, names, slots=None, rank=4, seed=3):
+    """init_scale=0.3: on the tiny test model the default 0.02 deltas
+    are too small to flip a greedy argmax — divergence tests need the
+    adapter to actually steer tokens."""
+    bank = AdapterBank.from_stack(model.stack._stack(),
+                                  slots=slots or max(len(names), 1),
+                                  rank=rank)
+    for name in names:
+        bank.load(bank.random_adapter(name, seed=seed,
+                                      init_scale=0.3))
+    return bank
+
+
+def _engine(model, bank=None, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_length", 128)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("slo", SLOConfig(prefill_chunk=16))
+    return ServingEngine(model, adapters=bank, **kw)
+
+
+def _workload(K, n_req, seed=5, lens=(12, 9, 17, 6)):
+    """Mixed base+adapter request list: every 4th request is a
+    base-model request, the rest round-robin the K adapters."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n_req):
+        p = rng.randint(0, 64, (lens[i % len(lens)],))
+        a = None if i % 4 == 3 else f"t{i % K}"
+        out.append((p, a))
+    return out
+
+
+class TestGreedyParity:
+    @pytest.mark.parametrize("K", [1, 4])
+    def test_batched_equals_sequential(self, K):
+        model = _model()
+        bank = _bank(model, [f"t{i}" for i in range(K)])
+        reqs = _workload(K, n_req=max(K, 4) + 2)
+        eng = _engine(model, bank)
+        rids = [eng.submit(p, max_new_tokens=8, adapter_id=a)
+                for p, a in reqs]
+        done = {r.id: r for r in eng.run()}
+        assert all(done[r].state == "ok" for r in rids)
+        nprog = len(eng._gen._decode_k_jit)
+        for rid, (p, a) in zip(rids, reqs):
+            solo = _engine(model, bank)
+            sid = solo.submit(p, max_new_tokens=8, adapter_id=a)
+            ref = {r.id: r for r in solo.run()}[sid]
+            np.testing.assert_array_equal(done[rid].output, ref.output)
+        # one adaptered + one base decode variant at most
+        assert nprog <= 2
+        assert all(bank.refcount(n) == 0 for n in bank.loaded())
+
+    @pytest.mark.slow
+    def test_batched_equals_sequential_k32(self):
+        model = _model()
+        bank = _bank(model, [f"t{i}" for i in range(32)], slots=32)
+        reqs = _workload(32, n_req=36)
+        eng = _engine(model, bank, max_batch=8)
+        rids = [eng.submit(p, max_new_tokens=6, adapter_id=a)
+                for p, a in reqs]
+        done = {r.id: r for r in eng.run()}
+        for rid, (p, a) in zip(rids, reqs):
+            solo = _engine(model, bank)
+            sid = solo.submit(p, max_new_tokens=6, adapter_id=a)
+            ref = {r.id: r for r in solo.run()}[sid]
+            np.testing.assert_array_equal(done[rid].output, ref.output)
+        assert len(eng._gen._decode_k_jit) <= 2
+
+    def test_adapter_steers_tokens(self):
+        model = _model()
+        bank = _bank(model, ["t0"])
+        eng = _engine(model, bank)
+        rng = np.random.RandomState(5)
+        p = rng.randint(0, 64, (12,))
+        r_base = eng.submit(p, max_new_tokens=8)
+        r_ad = eng.submit(p, max_new_tokens=8, adapter_id="t0")
+        done = {r.id: r for r in eng.run()}
+        assert not np.array_equal(done[r_base].output,
+                                  done[r_ad].output)
+
+    def test_unknown_and_bankless_adapter_rejected(self):
+        model = _model()
+        eng = _engine(model, _bank(model, ["t0"]))
+        with pytest.raises(KeyError):
+            eng.submit(np.arange(4), max_new_tokens=2,
+                       adapter_id="nope")
+        bankless = _engine(model)
+        with pytest.raises(ValueError, match="no adapter bank"):
+            bankless.submit(np.arange(4), max_new_tokens=2,
+                            adapter_id="t0")
+
+
+class TestHotSwap:
+    def test_swap_under_live_load_zero_dropped(self):
+        """load/unload mid-decode: nothing drops, nothing recompiles,
+        the draining adapter serves its live request to completion and
+        frees on the last release."""
+        model = _model()
+        bank = _bank(model, ["t0"], slots=3)
+        eng = _engine(model, bank)
+        rng = np.random.RandomState(5)
+        r0 = eng.submit(rng.randint(0, 64, (12,)), max_new_tokens=12,
+                        adapter_id="t0")
+        rb = eng.submit(rng.randint(0, 64, (9,)), max_new_tokens=12)
+        for _ in range(3):
+            eng.step()
+        nprog_mid = len(eng._gen._decode_k_jit)
+        bank.load(bank.random_adapter("t1", seed=4, init_scale=0.3))
+        r1 = eng.submit(rng.randint(0, 64, (7,)), max_new_tokens=8,
+                        adapter_id="t1")
+        assert bank.unload("t0") is False         # draining, r0 live
+        done = {r.id: r for r in eng.run()}
+        assert all(done[r].state == "ok" for r in (r0, rb, r1))
+        assert all(len(done[r].generated) > 0 for r in (r0, rb, r1))
+        # drained slot freed itself at r0's terminal release
+        assert "t0" not in bank.loaded()
+        # the swaps changed VALUES only — no new decode programs
+        assert len(eng._gen._decode_k_jit) == nprog_mid
+
+    def test_program_count_independent_of_adapter_set(self):
+        model = _model()
+        bank = _bank(model, ["t0"], slots=4)
+        eng = _engine(model, bank)
+        rng = np.random.RandomState(9)
+        eng.submit(rng.randint(0, 64, (10,)), max_new_tokens=4,
+                   adapter_id="t0")
+        eng.run()
+        progs = (len(eng._gen._decode_k_jit), len(eng._chunk_jit))
+        for name in ("t1", "t2"):
+            bank.load(bank.random_adapter(name, seed=8,
+                                          init_scale=0.3))
+        rids = [eng.submit(rng.randint(0, 64, (10,)),
+                           max_new_tokens=4, adapter_id=n)
+                for n in ("t0", "t1", "t2")]
+        done = {r.id: r for r in eng.run()}
+        assert all(done[r].state == "ok" for r in rids)
+        assert (len(eng._gen._decode_k_jit),
+                len(eng._chunk_jit)) == progs
+
+    def test_speculative_composition_rejected(self):
+        model = _model()
+        bank = _bank(model, ["t0"])
+        eng = ServingEngine(model, max_batch=2, page_size=4,
+                            max_length=128, adapters=bank,
+                            speculative="self")
+        with pytest.raises(ValueError, match="speculative"):
+            eng.submit(np.arange(6), max_new_tokens=2,
+                       adapter_id="t0")
+
+
+class TestPreemptResume:
+    def test_squeeze_preempts_adaptered_with_parity(self):
+        """Pool-pressure preemption-by-recompute on adaptered
+        decoders: streams stay exact vs the fault-free adaptered
+        run (the resume path re-acquires the same slot)."""
+        model = _model()
+        bank = _bank(model, ["t0", "t1"])
+        rng = np.random.RandomState(31)
+        prompts = [rng.randint(0, 64, (16,)) for _ in range(3)]
+        ads = ["t0", "t1", None]
+        refs = []
+        for p, a in zip(prompts, ads):
+            solo = _engine(model, bank)
+            sid = solo.submit(p, max_new_tokens=16, adapter_id=a)
+            refs.append({r.id: r for r in solo.run()}[sid].output)
+        before = stats.counter("serving.preemptions").value
+        inj = FaultInjector().add("decode.step", kind="squeeze",
+                                  pages=2, at=2)
+        eng = ServingEngine(model, faults=inj, max_batch=3,
+                            page_size=4, max_length=64,
+                            decode_chunk=2, num_pages=15,
+                            adapters=bank,
+                            slo=SLOConfig(prefill_chunk=8))
+        rids = [eng.submit(p, max_new_tokens=16, adapter_id=a)
+                for p, a in zip(prompts, ads)]
+        done = {r.id: r for r in eng.run()}
+        for rid, ref in zip(rids, refs):
+            assert done[rid].state == "ok"
+            np.testing.assert_array_equal(done[rid].output, ref)
+        assert stats.counter("serving.preemptions").value > before
+        assert all(bank.refcount(n) == 0 for n in bank.loaded())
+        inj.release_all()
+
+
+class TestFleetFailover:
+    def test_adaptered_failover_parity_shared_bank(self):
+        """Replica death mid-decode: the adaptered request migrates,
+        re-acquires from the SHARED bank on the adopting replica, and
+        its greedy stream matches the single-engine reference."""
+        model = _model()
+        bank = _bank(model, ["t0"], slots=4)
+        rng = np.random.RandomState(5)
+        p = rng.randint(0, 64, (12,))
+        ref_eng = _engine(_model(), bank)
+        ref_id = ref_eng.submit(p, max_new_tokens=8, adapter_id="t0")
+        ref = {r.id: r for r in ref_eng.run()}[ref_id].output
+
+        router = FleetRouter(
+            engine_factory=lambda i: _engine(_model(), bank),
+            n_replicas=2)
+        rid = router.submit(p, max_new_tokens=8, adapter_id="t0")
+        for _ in range(4):
+            router.step()
+        victim = next(
+            i for i, rep in enumerate(router.replicas)
+            if rep.eng.num_active or rep.eng.num_prefilling
+            or rep.eng.queue_depth)
+        router.kill(victim)
+        done = {r.id: r for r in router.run()}
+        assert done[rid].state == "ok"
+        np.testing.assert_array_equal(done[rid].output, ref)
+        # the dead replica's pin was released, the adopter's drained
+        assert bank.refcount("t0") == 0
+
+
+class TestTenantFairness:
+    def _fair_engine(self, weights, **kw):
+        return _engine(_model(), None,
+                       slo=SLOConfig(prefill_chunk=16,
+                                     tenant_fair=True,
+                                     tenant_weights=weights,
+                                     fair_quantum=16), **kw)
+
+    def _pick_order(self, eng, n):
+        eng._drain_inbox()
+        order = []
+        for _ in range(n):
+            r = eng._pick_waiting()
+            if r is None:
+                break
+            order.append(r.tenant)
+        return order
+
+    def test_dwrr_weighted_share(self):
+        """heavy (weight 3) admits ~3x light's share under equal
+        per-request cost — a flood cannot starve the light tenant."""
+        eng = self._fair_engine({"heavy": 3.0, "light": 1.0})
+        for i in range(12):
+            eng.submit(np.arange(8), max_new_tokens=8,
+                       tenant="light" if i < 6 else "heavy")
+        order = self._pick_order(eng, 8)
+        assert len(order) == 8
+        n_heavy = order.count("heavy")
+        n_light = order.count("light")
+        assert n_light >= 2                     # light keeps flowing
+        assert n_heavy > n_light                # ...at weighted share
+
+    def test_starvation_bound_preserved(self):
+        """Even a weight-50 flood cannot pass the queue head over
+        more than ``starvation_bound`` times."""
+        bound = 4
+        eng = self._fair_engine({"flood": 50.0},
+                                starvation_bound=bound)
+        eng.submit(np.arange(8), max_new_tokens=8, tenant="slim")
+        for _ in range(20):
+            eng.submit(np.arange(8), max_new_tokens=8,
+                       tenant="flood")
+        order = self._pick_order(eng, bound + 2)
+        assert "slim" in order[: bound + 1]
+
+
+class TestTenantQuota:
+    def test_rate_quota_sheds_typed_and_rolls(self):
+        F.set_flags({"FLAGS_tenant_quota_rps": 2.0,
+                     "FLAGS_tenant_quota_window_s": 1.0})
+        try:
+            with use_clock(ManualClock()) as clk:
+                router = FleetRouter(engines=[_engine(_model())])
+                p = np.arange(8)
+                router.submit(p, max_new_tokens=2, tenant="a")
+                router.submit(p, max_new_tokens=2, tenant="a")
+                with pytest.raises(TenantQuotaExceeded) as ei:
+                    router.submit(p, max_new_tokens=2, tenant="a")
+                assert ei.value.tenant == "a"
+                assert ei.value.kind == "rate"
+                # typed as an overload: callers' shed handling applies
+                from paddle_tpu.serving import ServerOverloaded
+                assert isinstance(ei.value, ServerOverloaded)
+                # other tenants are untouched by a's quota
+                router.submit(p, max_new_tokens=2, tenant="b")
+                clk.advance(1.5)                 # window rolls
+                router.submit(p, max_new_tokens=2, tenant="a")
+                router.run()
+        finally:
+            F.set_flags({"FLAGS_tenant_quota_rps": 0.0})

@@ -174,6 +174,20 @@ DEFAULT_METRICS: Dict[str, str] = {
     "serve_step_host_overhead_ms": "up",
     "alert_fired": "up",
     "alert.fired": "up",
+    # batched multi-LoRA serving rungs (tools/serve_bench.py
+    # --adapters, ISSUE 18): delivered multi-adapter throughput and
+    # its ratio to the single-tenant baseline regress DOWN (the ratio
+    # is the honest one — it cancels host noise and pins the grouped
+    # delta launch staying ONE kernel however many adapters the chunk
+    # mixes); TTFT UP like the plain serve_* siblings; the compiled
+    # decode-program count regresses UP (programs scaling with the
+    # adapter set is a retrace leak however small)
+    "serve_lora_tokens_per_sec": "down",
+    "serve_lora_pct_of_single_tenant": "down",
+    "serve_lora_p50_ttft_ms": "up",
+    "serve_lora_p99_ttft_ms": "up",
+    "serve_lora_goodput": "down",
+    "serve_lora_decode_programs": "up",
     # per-tenant usage metering (ISSUE 17): one tenant's share of
     # attributed device time regresses UP (a hog crowding out the
     # rest of the mix), and usage_unattributed_ms regresses UP with

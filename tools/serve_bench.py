@@ -57,6 +57,19 @@ run, and goodput stays within a pinned bound of the fault-free run's.
 Emits ``serve_chaos_*`` keys (gated by tools/bench_gate.py) and exits
 nonzero when any pin fails.
 
+``--adapters K`` (ISSUE 18) serves K distinct LoRA adapters from one
+:class:`AdapterBank` — every request is stamped with a round-robin
+``adapter_id`` so each decode chunk mixes adapters and the batched
+ragged grouped-GEMM delta path carries the whole set in ONE launch
+per target projection. The rung measures the multi-tenancy tax
+directly: the same workload is first driven single-tenant (every
+request on ONE adapter — the same adaptered programs, no grouping
+spread) and then multi-adapter, and ``serve_lora_pct_of_single_
+tenant`` is the ratio of the two throughputs (gated DOWN; the ISSUE
+18 acceptance pins >= 0.8 at K=32 on CPU). Also emits
+``serve_lora_{tokens_per_sec,swap_count,decode_programs}`` — the
+program count must stay independent of the adapter set.
+
 ``--tenants K`` (ISSUE 17) stamps a Zipf-popular tenant id on every
 request (rank k drawn ∝ 1/(k+1)^``--tenant-skew``) and turns the
 per-tenant usage ledger on (``serving/accounting.py``): the run emits
@@ -325,7 +338,9 @@ def drive(eng, reqs, max_new, deadline_ms=None):
                                            max_new_tokens=max_new,
                                            deadline_ms=deadline_ms,
                                            tenant=rest[0] if rest
-                                           else None))
+                                           else None,
+                                           adapter_id=rest[1]
+                                           if len(rest) > 1 else None))
                 except ServerOverloaded:
                     rids.append(None)  # backpressure — dropped load
         except BaseException as e:  # surface on the main thread
@@ -641,6 +656,110 @@ def run_fleet_chaos(args, reqs, base_rids, base_done, base_goodput,
     return out, ok
 
 
+def run_lora(args):
+    """The --adapters bench (ISSUE 18): one AdapterBank serving K
+    distinct LoRA adapters, requests stamped round-robin so every
+    decode chunk mixes adapters. Drives the SAME Poisson workload
+    twice on one warm engine — single-tenant (every request on one
+    adapter: identical adaptered programs, no grouping spread) then
+    multi-adapter — and reports the throughput ratio as
+    ``serve_lora_pct_of_single_tenant``. The compiled decode-program
+    count is emitted too: it must not scale with the adapter set."""
+    from paddle_tpu.profiler import stats
+    from paddle_tpu.serving import AdapterBank
+
+    rng = np.random.RandomState(args.seed)
+    eng, lens = build_engine(args)
+    bank = AdapterBank.from_stack(eng.model.stack._stack(),
+                                  slots=args.adapters,
+                                  rank=args.adapter_rank)
+    for i in range(args.adapters):
+        bank.load(bank.random_adapter(f"lora{i}", seed=args.seed + i,
+                                      rank=args.adapter_rank))
+    eng.adapters = bank
+    swaps_warm = int(stats.counter("lora.swaps").value)
+    reqs = make_requests(args, lens, rng)
+
+    def reset():
+        eng.finished.clear()
+        eng.action_log.clear()
+        eng.slo_monitor.reset()
+        if eng.journal is not None:
+            eng.journal.clear()
+        if eng.usage is not None:
+            eng.usage.reset()
+
+    if not args.no_warmup:
+        # compile every adaptered chunk/decode program (plus the
+        # base-path ones a mixed batch would touch) outside both
+        # measured windows, so the single-vs-multi ratio compares
+        # steady states
+        warm = [(np.full((L,), 1, np.int32), 0.0, None, "lora0")
+                for L in lens]
+        warm.append((np.full((lens[0],), 1, np.int32), 0.0))
+        drive(eng, warm, args.max_new)
+        reset()
+        stats.reset()
+
+    # single-tenant baseline: the whole load on ONE adapter
+    wall_s, rids_s = drive(
+        eng, [(p, g, None, "lora0") for p, g in reqs], args.max_new)
+    single_tokens = sum(len(r.generated) for r in eng.finished)
+    single_tps = single_tokens / wall_s if wall_s > 0 else 0.0
+    reset()
+
+    # multi-adapter run: round-robin over the full bank
+    multi = [(p, g, None, f"lora{i % args.adapters}")
+             for i, (p, g) in enumerate(reqs)]
+    sampler = _start_telemetry(args, journal=eng.journal)
+    wall_m, rids_m = drive(eng, multi, args.max_new)
+    tele_out = _stop_telemetry(sampler, args.telemetry_out)
+    done = eng.finished
+    ttfts = np.array([r.ttft_s for r in done
+                      if r.ttft_s is not None], np.float64) * 1e3
+    if ttfts.size == 0:
+        ttfts = np.array([0.0])
+    multi_tokens = sum(len(r.generated) for r in done)
+    multi_tps = multi_tokens / wall_m if wall_m > 0 else 0.0
+    judged = [r for r in done if getattr(r, "slo_ok", None) is not None]
+    goodput = round(sum(1 for r in judged if r.slo_ok)
+                    / len(judged), 4) if judged else None
+    if args.journal_out and eng.journal is not None:
+        eng.journal.dump_jsonl(args.journal_out)
+    _dump_usage(args, eng=eng)
+    out = {
+        "serve_lora_adapters": args.adapters,
+        "serve_lora_rank": args.adapter_rank,
+        "serve_lora_tokens_per_sec": round(multi_tps, 1),
+        "serve_lora_single_tenant_tokens_per_sec": round(single_tps, 1),
+        "serve_lora_pct_of_single_tenant": round(
+            multi_tps / single_tps, 4) if single_tps > 0 else None,
+        "serve_lora_swap_count": swaps_warm
+        + int(stats.counter("lora.swaps").value),
+        "serve_lora_grouped_launches": int(
+            stats.counter("lora.grouped_launches").value),
+        "serve_lora_decode_programs": len(eng._gen._decode_k_jit),
+        "serve_lora_p50_ttft_ms": round(
+            float(np.percentile(ttfts, 50)), 3),
+        "serve_lora_p99_ttft_ms": round(
+            float(np.percentile(ttfts, 99)), 3),
+        "serve_lora_goodput": goodput,
+        "serve_lora_requests": len(done),
+        "serve_lora_shed": sum(1 for r in rids_m if r is None),
+        "serve_lora_wall_s": round(wall_m, 3),
+        "telemetry": _telemetry(),
+    }
+    out.update(_alert_keys())
+    out.update(_usage_keys(eng=eng))
+    out.update(tele_out)
+    # the acceptance pin: batched multi-LoRA keeps >= 80% of the
+    # single-tenant throughput (the grouped delta launch is ONE kernel
+    # regardless of how many adapters the chunk mixes)
+    ok = out["serve_lora_pct_of_single_tenant"] is not None \
+        and out["serve_lora_pct_of_single_tenant"] >= 0.8
+    return out, ok
+
+
 def chaos_injector(seed):
     """The seeded chaos schedule: >=5 distinct serving-hot-path sites
     (kv.grow, prefill.dispatch, decode.step, prefix.insert,
@@ -837,6 +956,16 @@ def main():
                          "a failed pin)")
     ap.add_argument("--chaos-seed", type=int, default=None,
                     help="fault-schedule seed (default: --seed)")
+    ap.add_argument("--adapters", type=int, default=0,
+                    help="multi-LoRA workload (ISSUE 18): serve K "
+                         "distinct adapters from one AdapterBank, "
+                         "round-robin adapter_id per request; emits "
+                         "serve_lora_* keys and pins "
+                         "pct_of_single_tenant >= 0.8 (nonzero exit "
+                         "on a failed pin)")
+    ap.add_argument("--adapter-rank", type=int, default=8,
+                    help="LoRA rank for the bench adapters (padded "
+                         "to the bank's sublane tile)")
     ap.add_argument("--tenants", type=int, default=0,
                     help="multi-tenant workload (ISSUE 17): stamp a "
                          "Zipf-popular tenant id (K distinct) on "
@@ -926,6 +1055,17 @@ def main():
         set_flags({"usage_ledger": True})
 
     from paddle_tpu.profiler import stats
+
+    if args.adapters:
+        out, lora_ok = run_lora(args)
+        print(json.dumps(out))
+        if not lora_ok:
+            print("serve_bench --adapters: batched multi-LoRA pin "
+                  "FAILED (serve_lora_pct_of_single_tenant < 0.8 — "
+                  "the grouped delta path is paying per-adapter "
+                  "cost)", file=sys.stderr)
+            sys.exit(1)
+        return
 
     if args.fleet and args.fleet > 1:
         out, fleet_ok = run_fleet(args)

@@ -159,10 +159,12 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
             "rid": rid, "events": [], "phase": "waiting",
             "ttft_ms": None, "tpot_ms": None, "slo_ok": None,
             "preempts": 0, "requeues": 0, "stalls": 0,
-            "prompt_len": None, "n_tokens": None, "chunks": 0})
+            "prompt_len": None, "n_tokens": None, "chunks": 0,
+            "adapter": None})
         r["events"].append(e)
         if ev == "submit":
             r["prompt_len"] = e.get("prompt_len")
+            r["adapter"] = e.get("adapter")
         elif ev == "queued":
             r["phase"] = "waiting"
         elif ev == "admitted":
@@ -217,7 +219,10 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
               "error": 0}
     for r in reqs.values():
         phases[r["phase"]] = phases.get(r["phase"], 0) + 1
+    adaptered = [r for r in reqs.values() if r.get("adapter")]
     return {
+        "adapters": sorted({r["adapter"] for r in adaptered}),
+        "adaptered_requests": len(adaptered),
         "events": len(events),
         "requests": reqs,
         "queue_depth": phases["waiting"],
@@ -284,12 +289,13 @@ def _timeline_lines(r: dict) -> List[str]:
 def _request_row(r: dict) -> str:
     verdict = ("SLO ok" if r["slo_ok"] else "SLO MISS") \
         if r["slo_ok"] is not None else "unjudged"
+    adapter = f"  adapter {r['adapter']}" if r.get("adapter") else ""
     return (f"  req {r['rid']:<5} {r['phase']:<9} "
             f"ttft {_fmt(r['ttft_ms'], 1, 'ms'):>9}  "
             f"tpot {_fmt(r['tpot_ms'], 2, 'ms'):>9}  "
             f"tok {r['n_tokens'] if r['n_tokens'] is not None else '-':>4}  "
             f"preempts {r['preempts']}  requeues {r['requeues']}  "
-            f"{verdict}")
+            f"{verdict}{adapter}")
 
 
 def render(summary: dict, top: int = 5,
@@ -329,6 +335,14 @@ def render(summary: dict, top: int = 5,
             f"fleet: failovers_in {s.get('failovers', 0)}  "
             f"migrations_in {s.get('migrations', 0)}  "
             f"drains {s.get('drains', 0)}")
+    if s.get("adaptered_requests"):
+        # batched multi-LoRA (ISSUE 18): how many distinct adapters
+        # the journal's traffic mixed, and over how many requests
+        ads = s.get("adapters") or []
+        shown = ",".join(ads[:6]) + ("..." if len(ads) > 6 else "")
+        lines.append(
+            f"lora: {len(ads)} adapters over "
+            f"{s['adaptered_requests']} requests ({shown})")
     if s.get("spec_rounds"):
         # speculative decoding (ISSUE 12): the accept-rate row — the
         # one number that says whether the drafter is paying for its
@@ -498,17 +512,22 @@ def render_tenants(records: List[dict], am, top: int = 10) -> str:
         "device time",
         f"  {'tenant':<14} {'reqs':>5} {'device_ms':>10} "
         f"{'share':>6} {'kv_page_s':>10} {'queue_s':>8} "
-        f"{'prefill':>8} {'decode':>7} {'waste':>6} states",
+        f"{'prefill':>8} {'decode':>7} {'waste':>6} {'lora':>5} "
+        "states",
     ]
     for a in rows[:max(top, 0)]:
         states = ",".join(f"{k}:{v}" for k, v in
                           sorted(a["states"].items()))
+        # distinct LoRA adapters this tenant's requests rode (ISSUE
+        # 18); "-" for pure-base traffic
+        n_ad = len(a.get("adapters") or ())
         lines.append(
             f"  {a['tenant']:<14} {a['n_requests']:>5} "
             f"{a['device_ms']:>10.3f} {a['share']:>6.1%} "
             f"{a['kv_page_s']:>10.4f} {a['queue_s']:>8.4f} "
             f"{a['prefill_tokens']:>8} {a['decode_tokens']:>7} "
-            f"{a['waste_share']:>6.1%} {states}")
+            f"{a['waste_share']:>6.1%} {n_ad if n_ad else '-':>5} "
+            f"{states}")
     if len(rows) > top > 0:
         lines.append(f"  ... {len(rows) - top} more tenants")
     return "\n".join(lines)
