@@ -502,7 +502,7 @@ def run_moe_train_bench(d_model, n_layers, n_heads, seq, batch,
 
 def run_moe_decode_bench(batch=32, prompt=128, new_tokens=65,
                          d_model=1024, n_layers=12, n_heads=16,
-                         num_experts=8, top_k=2):
+                         num_experts=8, top_k=2, ep_degree=None):
     """MoE serving decode rung: FusedCausalLM with the expert-bank FFN
     through GenerationEngine (the no-drop ragged MoE FFN per layer).
     Returns (tokens/s, total stack params)."""
@@ -522,7 +522,8 @@ def run_moe_decode_bench(batch=32, prompt=128, new_tokens=65,
         if "weight" in n or n.startswith(("moe_w", "gate")):
             p._rebind(p._data.astype(jnp.bfloat16))
     engine = GenerationEngine(model, page_size=16,
-                              max_length=prompt + new_tokens)
+                              max_length=prompt + new_tokens,
+                              ep_degree=ep_degree)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, VOCAB, (batch, prompt))
     engine.generate(ids, max_new_tokens=new_tokens)   # warmup/compile
@@ -753,6 +754,96 @@ def _run_secondary(kind):
              "decode_tp_mp_degree": mp,
              "decode_tp_roofline": cost_rl,
              "decode_tp_telemetry": _telemetry()}))
+    elif kind == "--decode-tp-overlap":
+        # ring-overlap TP decode rung (ISSUE 19): the SAME mp2 decode
+        # workload with FLAGS_tp_overlap=ring — each layer's two
+        # reduce seams run as chunked ppermute rings interleaved with
+        # the chunk GEMMs instead of one blocking psum, so the ICI
+        # hop hides behind the weight-stream math. Keys are pinned to
+        # tp2 (the ring's win shrinks as P outgrows the interconnect
+        # depth; tp2 is the shape the S-OVERLAP census pins). Gated
+        # by bench_gate: tokens/s DOWN. CPU runs a tiny geometry —
+        # rung plumbing + parity only; the XLA fallback mirrors the
+        # ring op-for-op so the numbers are chip-only signal.
+        import jax
+
+        n = len(jax.devices())
+        if n < 2:
+            print(json.dumps({"decode_tp2_overlap_skipped":
+                              f"needs >= 2 devices, have {n}"}))
+            return
+        import paddle_tpu as _p
+
+        _p.set_flags({"tp_overlap": "ring"})
+        if jax.default_backend() == "tpu":
+            tps, pct, cost_rl = run_decode_bench(mp_degree=2)
+        else:
+            tps, pct, cost_rl = run_decode_bench(
+                batch=2, prompt=16, new_tokens=9, d_model=64,
+                n_layers=2, n_heads=4, mp_degree=2)
+        print(json.dumps(
+            {"decode_tp2_overlap_tokens_per_sec": round(tps, 1),
+             "decode_tp2_overlap_pct_of_hbm_roofline": pct,
+             "decode_tp2_overlap_roofline": cost_rl,
+             "decode_tp2_overlap_telemetry": _telemetry()}))
+    elif kind == "--moe-decode-ep-overlap":
+        # double-buffered EP decode rung (ISSUE 19): the MoE decode
+        # workload at ep2 with FLAGS_ep_overlap on — the all_to_all
+        # exchange splits into two half-capacity buffers so dispatch1
+        # rides the ICI while expert FFN0 runs. Gated by bench_gate:
+        # tokens/s DOWN.
+        import jax
+
+        n = len(jax.devices())
+        if n < 2:
+            print(json.dumps({"moe_decode_ep2_overlap_skipped":
+                              f"needs >= 2 devices, have {n}"}))
+            return
+        import paddle_tpu as _p
+
+        _p.set_flags({"ep_overlap": True})
+        if jax.default_backend() == "tpu":
+            tps, n_params = run_moe_decode_bench(ep_degree=2)
+        else:
+            tps, n_params = run_moe_decode_bench(
+                batch=2, prompt=16, new_tokens=9, d_model=64,
+                n_layers=2, n_heads=4, num_experts=4, ep_degree=2)
+        print(json.dumps(
+            {"moe_decode_ep2_overlap_tokens_per_sec": round(tps, 1),
+             "moe_decode_ep2_overlap_params": n_params,
+             "moe_decode_ep2_overlap_telemetry": _telemetry()}))
+    elif kind == "--fleet":
+        # fleet serving rung with the decode-concurrent drain (ISSUE
+        # 19): serve_bench --fleet 2 --drain-async — replica 0 drains
+        # mid-load under FLAGS_migrate_async, pages stream while both
+        # endpoints keep decoding (fleet_* + fleet_async_migration_*
+        # keys; gate: decode tokens DOWN, stall-ms UP).
+        import os
+        import subprocess
+
+        import jax
+
+        tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "serve_bench.py")
+        argv = [sys.executable, tool, "--no-lint", "--seed", "0",
+                "--streams", "4", "--fleet", "2", "--drain-async"]
+        if jax.default_backend() == "tpu":
+            argv += ["--d-model", "2048", "--layers", "24", "--heads",
+                     "16", "--vocab", "51200", "--bf16",
+                     "--prompt-mix", "128,512,1024",
+                     "--prefill-chunk", "256", "--max-new", "64",
+                     "--page-size", "16", "--rate", "64"]
+        else:
+            argv += ["--max-new", "24", "--rate", "200"]
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=1200)
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"serve_bench --fleet --drain-async "
+                f"rc={proc.returncode}: {proc.stderr[-300:]}")
+        print(lines[-1])
     elif kind == "--decode-spec":
         # speculative decoding at the acceptance ceiling (ISSUE 12):
         # replayed-greedy drafts -> accept rate 1.0, so the rung
@@ -914,6 +1005,95 @@ def _run_secondary(kind):
                           "s2048_roofline": roofline}))
 
 
+#: every secondary rung, in the accumulated BENCH_r06 order
+SECONDARY_KINDS = ("--s2048", "--decode", "--decode-int8",
+                   "--decode-a8w8", "--decode-bf16-grouped",
+                   "--decode-tp", "--decode-tp-overlap",
+                   "--decode-spec", "--decode-int8kv", "--serve",
+                   "--serve-long", "--fleet", "--attn-varlen",
+                   "--moe-train", "--moe-decode",
+                   "--moe-decode-ep-overlap", "--bert")
+
+#: rungs with CPU-sized fallback geometries — the --all manifest runs
+#: exactly these off-chip (the rest are chip-only shapes)
+CPU_KINDS = ("--decode-tp-overlap", "--decode-spec", "--serve",
+             "--serve-long", "--fleet", "--attn-varlen",
+             "--moe-train", "--moe-decode", "--moe-decode-ep-overlap")
+
+
+def _sub(argv, timeout, env=None):
+    """One rung in a fresh child process (a failed bigger config
+    leaves no stale HBM buffers behind; children skip the lint
+    preflight — the parent vetted the tree)."""
+    import os
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--no-lint"]
+        + argv,
+        capture_output=True, text=True, timeout=timeout, env=env)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    if proc.returncode == 0 and lines:
+        return json.loads(lines[-1]), None
+    return None, f"rc={proc.returncode}: {proc.stderr[-300:]}"
+
+
+def _accumulate(result, kinds, env=None):
+    """Run each secondary rung in its own subprocess, merging every
+    emitted key into ``result`` (errors land as ``<rung>_error``)."""
+    for kind in kinds:
+        # s2048's flash-attention bwd compile alone can take ~25min
+        # cold (measured r5); the run itself is seconds
+        extra, err = _sub([kind], 2400 if kind == "--s2048" else 1500,
+                          env=env)
+        if extra is None:
+            key = kind.strip("-").replace("-", "_")
+            result[f"{key}_error"] = err
+        else:
+            result.update(extra)
+    return result
+
+
+def _run_all():
+    """--all manifest mode (ISSUE 19): EVERY accumulated rung in one
+    invocation — per-rung subprocesses merged into a single
+    BENCH_r06-shaped JSON line, so clearing the standing bench debt is
+    one command on a chip. Off-chip the chip-only shapes are skipped
+    and each remaining rung runs its CPU geometry (rung plumbing +
+    parity signal only)."""
+    import os
+
+    import jax
+
+    if jax.default_backend() == "tpu":
+        result = None
+        for (name, *_rest) in LADDER:
+            result, err = _sub(["--config", name], 3000)
+            if result is not None:
+                break
+            print(f"bench: {name} failed ({err})", file=sys.stderr)
+        if result is None:
+            raise SystemExit("bench --all: all ladder configs failed")
+        print(json.dumps(_accumulate(result, SECONDARY_KINDS)))
+        return
+    # CPU manifest: the smoke training rung + every CPU-sized rung;
+    # children get 2 virtual devices so the mp2/ep2 overlap rungs
+    # exercise their collective paths (must land pre-jax-import, hence
+    # via the child environment)
+    result, err = _sub([], 1800)
+    if result is None:
+        result = {"train_error": err}
+    env = dict(os.environ)
+    if "xla_force_host_platform_device_count" not in \
+            env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    print(json.dumps(_accumulate(result, CPU_KINDS, env=env)))
+
+
 def main():
     # tpu_lint preflight (ISSUE 7): never spend chip time on a program
     # the static analyzer already knows is broken. The parent process
@@ -928,11 +1108,10 @@ def main():
     if "--config" in sys.argv:
         _run_one(sys.argv[sys.argv.index("--config") + 1])
         return
-    for kind in ("--decode", "--decode-int8", "--decode-a8w8",
-                 "--decode-bf16-grouped", "--decode-tp",
-                 "--decode-spec", "--decode-int8kv", "--serve",
-                 "--serve-long", "--attn-varlen", "--moe-train",
-                 "--moe-decode", "--bert", "--s2048"):
+    if "--all" in sys.argv:
+        _run_all()
+        return
+    for kind in SECONDARY_KINDS:
         if kind in sys.argv:
             _run_secondary(kind)
             return
@@ -951,21 +1130,6 @@ def main():
         }))
         return
 
-    import os
-    import subprocess
-
-    def _sub(argv, timeout):
-        # children skip the lint preflight: the parent vetted the tree
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--no-lint"]
-            + argv,
-            capture_output=True, text=True, timeout=timeout)
-        lines = [ln for ln in proc.stdout.splitlines()
-                 if ln.startswith("{")]
-        if proc.returncode == 0 and lines:
-            return json.loads(lines[-1]), None
-        return None, f"rc={proc.returncode}: {proc.stderr[-300:]}"
-
     for (name, *_rest) in LADDER:
         result, err = _sub(["--config", name], 3000)
         if result is None:
@@ -973,21 +1137,7 @@ def main():
             continue
         # secondary rungs each get a FRESH process (and a fresh chip —
         # the training rung's buffers die with its process)
-        for kind in ("--s2048", "--decode", "--decode-int8",
-                     "--decode-a8w8", "--decode-bf16-grouped",
-                     "--decode-tp", "--decode-spec",
-                     "--decode-int8kv", "--serve", "--serve-long",
-                     "--attn-varlen", "--moe-train", "--moe-decode",
-                     "--bert"):
-            # s2048's flash-attention bwd compile alone can take ~25min
-            # cold (measured r5); the run itself is seconds
-            extra, err = _sub([kind], 2400 if kind == "--s2048" else 1500)
-            if extra is None:
-                key = kind.strip("-").replace("-", "_")
-                result[f"{key}_error"] = err
-            else:
-                result.update(extra)
-        print(json.dumps(result))
+        print(json.dumps(_accumulate(result, SECONDARY_KINDS)))
         return
     raise SystemExit("bench: all ladder configs failed")
 

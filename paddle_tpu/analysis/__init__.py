@@ -41,6 +41,10 @@ jaxprs):
   partitioned HLO (``S-GATHER``), asymmetric collective sequences
   across branches (``S-MATCH``), missing output sharding constraints
   (``S-UNSPEC``).
+- **Pass 9 — OVERLAP** (:mod:`.overlap`): the comm/compute overlap
+  sites (ring-reduce TP decode, double-buffered EP exchange) keep
+  their exact collective census — phase counts, permute ordering, no
+  stray blocking psum (``S-OVERLAP``).
 
 Front-end: ``tools/tpu_lint.py`` (``--json`` for CI, ``--baseline``
 ratchet); :mod:`.preflight` gates the bench/profiling drivers; the
@@ -76,9 +80,13 @@ from .program_sites import (  # noqa: F401
 )
 from .purity import run_purity_pass  # noqa: F401
 from .sites import KERNEL_SITES, trace_all_sites, trace_site  # noqa: F401
+from .overlap import (  # noqa: F401
+    OVERLAP_SITES, OverlapSite, check_overlap_program,
+    run_overlap_pass,
+)
 from .spmd import (  # noqa: F401
     SPMD_SITES, SpmdSite, check_spmd_site, hlo_collective_counts,
-    mesh_available, run_spmd_pass, virtual_mesh,
+    mesh_available, run_spmd_pass, trace_census, virtual_mesh,
 )
 
 __all__ = [
@@ -96,13 +104,16 @@ __all__ = [
     "trace_program", "trace_all_programs", "estimate_program",
     "peak_live_bytes", "SPMD_SITES", "SpmdSite", "check_spmd_site",
     "hlo_collective_counts", "mesh_available", "virtual_mesh",
-    "waive_from_sources", "PASS_NAMES",
+    "waive_from_sources", "PASS_NAMES", "trace_census",
+    "OVERLAP_SITES", "OverlapSite", "check_overlap_program",
+    "run_overlap_pass",
 ]
 
-#: every pass, in report order: 3 kernel-level + flags (PR 6) and the
-#: 4 program-level passes (PR 7)
+#: every pass, in report order: 3 kernel-level + flags (PR 6), the
+#: 4 program-level passes (PR 7), and the overlap-structure pass
+#: (ISSUE 19)
 PASS_NAMES = ("geometry", "donation", "purity", "flags",
-              "dtype", "sync", "memory", "spmd")
+              "dtype", "sync", "memory", "spmd", "overlap")
 
 
 def _pkg_root() -> str:
@@ -148,6 +159,7 @@ def run_program_passes(generation: Optional[str] = None
         "sync": run_sync_pass(traced=traced),
         "memory": run_memory_pass(generation=generation, traced=traced),
         "spmd": run_spmd_pass(),
+        "overlap": run_overlap_pass(),
     }
 
 

@@ -1,0 +1,133 @@
+"""Pass 9 — OVERLAP: comm/compute overlap structure lint (S-OVERLAP).
+
+The ring-reduce TP decode and the double-buffered EP exchange
+(ISSUE 19) only hide collective latency while their PROGRAM STRUCTURE
+holds: the exact chunked ppermute sequence (P chunks x P-1 steps per
+reduction, interleaved with the chunk GEMMs) and the two half-capacity
+all_to_all pairs. A refactor that collapses the ring back into one
+blocking ``psum`` — or fuses the double buffer back into a single
+exchange — still produces bitwise-correct tokens on CPU, so no parity
+test catches it; only the collective census changes. This pass pins
+that census EXACTLY for every overlap-declared site:
+
+- the traced collective sequence must equal the site's expected
+  sequence (primitive + axes, in order — phase counts and permute
+  ordering included);
+- no blocking collective from the site's ``forbidden`` set may appear
+  anywhere in the trace (a stray ``psum`` inside a ring site is the
+  regression signature).
+
+Sites are skipped (not failed) without the virtual device mesh, same
+as the SPMD pass; waivers use the standard inline syntax at the site
+builder's line.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Tuple
+
+from .base import Finding, waive_from_sources
+from .jaxpr_util import repo_root
+from .spmd import mesh_available, trace_census
+
+__all__ = ["OverlapSite", "OVERLAP_SITES", "check_overlap_program",
+           "run_overlap_pass"]
+
+
+@dataclasses.dataclass
+class OverlapSite:
+    name: str                 # "overlap.tp_decode_ring", ...
+    build: Callable           # () -> (fn, args)
+    expected: Callable        # () -> exact [(prim, axes_str)] census
+    forbidden: tuple = ("psum",)   # blocking collectives banned here
+    path: str = ""
+    line: int = 0
+
+    def __post_init__(self):
+        import os
+
+        code = getattr(self.build, "__code__", None)
+        if code is not None and not self.path:
+            repo = repo_root()
+            fname = code.co_filename
+            self.path = os.path.relpath(fname, repo) \
+                if fname.startswith(repo) else fname
+            self.line = code.co_firstlineno
+
+
+def check_overlap_program(site: OverlapSite) -> List[Finding]:
+    """Trace one overlap site and pin its collective structure."""
+    findings: List[Finding] = []
+    fn, args = site.build()
+    seq = trace_census(fn, *args)
+    expected = list(site.expected())
+
+    stray = sorted({p for p, _ in seq if p in site.forbidden})
+    if stray:
+        findings.append(Finding(
+            rule="S-OVERLAP", site=site.name, path=site.path,
+            line=site.line,
+            message=(f"overlap-declared site `{site.name}` traces "
+                     f"blocking collective(s) {stray} — the pipelined "
+                     "ring/double-buffer structure collapsed back to a "
+                     "serialized reduce (the overlap knob is being "
+                     "bypassed somewhere in the call chain)")))
+    if seq != expected:
+        findings.append(Finding(
+            rule="S-OVERLAP", site=site.name, path=site.path,
+            line=site.line,
+            message=(f"collective census of `{site.name}` is {seq}, "
+                     f"expected exactly {expected} — phase counts / "
+                     "permute ordering drifted, so the comm/compute "
+                     "interleave the overlap mode promises no longer "
+                     "holds")))
+    return findings
+
+
+# ------------------------------------------------------------ repo sites
+
+def _ring_expected() -> List[Tuple[str, str]]:
+    """mp2 ring decode: 2 reductions per layer body (O-proj + FFN2),
+    each P*(P-1)=2 ppermutes at P=2 — the fori_loop body is traced
+    once, so the census carries one layer's sequence."""
+    from ..distributed.tp import ring_census
+
+    return ring_census("mp", 2, reductions=2)
+
+
+def _ep_double_expected() -> List[Tuple[str, str]]:
+    """ep2 double-buffered MoE decode: both half-buffer dispatches,
+    then combine0 / combine1 (the FFNs between them are not
+    collectives), then the replicated-hidden all_gather."""
+    # all_to_all carries its axis as a bare name, all_gather as the
+    # normalized tuple — the census keeps each primitive's raw form
+    a2a = ("all_to_all", "ep")
+    return [a2a] * 4 + [("all_gather", str(("ep",)))]
+
+
+def _sites() -> List[OverlapSite]:
+    from .spmd import (_build_moe_ep_decode_double,
+                       _build_tp_decode_ring)
+
+    return [
+        OverlapSite("overlap.tp_decode_ring", _build_tp_decode_ring,
+                    expected=_ring_expected),
+        OverlapSite("overlap.moe_ep_double",
+                    _build_moe_ep_decode_double,
+                    expected=_ep_double_expected),
+    ]
+
+
+OVERLAP_SITES: List[OverlapSite] = _sites()
+
+
+def run_overlap_pass(sites=None) -> List[Finding]:
+    """S-OVERLAP findings over the overlap-site inventory. Returns []
+    without checking when the virtual device mesh is unavailable
+    (same skip contract as the SPMD pass)."""
+    if not mesh_available():
+        return []
+    findings: List[Finding] = []
+    for site in (OVERLAP_SITES if sites is None else sites):
+        findings += check_overlap_program(site)
+    return waive_from_sources(findings, repo_root())

@@ -40,7 +40,7 @@ from .jaxpr_util import repo_root, sub_jaxprs
 
 __all__ = ["SpmdSite", "SPMD_SITES", "virtual_mesh", "mesh_available",
            "hlo_collective_counts", "check_spmd_site", "run_spmd_pass",
-           "VIRTUAL_MESH_DEVICES"]
+           "VIRTUAL_MESH_DEVICES", "trace_census"]
 
 #: devices the virtual CPU mesh needs (matches tests/conftest.py)
 VIRTUAL_MESH_DEVICES = 8
@@ -118,6 +118,19 @@ def _collective_seq(jaxpr) -> List[Tuple[str, str]]:
         for sj in sub_jaxprs(eqn):
             seq += _collective_seq(sj)
     return seq
+
+
+def trace_census(fn, *args) -> List[Tuple[str, str]]:
+    """The traced collective census of ``fn(*args)``: the ordered
+    (primitive, axes) sequence of every collective in the jaxpr,
+    sub-jaxprs included — a loop body's collectives appear ONCE (the
+    body is traced once), so a fori_loop decode layer contributes its
+    per-layer sequence exactly once. The shared helper behind the
+    census pins in test_tp_serving, test_moe_ep_decode, the dryrun
+    multichip/overlap phases, and the S-OVERLAP lint."""
+    import jax
+
+    return _collective_seq(jax.make_jaxpr(fn)(*args).jaxpr)
 
 
 def _check_branch_symmetry(jaxpr, site, findings):
@@ -412,6 +425,52 @@ def _build_tp_prefill_chunk():
     return fn, (w_tp, x, cache.k, cache.v)
 
 
+def _build_tp_decode_ring():
+    """The mp2 decode step under ``overlap="ring"`` (ISSUE 19): the
+    row-parallel reductions pipeline as chunked ppermute rings, so the
+    partitioned HLO may carry collective-permutes ONLY — an all-reduce
+    here means a site bypassed the overlap knob (a stray blocking
+    psum), a gather means a sharding annotation dropped."""
+    import jax.numpy as jnp
+
+    from ..incubate.nn.fused_transformer import PagedKV
+
+    st, tp, w_tp, cache, tables, cos, sin, lens = _tp_serving_setup()
+    x = jnp.ones((2, st.embed_dim), jnp.float32)
+
+    def fn(w, xb, ck, cv):
+        h, cache2 = st.decode_raw(w, xb, PagedKV(ck, cv), tables,
+                                  lens, cos, sin, tp=tp,
+                                  overlap="ring")
+        return h, cache2.k, cache2.v
+
+    return fn, (w_tp, x, cache.k, cache.v)
+
+
+def _build_moe_ep_decode_double():
+    """The ep2 MoE decode step with the double-buffered exchange
+    (``overlap=True`` via moe_ffn_ep): two half-capacity dispatch/
+    combine all_to_all pairs per MoE layer plus the replicated-hidden
+    all-gather — and nothing else."""
+    fn0, args = _build_moe_ep_decode()
+
+    # moe_ffn_ep resolves FLAGS_ep_overlap at trace time: pin the flag
+    # around every trace of fn so the site is independent of the
+    # process-wide setting
+    from ..core.flags import flag, set_flags
+
+    prev = flag("ep_overlap")
+
+    def fn(*a):
+        set_flags({"ep_overlap": True})
+        try:
+            return fn0(*a)
+        finally:
+            set_flags({"ep_overlap": prev})
+
+    return fn, args
+
+
 SPMD_SITES: List[SpmdSite] = [
     SpmdSite("mp.column_row_linear", _build_mp_linear,
              allowed=frozenset({"all-reduce"}),
@@ -432,6 +491,16 @@ SPMD_SITES: List[SpmdSite] = [
     # expert-parallel MoE decode (ISSUE 15): the per-layer all-to-all
     # dispatch/combine pair + the replicated-hidden all-gather
     SpmdSite("moe.ep_decode", _build_moe_ep_decode,
+             allowed=frozenset({"all-to-all", "all-gather"}),
+             expects_constraint=True),
+    # collective overlap (ISSUE 19): the ring-reduce TP decode carries
+    # collective-permutes ONLY (an all-reduce is a stray blocking
+    # psum); the double-buffered EP exchange keeps the a2a/gather
+    # contract with doubled pair count (checked exactly by S-OVERLAP)
+    SpmdSite("overlap.tp_decode_ring", _build_tp_decode_ring,
+             allowed=frozenset({"collective-permute"}),
+             expects_constraint=True),
+    SpmdSite("overlap.moe_ep_double", _build_moe_ep_decode_double,
              allowed=frozenset({"all-to-all", "all-gather"}),
              expects_constraint=True),
 ]

@@ -235,6 +235,34 @@ define_flag("fleet_dispatch_queue", 4096,
             "yet-admitted requests (every replica's inbox + waiting "
             "list) past this shed new submits with the typed "
             "FleetOverloaded BEFORE any replica admits; 0 = unbounded")
+define_flag("tp_overlap", "psum",
+            "row-parallel TP reduction schedule "
+            "(nn/functional/stream_linear.py reduce_axis= seam, "
+            "distributed/tp.py reduce_over_axis): psum (one blocking "
+            "all-reduce per projection pair — the bitwise/census "
+            "reference) | ring (the partial splits into mp column "
+            "chunks and each chunk all-reduces via mp-1 ppermute "
+            "steps pipelined under the next chunk's GEMM — "
+            "mp*(mp-1) collective-permutes per reduction, none "
+            "blocking the weight stream)")
+define_flag("ep_overlap", False,
+            "double-buffer the MoE expert-parallel exchange "
+            "(nn/functional/grouped_gemm.py moe_ffn_ep): the "
+            "dispatched capacity splits into two half buffers so "
+            "expert compute on buffer 0 overlaps buffer 1's dispatch "
+            "all_to_all and buffer 0's combine overlaps buffer 1's "
+            "compute — census becomes 4 all_to_alls + 1 all_gather "
+            "per MoE layer (off = the serialized "
+            "dispatch/compute/combine triple, the census reference)")
+define_flag("migrate_async", False,
+            "asynchronous KV-page migration on a fleet drain "
+            "(serving/router.py): COMPLETE pages stream to the "
+            "destination in page-granular batches while BOTH "
+            "endpoints keep taking decode steps (append-only pool "
+            "writes never touch a completed page), and only the "
+            "tail pages + slot metadata copy under the step locks "
+            "at re-home; off = the whole export/import runs under "
+            "the locks (the zero-loss reference path)")
 define_flag("lora_delta_backend", "auto",
             "batched multi-LoRA ragged delta-GEMM backend "
             "(nn/functional/lora.py lora_delta): auto (Pallas kernel "

@@ -36,7 +36,9 @@ import dataclasses
 from typing import Any, Optional
 
 __all__ = ["split_kv_heads", "serving_mesh", "TPContext",
-           "shard_map_fn"]
+           "shard_map_fn", "axis_extent", "ring_chunk_reduce",
+           "ring_reduce", "reduce_over_axis", "ring_census",
+           "resolve_overlap"]
 
 
 def shard_map_fn():
@@ -342,3 +344,121 @@ class TPContext:
             out[name] = jax.device_put(
                 a, self.sharding(*self.stack_spec(name)))
         return out
+
+
+# ---------------- collective overlap: ring reduction (ISSUE 19) ----------------
+
+def axis_extent(axis_name) -> int:
+    """Static extent of a named mesh axis at trace time (``psum`` of a
+    Python literal folds to the axis size without emitting a
+    collective — the jax idiom for a shard_map body that must branch
+    on its own parallelism degree)."""
+    import jax
+
+    return int(jax.lax.psum(1, axis_name))
+
+
+def ring_chunk_reduce(chunk, axis_name, size: int):
+    """All-reduce ONE column chunk of a row-parallel partial around the
+    ring: ``size - 1`` ``ppermute`` steps circulate every shard's
+    partial; the shard then re-orders the collected partials into
+    GLOBAL rank order and sums them left-to-right, so every shard
+    produces the bitwise-identical result (a rank-local accumulation
+    order would let replicas drift apart one ulp at a time).
+
+    Each step depends only on THIS chunk's partial, so XLA's async
+    collective-permute scheduler is free to run it under the next
+    chunk's GEMM — the overlap ``stream_linear(overlap="ring")``
+    pipelines for.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if size == 1:
+        return chunk
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    vals = [chunk]
+    recv = chunk
+    for _ in range(size - 1):
+        recv = jax.lax.ppermute(recv, axis_name, perm)
+        vals.append(recv)
+    # vals[t] holds shard (rank - t) % size's partial; re-index so
+    # position j holds shard j's partial, same on every member
+    idx = jax.lax.axis_index(axis_name)
+    stacked = jnp.stack(vals)
+    order = (idx - jnp.arange(size, dtype=idx.dtype)) % size
+    ordered = jnp.take(stacked, order, axis=0)
+    acc = ordered[0]
+    for j in range(1, size):
+        acc = acc + ordered[j]
+    return acc
+
+
+def ring_reduce(part, axis_name, size: Optional[int] = None):
+    """Software-pipelined replacement for ``jax.lax.psum(part, axis)``
+    on a row-parallel partial: the last dim splits into ``size`` column
+    chunks and each chunk all-reduces independently via
+    ``ring_chunk_reduce`` — ``size * (size - 1)`` ``ppermute`` steps
+    total, none of which blocks the others, where the single psum
+    serialized the whole reduction behind the slowest shard."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    if size is None:
+        size = axis_extent(axis_name)
+    if size == 1:
+        return part
+    n = part.shape[-1]
+    bounds = np.linspace(0, n, size + 1).astype(int)
+    chunks = [
+        ring_chunk_reduce(
+            jax.lax.slice_in_dim(part, int(lo), int(hi), axis=-1),
+            axis_name, size)
+        for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo]
+    return jnp.concatenate(chunks, axis=-1) if len(chunks) > 1 \
+        else chunks[0]
+
+
+def reduce_over_axis(part, axis_name, overlap: str = "psum"):
+    """The row-parallel reduction seam with the ``overlap`` knob:
+    ``"psum"`` is the single blocking all-reduce (the bitwise/census
+    reference), ``"ring"`` the chunked ``ppermute`` pipeline. An axis
+    of extent 1 (a single-shard TP view) skips the collective entirely
+    at trace time — the program census must not carry a no-op psum."""
+    import jax
+
+    from ..profiler import stats as _stats
+
+    size = axis_extent(axis_name)
+    if size == 1:
+        return part
+    if overlap == "ring":
+        _stats.counter("dist.overlap_ring_reduces").inc()
+        _stats.gauge("dist.overlap_ring_phases").set(
+            float(size * (size - 1)))
+        return ring_reduce(part, axis_name, size)
+    if overlap != "psum":
+        raise ValueError(
+            f"overlap={overlap!r}: expected 'ring' or 'psum'")
+    return jax.lax.psum(part, axis_name)
+
+
+def ring_census(axis_name, size: int, reductions: int = 1):
+    """The EXACT collective sequence ``reductions`` ring reductions
+    trace to — ``(prim, axes)`` pairs in ``trace_census`` format — for
+    census pins: ``size * (size - 1)`` ppermutes per reduction, zero
+    psums."""
+    step = ("ppermute", str((axis_name,)))
+    return [step] * (size * (size - 1)) * reductions
+
+
+def resolve_overlap(overlap: Optional[str]) -> str:
+    """The effective TP overlap mode: an explicit knob wins, else
+    ``FLAGS_tp_overlap``."""
+    if overlap is not None:
+        return overlap
+    from ..core.flags import flag
+
+    return flag("tp_overlap")

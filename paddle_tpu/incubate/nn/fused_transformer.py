@@ -300,7 +300,8 @@ class FusedMultiTransformer(Layer):
 
     def _layer_body(self, w, h, positions, kv_write, attend, cos_t,
                     sin_t, linear=None, a8w8=False, psum_axis=None,
-                    ep_axis=None, ep_size=1, adapters=None):
+                    ep_axis=None, ep_size=1, adapters=None,
+                    overlap=None):
         """One pre-LN transformer layer over hidden ``h`` (any leading
         dims). Compute dtype FOLLOWS h (bf16 weights + bf16 h → pure
         bf16 MXU dots; LN statistics promote to fp32 internally and are
@@ -344,7 +345,9 @@ class FusedMultiTransformer(Layer):
                     if d is not None:
                         y = y + d
                 if psum_axis is not None and kind in ("out", "ffn2"):
-                    y = jax.lax.psum(y, psum_axis)
+                    from ...distributed.tp import reduce_over_axis
+                    y = reduce_over_axis(y, psum_axis,
+                                         overlap or "psum")
                 return y + w[f"{kind}_bias"]
         hn = self._ln(h, w["ln1_scale"], w["ln1_bias"], eps) \
             .astype(h.dtype)
@@ -407,7 +410,8 @@ class FusedMultiTransformer(Layer):
         return v
 
     def _tp_wrap(self, tp, method: str, weights, x, cache, tables,
-                 rep_args, cos_t, sin_t, a8w8, adapters=None):
+                 rep_args, cos_t, sin_t, a8w8, adapters=None,
+                 overlap=None):
         """shard_map a raw phase over the ``mp`` and/or ``ep`` mesh
         axes: weights enter pre-sharded (TPContext.shard_stack specs —
         column/row slices over ``mp``, the MoE expert bank 1/ep over
@@ -419,8 +423,9 @@ class FusedMultiTransformer(Layer):
         exactly one psum) and ``ep_axis`` set when ep > 1 (each MoE
         layer contributes exactly the all_to_all dispatch/combine pair
         plus the replicated-hidden all_gather)."""
-        from ...distributed.tp import shard_map_fn
+        from ...distributed.tp import resolve_overlap, shard_map_fn
 
+        overlap = resolve_overlap(overlap)
         if cache is None:
             raise ValueError(
                 "tensor-parallel prefill needs a paged cache (the "
@@ -456,7 +461,7 @@ class FusedMultiTransformer(Layer):
 
         def body(w, xb, ck, cv, tbl, cos, sin, *extras):
             kw = dict(a8w8=a8w8, psum_axis=psum_axis, ep_axis=ep_axis,
-                      ep_size=tp.ep)
+                      ep_size=tp.ep, overlap=overlap)
             if adaptered:
                 kw["adapters"] = extras[-1]
                 extras = extras[:-1]
@@ -477,7 +482,7 @@ class FusedMultiTransformer(Layer):
 
     def prefill_raw(self, weights, x, cache, block_tables, cos_t, sin_t,
                     a8w8=False, tp=None, psum_axis=None,
-                    ep_axis=None, ep_size=1):
+                    ep_axis=None, ep_size=1, overlap=None):
         """Prompt pass: x [b, s, d] → (hidden [b, s, d], filled cache).
 
         Causal dense attention (flash-fusable by XLA/Pallas); each
@@ -499,7 +504,8 @@ class FusedMultiTransformer(Layer):
                              "(quantize_weight_only_int8 first)")
         if tp is not None:
             return self._tp_wrap(tp, "prefill_raw", weights, x, cache,
-                                 block_tables, (), cos_t, sin_t, a8w8)
+                                 block_tables, (), cos_t, sin_t, a8w8,
+                                 overlap=overlap)
         b, s, d = x.shape
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
         group = self.num_heads // self.num_kv_heads
@@ -515,7 +521,7 @@ class FusedMultiTransformer(Layer):
                 h, _, _ = self._layer_body(
                     w, h, positions, lambda k, v: (None, None), attend,
                     cos_t, sin_t, a8w8=a8w8, psum_axis=psum_axis,
-                    ep_axis=ep_axis, ep_size=ep_size)
+                    ep_axis=ep_axis, ep_size=ep_size, overlap=overlap)
                 return h, None
 
             h, _ = jax.lax.scan(body, x, weights)
@@ -532,7 +538,7 @@ class FusedMultiTransformer(Layer):
                 w, h, positions,
                 lambda k, v: write_prefill_kv_pages(ck, cv, k, v, tbl),
                 attend, cos_t, sin_t, a8w8=a8w8, psum_axis=psum_axis,
-                ep_axis=ep_axis, ep_size=ep_size)
+                ep_axis=ep_axis, ep_size=ep_size, overlap=overlap)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(
@@ -542,7 +548,7 @@ class FusedMultiTransformer(Layer):
     def prefill_chunk_raw(self, weights, x, cache, block_tables, start,
                           chunk_lens, cos_t, sin_t, a8w8=False,
                           tp=None, psum_axis=None, ep_axis=None,
-                          ep_size=1, adapters=None):
+                          ep_size=1, adapters=None, overlap=None):
         """CHUNKED prompt pass: x [b, c, d] embeds tokens at positions
         ``start[b] .. start[b]+c-1`` of sequences whose earlier tokens
         (previous chunks, or a shared prefix mapped by the prefix
@@ -565,7 +571,8 @@ class FusedMultiTransformer(Layer):
             return self._tp_wrap(tp, "prefill_chunk_raw", weights, x,
                                  cache, block_tables,
                                  (start, chunk_lens), cos_t, sin_t,
-                                 a8w8, adapters=adapters)
+                                 a8w8, adapters=adapters,
+                                 overlap=overlap)
         from ...core.flags import flag
         from ...nn.functional.flash_varlen import paged_prefill_attention
         from ...nn.functional.paged_attention import (
@@ -656,7 +663,7 @@ class FusedMultiTransformer(Layer):
             h, ck, cv = self._layer_body(
                 w, h, positions, kv_write, attend, cos_t, sin_t,
                 a8w8=a8w8, psum_axis=psum_axis, ep_axis=ep_axis,
-                ep_size=ep_size, adapters=ad)
+                ep_size=ep_size, adapters=ad, overlap=overlap)
             return h, ck, cv
 
         h, nk, nv = jax.lax.fori_loop(
@@ -678,7 +685,7 @@ class FusedMultiTransformer(Layer):
     def decode_raw(self, weights, x, cache: PagedKV, block_tables,
                    seq_lens, cos_t, sin_t, a8w8=False, tp=None,
                    psum_axis=None, ep_axis=None, ep_size=1,
-                   adapters=None):
+                   adapters=None, overlap=None):
         """One decode step: x [b, d] token embeddings, seq_lens [b] =
         tokens already cached (the new token's position). Returns
         (hidden [b, d], cache').
@@ -723,7 +730,8 @@ class FusedMultiTransformer(Layer):
         if tp is not None:
             return self._tp_wrap(tp, "decode_raw", weights, x, cache,
                                  block_tables, (seq_lens,), cos_t,
-                                 sin_t, a8w8, adapters=adapters)
+                                 sin_t, a8w8, adapters=adapters,
+                                 overlap=overlap)
         npages = self._pages_per_layer(cache)
         lens1 = (seq_lens + 1).astype(jnp.int32)
         # token-level pool ownership (the stream kernels' mask) is
@@ -863,7 +871,9 @@ class FusedMultiTransformer(Layer):
                 if d is not None:
                     y = y + d
                 if reduce and psum_axis is not None:
-                    y = jax.lax.psum(y, psum_axis)
+                    from ...distributed.tp import reduce_over_axis
+                    y = reduce_over_axis(y, psum_axis,
+                                         overlap or "psum")
                 y = y + small(f"{kind}_bias", l).astype(jnp.float32)
                 if activation is not None:
                     y = _apply_activation(y, activation)
@@ -895,14 +905,17 @@ class FusedMultiTransformer(Layer):
             return h, PagedKV(nk, nv)
 
         if psum_axis is not None:
-            # tensor-parallel shard body: four streamed per-shard
-            # matmuls per layer (QKV / O / FFN1 / FFN2 slices), the two
-            # row-parallel ones reduced over mp INSIDE stream_linear
-            # (reduce_axis psums the f32 partial before the replicated
-            # bias + activation — the collective stays fused with the
-            # projection instead of breaking the decode stream). The
-            # fused grouped tail cannot span a psum, so TP grouping
-            # splits at the two collective points.
+            # tensor-parallel shard body: streamed per-shard matmuls
+            # (QKV / O / FFN1 / FFN2 slices), the two row-parallel ones
+            # reduced over mp INSIDE stream_linear (reduce_axis reduces
+            # the f32 partial before the replicated bias + activation —
+            # the collective stays fused with the projection instead of
+            # breaking the decode stream; ``overlap="ring"`` pipelines
+            # the reduce as chunked ppermute phases under the next
+            # chunk's GEMM). The fused grouped tail cannot span a
+            # collective, so grouped TP runs stream_layer_tail's split
+            # form (reduce_axis=) which breaks at the two reduce seams
+            # while keeping the carried-QKV prefetch structure.
             L = self.num_layers
 
             def small(name, l):
@@ -915,25 +928,75 @@ class FusedMultiTransformer(Layer):
                     scale=weights.get(f"{kind}_scale"),
                     act_quant=a8w8, out_dtype=xx.dtype, **kw)
 
+            def qkv_at(l, hh):
+                hn = self._ln(hh, small("ln1_scale", l),
+                              small("ln1_bias", l),
+                              self.epsilon).astype(hh.dtype)
+                return lin(hn, "qkv", l, bias=weights["qkv_bias"])
+
+            if use_grouped:
+                def tail(att, h, l):
+                    nq = None
+                    if prefetch:
+                        nq = dict(w=weights["qkv_weight"],
+                                  b=weights["qkv_bias"],
+                                  s=weights.get("qkv_scale"),
+                                  ln_s=weights["ln1_scale"],
+                                  ln_b=weights["ln1_bias"],
+                                  layer=jnp.minimum(l + 1, L - 1))
+                    return stream_layer_tail(
+                        att, h, weights["out_weight"],
+                        weights["ffn1_weight"], weights["ffn2_weight"],
+                        layer=l, bo=weights["out_bias"],
+                        b1=weights["ffn1_bias"],
+                        b2=weights["ffn2_bias"],
+                        ln2_scale=weights["ln2_scale"],
+                        ln2_bias=weights["ln2_bias"],
+                        epsilon=self.epsilon,
+                        activation=self.activation,
+                        so=weights.get("out_scale"),
+                        s1=weights.get("ffn1_scale"),
+                        s2=weights.get("ffn2_scale"),
+                        next_qkv=nq, out_dtype=h.dtype,
+                        reduce_axis=psum_axis, overlap=overlap)
+
+                def gbody(l, carry):
+                    h, qkv, ck, cv = carry
+                    q, k, v = split_rope(qkv, h)
+                    att, ck, cv = attend_fn(q, k, v, ck, cv,
+                                            block_tables, l * npages)
+                    att = att.reshape(*h.shape[:-1], d_att) \
+                        .astype(h.dtype)
+                    if prefetch:
+                        h, qkv = tail(att, h, l)
+                    else:
+                        h = tail(att, h, l)
+                        qkv = qkv_at(jnp.minimum(l + 1, L - 1), h)
+                    return h, qkv, ck, cv
+
+                qkv0 = qkv_at(0, x)
+                h, _q, nk, nv = jax.lax.fori_loop(
+                    0, L, gbody, (x, qkv0, cache.k, cache.v))
+                return h, PagedKV(nk, nv)
+
             def body(l, carry):
                 h, ck, cv = carry
-                hn = self._ln(h, small("ln1_scale", l),
-                              small("ln1_bias", l),
-                              self.epsilon).astype(h.dtype)
-                qkv = lin(hn, "qkv", l, bias=weights["qkv_bias"])
+                qkv = qkv_at(l, h)
                 q, k, v = split_rope(qkv, h)
                 att, ck, cv = attend_fn(q, k, v, ck, cv, block_tables,
                                         l * npages)
                 att = att.reshape(*h.shape[:-1], d_att).astype(h.dtype)
                 h = (h + lin(att, "out", l, bias=weights["out_bias"],
-                             reduce_axis=psum_axis)).astype(h.dtype)
+                             reduce_axis=psum_axis, overlap=overlap)) \
+                    .astype(h.dtype)
                 hn = self._ln(h, small("ln2_scale", l),
                               small("ln2_bias", l),
                               self.epsilon).astype(h.dtype)
                 ff = lin(hn, "ffn1", l, bias=weights["ffn1_bias"],
                          activation=self.activation)
                 h = (h + lin(ff, "ffn2", l, bias=weights["ffn2_bias"],
-                             reduce_axis=psum_axis)).astype(h.dtype)
+                             reduce_axis=psum_axis, overlap=overlap)) \
+                    .astype(h.dtype)
                 return h, ck, cv
 
             h, nk, nv = jax.lax.fori_loop(

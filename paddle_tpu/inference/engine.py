@@ -968,6 +968,112 @@ class ContinuousBatchingEngine:
         self._last_tok[i] = int(blob["last_tok"])
         return True
 
+    # ------- async page streaming (decode-concurrent migration) -------
+    #
+    # Decode appends only: a page whose positions all sit below the
+    # slot's current length never mutates again, so COMPLETE pages can
+    # stream to the destination in batches with NO lock on the source
+    # (reads snapshot the functional pool arrays) and only a short
+    # per-batch critical section on the destination (the scatter swaps
+    # its pool arrays). The join copies the mutable tail + metadata
+    # under both step locks — byte-identical tokens preserved because
+    # every streamed page is byte-identical by construction.
+
+    def safe_page_count(self, i: int) -> int:
+        """Pages of slot ``i`` that are complete (every position below
+        the current length) and therefore immutable under further
+        decode steps — the lock-free streamable prefix."""
+        return min(int(self._lens[i]) // self.page_size,
+                   len(self._mgr._owned.get(("slot", i), ())))
+
+    def export_pages(self, i: int, lo: int, hi: int) -> dict:
+        """Gather logical pages ``[lo, hi)`` of decoding slot ``i`` to
+        host memory. Lock-free for complete pages: the pool arrays are
+        functional (decode steps REPLACE them), so a snapshot reference
+        carries byte-identical rows for any already-complete page."""
+        if not self.can_migrate():
+            raise NotImplementedError(
+                "KV-page migration needs a plain pool (no int8 "
+                "cache-KV, no TP kv-head sharding)")
+        pages = list(self._mgr._owned[("slot", i)])[lo:hi]
+        ck, cv = self._ck, self._cv
+        rows = jnp.asarray(self._mgr.phys_rows(pages))
+        return {"lo": lo, "hi": hi,
+                "k": np.asarray(ck[rows]), "v": np.asarray(cv[rows])}
+
+    def import_begin(self, n_pages: int):
+        """Reserve ``n_pages`` for an in-flight migration WITHOUT
+        claiming a decode slot (admission keeps running; the slot is
+        picked at ``import_finish``). Returns an opaque ticket, or
+        None when the pool can't cover the reservation. Call under
+        this engine's step lock."""
+        if not self.can_migrate():
+            raise NotImplementedError(
+                "KV-page migration needs a plain pool (no int8 "
+                "cache-KV, no TP kv-head sharding)")
+        if n_pages > self._mgr.free_pages or n_pages > self._pages_per_seq:
+            return None
+        self._mig_seq = getattr(self, "_mig_seq", 0) + 1
+        key = ("migrate", self._mig_seq)
+        self._mgr.allocate(key, n_pages * self.page_size)
+        return {"key": key, "n_pages": n_pages}
+
+    def import_pages(self, ticket, batch: dict):
+        """Scatter one streamed page batch (an ``export_pages`` blob)
+        into the ticket's reserved pages. Call under this engine's
+        step lock — the scatter swaps the pool arrays and must not
+        race a decode step's own swap."""
+        pages = list(self._mgr._owned[ticket["key"]])
+        rows = jnp.asarray(self._mgr.phys_rows(
+            pages[batch["lo"]:batch["hi"]]))
+        self._ck = self._ck.at[rows].set(
+            jnp.asarray(batch["k"], self._ck.dtype))
+        self._cv = self._cv.at[rows].set(
+            jnp.asarray(batch["v"], self._cv.dtype))
+
+    def export_slot_tail(self, i: int, lo: int) -> dict:
+        """The source's closing export for an async migration: slot
+        metadata plus ONLY the pages from ``lo`` on (the mutable tail
+        the background stream could not safely copy). Call under the
+        source's step lock so ``len``/``last_tok`` and the tail bytes
+        are one consistent snapshot."""
+        req = self._slots[i]
+        if req is None:
+            raise KeyError(f"slot {i} is not decoding")
+        n = len(self._mgr._owned[("slot", i)])
+        tail = self.export_pages(i, lo, n) if lo < n else None
+        return {"req": req, "len": int(self._lens[i]),
+                "last_tok": int(self._last_tok[i]),
+                "n_pages": n, "tail": tail}
+
+    def import_finish(self, ticket, i: int, blob: dict) -> bool:
+        """Join: adopt the reserved pages as free slot ``i`` and
+        re-home the request with its final metadata (``blob`` from
+        ``export_slot_tail`` — page range covers only the
+        not-yet-streamed tail). The reservation grows to cover pages
+        allocated on the source AFTER it was taken (decode kept
+        running there). False when the slot was taken or the pool
+        can't cover the growth — the caller aborts and falls back."""
+        n = int(blob["n_pages"])
+        if not self._slot_free(i):
+            return False
+        have = len(self._mgr._owned[ticket["key"]])
+        if n > have and (n - have) > self._mgr.free_pages:
+            return False
+        if n > have:
+            self._mgr.grow(ticket["key"], n - have)
+        self._mgr.rekey(ticket["key"], ("slot", i))
+        if blob.get("tail") is not None:
+            self.import_pages({"key": ("slot", i)}, blob["tail"])
+        self._slots[i] = blob["req"]
+        self._lens[i] = int(blob["len"])
+        self._last_tok[i] = int(blob["last_tok"])
+        return True
+
+    def import_abort(self, ticket):
+        """Release an unfinished migration reservation."""
+        self._mgr.free(ticket["key"])
+
     # ---------------- internals ----------------
 
     def _release(self, i: int):

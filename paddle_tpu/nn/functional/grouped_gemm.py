@@ -582,7 +582,7 @@ def moe_ffn_nodrop(x, gate_w, w1, b1, w2, b2, *, top_k: int,
 # ---------------------------------------------------------------------
 
 def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, *, top_k: int, axis: str,
-               ep: int, activation="gelu"):
+               ep: int, activation="gelu", overlap=None):
     """Expert-parallel MoE FFN for the serving mesh — call INSIDE a
     ``shard_map`` body whose mesh carries the ``axis`` (ep) axis.
 
@@ -604,6 +604,15 @@ def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, *, top_k: int, axis: str,
 
     ``w1 [E/ep, d, dff]`` etc. are this shard's expert slice (sharded
     by ``TPContext.shard_stack``). Returns ``y [T, d]`` replicated.
+
+    ``overlap`` (default: ``FLAGS_ep_overlap``): double-buffer the
+    exchange — the capacity dim splits into two half buffers, BOTH
+    dispatch all_to_alls issue before the first expert FFN so buffer
+    1's exchange rides under buffer 0's compute, and buffer 0's
+    combine issues before buffer 1's FFN. Math-exact (per-slot-row
+    GEMMs are independent, halves concatenate back along capacity);
+    the census becomes EXACTLY (all_to_all x4, all_gather). Falls
+    back to the single-buffer form when the capacity is odd.
     """
     T, d = x.shape
     e_loc = w1.shape[0]
@@ -624,25 +633,51 @@ def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, *, top_k: int, axis: str,
     x_rows = jnp.take(x_loc, order // top_k, axis=0)
     buf = jnp.zeros((E * c, d), x.dtype).at[slot].set(x_rows) \
         .reshape(E, c, d)
-    # dispatch: rows for MY experts from every shard, capacities
-    # concatenated (the exchange is an all-to-all, not a reduce)
-    recv = jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=1,
-                              tiled=True)                # [E/ep, ep*c, d]
-    # tpu-lint: ok(X-PROMOTE) -- fp32 expert-GEMM accumulation matches
-    # the grouped kernel's accumulator
-    h1 = jax.lax.dot_general(
-        recv, w1.astype(recv.dtype), (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)
-    h1 = _apply_activation(h1 + b1.reshape(e_loc, 1, -1)
-                           .astype(jnp.float32), activation) \
-        .astype(x.dtype)
-    out = jax.lax.dot_general(
-        h1, w2.astype(h1.dtype), (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)
-    out = out + b2.reshape(e_loc, 1, -1).astype(jnp.float32)
-    # combine: reverse exchange back to the token owners
-    back = jax.lax.all_to_all(out.astype(jnp.float32), axis,
-                              split_axis=1, concat_axis=0, tiled=True)
+    if overlap is None:
+        from ...core.flags import flag
+        overlap = bool(flag("ep_overlap"))
+
+    def dispatch(bh):
+        # rows for MY experts from every shard, capacities
+        # concatenated (the exchange is an all-to-all, not a reduce)
+        return jax.lax.all_to_all(bh, axis, split_axis=0,
+                                  concat_axis=1, tiled=True)
+
+    def expert_ffn(recv):
+        # tpu-lint: ok(X-PROMOTE) -- fp32 expert-GEMM accumulation
+        # matches the grouped kernel's accumulator
+        h1 = jax.lax.dot_general(
+            recv, w1.astype(recv.dtype), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        h1 = _apply_activation(h1 + b1.reshape(e_loc, 1, -1)
+                               .astype(jnp.float32), activation) \
+            .astype(x.dtype)
+        out = jax.lax.dot_general(
+            h1, w2.astype(h1.dtype), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        return out + b2.reshape(e_loc, 1, -1).astype(jnp.float32)
+
+    def combine(out):
+        # reverse exchange back to the token owners
+        return jax.lax.all_to_all(out.astype(jnp.float32), axis,
+                                  split_axis=1, concat_axis=0,
+                                  tiled=True)
+
+    if overlap and c % 2 == 0 and c >= 2:
+        from ...profiler import stats as _ep_stats
+        _ep_stats.counter("dist.overlap_ep_double_buffer").inc()
+        half = c // 2
+        # BOTH dispatches issue before the first FFN (buffer 1's
+        # exchange rides under buffer 0's compute), and buffer 0's
+        # combine issues before buffer 1's FFN — XLA's async collective
+        # scheduler overlaps the dataflow-independent pairs
+        r0 = dispatch(buf[:, :half])
+        r1 = dispatch(buf[:, half:])
+        back0 = combine(expert_ffn(r0))
+        back1 = combine(expert_ffn(r1))
+        back = jnp.concatenate([back0, back1], axis=1)
+    else:
+        back = combine(expert_ffn(dispatch(buf)))
     y_rows = jnp.take(back.reshape(E * c, d), slot, axis=0)
     y_flat = jnp.zeros((tl * top_k, d), jnp.float32) \
         .at[order].set(y_rows)

@@ -246,9 +246,49 @@ def _stream_linear_act_quant(x, w, layer, bias, scale, activation,
     return _apply_activation(out, activation).astype(out_dtype)
 
 
+def _ring_reduce_pipeline(x, w, layer, scale, act_quant, axis, size):
+    """The ring-overlap form of the row-parallel reduction (ISSUE 19):
+    the output columns split into ``size`` chunks, each chunk's GEMM
+    is a SEPARATE streamed call over its weight-column slice, and
+    chunk i's ``size - 1`` ppermute ring steps are emitted after chunk
+    i+1's GEMM — the permutes depend only on their own chunk's
+    partial, so the reduction of chunk i rides under the weight
+    stream of chunk i+1 instead of waiting for the full partial.
+    Returns the reduced f32 [M, N] (bias/activation stay with the
+    caller, AFTER the reduction, same as the psum form)."""
+    import numpy as np
+
+    from ...distributed.tp import ring_chunk_reduce
+
+    N = w.shape[-1]
+    bounds = [int(b) for b in np.linspace(0, N, size + 1).astype(int)]
+    spans = [(lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:])
+             if hi > lo]
+
+    def gemm(lo, hi):
+        return stream_linear(
+            x, jax.lax.slice_in_dim(w, lo, hi, axis=-1), layer=layer,
+            bias=None,
+            scale=None if scale is None
+            else jax.lax.slice_in_dim(scale, lo, hi, axis=-1),
+            activation=None, out_dtype=jnp.float32,
+            act_quant=act_quant)
+
+    parts: list = []
+    reduced: list = [None] * len(spans)
+    for j, (lo, hi) in enumerate(spans):
+        parts.append(gemm(lo, hi))
+        if j >= 1:
+            # ring phase for chunk j-1 under chunk j's GEMM stream
+            reduced[j - 1] = ring_chunk_reduce(parts[j - 1], axis, size)
+    reduced[-1] = ring_chunk_reduce(parts[-1], axis, size)
+    return jnp.concatenate(reduced, axis=-1) if len(reduced) > 1 \
+        else reduced[0]
+
+
 def stream_linear(x, w, layer=None, bias=None, scale=None,
                   activation=None, out_dtype=None, act_quant=False,
-                  reduce_axis=None):
+                  reduce_axis=None, overlap=None):
     """x [M, K] @ w[(L,) K, N] (+ bias) with streamed weights.
 
     layer: traced int32 index when w/bias/scale are layer-stacked.
@@ -259,10 +299,16 @@ def stream_linear(x, w, layer=None, bias=None, scale=None,
     requires int8 ``w`` with per-output-channel ``scale``.
     reduce_axis: ROW-PARALLEL tensor-parallel form (inside shard_map):
     ``w`` is this shard's [K/mp, N] slice — the f32 partial product is
-    ``psum``'d over the named mesh axis BEFORE the (replicated) bias
-    add and activation, so the collective stays fused with the
-    projection call (per-output-channel int8 dequant scales commute
-    with the sum and stay per-shard, inside the streamed kernel).
+    reduced over the named mesh axis BEFORE the (replicated) bias add
+    and activation, so the collective stays fused with the projection
+    call (per-output-channel int8 dequant scales commute with the sum
+    and stay per-shard, inside the streamed kernel). An axis of extent
+    1 skips the collective at trace time.
+    overlap: the reduction schedule when ``reduce_axis`` is set —
+    ``"psum"`` (one blocking all-reduce, the bitwise/census reference)
+    | ``"ring"`` (mp column chunks, each GEMM'd in its own streamed
+    call and ring-reduced via ppermute under the next chunk's weight
+    stream) | None (``FLAGS_tp_overlap``).
     Returns [M, N] in out_dtype (default: x.dtype).
     """
     from jax.experimental import pallas as pl
@@ -273,10 +319,33 @@ def stream_linear(x, w, layer=None, bias=None, scale=None,
     N = w.shape[-1]
     out_dtype = out_dtype or x.dtype
     if reduce_axis is not None:
-        part = stream_linear(x, w, layer=layer, bias=None, scale=scale,
-                             activation=None, out_dtype=jnp.float32,
-                             act_quant=act_quant)
-        out = jax.lax.psum(part, reduce_axis)
+        from ...distributed.tp import (axis_extent, resolve_overlap)
+        from ...profiler import stats as _rstats
+
+        mode = resolve_overlap(overlap)
+        size = axis_extent(reduce_axis)
+        if size == 1:
+            # single-shard TP view: the collective would be a no-op —
+            # skip it at trace time (the census must stay empty)
+            out = stream_linear(x, w, layer=layer, bias=None,
+                                scale=scale, activation=None,
+                                out_dtype=jnp.float32,
+                                act_quant=act_quant)
+        elif mode == "ring":
+            _rstats.counter("dist.overlap_ring_reduces").inc()
+            _rstats.gauge("dist.overlap_ring_phases").set(
+                float(size * (size - 1)))
+            out = _ring_reduce_pipeline(x, w, layer, scale, act_quant,
+                                        reduce_axis, size)
+        elif mode == "psum":
+            part = stream_linear(x, w, layer=layer, bias=None,
+                                 scale=scale, activation=None,
+                                 out_dtype=jnp.float32,
+                                 act_quant=act_quant)
+            out = jax.lax.psum(part, reduce_axis)
+        else:
+            raise ValueError(
+                f"stream_linear: overlap={mode!r} is not 'ring'|'psum'")
         if bias is not None:
             b = bias[0 if layer is None else layer] if stacked else bias
             out = out + b.astype(jnp.float32)
@@ -640,10 +709,53 @@ def _tail_fallback(att, h, wo, w1, w2, layer, so, s1, s2, bo, b1, b2,
     return h_out.astype(out_dtype), qkv.astype(out_dtype)
 
 
+def _tail_tp_split(att, h, wo, w1, w2, layer, so, s1, s2, bo, b1, b2,
+                   ln2_scale, ln2_bias, eps, activation, next_qkv,
+                   out_dtype, stacked, reduce_axis, overlap):
+    """Tensor-parallel grouped tail (ISSUE 19): the fused Pallas grid
+    cannot span a collective, so under a ``reduce_axis`` the tail
+    SPLITS at the two reduction points into streamed calls — O-proj
+    partial reduced (ring phases riding under the FFN1 weight stream
+    that follows), FFN1, FFN2 partial reduced, and the cross-layer
+    QKV prefetch emitted AFTER the FFN2 reduction so its weight DMA
+    overlaps the trailing ring phases. Op-for-op the ungrouped TP
+    decode math (stream_linear reduce_axis= calls), so grouped-TP
+    greedy tokens reproduce the four-call form's exactly."""
+    l = (0 if layer is None else layer) if stacked else None
+
+    def at(a):
+        return a[l] if (stacked and a is not None) else a
+
+    h2 = (h + stream_linear(
+        att, wo, layer=layer, bias=bo, scale=so, out_dtype=h.dtype,
+        reduce_axis=reduce_axis, overlap=overlap)).astype(h.dtype)
+    hn = _ln_f32(h2, at(ln2_scale), at(ln2_bias), eps).astype(h.dtype)
+    ff = stream_linear(hn, w1, layer=layer, bias=b1, scale=s1,
+                       activation=activation, out_dtype=h.dtype)
+    h_out = (h2 + stream_linear(
+        ff, w2, layer=layer, bias=b2, scale=s2, out_dtype=h.dtype,
+        reduce_axis=reduce_axis, overlap=overlap)).astype(h.dtype)
+    if next_qkv is None:
+        return h_out.astype(out_dtype)
+    lq = next_qkv.get("layer")
+    lq = (0 if lq is None else lq) if stacked else None
+
+    def atq(a):
+        return a[lq] if (stacked and a is not None) else a
+
+    hn1 = _ln_f32(h_out, atq(next_qkv["ln_s"]), atq(next_qkv["ln_b"]),
+                  eps).astype(h.dtype)
+    qkv = stream_linear(hn1, next_qkv["w"], layer=next_qkv.get("layer"),
+                        bias=next_qkv["b"], scale=next_qkv.get("s"),
+                        out_dtype=h.dtype)
+    return h_out.astype(out_dtype), qkv.astype(out_dtype)
+
+
 def stream_layer_tail(att, h, wo, w1, w2, layer=None, *, bo, b1, b2,
                       ln2_scale, ln2_bias, epsilon, activation=None,
                       so=None, s1=None, s2=None, next_qkv=None,
-                      out_dtype=None, interpret=None):
+                      out_dtype=None, interpret=None,
+                      reduce_axis=None, overlap=None):
     """GROUPED streamed layer tail: everything after attention in one
     call — ``h2 = h + att @ Wo + bo; h_out = h2 + FFN(LN2(h2))`` — and,
     when ``next_qkv`` is given, the CROSS-LAYER PREFETCH phase
@@ -662,6 +774,15 @@ def stream_layer_tail(att, h, wo, w1, w2, layer=None, *, bo, b1, b2,
     ``out_dtype`` (default: h.dtype). Off-TPU / ragged shapes take an
     XLA fallback with op-for-op ungrouped math; ``interpret=True``
     forces the Pallas kernel in interpret mode (the parity tests).
+
+    ``reduce_axis``/``overlap``: the tensor-parallel grouped tail —
+    ``wo``/``w2`` are row-parallel [K/mp, N] shards whose f32 partials
+    reduce over the named axis (``overlap="ring"`` pipelines the
+    reduction as ppermute chunks under the following weight stream,
+    ``"psum"`` is the blocking reference, None reads
+    ``FLAGS_tp_overlap``); a collective cannot live inside the fused
+    Pallas grid, so this form splits into streamed calls at the two
+    reduction points (``_tail_tp_split``).
     """
     out_dtype = out_dtype or h.dtype
     stacked = wo.ndim == 3
@@ -675,6 +796,11 @@ def stream_layer_tail(att, h, wo, w1, w2, layer=None, *, bo, b1, b2,
             not all(s is not None for s in scales):
         raise ValueError("stream_layer_tail: pass all of so/s1/s2 or "
                          "none (the engine quantizes all four stacks)")
+    if reduce_axis is not None:
+        return _tail_tp_split(
+            att, h, wo, w1, w2, layer, so, s1, s2, bo, b1, b2,
+            ln2_scale, ln2_bias, epsilon, activation, next_qkv,
+            out_dtype, stacked, reduce_axis, overlap)
     Ka = att.shape[1]
     d = h.shape[1]
     dff = w1.shape[-1]

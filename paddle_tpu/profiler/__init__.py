@@ -3,6 +3,7 @@ from .alerts import AlertEngine, Rule, default_rules  # noqa: F401
 from .profiler import (  # noqa: F401
     Profiler, ProfilerState, ProfilerTarget, RecordEvent, dump_rank,
     export_chrome_tracing, load_profiler_result, make_scheduler,
+    start_span_capture, stop_span_capture,
 )
 from .timer import Benchmark, benchmark  # noqa: F401
 from .timeseries import TimeSeriesSampler  # noqa: F401
@@ -11,4 +12,5 @@ __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing",
            "load_profiler_result", "Benchmark", "benchmark", "stats",
            "roofline", "memory", "dump_rank", "timeseries", "alerts",
-           "TimeSeriesSampler", "AlertEngine", "Rule", "default_rules"]
+           "TimeSeriesSampler", "AlertEngine", "Rule", "default_rules",
+           "start_span_capture", "stop_span_capture"]

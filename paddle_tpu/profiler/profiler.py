@@ -27,7 +27,8 @@ from typing import Callable, List, Optional
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing",
-           "load_profiler_result", "dump_rank"]
+           "load_profiler_result", "dump_rank",
+           "start_span_capture", "stop_span_capture"]
 
 
 def _process_index() -> int:
@@ -66,6 +67,36 @@ class _SpanStore(threading.local):
 
 _SPANS = _SpanStore()
 
+# Cross-thread span sinks: ``_SPANS`` is thread-local by design (the
+# Profiler lifecycle owns the calling thread's spans), which silently
+# drops RecordEvent spans emitted from BACKGROUND threads — the async
+# migration streamer, replica step threads. ``start_span_capture``
+# registers a process-wide sink every thread's ``RecordEvent.end``
+# appends into, so a trace test (or a fleet timeline) can observe
+# concurrent spans from all threads with wall-clock-comparable ``ts``.
+_SINK_LOCK = threading.Lock()
+_SINKS: List[List[dict]] = []
+
+
+def start_span_capture() -> List[dict]:
+    """Begin capturing RecordEvent spans from ALL threads into the
+    returned list (chrome-trace "X" dicts, appended live). Sinks stack:
+    each capture sees every span ended while it is registered."""
+    sink: List[dict] = []
+    with _SINK_LOCK:
+        _SINKS.append(sink)
+    return sink
+
+
+def stop_span_capture(sink: List[dict]) -> List[dict]:
+    """Unregister a ``start_span_capture`` sink and return it."""
+    with _SINK_LOCK:
+        try:
+            _SINKS.remove(sink)
+        except ValueError:
+            pass
+    return sink
+
 
 class RecordEvent:
     """Host span (reference RecordEvent, event_tracing.h): context
@@ -79,15 +110,21 @@ class RecordEvent:
         self._t0 = time.perf_counter_ns()
 
     def end(self):
-        if self._t0 is None or not _SPANS.enabled:
+        if self._t0 is None or not (_SPANS.enabled or _SINKS):
             return
         t1 = time.perf_counter_ns()
-        _SPANS.events.append({
+        ev = {
             "name": self.name, "ph": "X", "pid": os.getpid(),
             "tid": threading.get_ident() % 2 ** 31,
             "ts": self._t0 / 1e3, "dur": (t1 - self._t0) / 1e3,
             "cat": "host",
-        })
+        }
+        if _SPANS.enabled:
+            _SPANS.events.append(ev)
+        if _SINKS:
+            with _SINK_LOCK:
+                for s in _SINKS:
+                    s.append(ev)
 
     def __enter__(self):
         self.begin()

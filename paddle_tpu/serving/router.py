@@ -205,7 +205,9 @@ class Replica:
                     fi = self.router.faults
                     if fi is not None:
                         fi.fire("replica.step", rid=self.idx)
-                    self.eng.step()
+                    from ..profiler import RecordEvent
+                    with RecordEvent("fleet.replica.step"):
+                        self.eng.step()
                     did = True
         except BaseException as e:
             # the scheduler step is already crash-isolated per
@@ -554,6 +556,12 @@ class FleetRouter:
             if rep.crashed is not None:
                 self._mark_dead(rep, f"crashed: {rep.crashed!r}")
                 continue
+            if rep.state in ("draining", "drained"):
+                # a drain is a deliberate exit from service, not a
+                # silent failure: the draining thread is busy streaming
+                # pages (it still beats between decode steps on the
+                # async path) and a drained one stops beating forever
+                continue
             if hb <= 0 or not self.enforce_beats:
                 continue
             missed = (now - rep.last_beat) / hb
@@ -698,6 +706,7 @@ class FleetRouter:
 
     def _drain_now(self, rep: Replica) -> None:
         eng = rep.eng
+        use_async = bool(_flag("migrate_async")) and eng.can_migrate()
         with rep.step_lock:
             with eng._inbox_lock:
                 queued, eng._inbox = eng._inbox, []
@@ -713,17 +722,23 @@ class FleetRouter:
                         [req.prompt,
                          np.asarray(req.generated, np.int32)])
                 self._redispatch_from(rep, req)
-            for i in range(eng.max_batch):
-                if eng._slots[i] is None:
-                    continue
-                req = eng._slots[i]
-                if not self._migrate_slot(rep, i):
-                    # no peer could take the pages — recompute resume
-                    req._resume_tokens = np.concatenate(
-                        [req.prompt,
-                         np.asarray(req.generated, np.int32)])
-                    eng._release(i)
-                    self._redispatch_from(rep, req)
+        if use_async:
+            # decode-concurrent streaming: NO step lock held across
+            # the per-slot page streams (both endpoints keep decoding)
+            self._drain_async(rep)
+        with rep.step_lock:
+            if not use_async:
+                for i in range(eng.max_batch):
+                    if eng._slots[i] is None:
+                        continue
+                    req = eng._slots[i]
+                    if not self._migrate_slot(rep, i):
+                        # no peer took the pages — recompute resume
+                        req._resume_tokens = np.concatenate(
+                            [req.prompt,
+                             np.asarray(req.generated, np.int32)])
+                        eng._release(i)
+                        self._redispatch_from(rep, req)
             if eng.prefix_cache is not None:
                 # the replica leaves service: hand its pages back so
                 # the drain's page accounting closes exactly
@@ -784,6 +799,124 @@ class FleetRouter:
                            "n_generated": len(req.generated)})
             return True
         return False
+
+    #: page batch size of one async-migration stream step — small
+    #: enough that the destination's per-batch scatter critical
+    #: section stays shorter than a decode step
+    ASYNC_MIGRATE_BATCH_PAGES = 2
+
+    def _drain_async(self, rep: Replica) -> None:
+        """Decode-concurrent drain (``FLAGS_migrate_async``): each
+        occupied slot's COMPLETE pages stream to a peer in page
+        batches with no step lock held on the source — the source
+        keeps taking decode steps between batches (driven right here:
+        the drain owns the replica's thread) and the destinations
+        keep serving on their own threads. The join copies only the
+        mutable tail + metadata under both step locks, so zero-loss
+        and byte-identical continuation are preserved: a complete
+        page never mutates under append-only decode."""
+        eng = rep.eng
+        for i in range(eng.max_batch):
+            req = eng._slots[i]
+            if req is None:
+                continue
+            if not self._migrate_slot_async(rep, i):
+                with rep.step_lock:
+                    if eng._slots[i] is not req:
+                        continue      # finished while we tried
+                    req._resume_tokens = np.concatenate(
+                        [req.prompt,
+                         np.asarray(req.generated, np.int32)])
+                    eng._release(i)
+                self._redispatch_from(rep, req)
+
+    def _migrate_slot_async(self, src: Replica, i: int) -> bool:
+        """Stream decode slot ``i`` to a peer while BOTH endpoints
+        keep decoding: reserve pages on the destination (short lock),
+        copy complete pages batch-by-batch (source lock-free, one
+        short destination lock per batch, a decode step on the source
+        between batches), then join — tail pages + slot metadata —
+        under both step locks. True when the slot landed on a peer OR
+        finished on the source mid-stream; False sends the caller to
+        the recompute fallback."""
+        from ..profiler import RecordEvent
+
+        eng = src.eng
+        if not eng.can_migrate():
+            return False
+        req = eng._slots[i]
+        if req is None:
+            return True
+        tm0 = _faults.now()
+        n0 = len(eng._mgr._owned.get(("slot", i), ()))
+        dest = ticket = None
+        for cand in self._dispatchable(exclude={src.idx}):
+            if not cand.eng.can_migrate():
+                continue
+            with cand.step_lock:
+                t = cand.eng.import_begin(n0)
+            if t is not None:
+                dest, ticket = cand, t
+                break
+        if dest is None:
+            return False
+        streamed = 0
+        with RecordEvent("fleet.migrate.stream"):
+            while True:
+                if eng._slots[i] is not req:
+                    # finished on the source mid-stream: nothing left
+                    # to move — the reservation dies, the request
+                    # already completed where it was
+                    with dest.step_lock:
+                        dest.eng.import_abort(ticket)
+                    return True
+                safe = min(eng.safe_page_count(i), ticket["n_pages"])
+                if streamed >= safe:
+                    break
+                hi = min(streamed + self.ASYNC_MIGRATE_BATCH_PAGES,
+                         safe)
+                try:
+                    batch = eng.export_pages(i, streamed, hi)
+                except KeyError:
+                    continue   # slot released between check and read
+                with dest.step_lock:
+                    dest.eng.import_pages(ticket, batch)
+                streamed = hi
+                # the source's decode batch keeps moving between
+                # stream batches (the drain owns this thread)
+                src.step_once()
+        first, second = (src, dest) if src.idx < dest.idx \
+            else (dest, src)
+        with first.step_lock, second.step_lock:
+            if eng._slots[i] is not req:
+                dest.eng.import_abort(ticket)
+                return True
+            blob = eng.export_slot_tail(i, streamed)
+            j = next((j for j in range(dest.eng.max_batch)
+                      if dest.eng._slot_free(j)), None)
+            if j is None or not dest.eng.import_finish(ticket, j,
+                                                       blob):
+                dest.eng.import_abort(ticket)
+                return False
+            req.n_migrations = getattr(req, "n_migrations", 0) + 1
+            eng._release(i)   # src ledger closes its page integral
+            n_pages = blob["n_pages"]
+        _stats.inc("fleet.migrations")
+        _stats.inc("fleet.async_migrations")
+        _stats.inc("fleet.migrated_pages", n_pages)
+        mig_ms = (_faults.now() - tm0) * 1e3
+        ud = dest.eng.usage
+        if ud is not None:
+            ud.set_pages(req, n_pages)
+            ud.charge_phase("migration", mig_ms, (req,))
+        _stats.observe("serve.step.migration_ms", mig_ms)
+        jr = dest.eng.journal
+        if jr is not None:
+            jr.record("migrate", req.id, j,
+                      {"from": src.idx, "to": dest.idx,
+                       "pages": n_pages, "async": True,
+                       "n_generated": len(req.generated)})
+        return True
 
     # ---------------- driving ----------------
 

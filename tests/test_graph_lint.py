@@ -63,7 +63,8 @@ class TestRepoProgramsClean:
         t0 = time.time()
         results = analysis.run_program_passes()
         elapsed = time.time() - t0
-        assert set(results) == {"dtype", "sync", "memory", "spmd"}
+        assert set(results) == {"dtype", "sync", "memory", "spmd",
+                                "overlap"}
         for name, findings in results.items():
             live = analysis.unwaivered(findings)
             assert not live, (

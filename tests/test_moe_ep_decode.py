@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
-from paddle_tpu.analysis.spmd import _collective_seq
+from paddle_tpu.analysis import trace_census
 from paddle_tpu.distributed.tp import TPContext
 from paddle_tpu.incubate.nn.fused_transformer import (
     FusedMultiTransformer, PagedKV, rope_table)
@@ -129,9 +129,9 @@ class TestEPDecode:
                                   cos, sin, tp=tp)
             return h, c2.k, c2.v
 
-        seq = _collective_seq(jax.make_jaxpr(decode_fn)(
-            w_tp, jnp.ones((2, D), jnp.float32), cache.k,
-            cache.v).jaxpr)
+        seq = trace_census(decode_fn, w_tp,
+                           jnp.ones((2, D), jnp.float32), cache.k,
+                           cache.v)
         assert [p for p, _ in seq] == \
             ["all_to_all", "all_to_all", "all_gather"], seq
         assert all(tp.ep_axis in ax for _, ax in seq)

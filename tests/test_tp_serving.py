@@ -182,9 +182,9 @@ class TestCollectiveCount:
     total: O-proj + FFN2) and no other collective primitive."""
 
     def _seq(self, fn, *args):
-        from paddle_tpu.analysis.spmd import _collective_seq
+        from paddle_tpu.analysis import trace_census
 
-        return _collective_seq(jax.make_jaxpr(fn)(*args).jaxpr)
+        return trace_census(fn, *args)
 
     def test_decode_psums_per_layer(self, virtual_devices):
         st = _stack()

@@ -63,11 +63,11 @@ class TestRepoIsClean:
         t0 = time.time()
         results = analysis.run_all_passes()
         elapsed = time.time() - t0
-        # all seven passes + flags: 3 kernel-level (PR 6) + 4
-        # program-level (PR 7)
+        # 3 kernel-level (PR 6) + flags + 5 program-level (PR 7 +
+        # the ISSUE 19 overlap-census pass)
         assert set(results) == set(analysis.PASS_NAMES) == {
             "geometry", "donation", "purity", "flags",
-            "dtype", "sync", "memory", "spmd"}
+            "dtype", "sync", "memory", "spmd", "overlap"}
         for name, findings in results.items():
             live = analysis.unwaivered(findings)
             assert not live, (

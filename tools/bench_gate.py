@@ -195,6 +195,20 @@ DEFAULT_METRICS: Dict[str, str] = {
     # an accounting leak however small (strict-compared like lint)
     "serve_tenant_max_share": "up",
     "usage_unattributed_ms": "up",
+    # collective-overlap rungs (ISSUE 19): the ring-overlapped mp2
+    # decode and the double-buffered ep2 MoE decode regress DOWN like
+    # their blocking-psum siblings (overlap that stops paying shows
+    # here first); migration-concurrent drain: decode tokens delivered
+    # DURING the drain window regress DOWN (the overlap eroding back
+    # toward stop-the-world), per-step join stall UP, and lost
+    # requests UP with NO noise floor — a single request dropped by an
+    # async migration is a broken re-home, not jitter
+    "decode_tp2_overlap_tokens_per_sec": "down",
+    "decode_tp2_overlap_pct_of_hbm_roofline": "down",
+    "moe_decode_ep2_overlap_tokens_per_sec": "down",
+    "fleet_async_migration_decode_tokens": "down",
+    "fleet_async_migration_stall_ms": "up",
+    "fleet_async_migration_lost": "up",
 }
 
 #: absolute-change floors so tiny counts/latencies don't trip the
@@ -257,12 +271,14 @@ def _metric_value(block: dict, name: str) -> Optional[float]:
 def _regressed(name: str, direction: str, prev: float, cur: float,
                tol: float) -> bool:
     if name.startswith(("lint", "alert", "usage")) \
-            or name == "moe.dropped_tokens":
-        # lint findings, alert fires, unattributed device time, and
-        # no-drop-mode dropped tokens must only go down between
-        # rounds — ANY growth regresses, no noise floor (a single new
-        # finding / alert / unattributed ms / dropped token is a real
-        # defect, not measurement jitter)
+            or name in ("moe.dropped_tokens",
+                        "fleet_async_migration_lost"):
+        # lint findings, alert fires, unattributed device time,
+        # no-drop-mode dropped tokens, and requests lost across an
+        # async migration must only go down between rounds — ANY
+        # growth regresses, no noise floor (a single new finding /
+        # alert / unattributed ms / dropped token / lost request is a
+        # real defect, not measurement jitter)
         return cur > prev if direction == "up" else cur < prev
     floor = _ABS_FLOOR_US if name.endswith("_us") else _ABS_FLOOR_COUNT
     if direction == "up":

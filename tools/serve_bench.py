@@ -46,6 +46,16 @@ admitted requests lost, survivor greedy-token parity vs the
 undisturbed run, and bounded goodput loss (``fleet_chaos_*`` keys,
 nonzero exit on a failed pin).
 
+``--fleet --drain-async`` (ISSUE 19) gracefully drains replica 0
+MID-LOAD with ``FLAGS_migrate_async`` on: each occupied decode slot
+streams its complete KV pages to a peer in page batches while both
+endpoints keep decoding, and only the mutable tail + metadata copy
+under the step locks at the join. Emits ``fleet_async_migration_*``
+(streamed migration count, total migration stall-ms, decode tokens
+generated fleet-wide during the drain window) and exits nonzero when
+nothing streamed, decode made no progress during the drain, or any
+request was lost.
+
 ``--chaos`` (ISSUE 11) re-drives the SAME measured workload against a
 fresh engine with a seeded fault schedule installed
 (``serving/faults.py`` — raises, delays, token corruption, and pool
@@ -496,11 +506,48 @@ def fleet_chaos_injector(seed):
             .add("decode.step", kind="raise", at=6))
 
 
+def _start_drainer(router):
+    """--drain-async (ISSUE 19): once replica 0 is mid-decode, drain
+    it with ``FLAGS_migrate_async`` on — occupied slots STREAM their
+    complete KV pages to peers while both endpoints keep decoding —
+    and measure fleet-wide decode progress during the drain window
+    (the migration-concurrent-decode pin). Returns (thread, state)."""
+    from paddle_tpu.core.flags import set_flags
+
+    set_flags({"migrate_async": True})
+    state = {}
+
+    def _drainer():
+        rep = router.replicas[0]
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if any(rep.eng._slots[i] is not None
+                   for i in range(rep.eng.max_batch)):
+                break
+            time.sleep(0.001)
+        tok0 = sum(len(r.generated) for r in router._tracked)
+        t0 = time.monotonic()
+        router.drain(0)
+        while rep.state not in ("drained", "dead") \
+                and time.monotonic() < deadline:
+            time.sleep(0.001)
+        state["decode_tokens"] = sum(
+            len(r.generated) for r in router._tracked) - tok0
+        state["drain_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+
+    th = threading.Thread(target=_drainer, daemon=True)
+    th.start()
+    return th, state
+
+
 def run_fleet(args):
     """The --fleet bench: warmup, measured Poisson run, fleet_* keys;
     with --chaos, a second run under the seeded fleet fault schedule
     pinning zero-loss failover + survivor parity + bounded goodput
-    loss. Returns (out dict, ok)."""
+    loss; with --drain-async, a mid-load decode-concurrent drain of
+    replica 0 pinning streamed async migrations + decode progress
+    during the drain window (fleet_async_migration_* keys). Returns
+    (out dict, ok)."""
     from paddle_tpu.profiler import stats
 
     rng = np.random.RandomState(args.seed)
@@ -513,8 +560,11 @@ def run_fleet(args):
     sampler = _start_telemetry(
         args, journal=router.replicas[0].eng.journal,
         n_replicas=args.fleet)
+    drainer = _start_drainer(router) if args.drain_async else None
     wall, rids = drive_fleet(router, reqs, args.max_new,
                              deadline_ms=args.deadline_ms)
+    if drainer is not None:
+        drainer[0].join(timeout=10.0)
     tele_out = _stop_telemetry(sampler, args.telemetry_out)
     done = router.results()
     finished = [done[r] for r in rids if r is not None]
@@ -562,10 +612,34 @@ def run_fleet(args):
     out.update(_usage_keys(router=router))
     out.update(tele_out)
     ok = True
+    if drainer is not None:
+        h = stats.histogram("serve.step.migration_ms")
+        st = drainer[1]
+        out.update({
+            "fleet_drain_async": 1,
+            "fleet_async_migrations": int(
+                stats.counter("fleet.async_migrations").value),
+            # stall accounting: total migration phase time (gated UP —
+            # overlap exists to shrink what migration steals)
+            "fleet_async_migration_stall_ms": round(h.total, 3)
+            if h.count else 0.0,
+            # tokens generated FLEET-WIDE during the drain window:
+            # the migration-concurrent decode-progress pin (gated
+            # DOWN — zero means the drain serialized decode)
+            "fleet_async_migration_decode_tokens": st.get(
+                "decode_tokens"),
+            "fleet_async_migration_drain_ms": st.get("drain_ms"),
+        })
+        lost = sum(1 for r in rids if r is not None
+                   and getattr(done.get(r), "state", None) != "ok")
+        out["fleet_async_migration_lost"] = lost
+        ok = (out["fleet_async_migrations"] >= 1
+              and (st.get("decode_tokens") or 0) > 0 and lost == 0)
     if args.chaos:
-        chaos_out, ok = run_fleet_chaos(args, reqs, rids, done,
-                                        goodput, lens, prefixes)
+        chaos_out, chaos_ok = run_fleet_chaos(args, reqs, rids, done,
+                                              goodput, lens, prefixes)
         out.update(chaos_out)
+        ok = ok and chaos_ok
     return out, ok
 
 
@@ -939,6 +1013,16 @@ def main():
                          "serve-loop thread each); emits fleet_* keys "
                          "instead of serve_*; composes with --chaos "
                          "(replica kill mid-load, zero-loss pins)")
+    ap.add_argument("--drain-async", action="store_true",
+                    help="with --fleet (ISSUE 19): mid-load, "
+                         "gracefully drain replica 0 under "
+                         "FLAGS_migrate_async — its mid-decode slots "
+                         "stream complete KV pages to peers while "
+                         "both endpoints keep decoding — and pin "
+                         "migration-concurrent decode progress "
+                         "(fleet_async_migration_* keys; nonzero "
+                         "exit when no pages streamed, decode "
+                         "stalled, or a request was lost)")
     ap.add_argument("--fleet-policy", default="affinity",
                     choices=["affinity", "rr"],
                     help="dispatch policy: blake2b prefix-affinity + "
@@ -1071,10 +1155,12 @@ def main():
         out, fleet_ok = run_fleet(args)
         print(json.dumps(out))
         if not fleet_ok:
-            print("serve_bench --fleet --chaos: zero-loss failover "
-                  "pins FAILED (survivor parity / lost requests / "
+            print("serve_bench --fleet: acceptance pins FAILED "
+                  "(--chaos: survivor parity / lost requests / "
                   "goodput bound / failover+death accounting / site "
-                  "coverage)", file=sys.stderr)
+                  "coverage; --drain-async: no async migration "
+                  "streamed, decode made no progress during the "
+                  "drain, or a request was lost)", file=sys.stderr)
             sys.exit(1)
         return
 

@@ -27,6 +27,10 @@ check) report findings:
              mesh: undeclared collectives (S-GATHER), asymmetric
              branch collectives (S-MATCH), unconstrained outputs
              (S-UNSPEC)
+  overlap    comm/compute overlap sites keep their exact collective
+             census — ring phase counts / permute ordering, the
+             double-buffered EP exchange, no stray blocking psum
+             (S-OVERLAP)
 
 Exit status is nonzero when any UNWAIVERED finding exists. Intentional
 exceptions are documented in-line::
@@ -127,6 +131,7 @@ def main(argv=None) -> int:
         "memory": lambda: analysis.run_memory_pass(
             generation=args.generation),
         "spmd": analysis.run_spmd_pass,
+        "overlap": analysis.run_overlap_pass,
     }
     if args.which:
         results = {args.which: runners[args.which]()}
