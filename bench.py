@@ -844,6 +844,46 @@ def _run_secondary(kind):
                 f"serve_bench --fleet --drain-async "
                 f"rc={proc.returncode}: {proc.stderr[-300:]}")
         print(lines[-1])
+    elif kind == "--fleet-disagg":
+        # disaggregated prefill/decode rung (ISSUE 20): serve_bench
+        # --fleet 2 --disagg drives the same prefill-heavy skewed
+        # workload symmetric-then-disaggregated and pins disagg <=
+        # symmetric TTFT p99 with goodput held (serve_disagg_* +
+        # fleet_spill_* keys; gate: TTFT UP = regression, goodput /
+        # tokens_per_sec DOWN = regression). TPU targets (v5e-8, 2
+        # replicas, prompt mix 2048,8192,16384, rate 32):
+        # serve_disagg_p99_ttft_ms <= 0.7 * fleet_p99_ttft_ms with
+        # serve_disagg_tokens_per_sec >= 0.95 * fleet_tokens_per_sec.
+        import os
+        import subprocess
+
+        import jax
+
+        tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "serve_bench.py")
+        argv = [sys.executable, tool, "--no-lint", "--seed", "0",
+                "--streams", "8", "--fleet", "2", "--disagg"]
+        if jax.default_backend() == "tpu":
+            argv += ["--d-model", "2048", "--layers", "24", "--heads",
+                     "16", "--vocab", "51200", "--bf16",
+                     "--prompt-mix", "2048,8192,16384",
+                     "--prefill-chunk", "256", "--max-new", "64",
+                     "--page-size", "16", "--rate", "32"]
+        else:
+            # 24 requests / 64 decode tokens: enough decode-SLO
+            # pressure that the symmetric fleet's interleave tax
+            # shows, enough TTFT samples that the rep-median p99
+            # holds against shared-core scheduling noise
+            argv += ["--max-new", "64", "--rate", "200"]
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=1200)
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"serve_bench --fleet --disagg "
+                f"rc={proc.returncode}: {proc.stderr[-300:]}")
+        print(lines[-1])
     elif kind == "--decode-spec":
         # speculative decoding at the acceptance ceiling (ISSUE 12):
         # replayed-greedy drafts -> accept rate 1.0, so the rung
@@ -1010,15 +1050,16 @@ SECONDARY_KINDS = ("--s2048", "--decode", "--decode-int8",
                    "--decode-a8w8", "--decode-bf16-grouped",
                    "--decode-tp", "--decode-tp-overlap",
                    "--decode-spec", "--decode-int8kv", "--serve",
-                   "--serve-long", "--fleet", "--attn-varlen",
-                   "--moe-train", "--moe-decode",
+                   "--serve-long", "--fleet", "--fleet-disagg",
+                   "--attn-varlen", "--moe-train", "--moe-decode",
                    "--moe-decode-ep-overlap", "--bert")
 
 #: rungs with CPU-sized fallback geometries — the --all manifest runs
 #: exactly these off-chip (the rest are chip-only shapes)
 CPU_KINDS = ("--decode-tp-overlap", "--decode-spec", "--serve",
-             "--serve-long", "--fleet", "--attn-varlen",
-             "--moe-train", "--moe-decode", "--moe-decode-ep-overlap")
+             "--serve-long", "--fleet", "--fleet-disagg",
+             "--attn-varlen", "--moe-train", "--moe-decode",
+             "--moe-decode-ep-overlap")
 
 
 def _sub(argv, timeout, env=None):

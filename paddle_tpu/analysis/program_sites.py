@@ -376,6 +376,23 @@ def _build_moe_ffn():
                 _sds((E, d), jnp.float32))
 
 
+def _build_kv_restore():
+    """The host-tier KV restore scatter (ISSUE 20): a run of spilled
+    pages lands back in the paged pool as one row-indexed scatter,
+    pool donated so XLA updates in place instead of copying the whole
+    cache. Pool geometry mirrors the serving default (2 layers x 64
+    pages worth of rows at serving head widths)."""
+    import jax.numpy as jnp
+
+    from ..inference.kv_cache import restore_scatter
+
+    L, P, H, ps, hd = 2, 64, 4, 8, 16
+    n = 4       # pages restored per run
+    return restore_scatter, (_sds((L * P, H, ps, hd), jnp.bfloat16),
+                             _sds((L * n,), jnp.int32),
+                             _sds((L * n, H, ps, hd), jnp.bfloat16))
+
+
 PROGRAM_SITES: List[ProgramSite] = [
     ProgramSite("dispatch.gelu", _build_gelu,
                 compute_dtype="bfloat16",
@@ -404,4 +421,6 @@ PROGRAM_SITES: List[ProgramSite] = [
     ProgramSite("attn.varlen_packed", _build_varlen_packed,
                 compute_dtype="bfloat16"),
     ProgramSite("moe.ffn", _build_moe_ffn, compute_dtype="bfloat16"),
+    ProgramSite("serve.kv_restore", _build_kv_restore,
+                compute_dtype="bfloat16", donate_argnums=(0,)),
 ]

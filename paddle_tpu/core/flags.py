@@ -263,6 +263,37 @@ define_flag("migrate_async", False,
             "tail pages + slot metadata copy under the step locks "
             "at re-home; off = the whole export/import runs under "
             "the locks (the zero-loss reference path)")
+define_flag("kv_host_tier_bytes", 0,
+            "host-DRAM KV tier capacity per engine in bytes "
+            "(serving/host_tier.py): cold PrefixCache chains and "
+            "preempted-slot pages spill to host buffers instead of "
+            "being evicted/recomputed, and re-admissions restore "
+            "them back into free pool pages (int8-KV pools spill "
+            "quantized rows + scale columns, so traffic roughly "
+            "halves); 0 disables the tier and eviction releases "
+            "pages outright")
+define_flag("kv_restore_gbps", 10.0,
+            "assumed host->HBM restore bandwidth (GB/s) for the "
+            "router prefix-directory cost model "
+            "(serving/router.py): a host-tier directory entry is "
+            "worth PULLING when pages*page_bytes/bandwidth beats "
+            "re-prefilling the covered tokens at "
+            "FLAGS_disagg_prefill_tflops")
+define_flag("disagg_prefill_tflops", 100.0,
+            "assumed chunk-prefill throughput (TFLOP/s) for the "
+            "directory cost model's re-prefill arm; lower it on "
+            "hosts where prefill is slow (CPU rungs) so long "
+            "host-tier prefixes pull instead of recompute")
+define_flag("disagg", "",
+            "fleet role split (serving/router.py FleetRouter): "
+            "'' = symmetric replicas; 'auto' = half the fleet "
+            "(>=1) becomes prefill-heavy and the rest decode-heavy; "
+            "'P:D' pins the split explicitly. Prefill replicas take "
+            "new admissions with prefill-weighted SLO interleave "
+            "and hand finished-prefill slots to decode replicas "
+            "over the export/import migration path (async when "
+            "FLAGS_migrate_async), so decode TPOT never pays "
+            "prefill stalls")
 define_flag("lora_delta_backend", "auto",
             "batched multi-LoRA ragged delta-GEMM backend "
             "(nn/functional/lora.py lora_delta): auto (Pallas kernel "

@@ -23,7 +23,7 @@ from ..incubate.nn.fused_transformer import (
 from ..nn.layer_base import Layer
 from ..profiler import roofline as _roofline
 from ..profiler import stats as _stats
-from .kv_cache import BlockKVCacheManager
+from .kv_cache import BlockKVCacheManager, gather_rows, restore_scatter_jit
 
 __all__ = ["FusedCausalLM", "GenerationEngine",
            "ContinuousBatchingEngine", "GenRequest",
@@ -909,6 +909,26 @@ class ContinuousBatchingEngine:
 
     # ------------- slot migration (fleet drain, ISSUE 14) -------------
 
+    @staticmethod
+    def _pad_pow2(a: np.ndarray, axis: int = 0) -> np.ndarray:
+        """Pad ``a`` along ``axis`` to the next power-of-two length
+        (min 8) by repeating its last entry, so the KV gather/scatter
+        row shapes BUCKET instead of recompiling per page count — a
+        per-count XLA compile in the serving hot path wedges a
+        replica's stepping thread long enough to trip the fleet
+        health checker into hedging its queue away. Duplicate scatter
+        indices carry the duplicated (identical) values, so the
+        padded writes are no-ops; padded gather rows are sliced off
+        by the caller."""
+        n = a.shape[axis]
+        b = max(8, 1 << max(0, (n - 1).bit_length()))
+        if n == 0 or b == n:
+            return a
+        idx = [slice(None)] * a.ndim
+        idx[axis] = slice(n - 1, n)
+        pad = np.repeat(a[tuple(idx)], b - n, axis=axis)
+        return np.concatenate([a, pad], axis=axis)
+
     def can_migrate(self) -> bool:
         """Page-granular KV export/import is supported for plain
         (unsharded, non-int8) pools; int8 cache-KV carries scale
@@ -934,12 +954,14 @@ class ContinuousBatchingEngine:
         if req is None:
             raise KeyError(f"slot {i} is not decoding")
         pages = list(self._mgr._owned[("slot", i)])
-        rows = jnp.asarray(self._mgr.phys_rows(pages))
+        rows_np = self._mgr.phys_rows(pages)
+        nr = len(rows_np)
+        rows = jnp.asarray(self._pad_pow2(rows_np))
         return {"req": req, "len": int(self._lens[i]),
                 "last_tok": int(self._last_tok[i]),
                 "n_pages": len(pages),
-                "k": np.asarray(self._ck[rows]),
-                "v": np.asarray(self._cv[rows])}
+                "k": np.asarray(gather_rows(self._ck, rows))[:nr],
+                "v": np.asarray(gather_rows(self._cv, rows))[:nr]}
 
     def import_slot(self, i: int, blob: dict) -> bool:
         """Adopt an exported decode slot into free slot ``i``: allocate
@@ -957,12 +979,13 @@ class ContinuousBatchingEngine:
         if not self._slot_free(i) or n > self._mgr.free_pages \
                 or n > self._pages_per_seq:
             return False
+
         pages = self._mgr.allocate(("slot", i), n * self.page_size)
-        rows = jnp.asarray(self._mgr.phys_rows(pages))
-        self._ck = self._ck.at[rows].set(
-            jnp.asarray(blob["k"], self._ck.dtype))
-        self._cv = self._cv.at[rows].set(
-            jnp.asarray(blob["v"], self._cv.dtype))
+        rows = jnp.asarray(self._pad_pow2(self._mgr.phys_rows(pages)))
+        self._ck = restore_scatter_jit(
+            self._ck, rows, jnp.asarray(self._pad_pow2(blob["k"])))
+        self._cv = restore_scatter_jit(
+            self._cv, rows, jnp.asarray(self._pad_pow2(blob["v"])))
         self._slots[i] = blob["req"]
         self._lens[i] = int(blob["len"])
         self._last_tok[i] = int(blob["last_tok"])
@@ -997,9 +1020,12 @@ class ContinuousBatchingEngine:
                 "cache-KV, no TP kv-head sharding)")
         pages = list(self._mgr._owned[("slot", i)])[lo:hi]
         ck, cv = self._ck, self._cv
-        rows = jnp.asarray(self._mgr.phys_rows(pages))
+        rows_np = self._mgr.phys_rows(pages)
+        nr = len(rows_np)
+        rows = jnp.asarray(self._pad_pow2(rows_np))
         return {"lo": lo, "hi": hi,
-                "k": np.asarray(ck[rows]), "v": np.asarray(cv[rows])}
+                "k": np.asarray(gather_rows(ck, rows))[:nr],
+                "v": np.asarray(gather_rows(cv, rows))[:nr]}
 
     def import_begin(self, n_pages: int):
         """Reserve ``n_pages`` for an in-flight migration WITHOUT
@@ -1023,13 +1049,14 @@ class ContinuousBatchingEngine:
         into the ticket's reserved pages. Call under this engine's
         step lock — the scatter swaps the pool arrays and must not
         race a decode step's own swap."""
+
         pages = list(self._mgr._owned[ticket["key"]])
-        rows = jnp.asarray(self._mgr.phys_rows(
-            pages[batch["lo"]:batch["hi"]]))
-        self._ck = self._ck.at[rows].set(
-            jnp.asarray(batch["k"], self._ck.dtype))
-        self._cv = self._cv.at[rows].set(
-            jnp.asarray(batch["v"], self._cv.dtype))
+        rows = jnp.asarray(self._pad_pow2(self._mgr.phys_rows(
+            pages[batch["lo"]:batch["hi"]])))
+        self._ck = restore_scatter_jit(
+            self._ck, rows, jnp.asarray(self._pad_pow2(batch["k"])))
+        self._cv = restore_scatter_jit(
+            self._cv, rows, jnp.asarray(self._pad_pow2(batch["v"])))
 
     def export_slot_tail(self, i: int, lo: int) -> dict:
         """The source's closing export for an async migration: slot
@@ -1073,6 +1100,95 @@ class ContinuousBatchingEngine:
     def import_abort(self, ticket):
         """Release an unfinished migration reservation."""
         self._mgr.free(ticket["key"])
+
+    # -------- host-tier page spill/restore (tiered KV, ISSUE 20) --------
+    #
+    # Unlike slot migration, spill/restore moves IMMUTABLE pages only
+    # (full prefix-cache pages, a preempted slot's complete pages), so
+    # the int8 cache-KV mode is supported: a page's quantized rows spill
+    # together with their f32 scale-plane columns and the pair restores
+    # byte-identically — spilled traffic roughly halves vs bf16.
+
+    def can_spill(self) -> bool:
+        """Host-DRAM spill/restore supports plain AND int8 pools; only
+        TP kv-head-sharded pools fall back (a one-shard blob could not
+        restore into a differently-sharded peer pool)."""
+        return self._mgr._mesh is None
+
+    def _scale_cols(self, rows_np: np.ndarray) -> np.ndarray:
+        """Scale-plane columns of the given pool rows: row r position t
+        lives at plane column r * page_size + t (kv_cache.fresh_cache
+        lane-major layout)."""
+        ps = self.page_size
+        return (rows_np[:, None] * ps
+                + np.arange(ps, dtype=np.int64)[None, :]).reshape(-1)
+
+    def export_kv_pages(self, pages) -> dict:
+        """Gather arbitrary (immutable) pool pages to host memory —
+        layer-major page-inner layout per ``phys_rows``, so the blob
+        scatters back via ``import_kv_pages`` on any engine with the
+        same geometry. int8 pools add the per-token scale columns."""
+        if not self.can_spill():
+            raise NotImplementedError(
+                "host-tier KV spill needs an unsharded pool — TP "
+                "kv-head shards fall back to evict/recompute")
+        rows_np = self._mgr.phys_rows(list(pages))
+        nr = len(rows_np)
+        rows_pad = self._pad_pow2(rows_np)
+        rows = jnp.asarray(rows_pad)
+        if isinstance(self._ck, tuple):
+            nc = nr * self.page_size
+            cols = jnp.asarray(self._scale_cols(rows_pad))
+            return {"n_pages": len(pages), "int8": True,
+                    "k": np.asarray(self._ck[0][rows])[:nr],
+                    "v": np.asarray(self._cv[0][rows])[:nr],
+                    "k_scale": np.asarray(self._ck[1][:, cols])[:, :nc],
+                    "v_scale": np.asarray(self._cv[1][:, cols])[:, :nc]}
+        return {"n_pages": len(pages), "int8": False,
+                "k": np.asarray(gather_rows(self._ck, rows))[:nr],
+                "v": np.asarray(gather_rows(self._cv, rows))[:nr]}
+
+    def import_kv_pages(self, pages, blob: dict) -> None:
+        """Scatter a spilled host blob into freshly allocated pool
+        pages (the restore half — ``kv_cache.restore_scatter``, the
+        donated ``serve.kv_restore`` program). Swaps the functional
+        pool arrays; call from the step thread / under the step lock."""
+
+        rows_np = self._mgr.phys_rows(list(pages))
+        nr = len(rows_np)
+        rows_pad = self._pad_pow2(rows_np)
+        rows = jnp.asarray(rows_pad)
+        if blob.get("int8"):
+            ps = self.page_size
+            reps = len(rows_pad) - nr
+
+            def _pad_sc(x):
+                # the duplicated last row's scale columns, tiled to
+                # match the padded cols (identical duplicate writes)
+                x = np.asarray(x)
+                if reps:
+                    x = np.concatenate(
+                        [x, np.tile(x[:, -ps:], (1, reps))], axis=1)
+                return x
+
+            cols = jnp.asarray(self._scale_cols(rows_pad))
+            ck, cks = self._ck
+            cv, cvs = self._cv
+            self._ck = (restore_scatter_jit(
+                            ck, rows,
+                            jnp.asarray(self._pad_pow2(blob["k"]))),
+                        cks.at[:, cols].set(jnp.asarray(
+                            _pad_sc(blob["k_scale"]), cks.dtype)))
+            self._cv = (restore_scatter_jit(
+                            cv, rows,
+                            jnp.asarray(self._pad_pow2(blob["v"]))),
+                        cvs.at[:, cols].set(jnp.asarray(
+                            _pad_sc(blob["v_scale"]), cvs.dtype)))
+        else:
+            self._ck = restore_scatter_jit(
+                self._ck, rows, jnp.asarray(self._pad_pow2(blob["k"])))
+            self._cv = restore_scatter_jit(
+                self._cv, rows, jnp.asarray(self._pad_pow2(blob["v"])))
 
     # ---------------- internals ----------------
 

@@ -15,12 +15,40 @@ from __future__ import annotations
 
 from typing import Dict, List, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..incubate.nn.fused_transformer import PagedKV
 
-__all__ = ["BlockKVCacheManager"]
+__all__ = ["BlockKVCacheManager", "restore_scatter",
+           "restore_scatter_jit", "gather_rows"]
+
+
+def restore_scatter(pool, rows, vals):
+    """The host→HBM KV restore as one program: scatter a spilled page
+    blob (``vals``, layer-major rows — see ``phys_rows``) back into the
+    pool. The pool argument is DONATED at the jit boundary
+    (``restore_scatter_jit``) so a restore never holds two copies of
+    the pool in HBM; registered as the ``serve.kv_restore`` program
+    site for the lint passes."""
+    return pool.at[rows].set(vals.astype(pool.dtype))
+
+
+#: the jitted restore — what the serving restore/import paths call.
+#: One executable per (pool, rows, vals) shape bucket (row vectors are
+#: power-of-two padded, see ``ContinuousBatchingEngine._pad_pow2``);
+#: the eager op-by-op form costs several ms of dispatch overhead PER
+#: CALL, which a prefill replica's stepping thread pays mid-drive.
+restore_scatter_jit = jax.jit(restore_scatter, donate_argnums=(0,))
+
+
+@jax.jit
+def gather_rows(pool, rows):
+    """The export half (spill/migration): pool rows to one contiguous
+    blob as a single compiled gather — same bucketed-shape contract
+    (and the same dispatch-overhead rationale) as the restore."""
+    return pool[rows]
 
 
 class BlockKVCacheManager:
@@ -134,6 +162,21 @@ class BlockKVCacheManager:
 
     def pages_needed(self, length: int) -> int:
         return -(-length // self.page_size)
+
+    def page_hbm_bytes(self) -> int:
+        """Bytes ONE logical page occupies in HBM across both K and V
+        pools (all layers, all kv heads) — the unit of host-tier
+        capacity accounting and of the router directory's restore-vs-
+        re-prefill cost model. int8 cache-KV counts the quantized rows
+        plus their f32 scale-plane columns, so a spilled int8 page
+        moves roughly half the bytes of its bf16 equivalent."""
+        elems = (self.num_layers * self._pool_heads
+                 * self.page_size * self.head_dim)
+        if self.dtype == "int8" or self.dtype == jnp.int8:
+            scale = (self._pool_heads * self.num_layers
+                     * self.page_size * 4)
+            return 2 * (elems + scale)
+        return 2 * elems * jnp.dtype(self.dtype).itemsize
 
     def phys_rows(self, pages: Sequence[int]) -> np.ndarray:
         """Physical pool-row indices of logical ``pages`` across the

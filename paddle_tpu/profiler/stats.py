@@ -77,7 +77,17 @@ Conventions for the built-in instrumentation (all optional reading):
   circuit_open}`` gauges and ``fleet.{dispatches,failovers,
   failover_requests,migrations,migrated_pages,hedges,shed}``
   counters — the front-tier health/failover/drain accounting
-  tools/serve_top.py --fleet renders
+  tools/serve_top.py --fleet renders — plus the tiered-KV /
+  disaggregation accounting: ``fleet.{spills,restores,spill_bytes,
+  restore_bytes,host_evictions}`` host-tier page traffic
+  (serving/host_tier.py), ``fleet.{handoffs,handoff_pages}``
+  prefill→decode slot handoffs, and
+  ``fleet.directory_{hits,pulls,misses}`` prefix-directory routing
+  verdicts
+- ``tier.*``                   host-DRAM KV tier occupancy gauges
+  (serving/host_tier.py): ``tier.host_{pages,bytes,
+  capacity_bytes}``, summed over every engine's tier in the
+  process — the serve_top fleet tier view's source
 - ``roofline.*``               achieved FLOP/s / bytes/s / MFU / BW
   utilization vs device peaks (profiler/roofline.py)
 - ``hbm.*``                    device memory telemetry
@@ -139,8 +149,8 @@ __all__ = [
 CONVENTION_PREFIXES = (
     "op.", "vjp_cache.", "fwd_cache.", "compile.", "jit.", "autograd.",
     "inference.", "serving.", "serve.", "journal.", "slo.", "spec.",
-    "quant.", "moe.", "dist.", "fleet.", "roofline.", "hbm.", "lint.",
-    "telemetry.", "alert.", "usage.", "tenant.", "lora.",
+    "quant.", "moe.", "dist.", "fleet.", "tier.", "roofline.", "hbm.",
+    "lint.", "telemetry.", "alert.", "usage.", "tenant.", "lora.",
     "t.",
 )
 

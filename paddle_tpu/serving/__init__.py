@@ -49,6 +49,7 @@ from .faults import (Clock, DeadlineExceeded, FaultInjector, FaultSpec,
                      PoolSizingError, ReplicaKilled, ServerOverloaded,
                      TenantQuotaExceeded, TokenCorruption,
                      WatchdogTimeout, set_clock, use_clock)
+from .host_tier import HostKVTier
 from .journal import FlightRecorder
 from .prefix_cache import PrefixCache
 from .request import Request
@@ -56,7 +57,8 @@ from .router import CircuitBreaker, FleetRouter, Replica
 from .scheduler import ServingEngine, SLOConfig
 from .slo import SLOMonitor
 
-__all__ = ["Request", "PrefixCache", "ServingEngine", "SLOConfig",
+__all__ = ["Request", "PrefixCache", "HostKVTier",
+           "ServingEngine", "SLOConfig",
            "FlightRecorder", "SLOMonitor",
            "FleetRouter", "Replica", "CircuitBreaker",
            "AdapterBank", "LoRAAdapter",

@@ -1,0 +1,227 @@
+"""Host-DRAM KV tier: spill cold pages instead of recomputing them.
+
+HBM holds the hot working set; everything the pool evicts under
+pressure — cold ``PrefixCache`` chains, a preempted slot's complete
+pages — used to be released outright, turning the next admission into
+a full re-prefill (the evict-or-recompute cliff). This tier adds the
+memory level in between: evicted pages ``device_get`` into host
+buffers keyed by the SAME blake2b content chain the prefix cache uses,
+and a later admission restores them with one batched allocate+scatter
+(``kv_cache.restore_scatter``, a donated program) instead of burning
+prefill FLOPs. int8 cache-KV spills its quantized rows plus the f32
+scale-plane columns, so spilled traffic roughly halves vs bf16.
+
+Accounting is page-exact: ``fleet.spills``/``fleet.restores`` count
+pages, ``fleet.spill_bytes``/``fleet.restore_bytes`` count measured
+host-blob bytes, and ``tier.host_{pages,bytes,capacity_bytes}`` gauges
+publish the live occupancy summed over every tier in the process (one
+per engine). Over-capacity spills LRU-evict host entries
+(``fleet.host_evictions``) — the invariant the accounting tests pin is
+``spills - restores - host_evictions - dropped == live entries``.
+
+The router's prefix directory (serving/router.py) subscribes via the
+``on_spill``/``on_restore`` callbacks to track which tier each chain
+key lives in fleet-wide.
+"""
+from __future__ import annotations
+
+import weakref
+from collections import OrderedDict
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..profiler import stats as _stats
+
+__all__ = ["HostKVTier"]
+
+#: every live tier in the process — the ``tier.*`` gauges publish the
+#: fleet-wide sum so serve_top/telemetry see one occupancy number
+_TIERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _publish_gauges() -> None:
+    tiers = list(_TIERS)
+    _stats.set_gauge("tier.host_pages", sum(len(t) for t in tiers))
+    _stats.set_gauge("tier.host_bytes",
+                     sum(t.bytes_used for t in tiers))
+    _stats.set_gauge("tier.host_capacity_bytes",
+                     sum(t.capacity_bytes for t in tiers))
+
+
+class HostKVTier:
+    """LRU host-buffer store of spilled KV pages for ONE engine.
+
+    Entries are per-page host blobs keyed by the prefix-cache chain key
+    of the page's token contents — content-addressed, so a restore is
+    correct on any admission whose prompt walks the same chain, and a
+    preempted slot's pages restore through the ordinary prefix path.
+    """
+
+    def __init__(self, eng, capacity_bytes: int, journal=None):
+        self._eng = eng
+        self._mgr = eng._mgr
+        self.capacity_bytes = int(capacity_bytes)
+        #: HBM bytes one logical page frees when spilled (the directory
+        #: cost model's unit); host blob bytes are measured exactly
+        self.page_bytes = self._mgr.page_hbm_bytes()
+        self.bytes_used = 0
+        self._journal = journal
+        self._entries: "OrderedDict[bytes, dict]" = OrderedDict()
+        #: router directory subscriptions: called with the chain key
+        #: after a page enters (on_spill) / leaves (on_restore) the tier
+        self.on_spill: Optional[Callable[[bytes], None]] = None
+        self.on_restore: Optional[Callable[[bytes], None]] = None
+        self.on_drop: Optional[Callable[[bytes], None]] = None
+        self._restore_seq = 0
+        _TIERS.add(self)
+        _publish_gauges()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def has(self, key: bytes) -> bool:
+        return key in self._entries
+
+    @staticmethod
+    def _entry_bytes(ent: dict) -> int:
+        return sum(int(a.nbytes) for a in ent.values()
+                   if isinstance(a, np.ndarray))
+
+    def _evict_lru(self) -> None:
+        key, ent = self._entries.popitem(last=False)
+        self.bytes_used -= ent["_bytes"]
+        _stats.inc("fleet.host_evictions")
+        if self.on_drop is not None:
+            # gone from the tier entirely — the directory forgets it
+            self.on_drop(key)
+
+    # ------------------------------ spill ------------------------------
+
+    def spill(self, key: bytes, page: int) -> int:
+        return self.spill_pages([key], [page])
+
+    def spill_pages(self, keys: Sequence[bytes],
+                    pages: Sequence[int]) -> int:
+        """Copy immutable full pages ``keys[i] -> pages[i]`` to host
+        buffers in ONE gather. Pages are NOT released here — the caller
+        keeps its reference and releases after, so a failed spill never
+        loses KV. Returns the number of pages that landed."""
+        todo = [(k, p) for k, p in zip(keys, pages)
+                if k not in self._entries]
+        for k in keys:
+            if k in self._entries:
+                self._entries.move_to_end(k)
+        if not todo or self.capacity_bytes <= 0:
+            return 0
+        blob = self._eng.export_kv_pages([p for _, p in todo])
+        n = len(todo)
+        L = self._mgr.num_layers
+        k = blob["k"].reshape(L, n, *blob["k"].shape[1:])
+        v = blob["v"].reshape(L, n, *blob["v"].shape[1:])
+        if blob["int8"]:
+            H = self._mgr._pool_heads
+            ps = self._mgr.page_size
+            ks = blob["k_scale"].reshape(H, L, n, ps)
+            vs = blob["v_scale"].reshape(H, L, n, ps)
+        spilled = spilled_bytes = 0
+        for j, (key, _page) in enumerate(todo):
+            ent = {"k": np.ascontiguousarray(k[:, j]),
+                   "v": np.ascontiguousarray(v[:, j])}
+            if blob["int8"]:
+                ent["int8"] = True
+                ent["k_scale"] = np.ascontiguousarray(ks[:, :, j])
+                ent["v_scale"] = np.ascontiguousarray(vs[:, :, j])
+            nb = self._entry_bytes(ent)
+            while self.bytes_used + nb > self.capacity_bytes \
+                    and self._entries:
+                self._evict_lru()
+            if self.bytes_used + nb > self.capacity_bytes:
+                break  # tier genuinely too small for one more page
+            ent["_bytes"] = nb
+            self._entries[key] = ent
+            self.bytes_used += nb
+            spilled += 1
+            spilled_bytes += nb
+            if self.on_spill is not None:
+                self.on_spill(key)
+        if spilled:
+            _stats.inc("fleet.spills", spilled)
+            _stats.inc("fleet.spill_bytes", spilled_bytes)
+            if self._journal is not None:
+                self._journal.record("spill", -1, -1,
+                                     {"pages": spilled,
+                                      "bytes": spilled_bytes})
+        _publish_gauges()
+        return spilled
+
+    # ----------------------------- restore -----------------------------
+
+    def restore_run(self, keys: Sequence[bytes]) -> Optional[List[int]]:
+        """Restore a run of host entries in ONE allocate+scatter:
+        allocates ``len(keys)`` pool pages, rebuilds the layer-major
+        batch blob, scatters it, and pops the host entries. The pages
+        come back with the allocation's single reference TRANSFERRED
+        to the caller (the prefix cache registers them as entries).
+        None when a key is missing or the pool can't cover."""
+        keys = list(keys)
+        if not keys:
+            return []
+        ents = []
+        for key in keys:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None
+            ents.append(ent)
+        m = len(keys)
+        if m > self._mgr.free_pages:
+            return None
+        self._restore_seq += 1
+        tmp = ("hostrestore", self._restore_seq)
+        pages = self._mgr.allocate(tmp, m * self._mgr.page_size)
+        L = self._mgr.num_layers
+        batch = {
+            "n_pages": m, "int8": bool(ents[0].get("int8")),
+            "k": np.stack([e["k"] for e in ents], axis=1).reshape(
+                L * m, *ents[0]["k"].shape[1:]),
+            "v": np.stack([e["v"] for e in ents], axis=1).reshape(
+                L * m, *ents[0]["v"].shape[1:]),
+        }
+        if batch["int8"]:
+            H = self._mgr._pool_heads
+            batch["k_scale"] = np.stack(
+                [e["k_scale"] for e in ents], axis=2).reshape(H, -1)
+            batch["v_scale"] = np.stack(
+                [e["v_scale"] for e in ents], axis=2).reshape(H, -1)
+        self._eng.import_kv_pages(pages, batch)
+        # ownership transfer: the temp key's page list dissolves and
+        # the caller inherits the pages' single reference
+        self._mgr._owned.pop(tmp, None)
+        restored_bytes = 0
+        for key, ent in zip(keys, ents):
+            del self._entries[key]
+            self.bytes_used -= ent["_bytes"]
+            restored_bytes += ent["_bytes"]
+            if self.on_restore is not None:
+                self.on_restore(key)
+        _stats.inc("fleet.restores", m)
+        _stats.inc("fleet.restore_bytes", restored_bytes)
+        if self._journal is not None:
+            self._journal.record("restore", -1, -1,
+                                 {"pages": m, "bytes": restored_bytes})
+        _publish_gauges()
+        return pages
+
+    # ------------------------------ admin ------------------------------
+
+    def drop(self, n_entries: int) -> int:
+        """Drop up to n LRU entries without restoring (tests/draining)."""
+        dropped = 0
+        while self._entries and dropped < n_entries:
+            self._evict_lru()
+            dropped += 1
+        _publish_gauges()
+        return dropped
+
+    def clear(self) -> int:
+        return self.drop(len(self._entries))

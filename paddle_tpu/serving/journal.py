@@ -82,7 +82,8 @@ LIFECYCLE_EVENTS = (
     "submit", "queued", "admitted", "prefill_chunk", "first_token",
     "decode", "spec_verify", "preempt", "requeue", "stall",
     "evict_trigger", "fault", "retry", "watchdog",
-    "failover", "migrate", "drain", "alert",
+    "failover", "migrate", "handoff", "spill", "restore",
+    "drain", "alert",
     "finish", "error", "deadline_exceeded", "shed",
 )
 
@@ -197,10 +198,13 @@ def load_jsonl(path: str):
 
 #: lifecycle transitions that OPEN a phase span on a request's lane
 #: (``failover`` re-queues the request on the surviving replica's
-#: lane; ``migrate`` lands it straight in decode — no prefill replay)
+#: lane; ``migrate``/``handoff`` land it straight in decode — no
+#: prefill replay. ``spill``/``restore`` are engine-level rid=-1
+#: instants: host-tier page traffic, not a request phase)
 _PHASE_OF = {"submit": "queued", "queued": "queued",
              "admitted": "prefill", "decode": "decode",
-             "failover": "queued", "migrate": "decode"}
+             "failover": "queued", "migrate": "decode",
+             "handoff": "decode"}
 #: transitions that CLOSE whatever phase is open
 _CLOSERS = ("preempt", "requeue", "finish", "error",
             "deadline_exceeded", "shed")

@@ -66,6 +66,11 @@ class PrefixCache:
         #: any page ref is taken, so an injected failure never leaks
         #: a retain
         self._faults = None
+        #: host-DRAM KV tier (serving/host_tier.py) or None — when set,
+        #: every eviction funnels through the spill decision point and
+        #: ``restore_chain`` pulls spilled continuations back before
+        #: admission re-prefills them
+        self.host_tier = None
         self._entries: "OrderedDict[bytes, int]" = OrderedDict()
 
     def __len__(self) -> int:
@@ -124,16 +129,71 @@ class PrefixCache:
         """Drop up to n LRU entries, releasing the cache's reference
         (a page whose LAST reference this was returns to the free
         list; one still mapped by a live sequence just drops to its
-        sharers). Admission calls this under pool pressure."""
-        dropped = 0
+        sharers). Admission calls this under pool pressure. EVERY
+        eviction routes through the spill decision point below, so a
+        configured host tier turns pool pressure into a demotion
+        instead of a recompute — with no tier the decision degrades to
+        the plain release this always was."""
+        dropped = spilled = 0
         while self._entries and dropped < n_entries:
-            _key, page = self._entries.popitem(last=False)
-            self._mgr.release_pages([page])
+            key, page = self._entries.popitem(last=False)
+            spilled += self._spill_or_release(key, page)
             dropped += 1
         if dropped and self._journal is not None:
             self._journal.record("evict_trigger", -1, -1,
-                                 {"pages": dropped})
+                                 {"pages": dropped, "spilled": spilled})
         return dropped
+
+    def _spill_or_release(self, key: bytes, page: int) -> int:
+        """The single evict-vs-spill decision point (ISSUE 20): copy
+        the page's KV to the host tier (content-keyed, so any later
+        prompt walking the same chain can restore it), THEN drop the
+        cache's reference. The spill happens before the release, so a
+        tier rejection (over capacity, tier disabled) leaves exactly
+        the old eviction behaviour. Returns 1 if the page spilled."""
+        ht = self.host_tier
+        spilled = ht.spill(key, page) if ht is not None else 0
+        self._mgr.release_pages([page])
+        return spilled
+
+    def restore_chain(self, prompt, reserve: int = 1) -> int:
+        """Pull ``prompt``'s spilled chain continuation back from the
+        host tier into free pool pages — called once per admission
+        probe BEFORE ``match``, so restored pages are indistinguishable
+        from never-evicted ones. Walks the chain past the cached
+        prefix, batches every consecutive host-resident key into one
+        allocate+scatter, and registers the pages as ordinary entries.
+        ``reserve`` pool pages are left free for the admission's own
+        first chunk so a restore can never starve the very request it
+        serves. Returns the number of pages restored."""
+        ht = self.host_tier
+        if ht is None or not len(ht):
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        max_pages = max(0, (len(prompt) - 1) // self.page_size)
+        budget = self._mgr.free_pages - max(int(reserve), 0)
+        if self.capacity_pages is not None:
+            budget = min(budget,
+                         self.capacity_pages - len(self._entries))
+        if budget <= 0:
+            return 0
+        to_restore: List[bytes] = []
+        for key in self._chain(prompt, max_pages):
+            if key in self._entries:
+                continue
+            if not ht.has(key) or len(to_restore) >= budget:
+                break
+            to_restore.append(key)
+        if not to_restore:
+            return 0
+        pages = ht.restore_run(to_restore)
+        if pages is None:
+            return 0
+        for key, page in zip(to_restore, pages):
+            # the restore's single page reference transfers to the
+            # cache entry — same ownership shape as a fresh insert
+            self._entries[key] = page
+        return len(pages)
 
     def clear(self) -> int:
         return self.evict(len(self._entries))

@@ -78,7 +78,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -150,6 +150,31 @@ class CircuitBreaker:
             self._opened_at = _faults.now()
 
 
+def _parse_disagg(spec, n: int) -> Optional[Tuple[int, int]]:
+    """Normalize a disaggregation spec to ``(n_prefill, n_decode)``.
+
+    ``''``/None/False → None (symmetric fleet); ``'auto'``/True →
+    half the fleet (at least 1) prefill-heavy, the rest decode-heavy
+    — or None when the fleet is too small to split; ``'P:D'`` pins
+    the split explicitly (must cover the whole fleet)."""
+    if spec is None or spec is False or spec == "":
+        return None
+    if spec is True or spec == "auto":
+        if n < 2:
+            return None
+        n_pre = max(1, n // 2)
+        return (n_pre, n - n_pre)
+    s = str(spec)
+    if ":" in s:
+        p, d = (int(x) for x in s.split(":", 1))
+        if p < 1 or d < 1 or p + d != n:
+            raise ValueError(
+                f"disagg={s!r}: need P>=1, D>=1 and P+D == "
+                f"{n} replicas")
+        return (p, d)
+    raise ValueError(f"disagg={spec!r}: expected '', 'auto' or 'P:D'")
+
+
 class Replica:
     """One fleet replica: a ``ServingEngine`` plus its serve-loop /
     health / breaker state. ``step_once()`` is the unit both the
@@ -164,6 +189,9 @@ class Replica:
         self.idx = idx
         self.eng = eng
         self.router = router
+        #: disaggregation role (ISSUE 20): "prefill" | "decode" |
+        #: None (symmetric fleet) — stamped by the router
+        self.role: Optional[str] = None
         self.state = "alive"
         self.last_beat = _faults.now()
         self.crashed: Optional[BaseException] = None
@@ -231,6 +259,10 @@ class Replica:
                 return
             if not self.step_once():
                 time.sleep(0.0005)
+            elif self.role == "prefill":
+                # disaggregated fleet: this thread owns the replica's
+                # stepping, so the handoff never races its own decode
+                self.router._handoff_ready(self)
 
 
 class FleetRouter:
@@ -257,7 +289,8 @@ class FleetRouter:
                  n_replicas: Optional[int] = None,
                  policy: str = "affinity", faults=None,
                  affinity_pages: int = 8,
-                 breaker_cooldown_ms: float = 250.0):
+                 breaker_cooldown_ms: float = 250.0,
+                 disagg=None):
         if policy not in ("affinity", "rr"):
             raise ValueError(
                 f"policy={policy!r}: expected 'affinity' or 'rr'")
@@ -280,8 +313,52 @@ class FleetRouter:
         self.replicas: List[Replica] = [
             Replica(i, e, self, breaker_cooldown_ms)
             for i, e in enumerate(engines)]
-        #: blake2b chain key -> replica idx that owns the pages
-        self._affinity: Dict[bytes, int] = {}
+        #: fleet-wide prefix DIRECTORY (ISSUE 20): blake2b chain key →
+        #: ``(replica idx, tier)`` where tier is ``"hbm"`` (the pages
+        #: live in that replica's pool / prefix cache) or ``"host"``
+        #: (spilled to its host-DRAM tier). Generalizes the PR 14
+        #: chain→replica affinity map; the ``_affinity`` property
+        #: keeps the old owner-only read view.
+        self._directory: Dict[bytes, Tuple[int, str]] = {}
+        # ------ disaggregated prefill/decode roles (ISSUE 20) ------
+        self.disagg = _parse_disagg(
+            disagg if disagg is not None else _flag("disagg"),
+            len(self.replicas))
+        if self.disagg is not None:
+            n_pre, _ = self.disagg
+            for rep in self.replicas:
+                rep.role = "prefill" if rep.idx < n_pre else "decode"
+                # the scheduler's SLO interleave weights ARE the role:
+                # a prefill replica runs long prefill bursts between
+                # single decode chunks (its decode slots hand off
+                # anyway), a decode replica the inverse
+                slo = rep.eng.slo
+                if rep.role == "prefill":
+                    slo.prefill_burst = max(slo.prefill_burst, 8)
+                    slo.decode_burst = 1
+                else:
+                    slo.prefill_burst = 1
+                    slo.decode_burst = max(slo.decode_burst, 8)
+        # directory cost model constants: HBM bytes one page restores
+        # (host→device) vs the FLOPs re-prefilling its tokens costs
+        eng0 = self.replicas[0].eng
+        self._page_bytes = eng0._mgr.page_hbm_bytes()
+        st = eng0.model.stack
+        d, ff, nl = st.embed_dim, st.dim_feedforward, st.num_layers
+        self._flops_per_token = 2.0 * (
+            nl * (4 * d * d + 2 * d * ff)
+            + getattr(eng0.model, "vocab_size", 0) * d)
+        # directory tier tracking: each replica's host tier reports
+        # page movement between tiers through these callbacks
+        for rep in self.replicas:
+            ht = getattr(rep.eng, "host_tier", None)
+            if ht is not None:
+                ht.on_spill = (lambda key, i=rep.idx:
+                               self._note_tier(key, i, "host"))
+                ht.on_restore = (lambda key, i=rep.idx:
+                                 self._note_tier(key, i, "hbm"))
+                ht.on_drop = (lambda key, i=rep.idx:
+                              self._drop_tier(key, i))
         self._rr = 0
         self._tracked: List[Request] = []
         self._dispatch_lock = threading.Lock()
@@ -453,6 +530,13 @@ class FleetRouter:
         good = eng.slo_monitor.goodput
         return (depth, -(1.0 if good is None else good), rep.idx)
 
+    @property
+    def _affinity(self) -> Dict[bytes, int]:
+        """Owner-only read view of the prefix directory (chain key →
+        replica idx) — PR 14's affinity map, kept for callers that
+        care WHO holds a prefix, not which memory tier holds it."""
+        return {k: v[0] for k, v in self._directory.items()}
+
     def _affinity_chain(self, prompt) -> List[bytes]:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         ps = self.page_size
@@ -463,6 +547,37 @@ class FleetRouter:
             keys.append(key)
         return keys
 
+    def _note_tier(self, key: bytes, idx: int, tier: str) -> None:
+        """Host-tier callback: chain ``key``'s pages moved between
+        replica ``idx``'s memory tiers (spill → "host", restore →
+        "hbm"). A key another replica already owns keeps its owner —
+        an HBM holder elsewhere beats a host copy here."""
+        if self.policy != "affinity":
+            return
+        ent = self._directory.get(key)
+        if ent is None or ent[0] == idx:
+            self._directory[key] = (idx, tier)
+
+    def _drop_tier(self, key: bytes, idx: int) -> None:
+        """Host-tier LRU eviction: the key left replica ``idx``'s host
+        tier without restoring — forget the directory entry."""
+        if self._directory.get(key) == (idx, "host"):
+            self._directory.pop(key, None)
+
+    def _pull_worth(self, pages: int) -> bool:
+        """The directory cost model: restoring ``pages`` from a host
+        tier moves ``pages * page_bytes`` over the assumed
+        ``FLAGS_kv_restore_gbps`` host→HBM bandwidth; re-prefilling
+        the tokens they cover burns ~2·params FLOPs per token at
+        ``FLAGS_disagg_prefill_tflops``. Route to the host-tier holder
+        only when the restore is the cheaper arm."""
+        gbps = max(float(_flag("kv_restore_gbps")), 1e-9)
+        tflops = max(float(_flag("disagg_prefill_tflops")), 1e-12)
+        restore_s = pages * self._page_bytes / (gbps * 1e9)
+        prefill_s = (pages * self.page_size * self._flops_per_token
+                     / (tflops * 1e12))
+        return restore_s < prefill_s
+
     def _candidate_order(self, req: Request,
                          cands: List[Replica]) -> List[Replica]:
         if self.policy == "rr":
@@ -472,20 +587,40 @@ class FleetRouter:
             return cands[k:] + cands[:k]
         by_load = sorted(cands, key=self._load_score)
         # longest matching chain wins: walk the prompt's chain keys
-        # back-to-front so deeper (more specific) matches route first
+        # back-to-front so deeper (more specific) matches route first.
+        # The directory verdict is counted once per dispatch: hit =
+        # HBM holder found, pull = host-tier holder worth restoring,
+        # miss = nothing known (or the cost model said re-prefill)
         by_idx = {r.idx: r for r in cands}
-        for key in reversed(self._affinity_chain(req.prompt)):
-            owner = self._affinity.get(key)
-            if owner is not None and owner in by_idx:
-                tgt = by_idx[owner]
-                return [tgt] + [r for r in by_load if r is not tgt]
+        chain = self._affinity_chain(req.prompt)
+        for depth_back, key in enumerate(reversed(chain)):
+            ent = self._directory.get(key)
+            if ent is None:
+                continue
+            owner, tier = ent
+            if owner not in by_idx:
+                continue
+            tgt = by_idx[owner]
+            rest = [r for r in by_load if r is not tgt]
+            if tier == "hbm":
+                _stats.inc("fleet.directory_hits")
+                return [tgt] + rest
+            if self._pull_worth(len(chain) - depth_back):
+                # route to the holder; its admission path restores
+                # the chain from its host tier (restore_chain)
+                _stats.inc("fleet.directory_pulls")
+                return [tgt] + rest
+            _stats.inc("fleet.directory_misses")
+            return by_load
+        if chain:
+            _stats.inc("fleet.directory_misses")
         return by_load
 
     def _register_affinity(self, req: Request, rep: Replica) -> None:
         if self.policy != "affinity":
             return
         for key in self._affinity_chain(req.prompt):
-            self._affinity[key] = rep.idx
+            self._directory[key] = (rep.idx, "hbm")
 
     def _dispatch(self, req: Request, exclude=frozenset(),
                   force: bool = False) -> Replica:
@@ -497,6 +632,15 @@ class FleetRouter:
         replica's breaker and the next candidate is tried."""
         with self._dispatch_lock:
             cands = self._dispatchable(exclude)
+            if self.disagg is not None and not force:
+                # role routing: NEW requests land on prefill-heavy
+                # replicas (their finished slots hand off to the
+                # decode side); with every prefill replica down the
+                # decode side still serves — roles are a preference,
+                # never an availability constraint
+                pre = [r for r in cands if r.role == "prefill"]
+                if pre:
+                    cands = pre
             if not cands:
                 _stats.inc("fleet.shed")
                 raise FleetOverloaded(
@@ -756,20 +900,39 @@ class FleetRouter:
         except FleetOverloaded as e:
             self._fail(req, e)
 
-    def _migrate_slot(self, src: Replica, i: int) -> bool:
+    def _migrate_slot(self, src: Replica, i: int,
+                      event: str = "migrate",
+                      dest_role: Optional[str] = None) -> bool:
         """Hand decode slot ``i``'s KV pages from ``src`` to a healthy
         peer: export (gather), import (alloc + put + slot re-home),
         THEN release the source pages — a failed import leaves the
         source untouched. Counted in ``fleet.{migrations,
-        migrated_pages}`` and journaled on the destination's lane."""
+        migrated_pages}`` (``fleet.{handoffs,handoff_pages}`` when
+        ``event="handoff"`` — the disaggregated prefill→decode path)
+        and journaled on the destination's lane. ``dest_role``
+        restricts candidate peers to one disaggregation role."""
         eng = src.eng
         if not eng.can_migrate():
             return False
         req = eng._slots[i]
+        # cheap racy pre-check: skip the whole-slot export when no
+        # candidate has a landing slot right now (the authoritative
+        # check re-runs under the destination's step lock below) —
+        # a handoff retries every source step, so a full gather per
+        # doomed attempt would tax exactly the prefill steps the
+        # disaggregated split is trying to protect
+        if not any(d.eng.can_migrate()
+                   and (dest_role is None or d.role == dest_role)
+                   and any(d.eng._slot_free(j)
+                           for j in range(d.eng.max_batch))
+                   for d in self._dispatchable(exclude={src.idx})):
+            return False
         tm0 = _faults.now()
         blob = eng.export_slot(i)
         for dest in self._dispatchable(exclude={src.idx}):
             if not dest.eng.can_migrate():
+                continue
+            if dest_role is not None and dest.role != dest_role:
                 continue
             with dest.step_lock:
                 j = next((j for j in range(dest.eng.max_batch)
@@ -778,8 +941,12 @@ class FleetRouter:
                     continue
             req.n_migrations = getattr(req, "n_migrations", 0) + 1
             eng._release(i)   # src ledger closes its page integral
-            _stats.inc("fleet.migrations")
-            _stats.inc("fleet.migrated_pages", blob["n_pages"])
+            if event == "handoff":
+                _stats.inc("fleet.handoffs")
+                _stats.inc("fleet.handoff_pages", blob["n_pages"])
+            else:
+                _stats.inc("fleet.migrations")
+                _stats.inc("fleet.migrated_pages", blob["n_pages"])
             # the migration phase of serving-time attribution: export
             # through release, stamped via the clock seam (failed
             # attempts are not a phase — nothing moved). The ledger
@@ -793,7 +960,7 @@ class FleetRouter:
             _stats.observe("serve.step.migration_ms", mig_ms)
             jr = dest.eng.journal
             if jr is not None:
-                jr.record("migrate", req.id, j,
+                jr.record(event, req.id, j,
                           {"from": src.idx, "to": dest.idx,
                            "pages": blob["n_pages"],
                            "n_generated": len(req.generated)})
@@ -830,7 +997,9 @@ class FleetRouter:
                     eng._release(i)
                 self._redispatch_from(rep, req)
 
-    def _migrate_slot_async(self, src: Replica, i: int) -> bool:
+    def _migrate_slot_async(self, src: Replica, i: int,
+                            event: str = "migrate",
+                            dest_role: Optional[str] = None) -> bool:
         """Stream decode slot ``i`` to a peer while BOTH endpoints
         keep decoding: reserve pages on the destination (short lock),
         copy complete pages batch-by-batch (source lock-free, one
@@ -852,6 +1021,8 @@ class FleetRouter:
         dest = ticket = None
         for cand in self._dispatchable(exclude={src.idx}):
             if not cand.eng.can_migrate():
+                continue
+            if dest_role is not None and cand.role != dest_role:
                 continue
             with cand.step_lock:
                 t = cand.eng.import_begin(n0)
@@ -901,9 +1072,13 @@ class FleetRouter:
             req.n_migrations = getattr(req, "n_migrations", 0) + 1
             eng._release(i)   # src ledger closes its page integral
             n_pages = blob["n_pages"]
-        _stats.inc("fleet.migrations")
+        if event == "handoff":
+            _stats.inc("fleet.handoffs")
+            _stats.inc("fleet.handoff_pages", n_pages)
+        else:
+            _stats.inc("fleet.migrations")
+            _stats.inc("fleet.migrated_pages", n_pages)
         _stats.inc("fleet.async_migrations")
-        _stats.inc("fleet.migrated_pages", n_pages)
         mig_ms = (_faults.now() - tm0) * 1e3
         ud = dest.eng.usage
         if ud is not None:
@@ -912,11 +1087,48 @@ class FleetRouter:
         _stats.observe("serve.step.migration_ms", mig_ms)
         jr = dest.eng.journal
         if jr is not None:
-            jr.record("migrate", req.id, j,
+            jr.record(event, req.id, j,
                       {"from": src.idx, "to": dest.idx,
                        "pages": n_pages, "async": True,
                        "n_generated": len(req.generated)})
         return True
+
+    # ------------- disaggregated handoff (ISSUE 20) -------------
+
+    def _handoff_ready(self, rep: Replica) -> int:
+        """Move a prefill replica's decoding slots to the decode side:
+        a slot whose chunk prefill finished is pure decode work from
+        here on, and every step it stays is a decode step competing
+        with this replica's prefill bursts. Each occupied slot rides
+        the export/import migration path (page-streamed async under
+        ``FLAGS_migrate_async``) to a decode-role replica — journaled
+        as ``handoff``, counted in ``fleet.{handoffs,handoff_pages}``.
+        A slot no decode replica can take just keeps decoding here:
+        the handoff is an optimization, never a correctness step.
+        Call from the replica's own stepping thread (or the
+        synchronous driver) so the export never races a decode."""
+        if self.disagg is None or rep.role != "prefill" or rep.dead \
+                or rep.crashed is not None:
+            return 0
+        eng = rep.eng
+        if not eng.can_migrate():
+            return 0
+        use_async = bool(_flag("migrate_async"))
+        moved = 0
+        for i in range(eng.max_batch):
+            req = eng._slots[i]
+            if req is None:
+                continue
+            if req.max_new_tokens - len(req.generated) < 2:
+                continue   # finishing anyway — not worth the copy
+            if use_async:
+                ok = self._migrate_slot_async(rep, i, event="handoff",
+                                              dest_role="decode")
+            else:
+                ok = self._migrate_slot(rep, i, event="handoff",
+                                        dest_role="decode")
+            moved += bool(ok)
+        return moved
 
     # ---------------- driving ----------------
 
@@ -928,7 +1140,10 @@ class FleetRouter:
         self.check_health()
         did = False
         for rep in self.replicas:
-            did = rep.step_once() or did
+            worked = rep.step_once()
+            did = worked or did
+            if worked and rep.role == "prefill":
+                self._handoff_ready(rep)
         return did
 
     def pending(self) -> int:

@@ -237,6 +237,21 @@ class ServingEngine(ContinuousBatchingEngine):
             self.prefix_cache = PrefixCache(
                 self._mgr, self.page_size, slo.prefix_cache_pages,
                 journal=self.journal)
+        # host-DRAM KV tier (ISSUE 20, FLAGS_kv_host_tier_bytes):
+        # evicted prefix pages and preempted-slot pages spill to host
+        # buffers behind the prefix cache's chain keys instead of being
+        # recomputed; None (flag 0, no prefix cache, or a TP-sharded
+        # pool) keeps every spill site one attribute test
+        self.host_tier = None
+        if self.prefix_cache is not None \
+                and int(_flag("kv_host_tier_bytes") or 0) > 0 \
+                and self.can_spill():
+            from .host_tier import HostKVTier
+
+            self.host_tier = HostKVTier(
+                self, int(_flag("kv_host_tier_bytes")),
+                journal=self.journal)
+            self.prefix_cache.host_tier = self.host_tier
         self._prefilling: Dict[int, _Prefill] = {}
         # async admission: submit() appends here from ANY thread; the
         # scheduler thread drains into the priority-ordered waiting
@@ -1149,7 +1164,27 @@ class ServingEngine(ContinuousBatchingEngine):
                    self._pages_per_seq)
         return need - len(shared)
 
+    def _restore_prefix(self, req) -> int:
+        """Host-tier promotion ahead of admission (ISSUE 20): pull the
+        spilled continuation of this request's chain back into free
+        pool pages, so the ``match`` below sees it as an ordinary
+        prefix hit and the suffix prefill shrinks by the restored
+        coverage. Reserves the first chunk's worth of pages so a
+        restore can never starve the very admission it serves."""
+        ht = self.host_tier
+        if ht is None or not len(ht):
+            return 0
+        toks = self._admit_tokens(req)
+        reserve = self._mgr.pages_needed(
+            self._chunk_size(len(toks))) + 1
+        restored = self.prefix_cache.restore_chain(toks,
+                                                   reserve=reserve)
+        if restored:
+            _stats.inc("serving.prefix_restored_pages", restored)
+        return restored
+
     def _can_admit(self, req) -> bool:
+        self._restore_prefix(req)
         need = self._first_chunk_pages(req)
         # pool pressure: evict cold cached prefixes page by page (an
         # evicted entry only frees its page if no live sequence still
@@ -1570,6 +1605,7 @@ class ServingEngine(ContinuousBatchingEngine):
         req = self._slots[j]
         req._resume_tokens = np.concatenate(
             [req.prompt, np.asarray(req.generated, np.int32)])
+        self._park_preempted_kv(j, req._resume_tokens)
         self._release(j)   # the override closes the page integral
         _stats.inc("serving.preemptions")
         req.n_preempts = getattr(req, "n_preempts", 0) + 1
@@ -1584,6 +1620,32 @@ class ServingEngine(ContinuousBatchingEngine):
         self._sort_waiting()
         if jr is not None:
             jr.record("queued", req.id, -1, None)
+
+    def _park_preempted_kv(self, j: int, resume_toks) -> None:
+        """Keep a preempted slot's COMPLETE KV pages reachable instead
+        of dropping them (ISSUE 20): register them in the prefix cache
+        under the resume stream's content chain before the release.
+        Under continued pressure they are exactly the coldest entries
+        ``_evict_for`` evicts next — which, with a host tier, demotes
+        them to host DRAM — so re-admission restores pages and
+        re-prefills only the tail, and full recompute becomes the last
+        resort. Only positions strictly below ``lens-1`` are certainly
+        written between steps, hence the (lens-1)//page_size bound."""
+        if self.prefix_cache is None:
+            return
+        n_full = min((int(self._lens[j]) - 1) // self.page_size,
+                     len(resume_toks) // self.page_size)
+        if n_full <= 0:
+            return
+        pages = self._mgr._owned.get(("slot", j), [])[:n_full]
+        if not pages:
+            return
+        try:
+            self.prefix_cache.insert(resume_toks, pages)
+        except Exception:
+            # registration is an optimization; an injected
+            # prefix.insert fault must never break the preemption
+            _stats.inc("serving.prefix_insert_errors")
 
     def _grow_decode_slot(self, i: int, n_pages: int) -> bool:
         """Serving override of the decode-time grow: under pool

@@ -1,0 +1,329 @@
+"""Disaggregated prefill/decode fleet + prefix directory (ISSUE 20).
+
+Tier-1 acceptance pins:
+- role split changes WHERE work runs, never WHAT comes out: the same
+  prompts through a symmetric fleet and a ``disagg='1:1'`` fleet
+  produce identical greedy tokens, every request handed off exactly
+  once over the migration path, and the decode replica runs zero
+  prefill actions;
+- the fleet prefix DIRECTORY generalizes chain→replica affinity to
+  chain→(replica, tier): a spill flips the entry to "host", a restore
+  back to "hbm", a host-LRU drop forgets it, and admission consults
+  the restore-vs-re-prefill cost model (``FLAGS_kv_restore_gbps`` /
+  ``FLAGS_disagg_prefill_tflops``) before routing to a host holder.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.inference import FusedCausalLM
+from paddle_tpu.profiler import stats
+from paddle_tpu.serving import FleetRouter, ServingEngine, SLOConfig
+from paddle_tpu.serving.router import _parse_disagg
+
+pytestmark = pytest.mark.chaos
+
+
+def _model(seed=7, max_position=256):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=64, embed_dim=32, num_heads=4,
+                         dim_feedforward=64, num_layers=2,
+                         max_position=max_position)
+
+
+def _engine(seed=7, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_length", 96)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("slo", SLOConfig(prefill_chunk=8))
+    return ServingEngine(_model(seed), **kw)
+
+
+def _router(n=2, seed=7, **kw):
+    return FleetRouter(engine_factory=lambda i: _engine(seed),
+                       n_replicas=n, **kw)
+
+
+@pytest.fixture
+def host_tier_flag():
+    set_flags({"kv_host_tier_bytes": 1 << 22})
+    yield
+    set_flags({"kv_host_tier_bytes": 0})
+
+
+class TestParseDisagg:
+    def test_specs(self):
+        assert _parse_disagg("", 4) is None
+        assert _parse_disagg(None, 4) is None
+        assert _parse_disagg(False, 4) is None
+        assert _parse_disagg("auto", 4) == (2, 2)
+        assert _parse_disagg(True, 5) == (2, 3)
+        assert _parse_disagg("auto", 1) is None
+        assert _parse_disagg("1:3", 4) == (1, 3)
+        assert _parse_disagg("3:1", 4) == (3, 1)
+
+    def test_invalid_specs(self):
+        for bad in ("0:2", "2:0", "1:1", "nonsense"):
+            with pytest.raises(ValueError):
+                _parse_disagg(bad, 3)
+
+
+class TestDisaggHandoff:
+    def _prompts(self, seed=5):
+        rng = np.random.RandomState(seed)
+        return [rng.randint(0, 64, (L,)).astype(np.int32)
+                for L in (12, 21, 9, 16)]
+
+    def _drive(self, router, prompts, n=8):
+        rids = [router.submit(p, max_new_tokens=n) for p in prompts]
+        done = {r.id: r for r in router.run()}
+        return [list(done[r].generated) for r in rids]
+
+    def test_token_parity_and_handoff_census(self):
+        """Symmetric vs 1P:1D on identical prompts: same tokens, one
+        handoff per request, journal == counter, and role discipline
+        (the decode replica never prefilled)."""
+        prompts = self._prompts()
+        ref = self._drive(_router(), prompts)
+        stats.reset()
+        router = _router(disagg="1:1")
+        assert [r.role for r in router.replicas] == \
+            ["prefill", "decode"]
+        assert self._drive(router, prompts) == ref
+        handoffs = int(stats.counter("fleet.handoffs").value)
+        assert handoffs == len(prompts)
+        assert int(stats.counter("fleet.handoff_pages").value) > 0
+        # plain migrations stayed zero — handoffs are accounted apart
+        assert int(stats.counter("fleet.migrations").value) == 0
+        jr = router.replicas[1].eng.journal
+        if jr is not None:
+            evs = [e for e in jr.events() if e["ev"] == "handoff"]
+            assert len(evs) == handoffs
+            assert all(e["from"] == 0 and e["to"] == 1 for e in evs)
+        assert "prefill" not in set(router.replicas[1].eng.action_log)
+
+    def test_async_handoff_parity(self):
+        """The same census with FLAGS_migrate_async on — handoffs ride
+        the PR 19 streamed path (ticketed import, tail catch-up) and
+        must stay token-exact."""
+        prompts = self._prompts(seed=9)
+        ref = self._drive(_router(), prompts)
+        stats.reset()
+        set_flags({"migrate_async": True})
+        try:
+            router = _router(disagg="1:1")
+            assert self._drive(router, prompts) == ref
+        finally:
+            set_flags({"migrate_async": False})
+        # unlike the sync path, a streamed handoff can lose the race
+        # with its own decode (the request finishes before the pages
+        # do and the import aborts) — so >=1, not one-per-request
+        assert int(stats.counter("fleet.handoffs").value) >= 1
+        assert int(stats.counter("fleet.async_migrations").value) > 0
+
+    def test_roles_are_preference_not_availability(self):
+        """With every prefill replica excluded (dead), dispatch falls
+        back to decode-role replicas — the split degrades, it never
+        deadlocks."""
+        router = _router(disagg="1:1")
+        router.replicas[0].state = "dead"   # .dead property reads it
+        prompts = self._prompts(seed=3)[:2]
+        outs = self._drive(router, prompts)
+        assert all(len(o) == 8 for o in outs)
+
+    def test_flag_driven_roles(self):
+        """FLAGS_disagg wires the split without the constructor arg."""
+        set_flags({"disagg": "auto"})
+        try:
+            router = _router(n=3)
+        finally:
+            set_flags({"disagg": ""})
+        assert router.disagg == (1, 2)
+        assert [r.role for r in router.replicas] == \
+            ["prefill", "decode", "decode"]
+        # role burst weights stamped onto the scheduler SLO config
+        assert router.replicas[0].eng.slo.prefill_burst >= 8
+        assert router.replicas[1].eng.slo.decode_burst >= 8
+
+
+class TestPrefixDirectory:
+    def test_hbm_hit_routes_to_holder(self):
+        """Second request with a cached prefix routes to the replica
+        whose pool holds the chain — the directory hit path."""
+        rng = np.random.RandomState(4)
+        prefix = rng.randint(0, 64, (16,)).astype(np.int32)
+        router = _router()
+        stats.reset()
+        r1 = router.submit(np.concatenate(
+            [prefix, rng.randint(0, 64, (6,))]), max_new_tokens=4)
+        list(router.run())
+        owner = next(iter(router._directory.values()))[0]
+        assert all(v == (owner, "hbm")
+                   for v in router._directory.values())
+        router.submit(np.concatenate(
+            [prefix, rng.randint(0, 64, (9,))]), max_new_tokens=4)
+        list(router.run())
+        assert int(stats.counter("fleet.directory_hits").value) >= 1
+        assert router._affinity  # legacy owner-only view still reads
+
+    def test_spill_flips_tier_and_restore_flips_back(
+            self, host_tier_flag):
+        """The tentpole directory pin: evicting a registered chain to
+        the host tier flips its entries to (owner, "host"); restoring
+        flips them back to "hbm"; a host-LRU drop forgets them."""
+        rng = np.random.RandomState(8)
+        prefix = rng.randint(0, 64, (16,)).astype(np.int32)
+        prompt = np.concatenate([prefix, rng.randint(0, 64, (5,))])
+        router = _router()
+        eng0 = router.replicas[0].eng
+        assert eng0.host_tier is not None
+        router.submit(prompt, max_new_tokens=4)
+        list(router.run())
+        keys = router._affinity_chain(prompt)
+        assert keys and all(
+            router._directory.get(k, (None, None))[1] == "hbm"
+            for k in keys)
+        owner = router._directory[keys[0]][0]
+        eng = router.replicas[owner].eng
+        eng.prefix_cache.evict(len(eng.prefix_cache))
+        assert all(router._directory[k] == (owner, "host")
+                   for k in keys)
+        restored = eng.prefix_cache.restore_chain(prompt, reserve=0)
+        assert restored > 0
+        for k in keys[:restored]:
+            assert router._directory[k] == (owner, "hbm")
+        # drop the rest from the host tier -> directory forgets them
+        eng.host_tier.clear()
+        for k in keys[restored:]:
+            assert k not in router._directory
+
+    def test_pull_worth_cost_model_flags(self):
+        """_pull_worth flips with the flag-priced arms: a slow
+        re-prefill (tiny TFLOPs) makes the restore win; the default
+        real-hardware pricing makes re-prefilling this toy model
+        free by comparison."""
+        router = _router()
+        assert not router._pull_worth(4)   # defaults: prefill wins
+        set_flags({"disagg_prefill_tflops": 1e-6})
+        try:
+            assert router._pull_worth(4)
+        finally:
+            set_flags({"disagg_prefill_tflops": 100.0})
+        set_flags({"kv_restore_gbps": 1e-12})
+        try:
+            assert not router._pull_worth(4)  # bandwidth-starved
+        finally:
+            set_flags({"kv_restore_gbps": 10.0})
+
+    def test_directory_pull_end_to_end(self, host_tier_flag):
+        """A host-resident chain + a cost model that prices restore
+        cheaper routes the request to the holder, whose admission
+        PULLS the chain back (fleet.directory_pulls + fleet.restores),
+        with tokens identical to a cold fleet."""
+        rng = np.random.RandomState(11)
+        prefix = rng.randint(0, 64, (16,)).astype(np.int32)
+        p1 = np.concatenate([prefix, rng.randint(0, 64, (6,))])
+        p2 = np.concatenate([prefix, rng.randint(0, 64, (9,))])
+        set_flags({"kv_host_tier_bytes": 0})
+        ref_router = _router()
+        ra = ref_router.submit(p1, max_new_tokens=4)
+        rb = ref_router.submit(p2, max_new_tokens=4)
+        ref_done = {r.id: r for r in ref_router.run()}
+        set_flags({"kv_host_tier_bytes": 1 << 22})
+        stats.reset()
+        router = _router()
+        r1 = router.submit(p1, max_new_tokens=4)
+        done1 = {r.id: r for r in router.run()}
+        owner = next(iter(router._directory.values()))[0]
+        eng = router.replicas[owner].eng
+        eng.prefix_cache.evict(len(eng.prefix_cache))  # -> host tier
+        set_flags({"disagg_prefill_tflops": 1e-6})     # restore wins
+        try:
+            r2 = router.submit(p2, max_new_tokens=4)
+            done2 = {r.id: r for r in router.run()}
+        finally:
+            set_flags({"disagg_prefill_tflops": 100.0})
+        assert list(done1[r1].generated) == \
+            list(ref_done[ra].generated)
+        assert list(done2[r2].generated) == \
+            list(ref_done[rb].generated)
+        assert int(stats.counter("fleet.directory_pulls").value) >= 1
+        assert int(stats.counter("fleet.restores").value) >= 1
+
+    def test_miss_counter_on_cold_and_priced_out(self, host_tier_flag):
+        """Cold chains and host chains the cost model prices out both
+        count as directory misses (the re-prefill arm)."""
+        rng = np.random.RandomState(14)
+        prefix = rng.randint(0, 64, (16,)).astype(np.int32)
+        prompt = np.concatenate([prefix, rng.randint(0, 64, (5,))])
+        stats.reset()
+        router = _router()
+        router.submit(prompt, max_new_tokens=4)
+        list(router.run())
+        assert int(stats.counter(
+            "fleet.directory_misses").value) >= 1  # cold chain
+        owner = next(iter(router._directory.values()))[0]
+        eng = router.replicas[owner].eng
+        eng.prefix_cache.evict(len(eng.prefix_cache))
+        before = int(stats.counter("fleet.directory_misses").value)
+        # defaults price the toy re-prefill cheaper than any restore
+        router.submit(np.concatenate(
+            [prefix, rng.randint(0, 64, (7,))]), max_new_tokens=4)
+        list(router.run())
+        assert int(stats.counter(
+            "fleet.directory_misses").value) > before
+
+
+class TestObservability:
+    def test_journal_lifecycle_events(self):
+        from paddle_tpu.serving.journal import LIFECYCLE_EVENTS
+
+        for ev in ("handoff", "spill", "restore"):
+            assert ev in LIFECYCLE_EVENTS
+
+    def test_serve_top_counts_and_fleet_tier_view(self, host_tier_flag):
+        """serve_top folds handoff/spill/restore events and the fleet
+        renderer shows the per-replica tier occupancy + directory hit
+        rate."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        from tools import serve_top
+
+        events = [
+            {"seq": 1, "ts": 0.0, "ev": "submit", "rid": 1,
+             "prompt_len": 16},
+            {"seq": 2, "ts": 0.1, "ev": "handoff", "rid": 1,
+             "slot": 0, "from": 0, "to": 1, "pages": 4},
+            {"seq": 3, "ts": 0.2, "ev": "spill", "rid": -1,
+             "pages": 3, "bytes": 6144},
+            {"seq": 4, "ts": 0.3, "ev": "restore", "rid": -1,
+             "pages": 2, "bytes": 4096},
+        ]
+        s = serve_top.summarize(events)
+        assert s["handoffs"] == 1
+        assert s["spilled_pages"] == 3
+        assert s["restored_pages"] == 2
+        assert s["requests"][1]["phase"] == "decode"
+        text = serve_top.render(s)
+        assert "handoffs_in 1" in text
+        assert "spilled_pages 3" in text
+        stats.reset()
+        router = _router(disagg="1:1")
+        rng = np.random.RandomState(2)
+        router.submit(rng.randint(0, 64, (12,)).astype(np.int32),
+                      max_new_tokens=4)
+        list(router.run())
+        out = serve_top.render_fleet(router)
+        assert "role prefill" in out and "role decode" in out
+        assert "directory:" in out and "host" in out
+
+    def test_convention_prefixes_cover_tier(self):
+        from paddle_tpu.profiler.stats import CONVENTION_PREFIXES
+
+        assert "tier." in CONVENTION_PREFIXES
+        assert "fleet." in CONVENTION_PREFIXES

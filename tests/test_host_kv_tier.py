@@ -1,0 +1,294 @@
+"""Host-DRAM KV tier (ISSUE 20): spill/restore byte parity and exact
+page accounting.
+
+Tier-1 acceptance pins:
+- spill -> restore is BYTE-EXACT: an evicted prefix chain pulled back
+  from host buffers decodes greedy tokens identical to an engine that
+  never felt pool pressure, for both the bf16 and the int8 cache-KV
+  pools (the int8 path round-trips quantized rows + f32 scale-plane
+  columns bit-for-bit);
+- accounting is conserved: after any mix of spills, host-LRU
+  evictions and restores, ``fleet.spills - fleet.restores -
+  fleet.host_evictions == len(tier)`` and the pool's free-page count
+  returns exactly to its starting point;
+- with NO tier configured, the eviction decision point degrades to
+  the plain release it always was (zero spill counters, pages free).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.inference import FusedCausalLM
+from paddle_tpu.profiler import stats
+from paddle_tpu.serving import HostKVTier, Request, ServingEngine, SLOConfig
+
+
+def _model(seed=7, max_position=256, vocab=64):
+    paddle.seed(seed)
+    return FusedCausalLM(vocab_size=vocab, embed_dim=32, num_heads=4,
+                         dim_feedforward=64, num_layers=2,
+                         max_position=max_position)
+
+
+def _engine(seed=7, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_length", 96)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("slo", SLOConfig(prefill_chunk=8))
+    return ServingEngine(_model(seed), **kw)
+
+
+@pytest.fixture
+def host_tier_flag():
+    set_flags({"kv_host_tier_bytes": 1 << 22})
+    yield
+    set_flags({"kv_host_tier_bytes": 0})
+
+
+def _run_one(eng, prompt, n=8):
+    eng.submit_request(Request(np.array(prompt, np.int32),
+                               max_new_tokens=n))
+    while eng.has_work:
+        eng.step()
+    r = eng.finished[-1]
+    return list(r.generated)
+
+
+class TestSpillRestoreParity:
+    def test_bf16_spill_restore_token_parity(self, host_tier_flag):
+        """The headline byte-parity pin: run, evict the ENTIRE prefix
+        cache to the host tier, re-run the same prompt — tokens must
+        match an engine that never spilled, and the re-run must have
+        RESTORED (not re-prefilled) the chain."""
+        prompt = np.arange(24, dtype=np.int32) % 64
+        set_flags({"kv_host_tier_bytes": 0})
+        ref = _run_one(_engine(), prompt)
+        set_flags({"kv_host_tier_bytes": 1 << 22})
+        stats.reset()
+        eng = _engine()
+        assert eng.host_tier is not None
+        assert list(_run_one(eng, prompt)) == ref
+        pc = eng.prefix_cache
+        n_cached = len(pc)
+        assert pc.evict(n_cached) == n_cached
+        assert len(eng.host_tier) == n_cached
+        assert int(stats.counter("fleet.spills").value) == n_cached
+        eng.finished.clear()
+        assert _run_one(eng, prompt) == ref
+        # match() caps reuse at (len-1)//ps pages, so exactly that
+        # many restored; the final chain page stays host-resident
+        expect = (len(prompt) - 1) // eng.page_size
+        assert int(stats.counter("fleet.restores").value) == expect
+        assert int(stats.counter(
+            "serving.prefix_restored_pages").value) == expect
+
+    def test_spill_blob_restores_bit_exact(self, host_tier_flag):
+        """Raw pool bytes through the tier: export the spilled pages'
+        rows before eviction, restore, export again — identical."""
+        prompt = np.arange(20, dtype=np.int32) % 64
+        eng = _engine()
+        _run_one(eng, prompt)
+        pc = eng.prefix_cache
+        pages_before = dict(pc._entries)  # key -> page
+        blobs = {k: eng.export_kv_pages([p])
+                 for k, p in pages_before.items()}
+        pc.evict(len(pc))
+        restored = pc.restore_chain(prompt, reserve=0)
+        assert restored == (len(prompt) - 1) // eng.page_size
+        for k, page in pc._entries.items():
+            after = eng.export_kv_pages([page])
+            np.testing.assert_array_equal(blobs[k]["k"], after["k"])
+            np.testing.assert_array_equal(blobs[k]["v"], after["v"])
+
+    def test_seeded_deterministic_parity(self, host_tier_flag):
+        """Two identically seeded engines, one driven through a full
+        spill/restore cycle mid-stream — same greedy tokens (the
+        serving path is greedy, so seeded-determinism == the pressure
+        cycle being invisible to the decode)."""
+        rng = np.random.RandomState(13)
+        prompts = [rng.randint(0, 64, (L,)).astype(np.int32)
+                   for L in (18, 26)]
+        ref_eng = _engine(seed=21)
+        refs = [_run_one(ref_eng, p, n=6) for p in prompts]
+        eng = _engine(seed=21)
+        outs = []
+        for p in prompts:
+            outs.append(_run_one(eng, p, n=6))
+            pc = eng.prefix_cache
+            pc.evict(len(pc))      # spill everything between requests
+            pc.restore_chain(p, reserve=0)
+        assert outs == refs
+
+    def test_int8_pool_spill_restore_parity(self, host_tier_flag):
+        """int8 cache-KV spills quantized rows + f32 scale columns;
+        the round-trip must be bit-exact and roughly HALVE the spilled
+        bytes vs the bf16 pool (the int8-aware tier pin)."""
+        prompt = (np.arange(24, dtype=np.int32) * 3) % 64
+        set_flags({"kv_host_tier_bytes": 0})
+        ref = _run_one(_engine(kv_dtype="int8"), prompt)
+        set_flags({"kv_host_tier_bytes": 1 << 22})
+        stats.reset()
+        eng = _engine(kv_dtype="int8")
+        assert eng.host_tier is not None and eng.can_spill()
+        assert _run_one(eng, prompt) == ref
+        pc = eng.prefix_cache
+        pages_before = dict(pc._entries)
+        blobs = {k: eng.export_kv_pages([p])
+                 for k, p in pages_before.items()}
+        pc.evict(len(pc))
+        int8_bytes = int(stats.counter("fleet.spill_bytes").value)
+        assert pc.restore_chain(prompt, reserve=0) > 0
+        for k, page in pc._entries.items():
+            after = eng.export_kv_pages([page])
+            assert after["int8"]
+            for part in ("k", "v", "k_scale", "v_scale"):
+                np.testing.assert_array_equal(blobs[k][part],
+                                              after[part])
+        eng.finished.clear()
+        assert _run_one(eng, prompt) == ref
+        # vs bf16: same workload spills ~2x the bytes
+        stats.reset()
+        bf = _engine()
+        _run_one(bf, prompt)
+        bf.prefix_cache.evict(len(bf.prefix_cache))
+        bf16_bytes = int(stats.counter("fleet.spill_bytes").value)
+        assert int8_bytes < 0.75 * bf16_bytes
+
+    def test_preempt_spill_restore_cycle(self, host_tier_flag):
+        """Pool pressure end-to-end: concurrent decoders overflow a
+        tiny pool (preempted slots park their full pages in the prefix
+        cache; evictions spill), and every stream still matches the
+        unpressured reference."""
+        rng = np.random.RandomState(29)
+        prompts = [rng.randint(0, 64, (16,)).astype(np.int32)
+                   for _ in range(3)]
+        set_flags({"kv_host_tier_bytes": 0})
+        ref_eng = _engine(max_batch=3, max_length=64)
+        for p in prompts:
+            ref_eng.submit_request(Request(p, max_new_tokens=24))
+        refs = [list(r.generated)
+                for r in sorted(ref_eng.run(), key=lambda r: r.id)]
+        set_flags({"kv_host_tier_bytes": 1 << 22})
+        stats.reset()
+        eng = _engine(max_batch=3, max_length=64, num_pages=15)
+        for p in prompts:
+            eng.submit_request(Request(p, max_new_tokens=24))
+        done = sorted(eng.run(), key=lambda r: r.id)
+        assert [list(r.generated) for r in done] == refs
+        assert stats.counter("serving.preemptions").value > 0
+
+
+class TestAccounting:
+    def test_conservation_after_mixed_traffic(self, host_tier_flag):
+        """spills - restores - host_evictions == live entries, pool
+        free pages conserved, bytes_used == sum of entry blobs."""
+        prompt = np.arange(28, dtype=np.int32) % 64
+        stats.reset()
+        eng = _engine()
+        free0 = eng._mgr.free_pages
+        _run_one(eng, prompt)
+        eng.finished.clear()
+        pc, ht = eng.prefix_cache, eng.host_tier
+        pc.evict(len(pc))                       # all spill
+        # tier.* occupancy gauges published (naming-lint covered
+        # prefix; summed over every live tier in the process)
+        assert stats.gauge("tier.host_pages").value >= len(ht)
+        pc.restore_chain(prompt, reserve=0)     # most restore
+        pc.evict(2)                             # spill again (dedupe)
+        ht.drop(1)                              # host LRU eviction
+        spills = int(stats.counter("fleet.spills").value)
+        restores = int(stats.counter("fleet.restores").value)
+        hevict = int(stats.counter("fleet.host_evictions").value)
+        assert spills - restores - hevict == len(ht)
+        assert ht.bytes_used == sum(
+            e["_bytes"] for e in ht._entries.values())
+        assert int(stats.counter("fleet.spill_bytes").value) >= \
+            int(stats.counter("fleet.restore_bytes").value)
+        # release every cache-held page: the pool must return exactly
+        # to its starting free count (no leaked restore references)
+        pc.evict(len(pc))
+        assert eng._mgr.free_pages == free0
+        ht.clear()
+        assert ht.bytes_used == 0 and len(ht) == 0
+
+    def test_capacity_lru_eviction(self, host_tier_flag):
+        """A tier sized for two pages LRU-drops the oldest entry on
+        the third spill, firing on_drop for the directory."""
+        prompt = np.arange(24, dtype=np.int32) % 64
+        eng = _engine()
+        _run_one(eng, prompt)
+        ht = eng.host_tier
+        ht.capacity_bytes = 2 * ht.page_bytes + 2  # blobs ~ page size
+        dropped = []
+        ht.on_drop = dropped.append
+        stats.reset()
+        pc = eng.prefix_cache
+        n = len(pc)
+        pc.evict(n)
+        assert len(ht) == 2
+        hevict = int(stats.counter("fleet.host_evictions").value)
+        assert hevict == int(stats.counter("fleet.spills").value) - 2
+        assert len(dropped) == hevict >= 1
+
+    def test_no_tier_eviction_unchanged(self):
+        """Satellite 5 regression guard: with the tier disabled the
+        decision point is the old release — pages free, no counters."""
+        set_flags({"kv_host_tier_bytes": 0})
+        prompt = np.arange(24, dtype=np.int32) % 64
+        stats.reset()
+        eng = _engine()
+        assert eng.host_tier is None
+        _run_one(eng, prompt)
+        pc = eng.prefix_cache
+        free_before = eng._mgr.free_pages
+        n = len(pc)
+        assert pc.evict(n) == n
+        assert eng._mgr.free_pages == free_before + n
+        assert int(stats.counter("fleet.spills").value) == 0
+
+    def test_page_hbm_bytes_geometry(self):
+        """page_hbm_bytes is the cost model's unit: K+V rows for one
+        logical page across layers (+ scale planes in int8 mode)."""
+        eng = _engine()
+        m = eng._mgr
+        import jax.numpy as jnp
+
+        elems = m.num_layers * m._pool_heads * m.page_size * m.head_dim
+        assert m.page_hbm_bytes() == \
+            2 * elems * jnp.dtype(m.dtype).itemsize
+        eng8 = _engine(kv_dtype="int8")
+        m8 = eng8._mgr
+        elems8 = (m8.num_layers * m8._pool_heads * m8.page_size
+                  * m8.head_dim)
+        scales = m8._pool_heads * m8.num_layers * m8.page_size * 4
+        assert m8.page_hbm_bytes() == 2 * (elems8 + scales)
+        assert m8.page_hbm_bytes() < m.page_hbm_bytes()
+
+
+class TestTierUnit:
+    def test_restore_run_missing_key_is_none(self, host_tier_flag):
+        eng = _engine()
+        ht = eng.host_tier
+        assert ht.restore_run([b"nope"]) is None
+        assert ht.restore_run([]) == []
+
+    def test_direct_tier_roundtrip(self, host_tier_flag):
+        """HostKVTier against a live engine pool without the prefix
+        cache in the loop: spill two pages, restore them into fresh
+        pages, bytes identical."""
+        prompt = np.arange(16, dtype=np.int32) % 64
+        eng = _engine()
+        _run_one(eng, prompt)
+        pc = eng.prefix_cache
+        (k1, p1), (k2, p2) = list(pc._entries.items())[:2]
+        ht = HostKVTier(eng, capacity_bytes=1 << 20)
+        before = eng.export_kv_pages([p1, p2])
+        assert ht.spill_pages([k1, k2], [p1, p2]) == 2
+        pages = ht.restore_run([k1, k2])
+        assert pages is not None and len(pages) == 2
+        after = eng.export_kv_pages(pages)
+        np.testing.assert_array_equal(before["k"], after["k"])
+        np.testing.assert_array_equal(before["v"], after["v"])
+        eng._mgr.release_pages(pages)

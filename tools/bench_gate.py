@@ -209,6 +209,19 @@ DEFAULT_METRICS: Dict[str, str] = {
     "fleet_async_migration_decode_tokens": "down",
     "fleet_async_migration_stall_ms": "up",
     "fleet_async_migration_lost": "up",
+    # disaggregated prefill/decode fleet + tiered KV (ISSUE 20): the
+    # role-split fleet's TTFT tail regresses UP and its goodput /
+    # throughput DOWN like every serve sibling; lost requests UP with
+    # NO noise floor (a handoff that drops a request is a broken
+    # re-home); handoffs regress DOWN — the rung's workload is built
+    # to stream them, so a run with fewer is the prefill fleet
+    # stalling its hand-offs, not jitter
+    "serve_disagg_p50_ttft_ms": "up",
+    "serve_disagg_p99_ttft_ms": "up",
+    "serve_disagg_tokens_per_sec": "down",
+    "serve_disagg_goodput": "down",
+    "serve_disagg_lost": "up",
+    "serve_disagg_handoffs": "down",
 }
 
 #: absolute-change floors so tiny counts/latencies don't trip the
@@ -272,7 +285,8 @@ def _regressed(name: str, direction: str, prev: float, cur: float,
                tol: float) -> bool:
     if name.startswith(("lint", "alert", "usage")) \
             or name in ("moe.dropped_tokens",
-                        "fleet_async_migration_lost"):
+                        "fleet_async_migration_lost",
+                        "serve_disagg_lost"):
         # lint findings, alert fires, unattributed device time,
         # no-drop-mode dropped tokens, and requests lost across an
         # async migration must only go down between rounds — ANY

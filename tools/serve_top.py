@@ -127,8 +127,8 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
     counts = {"preempt": 0, "requeue": 0, "stall": 0, "error": 0,
               "deadline_exceeded": 0, "shed": 0, "retry": 0,
               "watchdog": 0, "fault": 0, "failover": 0, "migrate": 0,
-              "drain": 0}
-    evicted_pages = 0
+              "drain": 0, "handoff": 0}
+    evicted_pages = spilled_pages = restored_pages = 0
     spec_rounds = spec_drafted = spec_accepted = 0
     alerts_fired = alerts_resolved = 0
     alerts_active: set = set()
@@ -137,6 +137,12 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
         rid = int(e.get("rid", -1))
         if ev == "evict_trigger":
             evicted_pages += int(e.get("pages", 0))
+        if ev == "spill":
+            # ISSUE 20: KV pages demoted to the host-DRAM tier
+            # (rid=-1 — spills belong to pool pressure, not a request)
+            spilled_pages += int(e.get("pages", 0))
+        if ev == "restore":
+            restored_pages += int(e.get("pages", 0))
         if ev == "spec_verify":
             spec_rounds += 1
             spec_drafted += int(e.get("k", 0))
@@ -188,6 +194,10 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
             r["phase"] = "waiting"
         elif ev == "migrate":
             # KV pages handed over mid-decode — no prefill replay
+            r["phase"] = "decode"
+        elif ev == "handoff":
+            # ISSUE 20 disaggregation: prefilled KV landed on a
+            # decode-role replica — decoding continues here
             r["phase"] = "decode"
         elif ev == "finish":
             r["phase"] = "finished"
@@ -247,7 +257,10 @@ def summarize(events: List[dict], ttft_target: Optional[float] = None,
         "failovers": counts["failover"],
         "migrations": counts["migrate"],
         "drains": counts["drain"],
+        "handoffs": counts["handoff"],
         "evicted_pages": evicted_pages,
+        "spilled_pages": spilled_pages,
+        "restored_pages": restored_pages,
         "spec_rounds": spec_rounds,
         "spec_drafted": spec_drafted,
         "spec_accepted": spec_accepted,
@@ -329,12 +342,20 @@ def render(summary: dict, top: int = 5,
         f"deadline_exceeded {s.get('deadline_exceeded', 0)}  "
         f"shed {s.get('shed', 0)}",
     ]
-    if s.get("failovers") or s.get("migrations") or s.get("drains"):
+    if s.get("failovers") or s.get("migrations") or s.get("drains") \
+            or s.get("handoffs"):
         # fleet tier (ISSUE 14): requests that crossed replicas
         lines.append(
             f"fleet: failovers_in {s.get('failovers', 0)}  "
             f"migrations_in {s.get('migrations', 0)}  "
+            f"handoffs_in {s.get('handoffs', 0)}  "
             f"drains {s.get('drains', 0)}")
+    if s.get("spilled_pages") or s.get("restored_pages"):
+        # tiered KV (ISSUE 20): pool pressure demoted to host DRAM
+        # instead of evict-and-recompute
+        lines.append(
+            f"kv tier: spilled_pages {s.get('spilled_pages', 0)}  "
+            f"restored_pages {s.get('restored_pages', 0)}")
     if s.get("adaptered_requests"):
         # batched multi-LoRA (ISSUE 18): how many distinct adapters
         # the journal's traffic mixed, and over how many requests
@@ -479,6 +500,34 @@ def render_fleet(router, top: int = 5) -> str:
         f"hedges {int(c('fleet.hedges').value)}  "
         f"shed {int(c('fleet.shed').value)}  pending "
         f"{router.pending()}")
+    if getattr(router, "disagg", None) is not None or any(
+            getattr(r.eng, "host_tier", None) is not None
+            for r in router.replicas):
+        # ISSUE 20: the tiered-KV / disaggregation view — per-replica
+        # role and HBM-vs-host page residency, then the directory's
+        # routing outcome mix (hit = HBM holder, pull = host restore
+        # beat re-prefill, miss = re-prefill anyway)
+        for rep in router.replicas:
+            eng, mgr = rep.eng, rep.eng._mgr
+            ht = getattr(eng, "host_tier", None)
+            host = f"{len(ht)} pages / {ht.bytes_used}B" \
+                if ht is not None else "-"
+            lines.append(
+                f"  r{rep.idx:<3} role {rep.role or 'mixed':<7} "
+                f"hbm {mgr.num_pages - mgr.free_pages:>4}"
+                f"/{mgr.num_pages:<4} pages  host {host}")
+        hits = int(c("fleet.directory_hits").value)
+        pulls = int(c("fleet.directory_pulls").value)
+        misses = int(c("fleet.directory_misses").value)
+        probes = hits + pulls + misses
+        rate = f"{hits / probes:.3f}" if probes else "-"
+        lines.append(
+            f"  directory: hits {hits}  pulls {pulls}  "
+            f"misses {misses}  hit_rate {rate}  "
+            f"handoffs {int(c('fleet.handoffs').value)} "
+            f"({int(c('fleet.handoff_pages').value)} pages)  "
+            f"spills {int(c('fleet.spills').value)}  restores "
+            f"{int(c('fleet.restores').value)}")
     if getattr(router, "usage", None) is not None or any(
             getattr(r.eng, "usage", None) is not None
             for r in router.replicas):
